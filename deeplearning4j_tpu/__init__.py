@@ -1,0 +1,23 @@
+"""tpu-dl4j: a TPU-native deep-learning framework with the capability surface of
+Deeplearning4j 0.7.x (reference surveyed in SURVEY.md).
+
+Architecture is idiomatic JAX/XLA — functional layers over parameter pytrees,
+jit-compiled train steps, `jax.sharding.Mesh` data/tensor parallelism over ICI —
+not a port of the reference's JVM design.
+
+Top-level convenience re-exports mirror the reference's most-used entry points
+(reference: deeplearning4j-nn/src/main/java/org/deeplearning4j/nn).
+"""
+
+__version__ = "0.1.0"
+
+from deeplearning4j_tpu.nn.conf.builders import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.multilayer import MultiLayerConfiguration
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+__all__ = [
+    "NeuralNetConfiguration",
+    "MultiLayerConfiguration",
+    "MultiLayerNetwork",
+    "__version__",
+]

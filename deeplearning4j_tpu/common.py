@@ -1,0 +1,41 @@
+"""Global dtype / platform policy.
+
+The reference selects a tensor backend via Maven profiles (reference pom.xml:123-150,
+nd4j-native vs nd4j-cuda). Here the analogous knob is the JAX platform plus a dtype
+policy: parameters are kept in ``param_dtype`` (float32 by default for exact updater
+semantics) while matmul/conv compute may run in ``compute_dtype`` (bfloat16 on the MXU).
+"""
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass
+class DtypePolicy:
+    param_dtype: jnp.dtype = jnp.float32
+    compute_dtype: jnp.dtype = jnp.float32
+    output_dtype: jnp.dtype = jnp.float32
+
+
+_POLICY = DtypePolicy()
+
+
+def get_policy() -> DtypePolicy:
+    return _POLICY
+
+
+def set_policy(param_dtype=None, compute_dtype=None, output_dtype=None) -> DtypePolicy:
+    global _POLICY
+    _POLICY = DtypePolicy(
+        param_dtype=param_dtype or _POLICY.param_dtype,
+        compute_dtype=compute_dtype or _POLICY.compute_dtype,
+        output_dtype=output_dtype or _POLICY.output_dtype,
+    )
+    return _POLICY
+
+
+def bf16_matmul_policy() -> DtypePolicy:
+    """bfloat16 compute on the MXU, float32 params/outputs."""
+    return set_policy(compute_dtype=jnp.bfloat16)

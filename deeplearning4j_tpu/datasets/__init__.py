@@ -1,0 +1,5 @@
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.iterators import (
+    DataSetIterator, ListDataSetIterator, ArrayDataSetIterator,
+    AsyncDataSetIterator, MultipleEpochsIterator, SamplingDataSetIterator,
+)

@@ -1,0 +1,109 @@
+"""DataSet: features + labels (+ masks) container with normalization support.
+
+Reference: ND4J org.nd4j.linalg.dataset.DataSet (external dep, used 160x across the
+reference per SURVEY.md §1). Host-side numpy until it crosses into a jitted step.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class DataSet:
+    features: np.ndarray
+    labels: np.ndarray
+    features_mask: Optional[np.ndarray] = None
+    labels_mask: Optional[np.ndarray] = None
+
+    def num_examples(self) -> int:
+        return int(self.features.shape[0])
+
+    def split_test_and_train(self, n_train: int) -> tuple["DataSet", "DataSet"]:
+        def cut(a, sl):
+            return a[sl] if a is not None else None
+
+        tr = slice(0, n_train)
+        te = slice(n_train, None)
+        return (DataSet(self.features[tr], self.labels[tr],
+                        cut(self.features_mask, tr), cut(self.labels_mask, tr)),
+                DataSet(self.features[te], self.labels[te],
+                        cut(self.features_mask, te), cut(self.labels_mask, te)))
+
+    def shuffle(self, seed: Optional[int] = None) -> None:
+        rng = np.random.default_rng(seed)
+        idx = rng.permutation(self.num_examples())
+        self.features = self.features[idx]
+        self.labels = self.labels[idx]
+        if self.features_mask is not None:
+            self.features_mask = self.features_mask[idx]
+        if self.labels_mask is not None:
+            self.labels_mask = self.labels_mask[idx]
+
+    def batch_by(self, batch_size: int) -> list["DataSet"]:
+        out = []
+        for i in range(0, self.num_examples(), batch_size):
+            sl = slice(i, i + batch_size)
+            out.append(DataSet(
+                self.features[sl], self.labels[sl],
+                self.features_mask[sl] if self.features_mask is not None else None,
+                self.labels_mask[sl] if self.labels_mask is not None else None))
+        return out
+
+
+class NormalizerStandardize:
+    """Feature-wise zero-mean/unit-variance normalizer (reference ND4J
+    NormalizerStandardize; serialized into the model zip as normalizer.bin)."""
+
+    def __init__(self):
+        self.mean: Optional[np.ndarray] = None
+        self.std: Optional[np.ndarray] = None
+
+    def fit(self, ds: DataSet) -> None:
+        flat = ds.features.reshape(ds.features.shape[0], -1)
+        self.mean = flat.mean(axis=0)
+        self.std = flat.std(axis=0) + 1e-8
+
+    def transform(self, ds: DataSet) -> None:
+        shape = ds.features.shape
+        flat = ds.features.reshape(shape[0], -1)
+        ds.features = ((flat - self.mean) / self.std).reshape(shape)
+
+    def revert(self, ds: DataSet) -> None:
+        shape = ds.features.shape
+        flat = ds.features.reshape(shape[0], -1)
+        ds.features = (flat * self.std + self.mean).reshape(shape)
+
+    def to_arrays(self) -> dict:
+        return {"mean": self.mean, "std": self.std}
+
+    @staticmethod
+    def from_arrays(d: dict) -> "NormalizerStandardize":
+        n = NormalizerStandardize()
+        n.mean, n.std = d["mean"], d["std"]
+        return n
+
+
+class NormalizerMinMaxScaler:
+    """Min-max [0,1] scaling (reference ND4J NormalizerMinMaxScaler)."""
+
+    def __init__(self, min_range: float = 0.0, max_range: float = 1.0):
+        self.min_range = min_range
+        self.max_range = max_range
+        self.data_min: Optional[np.ndarray] = None
+        self.data_max: Optional[np.ndarray] = None
+
+    def fit(self, ds: DataSet) -> None:
+        flat = ds.features.reshape(ds.features.shape[0], -1)
+        self.data_min = flat.min(axis=0)
+        self.data_max = flat.max(axis=0)
+
+    def transform(self, ds: DataSet) -> None:
+        shape = ds.features.shape
+        flat = ds.features.reshape(shape[0], -1)
+        rng = np.where(self.data_max > self.data_min, self.data_max - self.data_min, 1.0)
+        scaled = (flat - self.data_min) / rng
+        ds.features = (scaled * (self.max_range - self.min_range)
+                       + self.min_range).reshape(shape)

@@ -1,0 +1,180 @@
+"""DataSetIterator family.
+
+Reference: nn datasets/iterator/*.java (19 files) — notably AsyncDataSetIterator.java:36
+(background prefetch thread + blocking queue). The async iterator here does the same
+host-side prefetch with a worker thread; on TPU this overlaps host batch assembly with
+device compute (the jitted step is dispatched asynchronously anyway, so one batch of
+lookahead suffices to keep the device fed).
+"""
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Iterator, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+
+
+class DataSetIterator:
+    """Iterator protocol: for ds in it: ...; reset() to rewind (reference
+    org.nd4j.linalg.dataset.api.iterator.DataSetIterator)."""
+
+    def __iter__(self) -> Iterator[DataSet]:
+        raise NotImplementedError
+
+    def reset(self) -> None:
+        pass
+
+    def batch_size(self) -> int:
+        raise NotImplementedError
+
+    def total_examples(self) -> int:
+        raise NotImplementedError
+
+
+class ListDataSetIterator(DataSetIterator):
+    """Iterate over a pre-built list of DataSets (reference ListDataSetIterator)."""
+
+    def __init__(self, datasets: list, batch: Optional[int] = None):
+        self._list = datasets
+        self._batch = batch or (datasets[0].num_examples() if datasets else 0)
+
+    def __iter__(self):
+        return iter(self._list)
+
+    def batch_size(self) -> int:
+        return self._batch
+
+    def total_examples(self) -> int:
+        return sum(d.num_examples() for d in self._list)
+
+
+class ArrayDataSetIterator(DataSetIterator):
+    """Minibatch iterator over arrays with optional shuffle per epoch."""
+
+    def __init__(self, features: np.ndarray, labels: np.ndarray, batch: int,
+                 shuffle: bool = False, seed: int = 0,
+                 features_mask: Optional[np.ndarray] = None,
+                 labels_mask: Optional[np.ndarray] = None,
+                 drop_last: bool = True):
+        self.features = np.asarray(features)
+        self.labels = np.asarray(labels)
+        self.features_mask = features_mask
+        self.labels_mask = labels_mask
+        self._batch = batch
+        self._shuffle = shuffle
+        self._seed = seed
+        self._epoch = 0
+        self._drop_last = drop_last
+
+    def __iter__(self):
+        n = self.features.shape[0]
+        idx = np.arange(n)
+        if self._shuffle:
+            rng = np.random.default_rng(self._seed + self._epoch)
+            rng.shuffle(idx)
+        self._epoch += 1
+        end = n - (n % self._batch) if self._drop_last and n % self._batch else n
+        for i in range(0, end, self._batch):
+            sl = idx[i:i + self._batch]
+            yield DataSet(
+                self.features[sl], self.labels[sl],
+                self.features_mask[sl] if self.features_mask is not None else None,
+                self.labels_mask[sl] if self.labels_mask is not None else None)
+
+    def batch_size(self) -> int:
+        return self._batch
+
+    def total_examples(self) -> int:
+        return int(self.features.shape[0])
+
+
+class AsyncDataSetIterator(DataSetIterator):
+    """Background-thread prefetch wrapper (reference AsyncDataSetIterator.java:36)."""
+
+    def __init__(self, base: DataSetIterator, queue_size: int = 4):
+        self.base = base
+        self.queue_size = queue_size
+
+    def __iter__(self):
+        q: queue.Queue = queue.Queue(maxsize=self.queue_size)
+        sentinel = object()
+        error: list = []
+
+        def producer():
+            try:
+                for ds in self.base:
+                    q.put(ds)
+            except BaseException as e:  # propagate into consumer
+                error.append(e)
+            finally:
+                q.put(sentinel)
+
+        t = threading.Thread(target=producer, daemon=True)
+        t.start()
+        while True:
+            item = q.get()
+            if item is sentinel:
+                if error:
+                    raise error[0]
+                return
+            yield item
+
+    def reset(self) -> None:
+        self.base.reset()
+
+    def batch_size(self) -> int:
+        return self.base.batch_size()
+
+    def total_examples(self) -> int:
+        return self.base.total_examples()
+
+
+class MultipleEpochsIterator(DataSetIterator):
+    """Repeat a base iterator N times (reference MultipleEpochsIterator.java)."""
+
+    def __init__(self, epochs: int, base: DataSetIterator):
+        self.epochs = epochs
+        self.base = base
+
+    def __iter__(self):
+        for _ in range(self.epochs):
+            self.base.reset()
+            yield from self.base
+
+    def reset(self) -> None:
+        self.base.reset()
+
+    def batch_size(self) -> int:
+        return self.base.batch_size()
+
+    def total_examples(self) -> int:
+        return self.epochs * self.base.total_examples()
+
+
+class SamplingDataSetIterator(DataSetIterator):
+    """Sample random minibatches with replacement (reference
+    SamplingDataSetIterator.java)."""
+
+    def __init__(self, dataset: DataSet, batch: int, total_batches: int, seed: int = 0):
+        self.dataset = dataset
+        self._batch = batch
+        self.total_batches = total_batches
+        self._seed = seed
+        self._epoch = 0
+
+    def __iter__(self):
+        rng = np.random.default_rng(self._seed + self._epoch)
+        self._epoch += 1
+        n = self.dataset.num_examples()
+        for _ in range(self.total_batches):
+            idx = rng.integers(0, n, self._batch)
+            yield DataSet(self.dataset.features[idx], self.dataset.labels[idx])
+
+    def batch_size(self) -> int:
+        return self._batch
+
+    def total_examples(self) -> int:
+        return self._batch * self.total_batches

@@ -1,0 +1,3 @@
+from deeplearning4j_tpu.eval.evaluation import Evaluation, ConfusionMatrix
+from deeplearning4j_tpu.eval.regression import RegressionEvaluation
+from deeplearning4j_tpu.eval.roc import ROC, ROCMultiClass

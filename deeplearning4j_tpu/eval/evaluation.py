@@ -1,0 +1,129 @@
+"""Classification evaluation: accuracy/precision/recall/F1 + confusion matrix.
+
+Reference: eval/Evaluation.java (eval(realOutcomes,guesses):191, stats():352) and
+eval/ConfusionMatrix.java. Time-series input ([B,T,C]) is flattened with the label mask
+applied, matching BaseEvaluation.evalTimeSeries.
+
+Accumulation happens on host in numpy (it's O(batch) bookkeeping, not TPU work);
+the model forward producing the guesses is the jitted TPU path.
+"""
+from __future__ import annotations
+
+from collections import defaultdict
+from typing import Optional
+
+import numpy as np
+
+
+class ConfusionMatrix:
+    def __init__(self, n_classes: int):
+        self.n_classes = n_classes
+        self.matrix = np.zeros((n_classes, n_classes), dtype=np.int64)
+
+    def add(self, actual: int, predicted: int, count: int = 1) -> None:
+        self.matrix[actual, predicted] += count
+
+    def get_count(self, actual: int, predicted: int) -> int:
+        return int(self.matrix[actual, predicted])
+
+    def actual_total(self, cls: int) -> int:
+        return int(self.matrix[cls].sum())
+
+    def predicted_total(self, cls: int) -> int:
+        return int(self.matrix[:, cls].sum())
+
+    def __str__(self) -> str:
+        return str(self.matrix)
+
+
+class Evaluation:
+    def __init__(self, n_classes: Optional[int] = None, labels: Optional[list] = None):
+        self.labels = labels
+        self.n_classes = n_classes or (len(labels) if labels else None)
+        self.confusion: Optional[ConfusionMatrix] = None
+        self.num_examples = 0
+
+    def _ensure(self, n: int):
+        if self.confusion is None:
+            self.n_classes = self.n_classes or n
+            self.confusion = ConfusionMatrix(self.n_classes)
+
+    def eval(self, labels: np.ndarray, predictions: np.ndarray,
+             mask: Optional[np.ndarray] = None) -> None:
+        """labels/predictions: one-hot/probabilities [B,C] or time series [B,T,C]."""
+        labels = np.asarray(labels)
+        predictions = np.asarray(predictions)
+        if labels.ndim == 3:  # [B,T,C] -> flatten with mask
+            B, T, C = labels.shape
+            labels = labels.reshape(-1, C)
+            predictions = predictions.reshape(-1, C)
+            if mask is not None:
+                keep = np.asarray(mask).reshape(-1) > 0
+                labels, predictions = labels[keep], predictions[keep]
+        self._ensure(labels.shape[-1])
+        actual = labels.argmax(-1)
+        guess = predictions.argmax(-1)
+        for a, g in zip(actual, guess):
+            self.confusion.add(int(a), int(g))
+        self.num_examples += len(actual)
+
+    # ------------------------------------------------------------------ metrics
+    def true_positives(self, cls: int) -> int:
+        return self.confusion.get_count(cls, cls)
+
+    def false_positives(self, cls: int) -> int:
+        return self.confusion.predicted_total(cls) - self.true_positives(cls)
+
+    def false_negatives(self, cls: int) -> int:
+        return self.confusion.actual_total(cls) - self.true_positives(cls)
+
+    def accuracy(self) -> float:
+        if self.num_examples == 0:
+            return 0.0
+        return float(np.trace(self.confusion.matrix)) / self.num_examples
+
+    def precision(self, cls: Optional[int] = None) -> float:
+        if cls is not None:
+            pt = self.confusion.predicted_total(cls)
+            return self.true_positives(cls) / pt if pt else 0.0
+        vals = [self.precision(c) for c in range(self.n_classes)
+                if self.confusion.actual_total(c) > 0]
+        return float(np.mean(vals)) if vals else 0.0
+
+    def recall(self, cls: Optional[int] = None) -> float:
+        if cls is not None:
+            at = self.confusion.actual_total(cls)
+            return self.true_positives(cls) / at if at else 0.0
+        vals = [self.recall(c) for c in range(self.n_classes)
+                if self.confusion.actual_total(c) > 0]
+        return float(np.mean(vals)) if vals else 0.0
+
+    def f1(self, cls: Optional[int] = None) -> float:
+        p, r = self.precision(cls), self.recall(cls)
+        return 2 * p * r / (p + r) if (p + r) else 0.0
+
+    def stats(self) -> str:
+        """Human-readable summary (reference Evaluation.stats():352)."""
+        lines = ["==========================Scores========================================",
+                 f" Examples:  {self.num_examples}",
+                 f" Accuracy:  {self.accuracy():.4f}",
+                 f" Precision: {self.precision():.4f}",
+                 f" Recall:    {self.recall():.4f}",
+                 f" F1 Score:  {self.f1():.4f}",
+                 "========================================================================"]
+        if self.confusion is not None and self.n_classes <= 20:
+            lines.append("Confusion matrix:")
+            lines.append(str(self.confusion))
+        return "\n".join(lines)
+
+    def merge(self, other: "Evaluation") -> "Evaluation":
+        """Combine accumulated stats (used by distributed evaluation, reference
+        spark impl/multilayer/evaluation/)."""
+        if other.confusion is None:
+            return self
+        if self.confusion is None:
+            self.n_classes = other.n_classes
+            self.confusion = ConfusionMatrix(other.n_classes)
+        self.confusion.matrix += other.confusion.matrix
+        self.num_examples += other.num_examples
+        return self

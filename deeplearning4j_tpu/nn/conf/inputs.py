@@ -1,0 +1,66 @@
+"""InputType system: shape inference between layers.
+
+Parity with reference nn/conf/inputs/InputType.java + nn/conf/layers/InputTypeUtil.java.
+Used by MultiLayerConfiguration/GraphBuilder ``set_input_type`` to (a) infer each layer's
+n_in from the previous layer's output type and (b) auto-insert InputPreProcessors at
+layer-family boundaries (CNN<->FF, CNN<->RNN, FF<->RNN).
+
+TPU-native layout conventions (differ from the reference's on purpose):
+  - convolutional activations are NHWC (XLA:TPU's preferred layout; reference is NCHW)
+  - recurrent activations are [batch, time, features] (reference is [batch, features, time])
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from deeplearning4j_tpu.nn.conf.serde import register_config
+
+
+@dataclasses.dataclass
+class InputType:
+    kind: str = "feedforward"  # feedforward | recurrent | convolutional | convolutionalflat
+    size: int = 0              # feature dim (ff / recurrent)
+    height: int = 0
+    width: int = 0
+    channels: int = 0
+    timesteps: Optional[int] = None  # recurrent, None = variable
+
+    # ---- factories (mirror reference InputType.feedForward/recurrent/convolutional) ----
+    @staticmethod
+    def feed_forward(size: int) -> "InputType":
+        return InputType(kind="feedforward", size=int(size))
+
+    @staticmethod
+    def recurrent(size: int, timesteps: Optional[int] = None) -> "InputType":
+        return InputType(kind="recurrent", size=int(size), timesteps=timesteps)
+
+    @staticmethod
+    def convolutional(height: int, width: int, channels: int) -> "InputType":
+        return InputType(kind="convolutional", height=int(height), width=int(width),
+                         channels=int(channels))
+
+    @staticmethod
+    def convolutional_flat(height: int, width: int, channels: int) -> "InputType":
+        return InputType(kind="convolutionalflat", height=int(height), width=int(width),
+                         channels=int(channels),
+                         size=int(height) * int(width) * int(channels))
+
+    # ---- helpers ----
+    def flat_size(self) -> int:
+        if self.kind in ("feedforward", "recurrent", "convolutionalflat"):
+            return self.size if self.size else self.height * self.width * self.channels
+        return self.height * self.width * self.channels
+
+    def array_shape(self, batch: int = 1) -> tuple:
+        """Concrete array shape for this type (NHWC / BTF conventions)."""
+        if self.kind == "feedforward" or self.kind == "convolutionalflat":
+            return (batch, self.flat_size())
+        if self.kind == "recurrent":
+            return (batch, self.timesteps or 1, self.size)
+        if self.kind == "convolutional":
+            return (batch, self.height, self.width, self.channels)
+        raise ValueError(self.kind)
+
+
+register_config("InputType")(InputType)

@@ -1,0 +1,135 @@
+"""Layer base classes.
+
+Reference contract: nn/api/Layer.java:37 (activate/backpropGradient/preOutput) +
+nn/conf/layers/Layer.java config hierarchy. Here a layer is a frozen-ish dataclass of
+hyperparameters with pure functions over explicit param/state pytrees:
+
+  init_params(key, input_type)  -> dict[str, Array]     (named param views; reference
+                                                         nn/params/*ParamInitializer)
+  init_state(input_type)        -> dict[str, Array]     (e.g. batchnorm running stats)
+  apply(params, state, x, ...)  -> (activations, state) (reference Layer.activate:192)
+  output_type(input_type)       -> InputType            (shape inference,
+                                                         reference InputTypeUtil)
+
+Fields set to None inherit network-level defaults; NeuralNetConfiguration's builder bakes
+the resolved values in at build time (the reference does the same via config cloning,
+nn/conf/NeuralNetConfiguration.java:478+).
+
+Dropout semantics follow the reference (inverted dropout where the configured value is
+the RETAIN probability — reference org.nd4j.linalg DropOutInverted as used by
+nn/layers/BaseLayer): keep with prob p, scale kept units by 1/p.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.weights import init_weights
+from deeplearning4j_tpu.ops.activations import get_activation
+
+Array = jax.Array
+
+
+@dataclasses.dataclass
+class Layer:
+    """Base hyperparameters shared by all layers (None = inherit network default)."""
+
+    name: Optional[str] = None
+    activation: Optional[str] = None
+    weight_init: Optional[str] = None
+    dist: Optional[dict] = None
+    bias_init: Optional[float] = None
+    l1: Optional[float] = None
+    l2: Optional[float] = None
+    dropout: Optional[float] = None          # retain probability; None/0 = no dropout
+    learning_rate: Optional[float] = None    # per-layer lr override
+    bias_learning_rate: Optional[float] = None
+    updater: Optional[str] = None            # per-layer updater override
+    momentum: Optional[float] = None
+    rho: Optional[float] = None
+    rms_decay: Optional[float] = None
+    adam_mean_decay: Optional[float] = None
+    adam_var_decay: Optional[float] = None
+    epsilon: Optional[float] = None
+    gradient_normalization: Optional[str] = None
+    gradient_normalization_threshold: Optional[float] = None
+
+    # ------------------------------------------------------------------ contracts
+    def init_params(self, key: jax.Array, itype: InputType) -> dict:
+        return {}
+
+    def init_state(self, itype: InputType) -> dict:
+        return {}
+
+    def apply(self, params: dict, state: dict, x: Array, *, train: bool = False,
+              rng: Optional[jax.Array] = None, mask: Optional[Array] = None):
+        raise NotImplementedError
+
+    def output_type(self, itype: InputType) -> InputType:
+        return itype
+
+    def set_n_in(self, itype: InputType) -> None:
+        """Infer input-size fields from the incoming InputType (override where relevant)."""
+
+    def regularizable_params(self) -> Sequence[str]:
+        """Param names subject to l1/l2 (weights, not biases — reference semantics)."""
+        return ("W",)
+
+    def is_pretrain_layer(self) -> bool:
+        return False
+
+    def has_loss(self) -> bool:
+        """True for output/loss layers that terminate backprop with a loss function."""
+        return False
+
+    # ------------------------------------------------------------------ helpers
+    def act_fn(self):
+        return get_activation(self.activation or "identity")
+
+    def _init_w(self, key: jax.Array, shape, dtype=jnp.float32) -> Array:
+        return init_weights(key, shape, self.weight_init or "xavier", self.dist, dtype)
+
+    def _init_b(self, shape, dtype=jnp.float32) -> Array:
+        return jnp.full(shape, self.bias_init or 0.0, dtype)
+
+    def apply_dropout(self, x: Array, rng: Optional[jax.Array], train: bool) -> Array:
+        p = self.dropout
+        if not train or p is None or p == 0.0 or p >= 1.0 or rng is None:
+            return x
+        keep = jax.random.bernoulli(rng, p, x.shape)
+        return jnp.where(keep, x / p, 0.0)
+
+
+@dataclasses.dataclass
+class FeedForwardLayer(Layer):
+    """Layers with an nIn->nOut dense-like shape contract (reference
+    nn/conf/layers/FeedForwardLayer.java)."""
+
+    n_in: int = 0
+    n_out: int = 0
+
+    def set_n_in(self, itype: InputType) -> None:
+        if not self.n_in:
+            self.n_in = itype.flat_size() if itype.kind != "recurrent" else itype.size
+
+    def output_type(self, itype: InputType) -> InputType:
+        if itype.kind == "recurrent":
+            return InputType.recurrent(self.n_out, itype.timesteps)
+        return InputType.feed_forward(self.n_out)
+
+
+@dataclasses.dataclass
+class PretrainLayer(FeedForwardLayer):
+    """Layers supporting unsupervised layerwise pretraining (AutoEncoder/RBM/VAE).
+    Reference: nn/api/Layer pretrain path, MultiLayerNetwork.pretrainLayer:183."""
+
+    def is_pretrain_layer(self) -> bool:
+        return True
+
+    def pretrain_loss(self, params: dict, x: Array, *, rng: jax.Array) -> Array:
+        """Unsupervised objective minimized during layerwise pretraining."""
+        raise NotImplementedError

@@ -1,0 +1,98 @@
+"""Normalization layers: BatchNormalization, LocalResponseNormalization.
+
+Reference: nn/layers/normalization/BatchNormalization.java (preOutput:398 with global
+mean/var EMA, backprop:91) and LocalResponseNormalization.java; cuDNN helpers in
+deeplearning4j-cuda. On TPU, XLA fuses the normalize+scale+shift elementwise chain into
+neighbouring ops, which is what the cuDNN helper bought the reference.
+
+BatchNorm running statistics live in the layer *state* pytree (mean/var), updated
+functionally during training — the pure-function equivalent of the reference's mutable
+global-mean/var fields. ``decay`` matches the reference's EMA decay semantics:
+new_mean = decay * old + (1-decay) * batch_mean.
+"""
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers.base import Layer
+from deeplearning4j_tpu.nn.conf.serde import register_config
+
+Array = jax.Array
+
+
+@register_config("BatchNormalization")
+@dataclasses.dataclass
+class BatchNormalization(Layer):
+    """Batch norm over the channel axis (last axis in NHWC / feature axis in FF)."""
+
+    decay: float = 0.9
+    eps: float = 1e-5
+    gamma: float = 1.0       # init values when lock_gamma_beta
+    beta: float = 0.0
+    lock_gamma_beta: bool = False
+    n_in: int = 0
+
+    def set_n_in(self, itype: InputType) -> None:
+        if not self.n_in:
+            self.n_in = itype.channels if itype.kind == "convolutional" else itype.flat_size()
+
+    def regularizable_params(self):
+        return ()
+
+    def init_params(self, key, itype: InputType) -> dict:
+        if self.lock_gamma_beta:
+            return {}
+        return {"gamma": jnp.full((self.n_in,), self.gamma, jnp.float32),
+                "beta": jnp.full((self.n_in,), self.beta, jnp.float32)}
+
+    def init_state(self, itype: InputType) -> dict:
+        return {"mean": jnp.zeros((self.n_in,), jnp.float32),
+                "var": jnp.ones((self.n_in,), jnp.float32)}
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        axes = tuple(range(x.ndim - 1))
+        if train:
+            mean = jnp.mean(x, axis=axes)
+            var = jnp.var(x, axis=axes)
+            new_state = {
+                "mean": self.decay * state["mean"] + (1 - self.decay) * mean,
+                "var": self.decay * state["var"] + (1 - self.decay) * var,
+            }
+        else:
+            mean, var = state["mean"], state["var"]
+            new_state = state
+        inv = jax.lax.rsqrt(var + self.eps)
+        xhat = (x - mean) * inv
+        if self.lock_gamma_beta:
+            out = self.gamma * xhat + self.beta
+        else:
+            out = params["gamma"] * xhat + params["beta"]
+        return self.act_fn()(out), new_state
+
+
+@register_config("LocalResponseNormalization")
+@dataclasses.dataclass
+class LocalResponseNormalization(Layer):
+    """Cross-channel LRN (reference nn/layers/normalization/LocalResponseNormalization.java):
+    out = x / (k + alpha * sum_{adjacent n channels} x^2)^beta."""
+
+    k: float = 2.0
+    n: int = 5
+    alpha: float = 1e-4
+    beta: float = 0.75
+
+    def regularizable_params(self):
+        return ()
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        # x is NHWC; sum x^2 over a window of n adjacent channels
+        half = self.n // 2
+        sq = x * x
+        padded = jnp.pad(sq, ((0, 0),) * (x.ndim - 1) + ((half, half),))
+        windowed = sum(padded[..., i:i + x.shape[-1]] for i in range(self.n))
+        denom = (self.k + self.alpha * windowed) ** self.beta
+        return x / denom, state

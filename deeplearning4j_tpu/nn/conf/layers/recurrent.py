@@ -1,0 +1,207 @@
+"""Recurrent layers: LSTM (Graves variant with peepholes), bidirectional LSTM,
+RnnOutputLayer.
+
+Reference: nn/layers/recurrent/LSTMHelpers.java (activateHelper:58,
+backpropGradientHelper:248 — hand-written BPTT) and GravesLSTM/GravesBidirectionalLSTM
+configs. TPU-native: the time recursion is a ``lax.scan`` whose body is one fused
+[B, n_in+H] x [n_in+H, 4H] matmul on the MXU; backprop-through-time is autodiff through
+the scan (XLA generates the reverse scan) — this *is* the accelerated LSTM path the
+cuDNN-helper seam would otherwise provide (SURVEY.md §2.3 note).
+
+Layout: [batch, time, features] (reference uses [batch, features, time]).
+Param names: "W" [n_in,4H] input weights, "RW" [H,4H] recurrent, "b" [4H],
+"pI"/"pF"/"pO" [H] peepholes (Graves 2013). Gate order: input, forget, cell(g), output.
+State pytree carries the streaming-inference hidden state for rnn_time_step
+(reference rnnTimeStep:2196 stateMap) — functional instead of mutable.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from deeplearning4j_tpu.common import get_policy
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers.base import FeedForwardLayer
+from deeplearning4j_tpu.nn.conf.layers.feedforward import _dense
+from deeplearning4j_tpu.nn.conf.serde import register_config
+from deeplearning4j_tpu.ops.losses import get_loss
+
+Array = jax.Array
+
+
+def _lstm_scan(params: dict, x: Array, act, gate_act, h0: Array, c0: Array,
+               peephole: bool, mask: Optional[Array]):
+    """Run the LSTM over time with lax.scan. x: [B,T,F]. Returns (outputs [B,T,H], (h,c))."""
+    pol = get_policy()
+    w = params["W"].astype(pol.compute_dtype)
+    rw = params["RW"].astype(pol.compute_dtype)
+    b = params["b"].astype(pol.compute_dtype)
+    hidden = rw.shape[0]
+
+    # Precompute input contributions for all timesteps in one big MXU matmul: [B,T,4H]
+    xw = jnp.einsum("btf,fg->btg", x.astype(pol.compute_dtype), w) + b
+
+    def step(carry, inputs):
+        h, c = carry
+        xw_t, m_t = inputs
+        z = xw_t + jnp.matmul(h.astype(pol.compute_dtype), rw)
+        zi, zf, zg, zo = jnp.split(z.astype(pol.output_dtype), 4, axis=-1)
+        if peephole:
+            zi = zi + c * params["pI"]
+            zf = zf + c * params["pF"]
+        i = gate_act(zi)
+        f = gate_act(zf)
+        g = act(zg)
+        c_new = f * c + i * g
+        if peephole:
+            zo = zo + c_new * params["pO"]
+        o = gate_act(zo)
+        h_new = o * act(c_new)
+        if m_t is not None:
+            m = m_t[:, None]
+            h_new = jnp.where(m > 0, h_new, h)
+            c_new = jnp.where(m > 0, c_new, c)
+        return (h_new, c_new), h_new
+
+    xw_t = jnp.moveaxis(xw, 1, 0)  # [T,B,4H]
+    mask_t = jnp.moveaxis(mask, 1, 0) if mask is not None else None
+    if mask_t is None:
+        (h, c), ys = lax.scan(lambda cr, xi: step(cr, (xi, None)), (h0, c0), xw_t)
+    else:
+        (h, c), ys = lax.scan(step, (h0, c0), (xw_t, mask_t))
+    return jnp.moveaxis(ys, 0, 1), (h, c)
+
+
+@register_config("LSTM")
+@dataclasses.dataclass
+class LSTM(FeedForwardLayer):
+    """Standard LSTM (no peepholes)."""
+
+    forget_gate_bias_init: float = 1.0
+    gate_activation: str = "sigmoid"
+    peephole: bool = False
+
+    def set_n_in(self, itype: InputType) -> None:
+        if not self.n_in:
+            self.n_in = itype.size if itype.kind == "recurrent" else itype.flat_size()
+
+    def init_params(self, key, itype: InputType) -> dict:
+        k1, k2 = jax.random.split(key)
+        h = self.n_out
+        b = jnp.zeros((4 * h,), jnp.float32)
+        b = b.at[h:2 * h].set(self.forget_gate_bias_init)
+        params = {"W": self._init_w(k1, (self.n_in, 4 * h)),
+                  "RW": self._init_w(k2, (h, 4 * h)),
+                  "b": b}
+        if self.peephole:
+            params["pI"] = jnp.zeros((h,), jnp.float32)
+            params["pF"] = jnp.zeros((h,), jnp.float32)
+            params["pO"] = jnp.zeros((h,), jnp.float32)
+        return params
+
+    def regularizable_params(self):
+        return ("W", "RW")
+
+    def output_type(self, itype: InputType) -> InputType:
+        return InputType.recurrent(self.n_out, itype.timesteps)
+
+    def _acts(self):
+        from deeplearning4j_tpu.ops.activations import get_activation
+        return get_activation(self.activation or "tanh"), get_activation(self.gate_activation)
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        # Training/inference over full sequences starts from zero state each batch
+        # (reference LSTMHelpers.activateHelper); streaming state is apply_streaming.
+        x = self.apply_dropout(x, rng, train)
+        act, gate = self._acts()
+        B = x.shape[0]
+        zeros = jnp.zeros((B, self.n_out), x.dtype)
+        ys, _ = _lstm_scan(params, x, act, gate, zeros, zeros, self.peephole, mask)
+        return ys, state
+
+    def apply_streaming(self, params, state, x, *, mask=None):
+        """rnnTimeStep equivalent: carry (h,c) across calls (reference
+        MultiLayerNetwork.rnnTimeStep:2196)."""
+        act, gate = self._acts()
+        B = x.shape[0]
+        h0 = state.get("h", jnp.zeros((B, self.n_out), x.dtype))
+        c0 = state.get("c", jnp.zeros((B, self.n_out), x.dtype))
+        ys, (h, c) = _lstm_scan(params, x, act, gate, h0, c0, self.peephole, mask)
+        return ys, {"h": h, "c": c}
+
+
+@register_config("GravesLSTM")
+@dataclasses.dataclass
+class GravesLSTM(LSTM):
+    """LSTM with peephole connections (Graves 2013; reference GravesLSTM.java)."""
+
+    peephole: bool = True
+
+
+@register_config("GravesBidirectionalLSTM")
+@dataclasses.dataclass
+class GravesBidirectionalLSTM(LSTM):
+    """Bidirectional Graves LSTM (reference GravesBidirectionalLSTM.java). Output is the
+    SUM of forward and backward passes, matching the reference's ADD mode."""
+
+    peephole: bool = True
+
+    def init_params(self, key, itype: InputType) -> dict:
+        kf, kb = jax.random.split(key)
+        fwd = LSTM.init_params(self, kf, itype)
+        bwd = LSTM.init_params(self, kb, itype)
+        return ({f"F{k}": v for k, v in fwd.items()}
+                | {f"B{k}": v for k, v in bwd.items()})
+
+    def regularizable_params(self):
+        return ("FW", "FRW", "BW", "BRW")
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        x = self.apply_dropout(x, rng, train)
+        act, gate = self._acts()
+        B = x.shape[0]
+        zeros = jnp.zeros((B, self.n_out), x.dtype)
+        fwd_p = {k[1:]: v for k, v in params.items() if k.startswith("F")}
+        bwd_p = {k[1:]: v for k, v in params.items() if k.startswith("B")}
+        ys_f, _ = _lstm_scan(fwd_p, x, act, gate, zeros, zeros, self.peephole, mask)
+        x_rev = jnp.flip(x, axis=1)
+        mask_rev = jnp.flip(mask, axis=1) if mask is not None else None
+        ys_b, _ = _lstm_scan(bwd_p, x_rev, act, gate, zeros, zeros, self.peephole, mask_rev)
+        return ys_f + jnp.flip(ys_b, axis=1), state
+
+
+@register_config("RnnOutput")
+@dataclasses.dataclass
+class RnnOutputLayer(FeedForwardLayer):
+    """Time-distributed output layer with loss (reference nn/conf/layers/RnnOutputLayer.java):
+    dense applied at every timestep of [B,T,F], loss masked by the time-series mask."""
+
+    loss: str = "mcxent"
+
+    def has_loss(self) -> bool:
+        return True
+
+    def set_n_in(self, itype: InputType) -> None:
+        if not self.n_in:
+            self.n_in = itype.size if itype.kind == "recurrent" else itype.flat_size()
+
+    def init_params(self, key, itype: InputType) -> dict:
+        return {"W": self._init_w(key, (self.n_in, self.n_out)),
+                "b": self._init_b((self.n_out,))}
+
+    def output_type(self, itype: InputType) -> InputType:
+        return InputType.recurrent(self.n_out, itype.timesteps)
+
+    def preout(self, params, x):
+        return _dense(params, x)
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        x = self.apply_dropout(x, rng, train)
+        return self.act_fn()(_dense(params, x)), state
+
+    def compute_loss(self, params, x, labels, mask=None) -> Array:
+        return get_loss(self.loss)(labels, _dense(params, x), self.act_fn(), mask)

@@ -1,0 +1,124 @@
+"""Variational autoencoder layer.
+
+Reference: nn/layers/variational/VariationalAutoencoder.java (1055 LoC) +
+nn/conf/layers/variational/ (ReconstructionDistribution family). Pretrain objective is
+the negative ELBO with the reparameterization trick; supervised forward propagates the
+mean of q(z|x) through the encoder (reference behaviour: activate() returns the latent
+mean when used as a frozen feature extractor).
+
+Encoder/decoder are MLPs given by ``encoder_layer_sizes`` / ``decoder_layer_sizes``.
+Reconstruction distributions: 'gaussian' (diagonal, learned variance), 'bernoulli'.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers.base import PretrainLayer
+from deeplearning4j_tpu.nn.conf.serde import register_config
+from deeplearning4j_tpu.ops.activations import get_activation
+
+Array = jax.Array
+
+
+@register_config("VariationalAutoencoder")
+@dataclasses.dataclass
+class VariationalAutoencoder(PretrainLayer):
+    encoder_layer_sizes: Sequence[int] = (100,)
+    decoder_layer_sizes: Sequence[int] = (100,)
+    reconstruction_distribution: str = "gaussian"  # gaussian | bernoulli
+    pzx_activation: str = "identity"
+    num_samples: int = 1
+
+    def regularizable_params(self):
+        return tuple(k for k in self._param_names() if k.startswith("eW") or
+                     k.startswith("dW") or k in ("zMeanW", "zLogVarW", "outW"))
+
+    def _param_names(self):
+        names = []
+        for i in range(len(self.encoder_layer_sizes)):
+            names += [f"eW{i}", f"eb{i}"]
+        names += ["zMeanW", "zMeanb", "zLogVarW", "zLogVarb"]
+        for i in range(len(self.decoder_layer_sizes)):
+            names += [f"dW{i}", f"db{i}"]
+        names += ["outW", "outb"]
+        return names
+
+    def init_params(self, key, itype: InputType) -> dict:
+        params = {}
+        sizes_in = [self.n_in] + list(self.encoder_layer_sizes)
+        keys = jax.random.split(key, len(self.encoder_layer_sizes)
+                                + len(self.decoder_layer_sizes) + 3)
+        ki = 0
+        for i, (a, b) in enumerate(zip(sizes_in[:-1], sizes_in[1:])):
+            params[f"eW{i}"] = self._init_w(keys[ki], (a, b)); ki += 1
+            params[f"eb{i}"] = self._init_b((b,))
+        enc_out = sizes_in[-1]
+        params["zMeanW"] = self._init_w(keys[ki], (enc_out, self.n_out)); ki += 1
+        params["zMeanb"] = self._init_b((self.n_out,))
+        params["zLogVarW"] = self._init_w(keys[ki], (enc_out, self.n_out)); ki += 1
+        params["zLogVarb"] = self._init_b((self.n_out,))
+        dsizes = [self.n_out] + list(self.decoder_layer_sizes)
+        for i, (a, b) in enumerate(zip(dsizes[:-1], dsizes[1:])):
+            params[f"dW{i}"] = self._init_w(keys[ki], (a, b)); ki += 1
+            params[f"db{i}"] = self._init_b((b,))
+        out_units = self.n_in * (2 if self.reconstruction_distribution == "gaussian" else 1)
+        params["outW"] = self._init_w(keys[-1], (dsizes[-1], out_units))
+        params["outb"] = self._init_b((out_units,))
+        return params
+
+    def _encode(self, params, x):
+        act = self.act_fn()
+        h = x
+        for i in range(len(self.encoder_layer_sizes)):
+            h = act(jnp.matmul(h, params[f"eW{i}"]) + params[f"eb{i}"])
+        pz = get_activation(self.pzx_activation)
+        mean = pz(jnp.matmul(h, params["zMeanW"]) + params["zMeanb"])
+        logvar = jnp.matmul(h, params["zLogVarW"]) + params["zLogVarb"]
+        return mean, logvar
+
+    def _decode(self, params, z):
+        act = self.act_fn()
+        h = z
+        for i in range(len(self.decoder_layer_sizes)):
+            h = act(jnp.matmul(h, params[f"dW{i}"]) + params[f"db{i}"])
+        return jnp.matmul(h, params["outW"]) + params["outb"]
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        mean, _ = self._encode(params, x)
+        return mean, state
+
+    def reconstruct(self, params, x):
+        mean, _ = self._encode(params, x)
+        out = self._decode(params, mean)
+        if self.reconstruction_distribution == "gaussian":
+            return out[..., :self.n_in]
+        return jax.nn.sigmoid(out)
+
+    def pretrain_loss(self, params, x, *, rng):
+        """Negative ELBO = reconstruction NLL + KL(q(z|x) || N(0,I))."""
+        mean, logvar = self._encode(params, x)
+        total = 0.0
+        keys = jax.random.split(rng, self.num_samples)
+        for k in keys:
+            eps = jax.random.normal(k, mean.shape, mean.dtype)
+            z = mean + jnp.exp(0.5 * logvar) * eps
+            out = self._decode(params, z)
+            if self.reconstruction_distribution == "gaussian":
+                rmean, rlogvar = out[..., :self.n_in], out[..., self.n_in:]
+                nll = 0.5 * jnp.sum(rlogvar + (x - rmean) ** 2 / jnp.exp(rlogvar)
+                                    + jnp.log(2 * jnp.pi), axis=-1)
+            else:
+                p = out  # logits
+                nll = jnp.sum(x * jax.nn.softplus(-p) + (1 - x) * jax.nn.softplus(p), axis=-1)
+            total = total + jnp.mean(nll)
+        recon = total / self.num_samples
+        kl = 0.5 * jnp.mean(jnp.sum(jnp.exp(logvar) + mean ** 2 - 1.0 - logvar, axis=-1))
+        return recon + kl
+
+    def output_type(self, itype: InputType) -> InputType:
+        return InputType.feed_forward(self.n_out)

@@ -1,0 +1,56 @@
+"""MultiLayerConfiguration: serializable sequential-network config.
+
+Reference: nn/conf/MultiLayerConfiguration.java (toYaml:79, toJson:108, fromJson:122).
+The JSON form is the checkpoint schema (written into model archives by ModelSerializer)
+and must round-trip exactly: to_json(from_json(s)) == s structurally.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from deeplearning4j_tpu.nn.conf.builders import GlobalConf
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers.base import Layer
+from deeplearning4j_tpu.nn.conf import serde
+
+
+@serde.register_config("MultiLayerConfiguration")
+@dataclasses.dataclass
+class MultiLayerConfiguration:
+    global_conf: GlobalConf = dataclasses.field(default_factory=GlobalConf)
+    layers: list = dataclasses.field(default_factory=list)
+    preprocessors: dict = dataclasses.field(default_factory=dict)  # str(idx) -> pp
+    input_type: Optional[InputType] = None
+    backprop: bool = True
+    pretrain: bool = False
+    backprop_type: str = "Standard"       # Standard | TruncatedBPTT
+    tbptt_fwd_length: int = 20
+    tbptt_back_length: int = 20
+
+    def to_json(self) -> str:
+        return serde.to_json(self)
+
+    def to_yaml(self) -> str:
+        return serde.to_yaml(self)
+
+    @staticmethod
+    def from_json(s: str) -> "MultiLayerConfiguration":
+        conf = serde.from_json(s)
+        if not isinstance(conf, MultiLayerConfiguration):
+            raise ValueError("JSON does not encode a MultiLayerConfiguration")
+        return conf
+
+    @staticmethod
+    def from_yaml(s: str) -> "MultiLayerConfiguration":
+        conf = serde.from_yaml(s)
+        if not isinstance(conf, MultiLayerConfiguration):
+            raise ValueError("YAML does not encode a MultiLayerConfiguration")
+        return conf
+
+    def preprocessor(self, idx: int):
+        return self.preprocessors.get(str(idx))
+
+    @property
+    def n_layers(self) -> int:
+        return len(self.layers)

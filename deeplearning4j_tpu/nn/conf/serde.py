@@ -1,0 +1,101 @@
+"""Config serialization: dataclass <-> JSON/YAML with a polymorphic type registry.
+
+The reference relies on Jackson polymorphic subtype registration discovered by classpath
+scan (reference nn/conf/NeuralNetConfiguration.java:329-476, ``registerSubtypes``:369) so
+user-defined custom layers serialize. The TPU-native equivalent is an explicit registry:
+``@register_config("Dense")`` adds a dataclass to the registry; ``to_dict`` stamps
+``"@type"``; ``from_dict`` dispatches on it. Custom layers register the same way, no
+scanning needed.
+
+Config JSON is the checkpoint schema (reference util/ModelSerializer.java writes
+configuration.json into the model zip) — keep it stable.
+"""
+from __future__ import annotations
+
+import dataclasses
+import enum
+import json
+from typing import Any, Optional, Type
+
+_REGISTRY: dict[str, type] = {}
+TYPE_KEY = "@type"
+
+
+def register_config(name: Optional[str] = None):
+    """Class decorator registering a dataclass config under ``name`` (default: class name)."""
+
+    def wrap(cls):
+        key = name or cls.__name__
+        if key in _REGISTRY and _REGISTRY[key] is not cls:
+            raise ValueError(f"Config type '{key}' already registered to {_REGISTRY[key]}")
+        _REGISTRY[key] = cls
+        cls._config_type_name = key
+        return cls
+
+    return wrap
+
+
+def registered_name(cls: type) -> str:
+    return getattr(cls, "_config_type_name", cls.__name__)
+
+
+def lookup(name: str) -> type:
+    if name not in _REGISTRY:
+        raise KeyError(f"Unknown config type '{name}'. Registered: {sorted(_REGISTRY)}")
+    return _REGISTRY[name]
+
+
+def to_dict(obj: Any) -> Any:
+    """Recursively convert a registered dataclass (or plain value) to JSON-native data."""
+    if obj is None or isinstance(obj, (bool, int, float, str)):
+        return obj
+    if isinstance(obj, enum.Enum):
+        return obj.value
+    if isinstance(obj, (list, tuple)):
+        return [to_dict(v) for v in obj]
+    if isinstance(obj, dict):
+        return {k: to_dict(v) for k, v in obj.items()}
+    if dataclasses.is_dataclass(obj):
+        d = {TYPE_KEY: registered_name(type(obj))}
+        for f in dataclasses.fields(obj):
+            if not f.metadata.get("serde", True):
+                continue
+            d[f.name] = to_dict(getattr(obj, f.name))
+        return d
+    raise TypeError(f"Cannot serialize {type(obj)} to config JSON")
+
+
+def from_dict(data: Any) -> Any:
+    """Inverse of to_dict: dispatch on '@type' for registered dataclasses."""
+    if isinstance(data, list):
+        return [from_dict(v) for v in data]
+    if isinstance(data, dict):
+        if TYPE_KEY in data:
+            cls = lookup(data[TYPE_KEY])
+            field_names = {f.name for f in dataclasses.fields(cls)}
+            kwargs = {k: from_dict(v) for k, v in data.items()
+                      if k != TYPE_KEY and k in field_names}
+            return cls(**kwargs)
+        return {k: from_dict(v) for k, v in data.items()}
+    return data
+
+
+def to_json(obj: Any, indent: int = 2) -> str:
+    return json.dumps(to_dict(obj), indent=indent)
+
+
+def from_json(s: str) -> Any:
+    return from_dict(json.loads(s))
+
+
+def to_yaml(obj: Any) -> str:
+    """YAML serde (reference MultiLayerConfiguration.toYaml:79); gated on PyYAML."""
+    import yaml  # baked into most images; gate at call time
+
+    return yaml.safe_dump(to_dict(obj), sort_keys=False)
+
+
+def from_yaml(s: str) -> Any:
+    import yaml
+
+    return from_dict(yaml.safe_load(s))

@@ -1,0 +1,91 @@
+"""Numeric-vs-analytic gradient checking — the correctness backbone.
+
+Reference: gradientcheck/GradientCheckUtil.java:62 (MLN), :194 (CG), :305 (pretrain) —
+central finite-difference comparison used by the whole reference test suite
+(SURVEY.md §4). Same contract here: perturb each parameter by +/-eps in float64,
+compare (f(p+eps)-f(p-eps))/(2 eps) against the autodiff gradient, fail if max
+relative error exceeds ``max_rel_error`` (absolute-error escape hatch for tiny grads).
+
+Runs on CPU in float64 via jax.experimental.enable_x64 for numerical headroom —
+float32 finite differences are too noisy for 1e-6-level checks.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.utils.pytree import flatten_params, unflatten_params
+
+
+def check_gradients(net, x, y, *, eps: float = 1e-6, max_rel_error: float = 1e-3,
+                    min_abs_error: float = 1e-8, subset: Optional[int] = None,
+                    seed: int = 0, verbose: bool = False) -> bool:
+    """Gradient-check a MultiLayerNetwork (or any object exposing
+    gradient_and_score + params_list). Checks ``subset`` randomly-chosen parameters
+    (all if None).
+    """
+    from deeplearning4j_tpu import common
+
+    saved_policy = common.get_policy()
+    common.set_policy(jnp.float64, jnp.float64, jnp.float64)
+    try:
+        return _check_gradients_x64(net, x, y, eps=eps, max_rel_error=max_rel_error,
+                                    min_abs_error=min_abs_error, subset=subset,
+                                    seed=seed, verbose=verbose)
+    finally:
+        common._POLICY = saved_policy
+
+
+def _check_gradients_x64(net, x, y, *, eps, max_rel_error, min_abs_error, subset,
+                         seed, verbose) -> bool:
+    with jax.enable_x64(True):
+        params64 = jax.tree_util.tree_map(
+            lambda a: jnp.asarray(np.asarray(a), jnp.float64), net.params_list)
+        x64 = jnp.asarray(np.asarray(x), jnp.float64)
+        y64 = jnp.asarray(np.asarray(y), jnp.float64)
+        state64 = jax.tree_util.tree_map(
+            lambda a: jnp.asarray(np.asarray(a), jnp.float64), net.state_list)
+
+        from deeplearning4j_tpu.nn.multilayer import loss_fn
+
+        def score(p):
+            loss, _ = loss_fn(net.conf, p, state64, x64, y64, None, None, None)
+            return loss
+
+        analytic = jax.grad(score)(params64)
+        flat_analytic = np.asarray(flatten_params(analytic), np.float64)
+        flat_params = np.asarray(flatten_params(params64), np.float64)
+
+        n = len(flat_params)
+        if subset is not None and subset < n:
+            rng = np.random.default_rng(seed)
+            indices = rng.choice(n, subset, replace=False)
+        else:
+            indices = np.arange(n)
+
+        score_jit = jax.jit(lambda flat: score(unflatten_params(params64, flat)))
+
+        max_err = 0.0
+        fails = 0
+        for i in indices:
+            plus = flat_params.copy()
+            plus[i] += eps
+            minus = flat_params.copy()
+            minus[i] -= eps
+            numeric = (float(score_jit(jnp.asarray(plus)))
+                       - float(score_jit(jnp.asarray(minus)))) / (2 * eps)
+            a = flat_analytic[i]
+            denom = max(abs(numeric), abs(a))
+            rel = abs(numeric - a) / denom if denom > 0 else 0.0
+            if rel > max_rel_error and abs(numeric - a) > min_abs_error:
+                fails += 1
+                if verbose:
+                    print(f"param {i}: analytic={a:.8g} numeric={numeric:.8g} rel={rel:.3g}")
+            max_err = max(max_err, rel if abs(numeric - a) > min_abs_error else 0.0)
+        if verbose:
+            print(f"gradient check: {len(indices)} params, max rel err {max_err:.3g}, "
+                  f"{fails} failures")
+        return fails == 0

@@ -1,0 +1,569 @@
+"""MultiLayerNetwork: sequential network with fit/output/score/evaluate.
+
+Reference: nn/multilayer/MultiLayerNetwork.java (2486 LoC) — init:386,
+fit(DataSetIterator):978, backprop:1049, computeGradientAndScore:1807, feedForward:657,
+rnnTimeStep:2196, doTruncatedBPTT:1140.
+
+TPU-native redesign: the whole optimizer step — forward, loss (+l1/l2), autodiff
+backward, gradient normalization, updater math, parameter update — is ONE jit-compiled
+pure function over the parameter pytree, donated so XLA updates in place. The reference's
+Solver/StochasticGradientDescent loop (optimize/solvers/StochasticGradientDescent.java:51)
+collapses into that fused step; listeners observe from the host side.
+
+Mutable-object API (net.fit(...), net.output(...)) is preserved as a thin stateful shell
+over the pure functions so reference users feel at home; the pure train_step itself is
+exposed for ParallelWrapper/pjit composition (see deeplearning4j_tpu.parallel).
+"""
+from __future__ import annotations
+
+import functools
+import math
+import time
+from typing import Any, Iterable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nn.conf.multilayer import MultiLayerConfiguration
+from deeplearning4j_tpu.nn.conf.layers.base import PretrainLayer
+from deeplearning4j_tpu.nn.conf.layers.recurrent import LSTM
+from deeplearning4j_tpu.nn.updaters import (
+    UpdaterSpec, effective_lr, normalize_gradients, updater_init, updater_step,
+)
+from deeplearning4j_tpu.utils.pytree import flatten_params, num_params, unflatten_params
+
+Array = jax.Array
+
+
+def _updater_spec(layer) -> UpdaterSpec:
+    return UpdaterSpec(
+        name=layer.updater or "sgd",
+        momentum=layer.momentum if layer.momentum is not None else 0.9,
+        momentum_schedule=getattr(layer, "momentum_schedule", None),
+        rho=layer.rho if layer.rho is not None else 0.95,
+        rms_decay=layer.rms_decay if layer.rms_decay is not None else 0.95,
+        adam_mean_decay=layer.adam_mean_decay if layer.adam_mean_decay is not None else 0.9,
+        adam_var_decay=layer.adam_var_decay if layer.adam_var_decay is not None else 0.999,
+        epsilon=layer.epsilon if layer.epsilon is not None else 1e-8,
+    )
+
+
+def _regularization(conf: MultiLayerConfiguration, params_list) -> Array:
+    """l1 * |W|_1 + 0.5 * l2 * ||W||^2 over regularizable params (reference
+    BaseLayer.calcL1/calcL2; gated on use_regularization like the builder's
+    .regularization(true))."""
+    if not conf.global_conf.use_regularization:
+        return jnp.float32(0.0)
+    total = jnp.float32(0.0)
+    for layer, params in zip(conf.layers, params_list):
+        for name in layer.regularizable_params():
+            if name not in params:
+                continue
+            w = params[name]
+            if layer.l1:
+                total = total + layer.l1 * jnp.sum(jnp.abs(w))
+            if layer.l2:
+                total = total + 0.5 * layer.l2 * jnp.sum(w * w)
+    return total
+
+
+def forward_fn(conf: MultiLayerConfiguration, params_list, state_list, x, *,
+               train: bool, rng: Optional[jax.Array], mask: Optional[Array] = None,
+               collect: bool = False):
+    """Pure feed-forward through all layers (reference feedForwardToLayer:680).
+    Returns (output, new_state_list, activations_list_or_None)."""
+    h = x
+    new_states = []
+    acts = [] if collect else None
+    rngs = (jax.random.split(rng, len(conf.layers))
+            if rng is not None else [None] * len(conf.layers))
+    for i, layer in enumerate(conf.layers):
+        pp = conf.preprocessor(i)
+        if pp is not None:
+            h = pp.pre_process(h, mask)
+        h, ns = layer.apply(params_list[i], state_list[i], h,
+                            train=train, rng=rngs[i], mask=mask)
+        new_states.append(ns)
+        if collect:
+            acts.append(h)
+    return h, new_states, acts
+
+
+def loss_fn(conf: MultiLayerConfiguration, params_list, state_list, x, y, rng,
+            fmask=None, lmask=None):
+    """Training loss: forward to the last (loss) layer + regularization.
+    Returns (loss, new_state_list)."""
+    layers = conf.layers
+    last = layers[-1]
+    if not last.has_loss():
+        raise ValueError("Last layer has no loss function; cannot compute supervised loss")
+    h = x
+    new_states = []
+    rngs = (jax.random.split(rng, len(layers))
+            if rng is not None else [None] * len(layers))
+    for i, layer in enumerate(layers[:-1]):
+        pp = conf.preprocessor(i)
+        if pp is not None:
+            h = pp.pre_process(h, fmask)
+        h, ns = layer.apply(params_list[i], state_list[i], h,
+                            train=True, rng=rngs[i], mask=fmask)
+        new_states.append(ns)
+    pp = conf.preprocessor(len(layers) - 1)
+    if pp is not None:
+        h = pp.pre_process(h, fmask)
+    h = last.apply_dropout(h, rngs[-1], True)
+    loss = last.compute_loss(params_list[-1], h, y, lmask)
+    new_states.append(state_list[-1])
+    return loss + _regularization(conf, params_list), new_states
+
+
+def make_train_step(conf: MultiLayerConfiguration):
+    """Build the fused train step: grads via autodiff, per-layer normalization + updater.
+    Pure: (params, states, upd_states, x, y, rng, iteration, fmask, lmask) ->
+    (params', states', upd_states', loss)."""
+    g = conf.global_conf
+
+    def train_step(params_list, state_list, upd_state, x, y, rng, iteration,
+                   fmask=None, lmask=None):
+        (loss, new_states), grads = jax.value_and_grad(
+            lambda p: loss_fn(conf, p, state_list, x, y, rng, fmask, lmask),
+            has_aux=True)(params_list)
+
+        new_params = []
+        new_upd = []
+        for i, layer in enumerate(conf.layers):
+            g_i = grads[i]
+            if not g_i:
+                new_params.append(params_list[i])
+                new_upd.append(upd_state[i])
+                continue
+            g_i = normalize_gradients(g_i, layer.gradient_normalization,
+                                      layer.gradient_normalization_threshold or 1.0)
+            spec = _updater_spec(layer)
+            lr = effective_lr(layer.learning_rate, g.lr_policy, iteration,
+                              g.lr_policy_decay_rate, g.lr_policy_power,
+                              g.lr_policy_steps, g.lr_schedule, g.max_num_iterations)
+            lr_bias = (jnp.float32(layer.bias_learning_rate)
+                       if layer.bias_learning_rate is not None else lr)
+            p_new = {}
+            u_new = {}
+            for name, grad in g_i.items():
+                this_lr = lr_bias if name in ("b", "vb", "beta") else lr
+                step, ustate = updater_step(spec, grad, upd_state[i][name],
+                                            this_lr, iteration)
+                p_new[name] = params_list[i][name] - step
+                u_new[name] = ustate
+            new_params.append(p_new)
+            new_upd.append(u_new)
+        return new_params, new_states, new_upd, loss
+
+    return train_step
+
+
+class MultiLayerNetwork:
+    """Stateful convenience shell over the pure functions above."""
+
+    def __init__(self, conf: MultiLayerConfiguration):
+        self.conf = conf
+        self.params_list: Optional[list] = None
+        self.state_list: Optional[list] = None
+        self.updater_state: Optional[list] = None
+        self.iteration = 0
+        self.epoch = 0
+        self.listeners: list = []
+        self.score_value = float("nan")
+        self._rng = None
+        self._jit_cache: dict = {}
+        self._rnn_state: Optional[list] = None  # streaming rnnTimeStep state
+
+    # ------------------------------------------------------------------ lifecycle
+    def init(self, seed: Optional[int] = None) -> "MultiLayerNetwork":
+        g = self.conf.global_conf
+        key = jax.random.PRNGKey(g.seed if seed is None else seed)
+        self._rng = jax.random.fold_in(key, 0xD14)
+        n = len(self.conf.layers)
+        keys = jax.random.split(key, n)
+        itype = self.conf.input_type
+        self.params_list = []
+        self.state_list = []
+        cur = itype
+        for i, layer in enumerate(self.conf.layers):
+            if cur is not None:
+                pp = self.conf.preprocessor(i)
+                if pp is not None:
+                    cur = pp.output_type(cur)
+            self.params_list.append(layer.init_params(keys[i], cur))
+            self.state_list.append(layer.init_state(cur))
+            if cur is not None:
+                cur = layer.output_type(cur)
+        self.updater_state = [
+            {name: updater_init(_updater_spec(layer), p)
+             for name, p in params.items()}
+            for layer, params in zip(self.conf.layers, self.params_list)
+        ]
+        return self
+
+    def set_listeners(self, *listeners) -> None:
+        self.listeners = list(listeners)
+
+    def add_listener(self, listener) -> None:
+        self.listeners.append(listener)
+
+    # ------------------------------------------------------------------ params API
+    def params(self) -> Array:
+        """Flat 1-D parameter view (reference MultiLayerNetwork.params())."""
+        return flatten_params(self.params_list)
+
+    def set_params(self, flat: Array) -> None:
+        self.params_list = unflatten_params(self.params_list, flat)
+
+    def num_params(self) -> int:
+        return num_params(self.params_list)
+
+    # ------------------------------------------------------------------ inference
+    def _jit(self, name, fn):
+        if name not in self._jit_cache:
+            self._jit_cache[name] = jax.jit(fn)
+        return self._jit_cache[name]
+
+    def output(self, x, train: bool = False) -> Array:
+        """Forward pass returning final activations (reference output:2061)."""
+        x = jnp.asarray(x)
+
+        fn = self._jit("output", functools.partial(self._output_pure, train=False))
+        out, _ = fn(self.params_list, self.state_list, x)
+        return out
+
+    def _output_pure(self, params_list, state_list, x, *, train):
+        out, ns, _ = forward_fn(self.conf, params_list, state_list, x,
+                                train=train, rng=None)
+        return out, ns
+
+    def feed_forward(self, x, train: bool = False) -> list:
+        """Per-layer activations (reference feedForward:657)."""
+        out, _, acts = forward_fn(self.conf, self.params_list, self.state_list,
+                                  jnp.asarray(x), train=train, rng=None, collect=True)
+        return acts
+
+    def predict(self, x) -> np.ndarray:
+        return np.asarray(jnp.argmax(self.output(x), axis=-1))
+
+    def score(self, x=None, y=None, dataset=None) -> float:
+        """Loss (incl. regularization) on a dataset, no dropout (reference score:1704)."""
+        if dataset is not None:
+            x, y = dataset.features, dataset.labels
+        x, y = jnp.asarray(x), jnp.asarray(y)
+        fn = self._jit("score", self._score_pure)
+        return float(fn(self.params_list, self.state_list, x, y))
+
+    def _score_pure(self, params_list, state_list, x, y):
+        layers = self.conf.layers
+        h = x
+        for i, layer in enumerate(layers[:-1]):
+            pp = self.conf.preprocessor(i)
+            if pp is not None:
+                h = pp.pre_process(h)
+            h, _ = layer.apply(params_list[i], state_list[i], h, train=False, rng=None)
+        pp = self.conf.preprocessor(len(layers) - 1)
+        if pp is not None:
+            h = pp.pre_process(h)
+        loss = layers[-1].compute_loss(params_list[-1], h, y, None)
+        return loss + _regularization(self.conf, params_list)
+
+    # ------------------------------------------------------------------ training
+    def _next_rng(self):
+        self._rng, sub = jax.random.split(self._rng)
+        return sub
+
+    def fit(self, x, y=None, *, epochs: int = 1, fmask=None, lmask=None) -> None:
+        """Fit on arrays, a DataSet, or a DataSetIterator (reference fit:978)."""
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+
+        if y is None and isinstance(x, DataSet):
+            self._fit_batch(x.features, x.labels, x.features_mask, x.labels_mask)
+            return
+        if y is None and hasattr(x, "__iter__") and not isinstance(x, (jnp.ndarray, np.ndarray)):
+            self.fit_iterator(x, epochs=epochs)
+            return
+        self._fit_batch(x, y, fmask, lmask)
+
+    def fit_iterator(self, iterator: Iterable, epochs: int = 1) -> None:
+        for _ in range(epochs):
+            for listener in self.listeners:
+                if hasattr(listener, "on_epoch_start"):
+                    listener.on_epoch_start(self)
+            if hasattr(iterator, "reset"):
+                iterator.reset()
+            if self.conf.pretrain:
+                self.pretrain(iterator)
+                if hasattr(iterator, "reset"):
+                    iterator.reset()
+            for ds in iterator:
+                self._fit_batch(ds.features, ds.labels, ds.features_mask, ds.labels_mask)
+            for listener in self.listeners:
+                if hasattr(listener, "on_epoch_end"):
+                    listener.on_epoch_end(self)
+            self.epoch += 1
+
+    def _fit_batch(self, x, y, fmask=None, lmask=None) -> None:
+        if (self.conf.backprop_type == "TruncatedBPTT"
+                and any(isinstance(l, LSTM) for l in self.conf.layers)):
+            self._fit_tbptt(x, y, fmask, lmask)
+            return
+        x, y = jnp.asarray(x), jnp.asarray(y)
+        fmask = jnp.asarray(fmask) if fmask is not None else None
+        lmask = jnp.asarray(lmask) if lmask is not None else None
+        step = self._jit("train_step", make_train_step(self.conf))
+        for _ in range(max(1, self.conf.global_conf.iterations)):
+            (self.params_list, self.state_list, self.updater_state,
+             loss) = step(self.params_list, self.state_list, self.updater_state,
+                          x, y, self._next_rng(), jnp.int32(self.iteration),
+                          fmask, lmask)
+            self.score_value = float(loss)
+            self.iteration += 1
+            for listener in self.listeners:
+                listener.iteration_done(self, self.iteration)
+
+    # ------------------------------------------------------------------ TBPTT
+    def _fit_tbptt(self, x, y, fmask=None, lmask=None) -> None:
+        """Truncated BPTT (reference doTruncatedBPTT:1140): slice the time axis into
+        tbptt_fwd_length chunks; RNN state carries across chunks via lax.stop_gradient
+        (the truncation). Time axis = 1 ([B,T,F] layout)."""
+        x, y = jnp.asarray(x), jnp.asarray(y)
+        T = x.shape[1]
+        L = self.conf.tbptt_fwd_length
+        n_chunks = max(1, math.ceil(T / L))
+        step = self._jit("tbptt_step", make_tbptt_step(self.conf))
+        rnn_state = _init_rnn_states(self.conf, x.shape[0], x.dtype)
+        for c in range(n_chunks):
+            sl = slice(c * L, min((c + 1) * L, T))
+            xc, yc = x[:, sl], y[:, sl]
+            fm = fmask[:, sl] if fmask is not None else None
+            lm = lmask[:, sl] if lmask is not None else None
+            (self.params_list, self.state_list, self.updater_state, rnn_state,
+             loss) = step(self.params_list, self.state_list, self.updater_state,
+                          rnn_state, xc, yc, self._next_rng(),
+                          jnp.int32(self.iteration), fm, lm)
+            self.score_value = float(loss)
+            self.iteration += 1
+            for listener in self.listeners:
+                listener.iteration_done(self, self.iteration)
+
+    # ------------------------------------------------------------------ pretrain
+    def pretrain(self, iterator) -> None:
+        """Greedy layerwise unsupervised pretraining (reference pretrain:152,
+        pretrainLayer:183): for each pretrain layer, feed inputs forward to it and
+        minimize its unsupervised objective."""
+        for idx, layer in enumerate(self.conf.layers):
+            if not isinstance(layer, PretrainLayer):
+                continue
+            step = jax.jit(make_pretrain_step(self.conf, idx))
+            if hasattr(iterator, "reset"):
+                iterator.reset()
+            for ds in iterator:
+                x = jnp.asarray(ds.features)
+                (self.params_list[idx], self.updater_state[idx], loss) = step(
+                    self.params_list, self.state_list, self.updater_state[idx],
+                    x, self._next_rng(), jnp.int32(self.iteration))
+                self.score_value = float(loss)
+
+    # ------------------------------------------------------------------ evaluation
+    def evaluate(self, iterator_or_x, y=None):
+        from deeplearning4j_tpu.eval.evaluation import Evaluation
+
+        ev = Evaluation()
+        if y is not None:
+            ev.eval(np.asarray(y), np.asarray(self.output(iterator_or_x)))
+            return ev
+        it = iterator_or_x
+        if hasattr(it, "reset"):
+            it.reset()
+        for ds in it:
+            out = self.output(ds.features)
+            ev.eval(np.asarray(ds.labels), np.asarray(out),
+                    mask=np.asarray(ds.labels_mask) if ds.labels_mask is not None else None)
+        return ev
+
+    def evaluate_regression(self, iterator):
+        from deeplearning4j_tpu.eval.regression import RegressionEvaluation
+
+        ev = RegressionEvaluation()
+        if hasattr(iterator, "reset"):
+            iterator.reset()
+        for ds in iterator:
+            ev.eval(np.asarray(ds.labels), np.asarray(self.output(ds.features)))
+        return ev
+
+    def evaluate_roc(self, iterator, threshold_steps: int = 30):
+        from deeplearning4j_tpu.eval.roc import ROC
+
+        roc = ROC(threshold_steps)
+        if hasattr(iterator, "reset"):
+            iterator.reset()
+        for ds in iterator:
+            roc.eval(np.asarray(ds.labels), np.asarray(self.output(ds.features)))
+        return roc
+
+    # ------------------------------------------------------------------ rnn API
+    def rnn_time_step(self, x) -> Array:
+        """Streaming inference carrying hidden state across calls (reference
+        rnnTimeStep:2196). x: [B,T,F] (T may be 1)."""
+        x = jnp.asarray(x)
+        if self._rnn_state is None:
+            self._rnn_state = _init_rnn_states(self.conf, x.shape[0], x.dtype)
+        fn = self._jit("rnn_time_step", functools.partial(_rnn_forward, self.conf))
+        out, self._rnn_state = fn(self.params_list, self.state_list,
+                                  self._rnn_state, x)
+        return out
+
+    def rnn_clear_previous_state(self) -> None:
+        self._rnn_state = None
+
+    # ------------------------------------------------------------------ grads (for checks)
+    def gradient_and_score(self, x, y, fmask=None, lmask=None):
+        """(grads pytree, score) without updating params (reference
+        computeGradientAndScore:1807). Deterministic: no dropout rng."""
+        x, y = jnp.asarray(x), jnp.asarray(y)
+
+        def lf(p):
+            loss, _ = loss_fn(self.conf, p, self.state_list, x, y, None, fmask, lmask)
+            return loss
+
+        loss, grads = jax.value_and_grad(lf)(self.params_list)
+        return grads, float(loss)
+
+    def clone(self) -> "MultiLayerNetwork":
+        import copy
+
+        net = MultiLayerNetwork(copy.deepcopy(self.conf))
+        net.params_list = jax.tree_util.tree_map(lambda a: a, self.params_list)
+        net.state_list = jax.tree_util.tree_map(lambda a: a, self.state_list)
+        net.updater_state = jax.tree_util.tree_map(lambda a: a, self.updater_state)
+        net.iteration = self.iteration
+        net._rng = self._rng
+        return net
+
+
+# ---------------------------------------------------------------------- rnn helpers
+def _init_rnn_states(conf, batch, dtype):
+    states = []
+    for layer in conf.layers:
+        if isinstance(layer, LSTM):
+            states.append({"h": jnp.zeros((batch, layer.n_out), dtype),
+                           "c": jnp.zeros((batch, layer.n_out), dtype)})
+        else:
+            states.append({})
+    return states
+
+
+def _rnn_forward(conf, params_list, state_list, rnn_states, x):
+    """Forward pass threading LSTM streaming state (pure)."""
+    h = x
+    new_rnn = []
+    for i, layer in enumerate(conf.layers):
+        pp = conf.preprocessor(i)
+        if pp is not None:
+            h = pp.pre_process(h)
+        if isinstance(layer, LSTM) and not type(layer).__name__.startswith("GravesBidirectional"):
+            h, rs = layer.apply_streaming(params_list[i], rnn_states[i], h)
+            new_rnn.append(rs)
+        else:
+            h, _ = layer.apply(params_list[i], state_list[i], h, train=False, rng=None)
+            new_rnn.append(rnn_states[i])
+    return h, new_rnn
+
+
+def make_tbptt_step(conf: MultiLayerConfiguration):
+    """TBPTT train step: like make_train_step but threads LSTM state across chunks,
+    truncating gradients at chunk boundaries with stop_gradient."""
+    g = conf.global_conf
+
+    def tbptt_step(params_list, state_list, upd_state, rnn_states, x, y, rng,
+                   iteration, fmask=None, lmask=None):
+        def lf(p):
+            h = x
+            new_rnn = []
+            rngs = jax.random.split(rng, len(conf.layers)) if rng is not None else None
+            for i, layer in enumerate(conf.layers[:-1]):
+                pp = conf.preprocessor(i)
+                if pp is not None:
+                    h = pp.pre_process(h, fmask)
+                if isinstance(layer, LSTM) and not type(layer).__name__.startswith("GravesBidirectional"):
+                    h, rs = layer.apply_streaming(p[i], rnn_states[i], h, mask=fmask)
+                    new_rnn.append(jax.tree_util.tree_map(jax.lax.stop_gradient, rs))
+                else:
+                    h, _ = layer.apply(p[i], state_list[i], h, train=True,
+                                       rng=rngs[i], mask=fmask)
+                    new_rnn.append(rnn_states[i])
+            last = conf.layers[-1]
+            h = last.apply_dropout(h, rngs[-1], True)
+            loss = last.compute_loss(p[-1], h, y, lmask)
+            new_rnn.append(rnn_states[-1])
+            return loss + _regularization(conf, p), new_rnn
+
+        (loss, new_rnn), grads = jax.value_and_grad(lf, has_aux=True)(params_list)
+        new_params = []
+        new_upd = []
+        for i, layer in enumerate(conf.layers):
+            g_i = grads[i]
+            if not g_i:
+                new_params.append(params_list[i])
+                new_upd.append(upd_state[i])
+                continue
+            g_i = normalize_gradients(g_i, layer.gradient_normalization,
+                                      layer.gradient_normalization_threshold or 1.0)
+            spec = _updater_spec(layer)
+            lr = effective_lr(layer.learning_rate, g.lr_policy, iteration,
+                              g.lr_policy_decay_rate, g.lr_policy_power,
+                              g.lr_policy_steps, g.lr_schedule, g.max_num_iterations)
+            p_new, u_new = {}, {}
+            for name, grad in g_i.items():
+                step, ustate = updater_step(spec, grad, upd_state[i][name], lr, iteration)
+                p_new[name] = params_list[i][name] - step
+                u_new[name] = ustate
+            new_params.append(p_new)
+            new_upd.append(u_new)
+        return new_params, state_list, new_upd, new_rnn, loss
+
+    return tbptt_step
+
+
+def make_pretrain_step(conf: MultiLayerConfiguration, layer_idx: int):
+    """Unsupervised pretrain step for layer ``layer_idx`` (reference pretrainLayer:183):
+    forward (no dropout) through preceding layers, minimize the layer's pretrain loss
+    wrt ITS params only."""
+    g = conf.global_conf
+    layer = conf.layers[layer_idx]
+
+    def pretrain_step(params_list, state_list, layer_upd_state, x, rng, iteration):
+        h = x
+        for i in range(layer_idx):
+            pp = conf.preprocessor(i)
+            if pp is not None:
+                h = pp.pre_process(h)
+            h, _ = conf.layers[i].apply(params_list[i], state_list[i], h,
+                                        train=False, rng=None)
+        pp = conf.preprocessor(layer_idx)
+        if pp is not None:
+            h = pp.pre_process(h)
+        h = jax.lax.stop_gradient(h)
+
+        def lf(p):
+            return layer.pretrain_loss(p, h, rng=rng)
+
+        loss, grads = jax.value_and_grad(lf)(params_list[layer_idx])
+        grads = normalize_gradients(grads, layer.gradient_normalization,
+                                    layer.gradient_normalization_threshold or 1.0)
+        spec = _updater_spec(layer)
+        lr = effective_lr(layer.learning_rate, g.lr_policy, iteration,
+                          g.lr_policy_decay_rate, g.lr_policy_power,
+                          g.lr_policy_steps, g.lr_schedule, g.max_num_iterations)
+        p_new, u_new = {}, {}
+        for name, grad in grads.items():
+            step, ustate = updater_step(spec, grad, layer_upd_state[name], lr, iteration)
+            p_new[name] = params_list[layer_idx][name] - step
+            u_new[name] = ustate
+        return p_new, u_new, loss
+
+    return pretrain_step

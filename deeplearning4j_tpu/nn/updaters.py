@@ -1,0 +1,172 @@
+"""Gradient updaters, learning-rate schedules, and gradient normalization/clipping.
+
+Parity target: the reference's updater stack — per-variable ``GradientUpdater`` math
+(ND4J org.nd4j.linalg.learning: Sgd/Nesterovs/Adam/AdaGrad/RmsProp/AdaDelta/NoOp,
+imported at nn/updater/LayerUpdater.java:18), learning-rate schedules/policies
+(LayerUpdater.java:135-154), and gradient normalization/clipping
+(LayerUpdater.java:182-221). Implemented optax-style as pure (init, update) pairs over
+param pytrees so the whole update fuses into the jitted train step; per-layer
+hyperparameter overrides are resolved by the network from layer configs.
+
+Update sign convention: ``update(grad, ...)`` returns the *step to subtract* from params
+(params_new = params - step), matching the reference's
+StochasticGradientDescent.stepFunction (NegativeGradientStepFunction).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+# --------------------------------------------------------------------------- schedules
+def effective_lr(base_lr: float, policy: Optional[str], iteration,
+                 decay: float = 0.0, power: float = 0.0, steps: float = 1.0,
+                 schedule: Optional[dict] = None, max_iterations: int = 1) -> Array:
+    """Learning rate at ``iteration`` per DL4J LearningRatePolicy semantics
+    (reference LayerUpdater.applyLrDecayPolicy:135-154)."""
+    it = jnp.asarray(iteration, jnp.float32)
+    p = (policy or "none").lower()
+    if p in ("none", "fixed"):
+        return jnp.asarray(base_lr, jnp.float32)
+    if p == "exponential":
+        return base_lr * jnp.power(decay, it)
+    if p == "inverse":
+        return base_lr / jnp.power(1.0 + decay * it, power)
+    if p == "poly":
+        return base_lr * jnp.power(1.0 - it / max(max_iterations, 1), power)
+    if p == "sigmoid":
+        return base_lr / (1.0 + jnp.exp(-decay * (it - steps)))
+    if p == "step":
+        return base_lr * jnp.power(decay, jnp.floor(it / steps))
+    if p == "schedule":
+        # piecewise-constant map {iteration: lr}: lr of the largest key <= iteration
+        lr = jnp.asarray(base_lr, jnp.float32)
+        for k in sorted((schedule or {}).keys(), key=int):
+            lr = jnp.where(it >= int(k), jnp.float32((schedule or {})[k]), lr)
+        return lr
+    raise ValueError(f"Unknown lr policy '{policy}'")
+
+
+def scheduled_value(base: float, schedule: Optional[dict], iteration) -> Array:
+    """Momentum-after style schedules: {iteration: value} (reference momentumSchedule)."""
+    val = jnp.asarray(base, jnp.float32)
+    if schedule:
+        it = jnp.asarray(iteration, jnp.float32)
+        for k in sorted(schedule.keys(), key=int):
+            val = jnp.where(it >= int(k), jnp.float32(schedule[k]), val)
+    return val
+
+
+# --------------------------------------------------------------------------- updaters
+@dataclasses.dataclass(frozen=True)
+class UpdaterSpec:
+    """Resolved per-layer updater hyperparameters."""
+
+    name: str = "sgd"
+    momentum: float = 0.9
+    momentum_schedule: Optional[dict] = None
+    rho: float = 0.95              # adadelta
+    rms_decay: float = 0.95
+    adam_mean_decay: float = 0.9
+    adam_var_decay: float = 0.999
+    epsilon: float = 1e-8
+
+
+def updater_init(spec: UpdaterSpec, param: Array) -> dict:
+    n = spec.name.lower()
+    z = lambda: jnp.zeros_like(param)
+    if n in ("sgd", "none", "noop"):
+        return {}
+    if n in ("nesterovs", "nesterov", "momentum"):
+        return {"v": z()}
+    if n == "adam":
+        return {"m": z(), "v": z()}
+    if n == "adagrad":
+        return {"h": z()}
+    if n == "rmsprop":
+        return {"g2": z()}
+    if n == "adadelta":
+        return {"msg": z(), "msdx": z()}
+    if n == "adamax":
+        return {"m": z(), "u": z()}
+    raise ValueError(f"Unknown updater '{spec.name}'")
+
+
+def updater_step(spec: UpdaterSpec, grad: Array, state: dict, lr: Array,
+                 iteration) -> tuple[Array, dict]:
+    """One update. Math mirrors ND4J org.nd4j.linalg.learning.* formulas."""
+    n = spec.name.lower()
+    eps = spec.epsilon
+    if n in ("none", "noop"):
+        return jnp.zeros_like(grad), state
+    if n == "sgd":
+        return lr * grad, state
+    if n in ("nesterovs", "nesterov", "momentum"):
+        # ND4J Nesterovs: v = mu*v_prev - lr*g; applied delta = -mu*v_prev + (1+mu)*v,
+        # returned here as the subtractend (step = -delta).
+        mu = scheduled_value(spec.momentum, spec.momentum_schedule, iteration)
+        v_prev = state["v"]
+        v = mu * v_prev - lr * grad
+        step = mu * v_prev - (1 + mu) * v
+        return step, {"v": v}
+    if n == "adam":
+        b1, b2 = spec.adam_mean_decay, spec.adam_var_decay
+        t = jnp.asarray(iteration, jnp.float32) + 1.0
+        m = b1 * state["m"] + (1 - b1) * grad
+        v = b2 * state["v"] + (1 - b2) * grad * grad
+        alpha = lr * jnp.sqrt(1 - jnp.power(b2, t)) / (1 - jnp.power(b1, t))
+        return alpha * m / (jnp.sqrt(v) + eps), {"m": m, "v": v}
+    if n == "adamax":
+        b1, b2 = spec.adam_mean_decay, spec.adam_var_decay
+        t = jnp.asarray(iteration, jnp.float32) + 1.0
+        m = b1 * state["m"] + (1 - b1) * grad
+        u = jnp.maximum(b2 * state["u"], jnp.abs(grad))
+        return lr / (1 - jnp.power(b1, t)) * m / (u + eps), {"m": m, "u": u}
+    if n == "adagrad":
+        h = state["h"] + grad * grad
+        return lr * grad / (jnp.sqrt(h) + eps), {"h": h}
+    if n == "rmsprop":
+        d = spec.rms_decay
+        g2 = d * state["g2"] + (1 - d) * grad * grad
+        return lr * grad / jnp.sqrt(g2 + eps), {"g2": g2}
+    if n == "adadelta":
+        rho = spec.rho
+        msg = rho * state["msg"] + (1 - rho) * grad * grad
+        dx = grad * jnp.sqrt(state["msdx"] + eps) / jnp.sqrt(msg + eps)
+        msdx = rho * state["msdx"] + (1 - rho) * dx * dx
+        return dx, {"msg": msg, "msdx": msdx}
+    raise ValueError(f"Unknown updater '{spec.name}'")
+
+
+# ------------------------------------------------------------- gradient normalization
+def normalize_gradients(grads: dict, kind: Optional[str], threshold: float) -> dict:
+    """Per-layer gradient normalization/clipping applied BEFORE the updater, matching
+    reference LayerUpdater.preApply ordering (:182-221). ``grads`` is one layer's
+    {param_name: grad} dict."""
+    if not kind or kind.lower() in ("none",):
+        return grads
+    k = kind.lower()
+    if k == "renormalizel2perlayer":
+        norm = jnp.sqrt(sum(jnp.sum(g * g) for g in grads.values()) + 1e-12)
+        return {n: g / norm for n, g in grads.items()}
+    if k == "renormalizel2perparamtype":
+        return {n: g / jnp.sqrt(jnp.sum(g * g) + 1e-12) for n, g in grads.items()}
+    if k == "clipelementwiseabsolutevalue":
+        t = threshold
+        return {n: jnp.clip(g, -t, t) for n, g in grads.items()}
+    if k == "clipl2perlayer":
+        norm = jnp.sqrt(sum(jnp.sum(g * g) for g in grads.values()) + 1e-12)
+        scale = jnp.minimum(1.0, threshold / norm)
+        return {n: g * scale for n, g in grads.items()}
+    if k == "clipl2perparamtype":
+        out = {}
+        for n, g in grads.items():
+            norm = jnp.sqrt(jnp.sum(g * g) + 1e-12)
+            out[n] = g * jnp.minimum(1.0, threshold / norm)
+        return out
+    raise ValueError(f"Unknown gradient normalization '{kind}'")

@@ -1,0 +1,101 @@
+"""Weight initialization schemes.
+
+Parity with the reference's ``WeightInit`` enum + ``WeightInitUtil``
+(reference nn/weights/WeightInit.java, nn/weights/WeightInitUtil.java). Fan-in/fan-out
+are computed from the param shape the same way (for conv kernels: fanIn =
+inChannels*kh*kw, fanOut = outChannels*kh*kw).
+
+All initializers take an explicit ``jax.random`` key — the functional replacement for
+the reference's global ND4J RNG, and the thing that makes init reproducible under
+`jit`/`shard_map`.
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+def fan_in_out(shape: Sequence[int]) -> tuple[float, float]:
+    """(fan_in, fan_out) for dense [in, out] or conv [kh, kw, in, out] shapes."""
+    if len(shape) == 2:
+        return float(shape[0]), float(shape[1])
+    if len(shape) == 4:
+        receptive = shape[0] * shape[1]
+        return float(shape[2] * receptive), float(shape[3] * receptive)
+    if len(shape) == 1:
+        return float(shape[0]), float(shape[0])
+    receptive = 1
+    for s in shape[:-2]:
+        receptive *= s
+    return float(shape[-2] * receptive), float(shape[-1] * receptive)
+
+
+def init_weights(key: jax.Array, shape: Sequence[int], scheme: str,
+                 distribution: Optional[dict] = None,
+                 dtype=jnp.float32) -> Array:
+    """Initialize a weight tensor per DL4J WeightInit scheme name."""
+    scheme = str(scheme).lower()
+    fan_in, fan_out = fan_in_out(shape)
+    shape = tuple(shape)
+
+    if scheme == "zero":
+        return jnp.zeros(shape, dtype)
+    if scheme == "one":
+        return jnp.ones(shape, dtype)
+    if scheme == "normal":
+        # DL4J NORMAL: N(0, 1/sqrt(fanIn))
+        return jax.random.normal(key, shape, dtype) / math.sqrt(fan_in)
+    if scheme == "uniform":
+        a = 1.0 / math.sqrt(fan_in)
+        return jax.random.uniform(key, shape, dtype, -a, a)
+    if scheme == "xavier":
+        # DL4J XAVIER: N(0, 2/(fanIn+fanOut))
+        return jax.random.normal(key, shape, dtype) * math.sqrt(2.0 / (fan_in + fan_out))
+    if scheme == "xavier_uniform":
+        a = math.sqrt(6.0 / (fan_in + fan_out))
+        return jax.random.uniform(key, shape, dtype, -a, a)
+    if scheme == "xavier_fan_in":
+        return jax.random.normal(key, shape, dtype) * math.sqrt(1.0 / fan_in)
+    if scheme == "xavier_legacy":
+        return jax.random.normal(key, shape, dtype) * math.sqrt(1.0 / (fan_in + fan_out))
+    if scheme == "relu":
+        # He init: N(0, 2/fanIn)
+        return jax.random.normal(key, shape, dtype) * math.sqrt(2.0 / fan_in)
+    if scheme == "relu_uniform":
+        a = math.sqrt(6.0 / fan_in)
+        return jax.random.uniform(key, shape, dtype, -a, a)
+    if scheme == "sigmoid_uniform":
+        a = 4.0 * math.sqrt(6.0 / (fan_in + fan_out))
+        return jax.random.uniform(key, shape, dtype, -a, a)
+    if scheme == "lecun_normal":
+        return jax.random.normal(key, shape, dtype) * math.sqrt(1.0 / fan_in)
+    if scheme == "lecun_uniform":
+        a = math.sqrt(3.0 / fan_in)
+        return jax.random.uniform(key, shape, dtype, -a, a)
+    if scheme == "distribution":
+        return _from_distribution(key, shape, distribution or {}, dtype)
+    raise ValueError(f"Unknown weight init scheme '{scheme}'")
+
+
+def _from_distribution(key, shape, dist: dict, dtype) -> Array:
+    """DL4J Distribution configs: {"type": "normal"|"uniform"|"binomial", ...}
+    (reference nn/conf/distribution/*.java)."""
+    kind = str(dist.get("type", "normal")).lower()
+    if kind in ("normal", "gaussian"):
+        mean = float(dist.get("mean", 0.0))
+        std = float(dist.get("std", 1.0))
+        return mean + std * jax.random.normal(key, shape, dtype)
+    if kind == "uniform":
+        lower = float(dist.get("lower", -1.0))
+        upper = float(dist.get("upper", 1.0))
+        return jax.random.uniform(key, shape, dtype, lower, upper)
+    if kind == "binomial":
+        n = int(dist.get("n", dist.get("numberOfTrials", 1)))
+        p = float(dist.get("p", dist.get("probabilityOfSuccess", 0.5)))
+        return jax.random.binomial(key, n, p, shape=shape).astype(dtype)
+    raise ValueError(f"Unknown distribution type '{kind}'")

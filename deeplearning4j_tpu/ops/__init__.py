@@ -1,0 +1,8 @@
+"""Low-level op layer: activations, losses, weight init, conv/pool primitives.
+
+This is the substrate the reference gets from ND4J/libnd4j (external C++ backends);
+here it is jax.numpy / lax, compiled by XLA:TPU, with Pallas kernels for fused
+hot paths (see deeplearning4j_tpu.ops.pallas_kernels).
+"""
+from deeplearning4j_tpu.ops.activations import get_activation, ACTIVATIONS
+from deeplearning4j_tpu.ops.losses import get_loss, LOSSES

@@ -1,0 +1,130 @@
+"""Activation functions.
+
+Capability parity with the reference's ``IActivation`` set (ND4J
+org.nd4j.linalg.activations, referenced from nn/conf/NeuralNetConfiguration.java:478
+``activationFn``). All are pure jnp functions — XLA fuses them into adjacent
+matmuls/convs on TPU, which replaces the reference's separate elementwise op dispatch.
+
+Names are matched case-insensitively to the DL4J enum names so imported / serialized
+configs round-trip.
+"""
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+def identity(x: Array) -> Array:
+    return x
+
+
+def relu(x: Array) -> Array:
+    return jnp.maximum(x, 0)
+
+
+def relu6(x: Array) -> Array:
+    return jnp.clip(x, 0, 6)
+
+
+def leakyrelu(x: Array, alpha: float = 0.01) -> Array:
+    return jnp.where(x >= 0, x, alpha * x)
+
+
+def elu(x: Array, alpha: float = 1.0) -> Array:
+    safe = jnp.where(x > 0, 0.0, x)
+    return jnp.where(x > 0, x, alpha * (jnp.exp(safe) - 1.0))
+
+
+def selu(x: Array) -> Array:
+    return jax.nn.selu(x)
+
+
+def sigmoid(x: Array) -> Array:
+    return jax.nn.sigmoid(x)
+
+
+def hardsigmoid(x: Array) -> Array:
+    return jnp.clip(0.2 * x + 0.5, 0.0, 1.0)
+
+
+def tanh(x: Array) -> Array:
+    return jnp.tanh(x)
+
+
+def hardtanh(x: Array) -> Array:
+    return jnp.clip(x, -1.0, 1.0)
+
+
+def rationaltanh(x: Array) -> Array:
+    # 1.7159 * tanh(2x/3) approximation via rational function (DL4J ActivationRationalTanh)
+    return 1.7159 * jnp.tanh(2.0 * x / 3.0)
+
+
+def rectifiedtanh(x: Array) -> Array:
+    return jnp.maximum(0.0, jnp.tanh(x))
+
+
+def softmax(x: Array) -> Array:
+    return jax.nn.softmax(x, axis=-1)
+
+
+def logsoftmax(x: Array) -> Array:
+    return jax.nn.log_softmax(x, axis=-1)
+
+
+def softplus(x: Array) -> Array:
+    return jax.nn.softplus(x)
+
+
+def softsign(x: Array) -> Array:
+    return jax.nn.soft_sign(x)
+
+
+def cube(x: Array) -> Array:
+    return x ** 3
+
+
+def swish(x: Array) -> Array:
+    return x * jax.nn.sigmoid(x)
+
+
+def gelu(x: Array) -> Array:
+    return jax.nn.gelu(x)
+
+
+ACTIVATIONS: dict[str, Callable[[Array], Array]] = {
+    "identity": identity,
+    "linear": identity,
+    "relu": relu,
+    "relu6": relu6,
+    "leakyrelu": leakyrelu,
+    "elu": elu,
+    "selu": selu,
+    "sigmoid": sigmoid,
+    "hardsigmoid": hardsigmoid,
+    "tanh": tanh,
+    "hardtanh": hardtanh,
+    "rationaltanh": rationaltanh,
+    "rectifiedtanh": rectifiedtanh,
+    "softmax": softmax,
+    "logsoftmax": logsoftmax,
+    "softplus": softplus,
+    "softsign": softsign,
+    "cube": cube,
+    "swish": swish,
+    "gelu": gelu,
+}
+
+
+def get_activation(name) -> Callable[[Array], Array]:
+    """Resolve an activation by DL4J-style name (case-insensitive) or pass a callable through."""
+    if callable(name):
+        return name
+    key = str(name).lower()
+    if key not in ACTIVATIONS:
+        raise ValueError(f"Unknown activation '{name}'. Known: {sorted(ACTIVATIONS)}")
+    return ACTIVATIONS[key]

@@ -1,0 +1,172 @@
+"""Loss functions.
+
+Parity with the reference's ``ILossFunction`` family (ND4J org.nd4j.linalg.lossfunctions,
+used by output-layer configs, reference nn/conf/layers/OutputLayer.java). Each loss is a
+pure function ``loss(labels, preout, activation, mask) -> scalar mean score`` where
+``preout`` is the pre-activation output of the final layer; applying the activation inside
+the loss lets us use numerically-stable fused forms (softmax+CE, sigmoid+BCE) — the
+TPU-native equivalent of the reference's computeGradient analytic pairings.
+
+Per-example scores (for masking and per-output weighting) are computed then mean-reduced
+over batch; mask arrays broadcast over the output dim (reference BaseEvaluation masking).
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+def _reduce(per_example: Array, mask: Optional[Array]) -> Array:
+    """Mean over examples (per_example has trailing dim 1), honoring an optional
+    {0,1} mask over the leading (batch[, time]) dims."""
+    if mask is None:
+        return jnp.mean(per_example)
+    mask = mask.astype(per_example.dtype)
+    while mask.ndim < per_example.ndim:
+        mask = mask[..., None]
+    return jnp.sum(per_example * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+
+
+def mse(labels: Array, preout: Array, activation, mask=None) -> Array:
+    out = activation(preout)
+    per = jnp.sum((labels - out) ** 2, axis=-1, keepdims=True) / labels.shape[-1]
+    return _reduce(per, mask)
+
+
+def l2(labels: Array, preout: Array, activation, mask=None) -> Array:
+    out = activation(preout)
+    per = jnp.sum((labels - out) ** 2, axis=-1, keepdims=True)
+    return _reduce(per, mask)
+
+
+def mae(labels: Array, preout: Array, activation, mask=None) -> Array:
+    out = activation(preout)
+    per = jnp.sum(jnp.abs(labels - out), axis=-1, keepdims=True) / labels.shape[-1]
+    return _reduce(per, mask)
+
+
+def l1(labels: Array, preout: Array, activation, mask=None) -> Array:
+    out = activation(preout)
+    per = jnp.sum(jnp.abs(labels - out), axis=-1, keepdims=True)
+    return _reduce(per, mask)
+
+
+def mape(labels: Array, preout: Array, activation, mask=None) -> Array:
+    out = activation(preout)
+    per = 100.0 * jnp.sum(jnp.abs((labels - out) / jnp.where(labels == 0, 1e-8, labels)),
+                          axis=-1, keepdims=True) / labels.shape[-1]
+    return _reduce(per, mask)
+
+
+def msle(labels: Array, preout: Array, activation, mask=None) -> Array:
+    out = activation(preout)
+    per = jnp.sum((jnp.log1p(jnp.maximum(labels, 0)) - jnp.log1p(jnp.maximum(out, -0.999999))) ** 2,
+                  axis=-1, keepdims=True) / labels.shape[-1]
+    return _reduce(per, mask)
+
+
+def _is_softmax(activation) -> bool:
+    return getattr(activation, "__name__", "") in ("softmax", "logsoftmax")
+
+
+def _is_sigmoid(activation) -> bool:
+    return getattr(activation, "__name__", "") == "sigmoid"
+
+
+def mcxent(labels: Array, preout: Array, activation, mask=None) -> Array:
+    """Multi-class cross entropy (reference LossMCXENT). Fused log-softmax when the
+    output activation is softmax (the common OutputLayer pairing)."""
+    if _is_softmax(activation):
+        logp = jax.nn.log_softmax(preout, axis=-1)
+    else:
+        out = activation(preout)
+        logp = jnp.log(jnp.clip(out, 1e-10, 1.0))
+    per = -jnp.sum(labels * logp, axis=-1, keepdims=True)
+    return _reduce(per, mask)
+
+
+def negativeloglikelihood(labels, preout, activation, mask=None) -> Array:
+    return mcxent(labels, preout, activation, mask)
+
+
+def xent(labels: Array, preout: Array, activation, mask=None) -> Array:
+    """Binary cross entropy (reference LossBinaryXENT). Fused stable form for sigmoid."""
+    if _is_sigmoid(activation):
+        # log(sigmoid(x)) = -softplus(-x); log(1-sigmoid(x)) = -softplus(x)
+        per = jnp.sum(labels * jax.nn.softplus(-preout) + (1 - labels) * jax.nn.softplus(preout),
+                      axis=-1, keepdims=True)
+    else:
+        out = jnp.clip(activation(preout), 1e-10, 1 - 1e-10)
+        per = -jnp.sum(labels * jnp.log(out) + (1 - labels) * jnp.log(1 - out),
+                       axis=-1, keepdims=True)
+    return _reduce(per, mask)
+
+
+def hinge(labels: Array, preout: Array, activation, mask=None) -> Array:
+    out = activation(preout)
+    # labels in {-1, +1} or {0, 1} (mapped)
+    y = jnp.where(labels <= 0, -1.0, 1.0)
+    per = jnp.sum(jnp.maximum(0.0, 1.0 - y * out), axis=-1, keepdims=True)
+    return _reduce(per, mask)
+
+
+def squared_hinge(labels: Array, preout: Array, activation, mask=None) -> Array:
+    out = activation(preout)
+    y = jnp.where(labels <= 0, -1.0, 1.0)
+    per = jnp.sum(jnp.maximum(0.0, 1.0 - y * out) ** 2, axis=-1, keepdims=True)
+    return _reduce(per, mask)
+
+
+def kl_divergence(labels: Array, preout: Array, activation, mask=None) -> Array:
+    out = jnp.clip(activation(preout), 1e-10, 1.0)
+    lbl = jnp.clip(labels, 1e-10, 1.0)
+    per = jnp.sum(lbl * (jnp.log(lbl) - jnp.log(out)), axis=-1, keepdims=True)
+    return _reduce(per, mask)
+
+
+def poisson(labels: Array, preout: Array, activation, mask=None) -> Array:
+    out = jnp.maximum(activation(preout), 1e-10)
+    per = jnp.sum(out - labels * jnp.log(out), axis=-1, keepdims=True)
+    return _reduce(per, mask)
+
+
+def cosine_proximity(labels: Array, preout: Array, activation, mask=None) -> Array:
+    out = activation(preout)
+    ln = jnp.linalg.norm(labels, axis=-1, keepdims=True)
+    on = jnp.linalg.norm(out, axis=-1, keepdims=True)
+    per = -jnp.sum(labels * out, axis=-1, keepdims=True) / jnp.maximum(ln * on, 1e-10)
+    return _reduce(per, mask)
+
+
+LOSSES: dict[str, Callable] = {
+    "mse": mse,
+    "l2": l2,
+    "mae": mae,
+    "l1": l1,
+    "mape": mape,
+    "msle": msle,
+    "mcxent": mcxent,
+    "negativeloglikelihood": negativeloglikelihood,
+    "xent": xent,
+    "hinge": hinge,
+    "squared_hinge": squared_hinge,
+    "squaredhinge": squared_hinge,
+    "kl_divergence": kl_divergence,
+    "kld": kl_divergence,
+    "reconstruction_crossentropy": xent,
+    "poisson": poisson,
+    "cosine_proximity": cosine_proximity,
+}
+
+
+def get_loss(name) -> Callable:
+    if callable(name):
+        return name
+    key = str(name).lower()
+    if key not in LOSSES:
+        raise ValueError(f"Unknown loss '{name}'. Known: {sorted(LOSSES)}")
+    return LOSSES[key]

@@ -1,0 +1,4 @@
+from deeplearning4j_tpu.optimize.listeners import (
+    IterationListener, ScoreIterationListener, PerformanceListener,
+    CollectScoresIterationListener, TimeIterationListener,
+)

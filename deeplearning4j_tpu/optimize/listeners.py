@@ -1,0 +1,100 @@
+"""Training listeners.
+
+Reference: optimize/api/IterationListener.java + TrainingListener.java and
+optimize/listeners/*.java (ScoreIterationListener, PerformanceListener,
+CollectScoresIterationListener). Listeners run on host between jitted steps — exactly
+the reference's seam (StochasticGradientDescent.java:64 iterationDone), so the
+training-UI / stats pipeline attaches here identically.
+"""
+from __future__ import annotations
+
+import logging
+import time
+from typing import Optional
+
+log = logging.getLogger(__name__)
+
+
+class IterationListener:
+    """Base listener (reference optimize/api/IterationListener.java)."""
+
+    def iteration_done(self, model, iteration: int) -> None:
+        pass
+
+    def on_epoch_start(self, model) -> None:
+        pass
+
+    def on_epoch_end(self, model) -> None:
+        pass
+
+
+TrainingListener = IterationListener  # epoch hooks included above
+
+
+class ScoreIterationListener(IterationListener):
+    """Log score every N iterations (reference ScoreIterationListener.java)."""
+
+    def __init__(self, print_iterations: int = 10):
+        self.print_iterations = max(1, print_iterations)
+
+    def iteration_done(self, model, iteration: int) -> None:
+        if iteration % self.print_iterations == 0:
+            log.info("Score at iteration %d is %s", iteration, model.score_value)
+            print(f"Score at iteration {iteration} is {model.score_value}")
+
+
+class PerformanceListener(IterationListener):
+    """Throughput reporting: samples/sec + batches/sec (reference
+    PerformanceListener.java). Used by bench.py for the headline metric."""
+
+    def __init__(self, frequency: int = 1, report: bool = True):
+        self.frequency = max(1, frequency)
+        self.report = report
+        self.last_time: Optional[float] = None
+        self.last_iter = 0
+        self.samples_per_sec = 0.0
+        self.batches_per_sec = 0.0
+        self.batch_size = 0
+
+    def iteration_done(self, model, iteration: int) -> None:
+        now = time.perf_counter()
+        if self.last_time is not None and iteration % self.frequency == 0:
+            dt = now - self.last_time
+            iters = iteration - self.last_iter
+            if dt > 0 and iters > 0:
+                self.batches_per_sec = iters / dt
+                self.samples_per_sec = self.batches_per_sec * self.batch_size
+                if self.report:
+                    print(f"iteration {iteration}: {self.batches_per_sec:.1f} batches/sec, "
+                          f"{self.samples_per_sec:.1f} samples/sec")
+        if iteration % self.frequency == 0:
+            self.last_time = now
+            self.last_iter = iteration
+
+
+class CollectScoresIterationListener(IterationListener):
+    """Collect (iteration, score) pairs (reference CollectScoresIterationListener.java)."""
+
+    def __init__(self, frequency: int = 1):
+        self.frequency = max(1, frequency)
+        self.scores: list[tuple[int, float]] = []
+
+    def iteration_done(self, model, iteration: int) -> None:
+        if iteration % self.frequency == 0:
+            self.scores.append((iteration, model.score_value))
+
+
+class TimeIterationListener(IterationListener):
+    """Estimate remaining training time (reference TimeIterationListener.java)."""
+
+    def __init__(self, total_iterations: int):
+        self.total_iterations = total_iterations
+        self.start = time.perf_counter()
+
+    def iteration_done(self, model, iteration: int) -> None:
+        elapsed = time.perf_counter() - self.start
+        if iteration > 0:
+            remaining = elapsed / iteration * (self.total_iterations - iteration)
+            if iteration % 50 == 0:
+                print(f"iteration {iteration}/{self.total_iterations}, "
+                      f"ETA {remaining:.0f}s")

@@ -1,0 +1,12 @@
+#!/usr/bin/env bash
+# Run the test suite on a virtual 8-device CPU mesh (reference runtests.sh analog).
+#
+# PALLAS_AXON_POOL_IPS is cleared so the axon TPU-relay sitecustomize doesn't dial
+# the tunnel for CPU-only test runs (it can hang interpreter startup); tests never
+# need the real chip. bench.py, by contrast, runs under the default env to use it.
+set -e
+cd "$(dirname "$0")"
+PALLAS_AXON_POOL_IPS= \
+JAX_PLATFORMS=cpu \
+XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+python -m pytest tests/ -q "$@"

@@ -1,0 +1,22 @@
+"""Test configuration: run the whole suite on a virtual 8-device CPU mesh.
+
+Mirrors the reference's backend-profile testing (reference pom.xml:123-150,
+test-nd4j-native profile; Spark tests' local[N] master at BaseSparkTest.java:90):
+the same tests validate single-device math and multi-device sharding without TPU
+hardware. MUST set env vars before jax import.
+"""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    return jax.devices()

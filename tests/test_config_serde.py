@@ -1,0 +1,117 @@
+"""Config DSL + JSON/YAML round-trip tests.
+
+Modeled on the reference's config serde battery (deeplearning4j-core src/test
+MultiLayerTest / serde tests): toJson->fromJson must reproduce the configuration.
+"""
+import dataclasses
+
+from deeplearning4j_tpu.nn.conf.builders import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import (
+    BatchNormalization, ConvolutionLayer, DenseLayer, GravesLSTM, OutputLayer,
+    RnnOutputLayer, SubsamplingLayer,
+)
+from deeplearning4j_tpu.nn.conf.multilayer import MultiLayerConfiguration
+
+
+def lenet_conf():
+    return (NeuralNetConfiguration.builder()
+            .seed(12345)
+            .learning_rate(0.01)
+            .updater("nesterovs").momentum(0.9)
+            .weight_init("xavier")
+            .list()
+            .layer(ConvolutionLayer(n_out=20, kernel_size=(5, 5), stride=(1, 1),
+                                    activation="identity"))
+            .layer(SubsamplingLayer(pooling_type="max", kernel_size=(2, 2), stride=(2, 2)))
+            .layer(ConvolutionLayer(n_out=50, kernel_size=(5, 5), activation="identity"))
+            .layer(SubsamplingLayer(pooling_type="max", kernel_size=(2, 2), stride=(2, 2)))
+            .layer(DenseLayer(n_out=500, activation="relu"))
+            .layer(OutputLayer(n_out=10, loss="mcxent", activation="softmax"))
+            .set_input_type(InputType.convolutional_flat(28, 28, 1))
+            .build())
+
+
+def test_json_roundtrip_mlp():
+    conf = (NeuralNetConfiguration.builder()
+            .seed(42).learning_rate(0.1).updater("adam")
+            .list()
+            .layer(DenseLayer(n_in=4, n_out=10, activation="tanh"))
+            .layer(OutputLayer(n_in=10, n_out=3, loss="mcxent", activation="softmax"))
+            .build())
+    s = conf.to_json()
+    conf2 = MultiLayerConfiguration.from_json(s)
+    assert conf2.to_json() == s
+    assert len(conf2.layers) == 2
+    assert conf2.layers[0].n_out == 10
+    assert conf2.layers[1].loss == "mcxent"
+    # baked global defaults survive round-trip
+    assert conf2.layers[0].updater == "adam"
+
+
+def test_yaml_roundtrip():
+    conf = lenet_conf()
+    conf2 = MultiLayerConfiguration.from_yaml(conf.to_yaml())
+    assert conf2.to_json() == conf.to_json()
+
+
+def test_input_type_inference_lenet():
+    conf = lenet_conf()
+    # conv layers get n_in from channel propagation
+    assert conf.layers[0].n_in == 1
+    assert conf.layers[2].n_in == 20
+    # dense layer n_in = flattened conv output: 28->24->12->8->4; 4*4*50 = 800
+    assert conf.layers[4].n_in == 800
+    assert conf.layers[5].n_in == 500
+    # preprocessors: flat->cnn at 0, cnn->ff at dense
+    assert conf.preprocessor(0) is not None
+    assert conf.preprocessor(4) is not None
+
+
+def test_global_default_baking():
+    conf = (NeuralNetConfiguration.builder()
+            .learning_rate(0.05).activation("relu").weight_init("relu")
+            .l2(1e-4).regularization(True)
+            .list()
+            .layer(DenseLayer(n_in=4, n_out=8))
+            .layer(DenseLayer(n_out=8, activation="tanh"))  # per-layer override
+            .layer(OutputLayer(n_out=3, loss="mse", activation="identity"))
+            .set_input_type(InputType.feed_forward(4))
+            .build())
+    assert conf.layers[0].activation == "relu"
+    assert conf.layers[1].activation == "tanh"
+    assert conf.layers[0].l2 == 1e-4
+    assert conf.layers[1].n_in == 8
+    assert conf.global_conf.use_regularization
+
+
+def test_rnn_conf():
+    conf = (NeuralNetConfiguration.builder()
+            .list()
+            .layer(GravesLSTM(n_in=10, n_out=20))
+            .layer(RnnOutputLayer(n_in=20, n_out=5, loss="mcxent", activation="softmax"))
+            .backprop_type("TruncatedBPTT")
+            .t_bptt_forward_length(8)
+            .build())
+    conf2 = MultiLayerConfiguration.from_json(conf.to_json())
+    assert conf2.backprop_type == "TruncatedBPTT"
+    assert conf2.tbptt_fwd_length == 8
+    assert conf2.layers[0].peephole
+
+
+def test_custom_layer_registration():
+    from deeplearning4j_tpu.nn.conf.layers.base import Layer
+    from deeplearning4j_tpu.nn.conf.serde import register_config, from_json, to_json
+
+    @register_config("MyCustomScale")
+    @dataclasses.dataclass
+    class MyCustomScale(Layer):
+        factor: float = 2.0
+
+        def apply(self, params, state, x, **kw):
+            return x * self.factor, state
+
+    layer = MyCustomScale(factor=3.5)
+    restored = from_json(to_json(layer))
+    assert isinstance(restored, MyCustomScale)
+    assert restored.factor == 3.5

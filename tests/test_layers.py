@@ -1,0 +1,178 @@
+"""Layer forward-pass shape/semantics tests (reference: deeplearning4j-core layer tests)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import (
+    ActivationLayer, AutoEncoder, BatchNormalization, ConvolutionLayer, DenseLayer,
+    DropoutLayer, EmbeddingLayer, GlobalPoolingLayer, GravesBidirectionalLSTM,
+    GravesLSTM, LocalResponseNormalization, LSTM, OutputLayer, RBM, SubsamplingLayer,
+    VariationalAutoencoder,
+)
+
+KEY = jax.random.PRNGKey(0)
+
+
+def test_dense_forward():
+    layer = DenseLayer(n_in=4, n_out=8, activation="relu", weight_init="xavier")
+    params = layer.init_params(KEY, InputType.feed_forward(4))
+    assert params["W"].shape == (4, 8)
+    x = jnp.ones((3, 4))
+    y, _ = layer.apply(params, {}, x)
+    assert y.shape == (3, 8)
+    assert (np.asarray(y) >= 0).all()
+
+
+def test_conv_shapes():
+    layer = ConvolutionLayer(n_in=3, n_out=16, kernel_size=(3, 3), stride=(1, 1),
+                             activation="relu", weight_init="relu")
+    itype = InputType.convolutional(8, 8, 3)
+    params = layer.init_params(KEY, itype)
+    assert params["W"].shape == (3, 3, 3, 16)
+    x = jnp.ones((2, 8, 8, 3))
+    y, _ = layer.apply(params, {}, x)
+    assert y.shape == (2, 6, 6, 16)
+    ot = layer.output_type(itype)
+    assert (ot.height, ot.width, ot.channels) == (6, 6, 16)
+
+
+def test_conv_same_mode():
+    layer = ConvolutionLayer(n_in=3, n_out=4, kernel_size=(3, 3), stride=(2, 2),
+                             convolution_mode="same", activation="identity")
+    x = jnp.ones((1, 9, 9, 3))
+    params = layer.init_params(KEY, InputType.convolutional(9, 9, 3))
+    y, _ = layer.apply(params, {}, x)
+    assert y.shape == (1, 5, 5, 4)
+
+
+def test_subsampling_max_avg():
+    x = jnp.arange(16.0).reshape(1, 4, 4, 1)
+    mx = SubsamplingLayer(pooling_type="max", kernel_size=(2, 2), stride=(2, 2))
+    y, _ = mx.apply({}, {}, x)
+    assert y.shape == (1, 2, 2, 1)
+    assert float(y[0, 0, 0, 0]) == 5.0
+    avg = SubsamplingLayer(pooling_type="avg", kernel_size=(2, 2), stride=(2, 2))
+    y2, _ = avg.apply({}, {}, x)
+    assert float(y2[0, 0, 0, 0]) == 2.5
+
+
+def test_batchnorm_train_vs_eval():
+    layer = BatchNormalization(n_in=5, activation="identity")
+    itype = InputType.feed_forward(5)
+    params = layer.init_params(KEY, itype)
+    state = layer.init_state(itype)
+    x = jax.random.normal(KEY, (64, 5)) * 3 + 2
+    y, new_state = layer.apply(params, state, x, train=True)
+    # normalized output roughly zero-mean unit-var
+    assert abs(float(jnp.mean(y))) < 0.1
+    assert abs(float(jnp.std(y)) - 1.0) < 0.15
+    # running stats moved toward batch stats
+    assert float(new_state["mean"].mean()) != 0.0
+
+
+def test_lstm_shapes_and_mask():
+    layer = GravesLSTM(n_in=6, n_out=10, activation="tanh")
+    itype = InputType.recurrent(6)
+    params = layer.init_params(KEY, itype)
+    assert params["W"].shape == (6, 40)
+    assert params["RW"].shape == (10, 40)
+    x = jax.random.normal(KEY, (2, 7, 6))
+    y, _ = layer.apply(params, {}, x)
+    assert y.shape == (2, 7, 10)
+    # mask freezes state after the masked timestep
+    mask = jnp.array([[1, 1, 1, 0, 0, 0, 0], [1, 1, 1, 1, 1, 1, 1]], jnp.float32)
+    ym, _ = layer.apply(params, {}, x, mask=mask)
+    np.testing.assert_allclose(np.asarray(ym[0, 3]), np.asarray(ym[0, 2]), rtol=1e-5)
+
+
+def test_bidirectional_lstm():
+    layer = GravesBidirectionalLSTM(n_in=4, n_out=6, activation="tanh")
+    params = layer.init_params(KEY, InputType.recurrent(4))
+    x = jax.random.normal(KEY, (3, 5, 4))
+    y, _ = layer.apply(params, {}, x)
+    assert y.shape == (3, 5, 6)
+
+
+def test_lstm_streaming_matches_full():
+    layer = LSTM(n_in=4, n_out=6, activation="tanh")
+    params = layer.init_params(KEY, InputType.recurrent(4))
+    x = jax.random.normal(KEY, (2, 6, 4))
+    full, _ = layer.apply(params, {}, x)
+    # stream one timestep at a time
+    state = {"h": jnp.zeros((2, 6)), "c": jnp.zeros((2, 6))}
+    outs = []
+    for t in range(6):
+        y, state = layer.apply_streaming(params, state, x[:, t:t + 1])
+        outs.append(y)
+    streamed = jnp.concatenate(outs, axis=1)
+    np.testing.assert_allclose(np.asarray(full), np.asarray(streamed), atol=1e-5)
+
+
+def test_embedding():
+    layer = EmbeddingLayer(n_in=50, n_out=8, activation="identity")
+    params = layer.init_params(KEY, InputType.feed_forward(50))
+    idx = jnp.array([[0], [3], [49]])
+    y, _ = layer.apply(params, {}, idx)
+    assert y.shape == (3, 8)
+    np.testing.assert_allclose(np.asarray(y[1]),
+                               np.asarray(params["W"][3] + params["b"]), rtol=1e-6)
+
+
+def test_dropout_train_only():
+    layer = DropoutLayer(dropout=0.5)
+    x = jnp.ones((10, 20))
+    y_eval, _ = layer.apply({}, {}, x, train=False)
+    np.testing.assert_array_equal(np.asarray(y_eval), np.asarray(x))
+    y_train, _ = layer.apply({}, {}, x, train=True, rng=KEY)
+    arr = np.asarray(y_train)
+    assert ((arr == 0) | (arr == 2.0)).all()
+    assert (arr == 0).any()
+
+
+def test_lrn():
+    layer = LocalResponseNormalization()
+    x = jax.random.normal(KEY, (2, 4, 4, 8))
+    y, _ = layer.apply({}, {}, x)
+    assert y.shape == x.shape
+    assert float(jnp.max(jnp.abs(y))) <= float(jnp.max(jnp.abs(x)))
+
+
+def test_global_pooling():
+    layer = GlobalPoolingLayer(pooling_type="avg")
+    x = jnp.ones((2, 4, 4, 8))
+    y, _ = layer.apply({}, {}, x)
+    assert y.shape == (2, 8)
+
+
+def test_autoencoder_pretrain_loss():
+    layer = AutoEncoder(n_in=10, n_out=5, activation="sigmoid",
+                        corruption_level=0.3, weight_init="xavier")
+    params = layer.init_params(KEY, InputType.feed_forward(10))
+    x = jax.random.uniform(KEY, (8, 10))
+    loss = layer.pretrain_loss(params, x, rng=KEY)
+    assert float(loss) > 0
+
+
+def test_vae_elbo_and_forward():
+    layer = VariationalAutoencoder(n_in=12, n_out=4, activation="tanh",
+                                   encoder_layer_sizes=(16,), decoder_layer_sizes=(16,),
+                                   reconstruction_distribution="bernoulli",
+                                   weight_init="xavier")
+    params = layer.init_params(KEY, InputType.feed_forward(12))
+    x = (jax.random.uniform(KEY, (4, 12)) > 0.5).astype(jnp.float32)
+    loss = layer.pretrain_loss(params, x, rng=KEY)
+    assert np.isfinite(float(loss))
+    y, _ = layer.apply(params, {}, x)
+    assert y.shape == (4, 4)
+
+
+def test_rbm_cd_runs():
+    layer = RBM(n_in=8, n_out=6, activation="sigmoid", weight_init="xavier")
+    params = layer.init_params(KEY, InputType.feed_forward(8))
+    x = (jax.random.uniform(KEY, (4, 8)) > 0.5).astype(jnp.float32)
+    loss = layer.pretrain_loss(params, x, rng=KEY)
+    grads = jax.grad(lambda p: layer.pretrain_loss(p, x, rng=KEY))(params)
+    assert np.isfinite(float(loss))
+    assert all(np.isfinite(np.asarray(g)).all() for g in grads.values())

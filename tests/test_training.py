@@ -1,0 +1,200 @@
+"""End-to-end training tests: networks must actually learn.
+
+Reference analog: deeplearning4j-core MultiLayerTest / LenetMnistExample-style smoke
+tests — fit on small data, assert score decreases and accuracy beats chance.
+"""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets.iterators import ArrayDataSetIterator
+from deeplearning4j_tpu.datasets.mnist import IrisDataSetIterator, MnistDataSetIterator
+from deeplearning4j_tpu.nn.conf.builders import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import (
+    ConvolutionLayer, DenseLayer, GravesLSTM, OutputLayer, RnnOutputLayer,
+    SubsamplingLayer,
+)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.optimize.listeners import CollectScoresIterationListener
+
+
+def test_iris_mlp_learns():
+    it = IrisDataSetIterator(batch=30)
+    conf = (NeuralNetConfiguration.builder()
+            .seed(123).learning_rate(0.1).updater("adam")
+            .weight_init("xavier")
+            .list()
+            .layer(DenseLayer(n_in=4, n_out=16, activation="relu"))
+            .layer(OutputLayer(n_in=16, n_out=3, loss="mcxent", activation="softmax"))
+            .build())
+    net = MultiLayerNetwork(conf)
+    net.init()
+    collector = CollectScoresIterationListener()
+    net.set_listeners(collector)
+    net.fit_iterator(it, epochs=30)
+    ev = net.evaluate(it)
+    assert ev.accuracy() > 0.9, ev.stats()
+    scores = [s for _, s in collector.scores]
+    assert scores[-1] < scores[0] * 0.5
+
+
+def test_score_decreases_sgd():
+    x = np.random.default_rng(0).normal(size=(64, 10)).astype(np.float32)
+    w_true = np.random.default_rng(1).normal(size=(10, 2)).astype(np.float32)
+    y = x @ w_true
+    conf = (NeuralNetConfiguration.builder()
+            .seed(1).learning_rate(0.05).updater("sgd")
+            .list()
+            .layer(DenseLayer(n_in=10, n_out=8, activation="tanh"))
+            .layer(OutputLayer(n_in=8, n_out=2, loss="mse", activation="identity"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    s0 = net.score(x, y)
+    for _ in range(50):
+        net.fit(x, y)
+    assert net.score(x, y) < s0 * 0.5
+
+
+def test_mnist_lenet_smoke():
+    """Tiny LeNet on (synthetic) MNIST: one pass improves over chance."""
+    it = MnistDataSetIterator(batch=32, num_examples=512, seed=1)
+    conf = (NeuralNetConfiguration.builder()
+            .seed(12345).learning_rate(0.01).updater("adam")
+            .weight_init("xavier")
+            .list()
+            .layer(ConvolutionLayer(n_out=8, kernel_size=(5, 5), activation="relu"))
+            .layer(SubsamplingLayer(pooling_type="max", kernel_size=(2, 2), stride=(2, 2)))
+            .layer(ConvolutionLayer(n_out=16, kernel_size=(5, 5), activation="relu"))
+            .layer(SubsamplingLayer(pooling_type="max", kernel_size=(2, 2), stride=(2, 2)))
+            .layer(DenseLayer(n_out=64, activation="relu"))
+            .layer(OutputLayer(n_out=10, loss="mcxent", activation="softmax"))
+            .set_input_type(InputType.convolutional_flat(28, 28, 1))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    net.fit_iterator(it, epochs=3)
+    ev = net.evaluate(it)
+    assert ev.accuracy() > 0.5, ev.stats()
+
+
+def test_rnn_learns_sequence():
+    """LSTM learns to echo the previous input token class."""
+    rng = np.random.default_rng(0)
+    B, T, C = 32, 8, 4
+    idx = rng.integers(0, C, (B, T))
+    x = np.zeros((B, T, C), np.float32)
+    for b in range(B):
+        x[b, np.arange(T), idx[b]] = 1
+    y = np.zeros((B, T, C), np.float32)
+    y[:, 1:] = x[:, :-1]
+    y[:, 0, 0] = 1
+    conf = (NeuralNetConfiguration.builder()
+            .seed(5).learning_rate(0.02).updater("adam")
+            .list()
+            .layer(GravesLSTM(n_in=C, n_out=16, activation="tanh"))
+            .layer(RnnOutputLayer(n_in=16, n_out=C, loss="mcxent", activation="softmax"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    s0 = net.score(x, y)
+    for _ in range(60):
+        net.fit(x, y)
+    assert net.score(x, y) < s0 * 0.5
+
+
+def test_tbptt_runs():
+    rng = np.random.default_rng(0)
+    B, T, C = 8, 20, 3
+    x = rng.normal(size=(B, T, C)).astype(np.float32)
+    y = np.zeros((B, T, C), np.float32)
+    y[..., 0] = 1
+    conf = (NeuralNetConfiguration.builder()
+            .seed(5).learning_rate(0.05)
+            .list()
+            .layer(GravesLSTM(n_in=C, n_out=8, activation="tanh"))
+            .layer(RnnOutputLayer(n_in=8, n_out=C, loss="mcxent", activation="softmax"))
+            .backprop_type("TruncatedBPTT")
+            .t_bptt_forward_length(5)
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    net.fit(x, y)
+    assert net.iteration == 4  # 20 timesteps / 5 per chunk
+    assert np.isfinite(net.score_value)
+
+
+def test_rnn_time_step_streaming():
+    conf = (NeuralNetConfiguration.builder()
+            .seed(5)
+            .list()
+            .layer(GravesLSTM(n_in=3, n_out=6, activation="tanh"))
+            .layer(RnnOutputLayer(n_in=6, n_out=2, loss="mcxent", activation="softmax"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    x = np.random.default_rng(1).normal(size=(2, 6, 3)).astype(np.float32)
+    full = np.asarray(net.output(x))
+    net.rnn_clear_previous_state()
+    outs = [np.asarray(net.rnn_time_step(x[:, t:t + 1])) for t in range(6)]
+    streamed = np.concatenate(outs, axis=1)
+    np.testing.assert_allclose(full, streamed, atol=1e-5)
+
+
+def test_updaters_all_run():
+    x = np.random.default_rng(0).normal(size=(16, 4)).astype(np.float32)
+    y = np.zeros((16, 2), np.float32)
+    y[:, 0] = 1
+    for upd in ["sgd", "nesterovs", "adam", "adagrad", "rmsprop", "adadelta", "adamax"]:
+        conf = (NeuralNetConfiguration.builder()
+                .seed(1).learning_rate(0.01).updater(upd)
+                .list()
+                .layer(DenseLayer(n_in=4, n_out=6, activation="tanh"))
+                .layer(OutputLayer(n_in=6, n_out=2, loss="mcxent", activation="softmax"))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        net.fit(x, y)
+        net.fit(x, y)
+        assert np.isfinite(net.score_value), upd
+
+
+def test_lr_schedules():
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.nn.updaters import effective_lr
+
+    assert float(effective_lr(0.1, None, 5)) == pytest.approx(0.1)
+    assert float(effective_lr(0.1, "exponential", 2, decay=0.5)) == pytest.approx(0.025)
+    assert float(effective_lr(0.1, "step", 10, decay=0.5, steps=5)) == pytest.approx(0.025)
+    assert float(effective_lr(0.1, "schedule", 7,
+                              schedule={0: 0.1, 5: 0.01})) == pytest.approx(0.01)
+
+
+def test_gradient_normalization_clipping():
+    conf = (NeuralNetConfiguration.builder()
+            .seed(1).learning_rate(1.0)
+            .gradient_normalization("ClipL2PerLayer")
+            .gradient_normalization_threshold(0.5)
+            .list()
+            .layer(DenseLayer(n_in=4, n_out=6, activation="tanh"))
+            .layer(OutputLayer(n_in=6, n_out=2, loss="mse", activation="identity"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    x = np.random.default_rng(0).normal(size=(8, 4)).astype(np.float32) * 100
+    y = np.random.default_rng(1).normal(size=(8, 2)).astype(np.float32) * 100
+    p0 = np.asarray(net.params())
+    net.fit(x, y)
+    p1 = np.asarray(net.params())
+    # with lr=1 and clip threshold 0.5, per-layer param change norm <= ~0.5
+    delta = p1 - p0
+    assert np.linalg.norm(delta) < 1.5
+
+
+def test_params_flat_view_roundtrip():
+    conf = (NeuralNetConfiguration.builder()
+            .seed(1)
+            .list()
+            .layer(DenseLayer(n_in=4, n_out=6))
+            .layer(OutputLayer(n_in=6, n_out=2, loss="mse", activation="identity"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    flat = np.asarray(net.params())
+    assert flat.shape == (net.num_params(),)
+    assert net.num_params() == 4 * 6 + 6 + 6 * 2 + 2
+    net2 = MultiLayerNetwork(conf).init()
+    net2.set_params(flat)
+    np.testing.assert_allclose(np.asarray(net2.params()), flat)
