@@ -1,0 +1,119 @@
+"""Benchmark driver: prints ONE JSON line with the headline metric.
+
+Headline (BASELINE.md): LeNet-5 MNIST training throughput (samples/sec) on one TPU
+chip — the reference's LenetMnistExample config measured by its PerformanceListener
+(reference optimize/listeners/PerformanceListener.java). The reference publishes no
+numbers (BASELINE.md), so vs_baseline is reported against the first empirical
+recording in BASELINE.md once established.
+
+Usage: python bench.py [--model lenet|resnet50] [--batch N] [--iters N]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+BASELINE_SAMPLES_PER_SEC = None  # populated from first recorded round; see BASELINE.md
+
+
+def bench_lenet(batch: int, iters: int, warmup: int = 5) -> dict:
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.models.lenet import lenet_mnist
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork, make_train_step
+
+    net = MultiLayerNetwork(lenet_mnist()).init()
+    step = jax.jit(make_train_step(net.conf), donate_argnums=(0, 1, 2))
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(batch, 784)).astype(np.float32))
+    y_np = np.zeros((batch, 10), np.float32)
+    y_np[np.arange(batch), rng.integers(0, 10, batch)] = 1
+    y = jnp.asarray(y_np)
+    key = jax.random.PRNGKey(0)
+
+    params, states, upd = net.params_list, net.state_list, net.updater_state
+    for i in range(warmup):
+        params, states, upd, loss = step(params, states, upd, x, y, key,
+                                         jnp.int32(i))
+    jax.block_until_ready(loss)
+
+    t0 = time.perf_counter()
+    for i in range(iters):
+        params, states, upd, loss = step(params, states, upd, x, y, key,
+                                         jnp.int32(i))
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+    return {
+        "samples_per_sec": batch * iters / dt,
+        "step_time_ms": dt / iters * 1000,
+        "batch": batch,
+        "iters": iters,
+    }
+
+
+def bench_resnet50(batch: int, iters: int, warmup: int = 3) -> dict:
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.models.resnet import resnet50
+    from deeplearning4j_tpu.nn.graph_network import ComputationGraph, make_graph_train_step
+
+    net = ComputationGraph(resnet50(n_classes=1000, image_size=224)).init()
+    step = jax.jit(make_graph_train_step(net.conf), donate_argnums=(0, 1, 2))
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(batch, 224, 224, 3)).astype(np.float32))
+    y_np = np.zeros((batch, 1000), np.float32)
+    y_np[np.arange(batch), rng.integers(0, 1000, batch)] = 1
+    y = jnp.asarray(y_np)
+    key = jax.random.PRNGKey(0)
+    params, states, upd = net.params_list, net.state_list, net.updater_state
+    for i in range(warmup):
+        params, states, upd, loss = step(params, states, upd, [x], [y], key,
+                                         jnp.int32(i))
+    jax.block_until_ready(loss)
+    t0 = time.perf_counter()
+    for i in range(iters):
+        params, states, upd, loss = step(params, states, upd, [x], [y], key,
+                                         jnp.int32(i))
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+    return {
+        "samples_per_sec": batch * iters / dt,
+        "step_time_ms": dt / iters * 1000,
+        "batch": batch,
+        "iters": iters,
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="lenet", choices=["lenet", "resnet50"])
+    ap.add_argument("--batch", type=int, default=None)
+    ap.add_argument("--iters", type=int, default=None)
+    args = ap.parse_args()
+
+    if args.model == "lenet":
+        r = bench_lenet(args.batch or 128, args.iters or 50)
+        metric = "lenet_mnist_samples_per_sec"
+    else:
+        r = bench_resnet50(args.batch or 32, args.iters or 10)
+        metric = "resnet50_samples_per_sec_per_chip"
+
+    vs = (r["samples_per_sec"] / BASELINE_SAMPLES_PER_SEC
+          if BASELINE_SAMPLES_PER_SEC else 1.0)
+    print(json.dumps({
+        "metric": metric,
+        "value": round(r["samples_per_sec"], 2),
+        "unit": "samples/sec",
+        "vs_baseline": round(vs, 3),
+        "detail": r,
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -123,7 +123,8 @@ class AsyncDataSetIterator(DataSetIterator):
             yield item
 
     def reset(self) -> None:
-        self.base.reset()
+        if hasattr(self.base, "reset"):  # base may be a plain iterable/list
+            self.base.reset()
 
     def batch_size(self) -> int:
         return self.base.batch_size()

@@ -1,0 +1,30 @@
+"""GravesLSTM char-RNN configuration — BASELINE.json config-3 benchmark.
+
+Matches the reference's canonical character-modelling example (2x GravesLSTM 200 +
+RnnOutputLayer, TBPTT 50) built on this framework's XLA-scan LSTM.
+"""
+from __future__ import annotations
+
+from deeplearning4j_tpu.nn.conf.builders import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.layers import GravesLSTM, RnnOutputLayer
+from deeplearning4j_tpu.nn.conf.multilayer import MultiLayerConfiguration
+
+
+def char_rnn_lstm(vocab_size: int, hidden: int = 200, layers: int = 2,
+                  tbptt_length: int = 50, seed: int = 12345,
+                  learning_rate: float = 0.1) -> MultiLayerConfiguration:
+    lb = (NeuralNetConfiguration.builder()
+          .seed(seed)
+          .learning_rate(learning_rate)
+          .updater("rmsprop").rms_decay(0.95)
+          .weight_init("xavier")
+          .list())
+    for i in range(layers):
+        lb.layer(GravesLSTM(n_in=vocab_size if i == 0 else hidden, n_out=hidden,
+                            activation="tanh"))
+    lb.layer(RnnOutputLayer(n_in=hidden, n_out=vocab_size, loss="mcxent",
+                            activation="softmax"))
+    lb.backprop_type("TruncatedBPTT")
+    lb.t_bptt_forward_length(tbptt_length)
+    lb.t_bptt_backward_length(tbptt_length)
+    return lb.build()

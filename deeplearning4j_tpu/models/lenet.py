@@ -1,0 +1,32 @@
+"""LeNet-5 MNIST configuration — the reference's canonical CNN example and the
+BASELINE.json config-1 benchmark (reference deeplearning4j-core LenetMnistExample
+hyperparameters: 20/50 conv filters, 500 dense, nesterovs 0.9, lr 0.01)."""
+from __future__ import annotations
+
+from deeplearning4j_tpu.nn.conf.builders import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import (
+    ConvolutionLayer, DenseLayer, OutputLayer, SubsamplingLayer,
+)
+from deeplearning4j_tpu.nn.conf.multilayer import MultiLayerConfiguration
+
+
+def lenet_mnist(seed: int = 12345, learning_rate: float = 0.01) -> MultiLayerConfiguration:
+    return (NeuralNetConfiguration.builder()
+            .seed(seed)
+            .learning_rate(learning_rate)
+            .updater("nesterovs").momentum(0.9)
+            .weight_init("xavier")
+            .list()
+            .layer(ConvolutionLayer(n_out=20, kernel_size=(5, 5), stride=(1, 1),
+                                    activation="identity"))
+            .layer(SubsamplingLayer(pooling_type="max", kernel_size=(2, 2),
+                                    stride=(2, 2)))
+            .layer(ConvolutionLayer(n_out=50, kernel_size=(5, 5), stride=(1, 1),
+                                    activation="identity"))
+            .layer(SubsamplingLayer(pooling_type="max", kernel_size=(2, 2),
+                                    stride=(2, 2)))
+            .layer(DenseLayer(n_out=500, activation="relu"))
+            .layer(OutputLayer(n_out=10, loss="mcxent", activation="softmax"))
+            .set_input_type(InputType.convolutional_flat(28, 28, 1))
+            .build())

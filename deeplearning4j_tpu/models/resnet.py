@@ -1,0 +1,117 @@
+"""ResNet-50 as a ComputationGraph — BASELINE.json config-2 benchmark model.
+
+The reference expresses ResNet-style models through ComputationGraph
+(ElementWiseVertex residual adds, reference nn/graph/vertex/impl/ElementWiseVertex.java);
+this builder produces the standard 50-layer bottleneck architecture with the
+conv->BN->ReLU ordering, NHWC layout for XLA:TPU.
+"""
+from __future__ import annotations
+
+from deeplearning4j_tpu.nn.conf.builders import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.graphconf import ComputationGraphConfiguration
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import (
+    ActivationLayer, BatchNormalization, ConvolutionLayer, DenseLayer,
+    GlobalPoolingLayer, OutputLayer, SubsamplingLayer,
+)
+from deeplearning4j_tpu.nn.conf.vertices import ElementWiseVertex
+
+
+def _conv_bn(gb, name: str, n_out: int, kernel, stride, input_name: str,
+             activation: str = "relu", mode: str = "same") -> str:
+    gb.add_layer(f"{name}_conv",
+                 ConvolutionLayer(n_out=n_out, kernel_size=kernel, stride=stride,
+                                  convolution_mode=mode, activation="identity",
+                                  has_bias=False),
+                 input_name)
+    gb.add_layer(f"{name}_bn", BatchNormalization(activation=activation),
+                 f"{name}_conv")
+    return f"{name}_bn"
+
+
+def _bottleneck(gb, name: str, in_name: str, filters: int, stride: int,
+                downsample: bool) -> str:
+    """1x1 -> 3x3 -> 1x1(x4) bottleneck with identity/projection shortcut."""
+    out_ch = filters * 4
+    a = _conv_bn(gb, f"{name}_a", filters, (1, 1), (stride, stride), in_name)
+    b = _conv_bn(gb, f"{name}_b", filters, (3, 3), (1, 1), a)
+    c = _conv_bn(gb, f"{name}_c", out_ch, (1, 1), (1, 1), b, activation="identity")
+    if downsample:
+        shortcut = _conv_bn(gb, f"{name}_proj", out_ch, (1, 1), (stride, stride),
+                            in_name, activation="identity")
+    else:
+        shortcut = in_name
+    gb.add_vertex(f"{name}_add", ElementWiseVertex(op="add"), c, shortcut)
+    gb.add_layer(f"{name}_relu", ActivationLayer(activation="relu"), f"{name}_add")
+    return f"{name}_relu"
+
+
+def resnet50(n_classes: int = 1000, image_size: int = 224, channels: int = 3,
+             seed: int = 12345, learning_rate: float = 0.1,
+             stage_blocks=(3, 4, 6, 3)) -> ComputationGraphConfiguration:
+    gb = (NeuralNetConfiguration.builder()
+          .seed(seed)
+          .learning_rate(learning_rate)
+          .updater("nesterovs").momentum(0.9)
+          .weight_init("relu")
+          .graph_builder()
+          .add_inputs("input"))
+    stem = _conv_bn(gb, "stem", 64, (7, 7), (2, 2), "input")
+    gb.add_layer("stem_pool",
+                 SubsamplingLayer(pooling_type="max", kernel_size=(3, 3),
+                                  stride=(2, 2), convolution_mode="same"),
+                 stem)
+    cur = "stem_pool"
+    filters = [64, 128, 256, 512]
+    for stage, blocks in enumerate(stage_blocks):
+        for block in range(blocks):
+            stride = 2 if (stage > 0 and block == 0) else 1
+            downsample = block == 0
+            cur = _bottleneck(gb, f"s{stage}b{block}", cur, filters[stage],
+                              stride, downsample)
+    gb.add_layer("avgpool", GlobalPoolingLayer(pooling_type="avg"), cur)
+    gb.add_layer("fc", OutputLayer(n_out=n_classes, loss="mcxent",
+                                   activation="softmax", weight_init="xavier"),
+                 "avgpool")
+    gb.set_outputs("fc")
+    gb.set_input_types(InputType.convolutional(image_size, image_size, channels))
+    return gb.build()
+
+
+def resnet18(n_classes: int = 1000, image_size: int = 224, channels: int = 3,
+             seed: int = 12345, learning_rate: float = 0.1) -> ComputationGraphConfiguration:
+    """Basic-block ResNet-18 (smaller benchmarking/test variant)."""
+    gb = (NeuralNetConfiguration.builder()
+          .seed(seed).learning_rate(learning_rate)
+          .updater("nesterovs").momentum(0.9).weight_init("relu")
+          .graph_builder()
+          .add_inputs("input"))
+    stem = _conv_bn(gb, "stem", 64, (7, 7), (2, 2), "input")
+    gb.add_layer("stem_pool",
+                 SubsamplingLayer(pooling_type="max", kernel_size=(3, 3),
+                                  stride=(2, 2), convolution_mode="same"), stem)
+    cur = "stem_pool"
+    filters = [64, 128, 256, 512]
+    for stage in range(4):
+        for block in range(2):
+            name = f"s{stage}b{block}"
+            stride = 2 if (stage > 0 and block == 0) else 1
+            a = _conv_bn(gb, f"{name}_a", filters[stage], (3, 3), (stride, stride), cur)
+            b = _conv_bn(gb, f"{name}_b", filters[stage], (3, 3), (1, 1), a,
+                         activation="identity")
+            if stage > 0 and block == 0:
+                shortcut = _conv_bn(gb, f"{name}_proj", filters[stage], (1, 1),
+                                    (stride, stride), cur, activation="identity")
+            else:
+                shortcut = cur
+            gb.add_vertex(f"{name}_add", ElementWiseVertex(op="add"), b, shortcut)
+            gb.add_layer(f"{name}_relu", ActivationLayer(activation="relu"),
+                         f"{name}_add")
+            cur = f"{name}_relu"
+    gb.add_layer("avgpool", GlobalPoolingLayer(pooling_type="avg"), cur)
+    gb.add_layer("fc", OutputLayer(n_out=n_classes, loss="mcxent",
+                                   activation="softmax", weight_init="xavier"),
+                 "avgpool")
+    gb.set_outputs("fc")
+    gb.set_input_types(InputType.convolutional(image_size, image_size, channels))
+    return gb.build()

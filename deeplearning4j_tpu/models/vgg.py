@@ -1,0 +1,42 @@
+"""VGG-16 configuration — BASELINE.json config-5 (Keras-import fine-tune target).
+
+Matches the Keras 1.x VGG-16 layer stack the reference's modelimport handles
+(reference KerasLayer.java:39-52 supported set: Convolution2D/MaxPooling2D/Flatten/
+Dense/Dropout), so an imported Keras VGG-16 lands on this exact architecture.
+"""
+from __future__ import annotations
+
+from deeplearning4j_tpu.nn.conf.builders import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import (
+    ConvolutionLayer, DenseLayer, DropoutLayer, OutputLayer, SubsamplingLayer,
+)
+from deeplearning4j_tpu.nn.conf.multilayer import MultiLayerConfiguration
+
+_VGG16_BLOCKS = [(64, 2), (128, 2), (256, 3), (512, 3), (512, 3)]
+
+
+def vgg16(n_classes: int = 1000, image_size: int = 224, channels: int = 3,
+          seed: int = 12345, learning_rate: float = 0.01,
+          dropout: float = 0.5) -> MultiLayerConfiguration:
+    lb = (NeuralNetConfiguration.builder()
+          .seed(seed)
+          .learning_rate(learning_rate)
+          .updater("nesterovs").momentum(0.9)
+          .weight_init("relu")
+          .list())
+    for filters, convs in _VGG16_BLOCKS:
+        for _ in range(convs):
+            lb.layer(ConvolutionLayer(n_out=filters, kernel_size=(3, 3),
+                                      stride=(1, 1), convolution_mode="same",
+                                      activation="relu"))
+        lb.layer(SubsamplingLayer(pooling_type="max", kernel_size=(2, 2),
+                                  stride=(2, 2)))
+    lb.layer(DenseLayer(n_out=4096, activation="relu"))
+    lb.layer(DropoutLayer(dropout=dropout))
+    lb.layer(DenseLayer(n_out=4096, activation="relu"))
+    lb.layer(DropoutLayer(dropout=dropout))
+    lb.layer(OutputLayer(n_out=n_classes, loss="mcxent", activation="softmax",
+                         weight_init="xavier"))
+    lb.set_input_type(InputType.convolutional(image_size, image_size, channels))
+    return lb.build()

@@ -1,0 +1,176 @@
+"""ComputationGraphConfiguration + GraphBuilder DSL.
+
+Reference: nn/conf/ComputationGraphConfiguration.java (664 LoC, GraphBuilder DSL used as
+NeuralNetConfiguration.builder()...graphBuilder().addInputs("in").addLayer("L1", layer,
+"in")...setOutputs("out")). Build-time work: topological sort, InputType propagation
+through vertices (n_in inference + auto preprocessor insertion), validation.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from deeplearning4j_tpu.nn.conf.builders import GlobalConf, bake_layer_defaults
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers.base import Layer
+from deeplearning4j_tpu.nn.conf.preprocessors import infer_preprocessor
+from deeplearning4j_tpu.nn.conf.serde import register_config
+from deeplearning4j_tpu.nn.conf import serde
+from deeplearning4j_tpu.nn.conf.vertices import GraphVertex, LayerVertex, PreprocessorVertex
+
+
+@register_config("ComputationGraphConfiguration")
+@dataclasses.dataclass
+class ComputationGraphConfiguration:
+    global_conf: GlobalConf = dataclasses.field(default_factory=GlobalConf)
+    vertices: dict = dataclasses.field(default_factory=dict)       # name -> GraphVertex
+    vertex_inputs: dict = dataclasses.field(default_factory=dict)  # name -> [input names]
+    network_inputs: list = dataclasses.field(default_factory=list)
+    network_outputs: list = dataclasses.field(default_factory=list)
+    input_types: list = dataclasses.field(default_factory=list)
+    topological_order: list = dataclasses.field(default_factory=list)
+    backprop: bool = True
+    pretrain: bool = False
+    backprop_type: str = "Standard"
+    tbptt_fwd_length: int = 20
+    tbptt_back_length: int = 20
+
+    def to_json(self) -> str:
+        return serde.to_json(self)
+
+    @staticmethod
+    def from_json(s: str) -> "ComputationGraphConfiguration":
+        conf = serde.from_json(s)
+        if not isinstance(conf, ComputationGraphConfiguration):
+            raise ValueError("JSON does not encode a ComputationGraphConfiguration")
+        return conf
+
+    def topo_sort(self) -> list:
+        """Kahn topological order over vertices (reference
+        ComputationGraph.topologicalSortOrder:849)."""
+        indeg = {name: 0 for name in self.vertices}
+        children: dict[str, list] = {name: [] for name in self.vertices}
+        for name, ins in self.vertex_inputs.items():
+            for src in ins:
+                if src in self.vertices:
+                    indeg[name] += 1
+                    children[src].append(name)
+        ready = sorted(n for n, d in indeg.items() if d == 0)
+        order = []
+        while ready:
+            n = ready.pop(0)
+            order.append(n)
+            for c in children[n]:
+                indeg[c] -= 1
+                if indeg[c] == 0:
+                    ready.append(c)
+            ready.sort()
+        if len(order) != len(self.vertices):
+            cyc = set(self.vertices) - set(order)
+            raise ValueError(f"Graph has a cycle involving: {sorted(cyc)}")
+        return order
+
+
+class GraphBuilder:
+    """Reference ComputationGraphConfiguration.GraphBuilder DSL."""
+
+    def __init__(self, g: GlobalConf):
+        self._g = g
+        self._vertices: dict[str, GraphVertex] = {}
+        self._vertex_inputs: dict[str, list] = {}
+        self._inputs: list = []
+        self._outputs: list = []
+        self._input_types: list = []
+        self._backprop = True
+        self._pretrain = False
+        self._backprop_type = "Standard"
+        self._tbptt_fwd = 20
+        self._tbptt_back = 20
+
+    def add_inputs(self, *names: str) -> "GraphBuilder":
+        self._inputs.extend(names)
+        return self
+
+    def add_layer(self, name: str, layer: Layer, *inputs: str) -> "GraphBuilder":
+        bake_layer_defaults(layer, self._g)
+        if layer.name is None:
+            layer.name = name
+        self._vertices[name] = LayerVertex(layer=layer)
+        self._vertex_inputs[name] = list(inputs)
+        return self
+
+    def add_vertex(self, name: str, vertex: GraphVertex, *inputs: str) -> "GraphBuilder":
+        self._vertices[name] = vertex
+        self._vertex_inputs[name] = list(inputs)
+        return self
+
+    def set_outputs(self, *names: str) -> "GraphBuilder":
+        self._outputs = list(names)
+        return self
+
+    def set_input_types(self, *itypes: InputType) -> "GraphBuilder":
+        self._input_types = list(itypes)
+        return self
+
+    def backprop(self, flag: bool) -> "GraphBuilder":
+        self._backprop = flag
+        return self
+
+    def pretrain(self, flag: bool) -> "GraphBuilder":
+        self._pretrain = flag
+        return self
+
+    def backprop_type(self, t: str) -> "GraphBuilder":
+        self._backprop_type = t
+        return self
+
+    def t_bptt_forward_length(self, n: int) -> "GraphBuilder":
+        self._tbptt_fwd = n
+        return self
+
+    def t_bptt_backward_length(self, n: int) -> "GraphBuilder":
+        self._tbptt_back = n
+        return self
+
+    def build(self) -> ComputationGraphConfiguration:
+        conf = ComputationGraphConfiguration(
+            global_conf=self._g,
+            vertices=self._vertices,
+            vertex_inputs=self._vertex_inputs,
+            network_inputs=self._inputs,
+            network_outputs=self._outputs,
+            input_types=self._input_types,
+            backprop=self._backprop,
+            pretrain=self._pretrain,
+            backprop_type=self._backprop_type,
+            tbptt_fwd_length=self._tbptt_fwd,
+            tbptt_back_length=self._tbptt_back,
+        )
+        for out in conf.network_outputs:
+            if out not in conf.vertices:
+                raise ValueError(f"Output '{out}' is not a vertex")
+        for name, ins in conf.vertex_inputs.items():
+            for src in ins:
+                if src not in conf.vertices and src not in conf.network_inputs:
+                    raise ValueError(f"Vertex '{name}' input '{src}' undefined")
+        conf.topological_order = conf.topo_sort()
+
+        # InputType propagation: infer n_in + insert preprocessors inside LayerVertexes
+        if self._input_types:
+            types: dict[str, InputType] = dict(zip(conf.network_inputs, self._input_types))
+            for name in conf.topological_order:
+                v = conf.vertices[name]
+                in_types = [types[src] for src in conf.vertex_inputs[name]]
+                if isinstance(v, LayerVertex):
+                    pp = infer_preprocessor(in_types[0], v.layer)
+                    if pp is not None:
+                        # wrap: preprocessor folded into the vertex via explicit chain
+                        pre_name = f"{name}-preprocessor"
+                        conf.vertices[pre_name] = PreprocessorVertex(preprocessor=pp)
+                        conf.vertex_inputs[pre_name] = conf.vertex_inputs[name]
+                        conf.vertex_inputs[name] = [pre_name]
+                        in_types = [pp.output_type(in_types[0])]
+                    v.layer.set_n_in(in_types[0])
+                types[name] = v.output_type(in_types)
+            conf.topological_order = conf.topo_sort()
+        return conf

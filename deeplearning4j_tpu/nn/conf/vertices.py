@@ -1,0 +1,284 @@
+"""Graph vertices for ComputationGraph DAGs.
+
+Reference: nn/graph/vertex/impl/ — ElementWiseVertex, MergeVertex, SubsetVertex,
+L2NormalizeVertex, ScaleVertex, ShiftVertex, StackVertex, UnstackVertex,
+PreprocessorVertex, LayerVertex, rnn/{LastTimeStepVertex, DuplicateToTimeSeriesVertex}.
+Each is a pure function over its input activations; LayerVertex wraps a Layer config.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers.base import Layer
+from deeplearning4j_tpu.nn.conf.serde import register_config
+
+Array = jax.Array
+
+
+@dataclasses.dataclass
+class GraphVertex:
+    """Base vertex: pure apply over a list of input activations."""
+
+    def init_params(self, key: jax.Array, itypes: list) -> dict:
+        return {}
+
+    def init_state(self, itypes: list) -> dict:
+        return {}
+
+    def apply(self, params: dict, state: dict, inputs: list, *, train=False,
+              rng=None, mask=None):
+        raise NotImplementedError
+
+    def output_type(self, itypes: list) -> InputType:
+        return itypes[0]
+
+    def n_inputs(self) -> Optional[int]:
+        return None  # None = any
+
+
+@register_config("LayerVertex")
+@dataclasses.dataclass
+class LayerVertex(GraphVertex):
+    """Wraps a Layer config (reference nn/graph/vertex/impl/LayerVertex.java)."""
+
+    layer: Optional[Layer] = None
+
+    def init_params(self, key, itypes):
+        return self.layer.init_params(key, itypes[0])
+
+    def init_state(self, itypes):
+        return self.layer.init_state(itypes[0])
+
+    def apply(self, params, state, inputs, *, train=False, rng=None, mask=None):
+        return self.layer.apply(params, state, inputs[0], train=train, rng=rng,
+                                mask=mask)
+
+    def output_type(self, itypes):
+        return self.layer.output_type(itypes[0])
+
+    def n_inputs(self):
+        return 1
+
+
+@register_config("MergeVertex")
+@dataclasses.dataclass
+class MergeVertex(GraphVertex):
+    """Concatenate along the feature/channel axis (reference MergeVertex.java).
+    NHWC/BTF layouts put that at axis -1 for all ranks."""
+
+    def apply(self, params, state, inputs, *, train=False, rng=None, mask=None):
+        return jnp.concatenate(inputs, axis=-1), state
+
+    def output_type(self, itypes):
+        first = itypes[0]
+        if first.kind == "convolutional":
+            return InputType.convolutional(first.height, first.width,
+                                           sum(t.channels for t in itypes))
+        if first.kind == "recurrent":
+            return InputType.recurrent(sum(t.size for t in itypes), first.timesteps)
+        return InputType.feed_forward(sum(t.flat_size() for t in itypes))
+
+
+@register_config("ElementWiseVertex")
+@dataclasses.dataclass
+class ElementWiseVertex(GraphVertex):
+    """Elementwise Add/Subtract/Product/Max/Average (reference ElementWiseVertex.java)."""
+
+    op: str = "add"
+
+    def apply(self, params, state, inputs, *, train=False, rng=None, mask=None):
+        op = self.op.lower()
+        if op == "add":
+            out = sum(inputs)
+        elif op == "subtract":
+            out = inputs[0] - inputs[1]
+        elif op in ("product", "mul"):
+            out = inputs[0]
+            for x in inputs[1:]:
+                out = out * x
+            # product of >2 fine
+        elif op == "max":
+            out = inputs[0]
+            for x in inputs[1:]:
+                out = jnp.maximum(out, x)
+        elif op in ("average", "avg"):
+            out = sum(inputs) / len(inputs)
+        else:
+            raise ValueError(f"Unknown elementwise op '{self.op}'")
+        return out, state
+
+
+@register_config("SubsetVertex")
+@dataclasses.dataclass
+class SubsetVertex(GraphVertex):
+    """Select feature range [start, end] inclusive (reference SubsetVertex.java)."""
+
+    start: int = 0
+    end: int = 0
+
+    def apply(self, params, state, inputs, *, train=False, rng=None, mask=None):
+        return inputs[0][..., self.start:self.end + 1], state
+
+    def output_type(self, itypes):
+        n = self.end - self.start + 1
+        t = itypes[0]
+        if t.kind == "recurrent":
+            return InputType.recurrent(n, t.timesteps)
+        if t.kind == "convolutional":
+            return InputType.convolutional(t.height, t.width, n)
+        return InputType.feed_forward(n)
+
+    def n_inputs(self):
+        return 1
+
+
+@register_config("L2NormalizeVertex")
+@dataclasses.dataclass
+class L2NormalizeVertex(GraphVertex):
+    """x / ||x||_2 over feature dims (reference L2NormalizeVertex.java)."""
+
+    eps: float = 1e-8
+
+    def apply(self, params, state, inputs, *, train=False, rng=None, mask=None):
+        x = inputs[0]
+        axes = tuple(range(1, x.ndim))
+        norm = jnp.sqrt(jnp.sum(x * x, axis=axes, keepdims=True) + self.eps)
+        return x / norm, state
+
+    def n_inputs(self):
+        return 1
+
+
+@register_config("L2Vertex")
+@dataclasses.dataclass
+class L2Vertex(GraphVertex):
+    """Pairwise L2 distance between two inputs (reference L2Vertex.java) -> [B,1]."""
+
+    eps: float = 1e-8
+
+    def apply(self, params, state, inputs, *, train=False, rng=None, mask=None):
+        a, b = inputs[0], inputs[1]
+        d = a - b
+        axes = tuple(range(1, d.ndim))
+        return jnp.sqrt(jnp.sum(d * d, axis=axes, keepdims=False)[..., None] + self.eps), state
+
+    def output_type(self, itypes):
+        return InputType.feed_forward(1)
+
+    def n_inputs(self):
+        return 2
+
+
+@register_config("ScaleVertex")
+@dataclasses.dataclass
+class ScaleVertex(GraphVertex):
+    scale: float = 1.0
+
+    def apply(self, params, state, inputs, *, train=False, rng=None, mask=None):
+        return inputs[0] * self.scale, state
+
+    def n_inputs(self):
+        return 1
+
+
+@register_config("ShiftVertex")
+@dataclasses.dataclass
+class ShiftVertex(GraphVertex):
+    shift: float = 0.0
+
+    def apply(self, params, state, inputs, *, train=False, rng=None, mask=None):
+        return inputs[0] + self.shift, state
+
+    def n_inputs(self):
+        return 1
+
+
+@register_config("StackVertex")
+@dataclasses.dataclass
+class StackVertex(GraphVertex):
+    """Stack along batch dim (reference StackVertex.java — used for sharing one layer
+    across several inputs)."""
+
+    def apply(self, params, state, inputs, *, train=False, rng=None, mask=None):
+        return jnp.concatenate(inputs, axis=0), state
+
+
+@register_config("UnstackVertex")
+@dataclasses.dataclass
+class UnstackVertex(GraphVertex):
+    """Take slice ``index`` of ``num_stacks`` along batch dim (reference
+    UnstackVertex.java)."""
+
+    index: int = 0
+    num_stacks: int = 1
+
+    def apply(self, params, state, inputs, *, train=False, rng=None, mask=None):
+        x = inputs[0]
+        size = x.shape[0] // self.num_stacks
+        return x[self.index * size:(self.index + 1) * size], state
+
+    def n_inputs(self):
+        return 1
+
+
+@register_config("PreprocessorVertex")
+@dataclasses.dataclass
+class PreprocessorVertex(GraphVertex):
+    """Apply an InputPreProcessor standalone (reference PreprocessorVertex.java)."""
+
+    preprocessor: Optional[object] = None
+
+    def apply(self, params, state, inputs, *, train=False, rng=None, mask=None):
+        return self.preprocessor.pre_process(inputs[0], mask), state
+
+    def output_type(self, itypes):
+        return self.preprocessor.output_type(itypes[0])
+
+    def n_inputs(self):
+        return 1
+
+
+@register_config("LastTimeStepVertex")
+@dataclasses.dataclass
+class LastTimeStepVertex(GraphVertex):
+    """[B,T,F] -> [B,F] taking the last (or last-unmasked) step (reference
+    rnn/LastTimeStepVertex.java)."""
+
+    mask_input: Optional[str] = None
+
+    def apply(self, params, state, inputs, *, train=False, rng=None, mask=None):
+        x = inputs[0]
+        if mask is not None:
+            idx = jnp.maximum(jnp.sum(mask.astype(jnp.int32), axis=1) - 1, 0)
+            return x[jnp.arange(x.shape[0]), idx], state
+        return x[:, -1], state
+
+    def output_type(self, itypes):
+        return InputType.feed_forward(itypes[0].size)
+
+    def n_inputs(self):
+        return 1
+
+
+@register_config("DuplicateToTimeSeriesVertex")
+@dataclasses.dataclass
+class DuplicateToTimeSeriesVertex(GraphVertex):
+    """[B,F] -> [B,T,F] broadcast over time of a reference input (reference
+    rnn/DuplicateToTimeSeriesVertex.java). Needs two inputs: (vector, timeseries)."""
+
+    ts_input: Optional[str] = None
+
+    def apply(self, params, state, inputs, *, train=False, rng=None, mask=None):
+        x, ts = inputs[0], inputs[1]
+        return jnp.broadcast_to(x[:, None, :], (x.shape[0], ts.shape[1], x.shape[-1])), state
+
+    def output_type(self, itypes):
+        return InputType.recurrent(itypes[0].flat_size(), itypes[1].timesteps)
+
+    def n_inputs(self):
+        return 2

@@ -1,0 +1,328 @@
+"""ComputationGraph: DAG network with multi-input/multi-output training.
+
+Reference: nn/graph/ComputationGraph.java (2280 LoC) — init:266, fit:670/747,
+computeGradientAndScore:952, feedForward:1003, calcBackpropGradients:1174 (reverse topo).
+
+TPU-native: forward walks the topological order inside one traced function; autodiff
+produces the reverse-topo backward (the reference's hand-written calcBackpropGradients).
+The whole train step (multi-output loss sum + updaters) is one jit-compiled, donated
+function, as in MultiLayerNetwork.
+"""
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Iterable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nn.conf.graphconf import ComputationGraphConfiguration
+from deeplearning4j_tpu.nn.conf.vertices import LayerVertex
+from deeplearning4j_tpu.nn.multilayer import _updater_spec
+from deeplearning4j_tpu.nn.updaters import (
+    effective_lr, normalize_gradients, updater_init, updater_step,
+)
+from deeplearning4j_tpu.utils.pytree import flatten_params, num_params, unflatten_params
+
+Array = jax.Array
+
+
+@dataclasses.dataclass
+class MultiDataSet:
+    """Multi-input/multi-output dataset (reference ND4J MultiDataSet)."""
+
+    features: list
+    labels: list
+    features_masks: Optional[list] = None
+    labels_masks: Optional[list] = None
+
+    def num_examples(self) -> int:
+        return int(self.features[0].shape[0])
+
+
+def _graph_regularization(conf, params):
+    if not conf.global_conf.use_regularization:
+        return jnp.float32(0.0)
+    total = jnp.float32(0.0)
+    for name, vertex in conf.vertices.items():
+        if not isinstance(vertex, LayerVertex) or name not in params:
+            continue
+        layer = vertex.layer
+        for pname in layer.regularizable_params():
+            if pname not in params[name]:
+                continue
+            w = params[name][pname]
+            if layer.l1:
+                total = total + layer.l1 * jnp.sum(jnp.abs(w))
+            if layer.l2:
+                total = total + 0.5 * layer.l2 * jnp.sum(w * w)
+    return total
+
+
+def graph_forward(conf: ComputationGraphConfiguration, params: dict, states: dict,
+                  inputs: list, *, train: bool, rng: Optional[jax.Array],
+                  masks: Optional[list] = None, collect_loss_inputs: bool = False):
+    """Walk the DAG in topological order (reference feedForward:1003).
+
+    Masks are routed per input stream: each vertex receives the mask propagated from
+    its ancestors (first non-None among its inputs), mirroring the reference's
+    per-input mask arrays (ComputationGraph.setLayerMaskArrays).
+
+    Returns (activations dict, new states dict, loss_inputs dict) — loss_inputs maps
+    each loss-bearing output vertex to its pre-layer input (for compute_loss), while
+    acts[name] always holds the real activation so downstream consumers see the right
+    tensor even during training.
+    """
+    acts: dict[str, Array] = dict(zip(conf.network_inputs, inputs))
+    mask_of: dict[str, Optional[Array]] = {name: None for name in conf.network_inputs}
+    if masks:
+        for i, name in enumerate(conf.network_inputs):
+            if i < len(masks):
+                mask_of[name] = masks[i]
+    new_states: dict[str, dict] = {}
+    loss_inputs: dict[str, Array] = {}
+    order = conf.topological_order or conf.topo_sort()
+    rngs = (jax.random.split(rng, len(order)) if rng is not None
+            else [None] * len(order))
+    for i, name in enumerate(order):
+        vertex = conf.vertices[name]
+        srcs = conf.vertex_inputs[name]
+        vins = [acts[src] for src in srcs]
+        mask = next((mask_of[s] for s in srcs if mask_of.get(s) is not None), None)
+        if (collect_loss_inputs and name in conf.network_outputs
+                and isinstance(vertex, LayerVertex) and vertex.layer.has_loss()):
+            loss_inputs[name] = vins[0]
+        y, ns = vertex.apply(params.get(name, {}), states.get(name, {}), vins,
+                             train=train, rng=rngs[i], mask=mask)
+        acts[name] = y
+        new_states[name] = ns
+        mask_of[name] = mask
+    return acts, new_states, loss_inputs
+
+
+def graph_loss(conf, params, states, inputs, labels, rng, fmasks=None, lmasks=None):
+    """Sum of output-layer losses + regularization (reference computeGradientAndScore:952)."""
+    acts, new_states, loss_inputs = graph_forward(
+        conf, params, states, inputs, train=True, rng=rng, masks=fmasks,
+        collect_loss_inputs=True)
+    total = jnp.float32(0.0)
+    for i, out_name in enumerate(conf.network_outputs):
+        vertex = conf.vertices[out_name]
+        if not (isinstance(vertex, LayerVertex) and vertex.layer.has_loss()):
+            raise ValueError(f"Output vertex '{out_name}' has no loss function")
+        h = loss_inputs[out_name]
+        lmask = lmasks[i] if lmasks else None
+        total = total + vertex.layer.compute_loss(params[out_name], h, labels[i], lmask)
+    return total + _graph_regularization(conf, params), new_states
+
+
+def make_graph_train_step(conf: ComputationGraphConfiguration):
+    g = conf.global_conf
+
+    def train_step(params, states, upd_state, inputs, labels, rng, iteration,
+                   fmasks=None, lmasks=None):
+        (loss, new_states), grads = jax.value_and_grad(
+            lambda p: graph_loss(conf, p, states, inputs, labels, rng, fmasks, lmasks),
+            has_aux=True)(params)
+
+        new_params = {}
+        new_upd = {}
+        for name in conf.topological_order:
+            vertex = conf.vertices[name]
+            g_v = grads.get(name, {})
+            if not g_v or not isinstance(vertex, LayerVertex):
+                new_params[name] = params.get(name, {})
+                new_upd[name] = upd_state.get(name, {})
+                continue
+            layer = vertex.layer
+            g_v = normalize_gradients(g_v, layer.gradient_normalization,
+                                      layer.gradient_normalization_threshold or 1.0)
+            spec = _updater_spec(layer)
+            lr = effective_lr(layer.learning_rate, g.lr_policy, iteration,
+                              g.lr_policy_decay_rate, g.lr_policy_power,
+                              g.lr_policy_steps, g.lr_schedule, g.max_num_iterations)
+            lr_bias = (jnp.float32(layer.bias_learning_rate)
+                       if layer.bias_learning_rate is not None else lr)
+            p_new, u_new = {}, {}
+            for pname, grad in g_v.items():
+                this_lr = lr_bias if pname in ("b", "vb", "beta") else lr
+                step, ustate = updater_step(spec, grad, upd_state[name][pname],
+                                            this_lr, iteration)
+                p_new[pname] = params[name][pname] - step
+                u_new[pname] = ustate
+            new_params[name] = p_new
+            new_upd[name] = u_new
+        return new_params, new_states, new_upd, loss
+
+    return train_step
+
+
+class ComputationGraph:
+    """Stateful shell (reference nn/graph/ComputationGraph.java)."""
+
+    def __init__(self, conf: ComputationGraphConfiguration):
+        self.conf = conf
+        self.params_list: Optional[dict] = None   # name -> params dict
+        self.state_list: Optional[dict] = None
+        self.updater_state: Optional[dict] = None
+        self.iteration = 0
+        self.epoch = 0
+        self.listeners: list = []
+        self.score_value = float("nan")
+        self._rng = None
+        self._jit_cache: dict = {}
+
+    # ------------------------------------------------------------------ lifecycle
+    def init(self, seed: Optional[int] = None) -> "ComputationGraph":
+        g = self.conf.global_conf
+        key = jax.random.PRNGKey(g.seed if seed is None else seed)
+        self._rng = jax.random.fold_in(key, 0xC6)
+        order = self.conf.topological_order or self.conf.topo_sort()
+        self.conf.topological_order = order
+        keys = jax.random.split(key, max(len(order), 1))
+        # propagate input types for init
+        types: dict = {}
+        if self.conf.input_types:
+            types.update(zip(self.conf.network_inputs, self.conf.input_types))
+        self.params_list = {}
+        self.state_list = {}
+        for i, name in enumerate(order):
+            vertex = self.conf.vertices[name]
+            in_types = [types.get(src) for src in self.conf.vertex_inputs[name]]
+            self.params_list[name] = vertex.init_params(keys[i], in_types)
+            self.state_list[name] = vertex.init_state(in_types)
+            try:
+                types[name] = vertex.output_type(in_types)
+            except Exception:
+                types[name] = None
+        self.updater_state = {
+            name: {pname: updater_init(_updater_spec(self.conf.vertices[name].layer), p)
+                   for pname, p in params.items()}
+            if isinstance(self.conf.vertices[name], LayerVertex) else {}
+            for name, params in self.params_list.items()
+        }
+        return self
+
+    def set_listeners(self, *listeners) -> None:
+        self.listeners = list(listeners)
+
+    # ------------------------------------------------------------------ params API
+    def params(self) -> Array:
+        return flatten_params(self.params_list, jnp.float32)
+
+    def set_params(self, flat: Array) -> None:
+        self.params_list = unflatten_params(self.params_list, flat)
+
+    def num_params(self) -> int:
+        return num_params(self.params_list)
+
+    # ------------------------------------------------------------------ inference
+    def _jit(self, name, fn):
+        if name not in self._jit_cache:
+            self._jit_cache[name] = jax.jit(fn)
+        return self._jit_cache[name]
+
+    def output(self, *inputs) -> list:
+        """Forward pass returning all network outputs (reference output:1520)."""
+        xs = [jnp.asarray(x) for x in inputs]
+        fn = self._jit("output", self._output_pure)
+        outs, _ = fn(self.params_list, self.state_list, xs)
+        return outs
+
+    def _output_pure(self, params, states, xs):
+        acts, ns, _ = graph_forward(self.conf, params, states, xs, train=False,
+                                    rng=None)
+        return [acts[o] for o in self.conf.network_outputs], ns
+
+    def score(self, mds: MultiDataSet) -> float:
+        xs = [jnp.asarray(f) for f in mds.features]
+        ys = [jnp.asarray(l) for l in mds.labels]
+        fn = self._jit("score", self._score_pure)
+        return float(fn(self.params_list, self.state_list, xs, ys))
+
+    def _score_pure(self, params, states, xs, ys):
+        loss, _ = graph_loss(self.conf, params, states, xs, ys, None)
+        return loss
+
+    # ------------------------------------------------------------------ training
+    def _next_rng(self):
+        if self._rng is None:
+            raise RuntimeError("Network not initialized — call net.init() before "
+                               "fit/output (reference ComputationGraph.init:266)")
+        self._rng, sub = jax.random.split(self._rng)
+        return sub
+
+    def fit(self, data, labels=None, *, epochs: int = 1) -> None:
+        """Fit on a MultiDataSet, DataSet, iterator, or (inputs, labels) lists
+        (reference fit:670/747)."""
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+
+        if isinstance(data, MultiDataSet):
+            self._fit_batch(data.features, data.labels,
+                            data.features_masks, data.labels_masks)
+            return
+        if isinstance(data, DataSet):
+            self._fit_batch([data.features], [data.labels],
+                            [data.features_mask] if data.features_mask is not None else None,
+                            [data.labels_mask] if data.labels_mask is not None else None)
+            return
+        if labels is not None:
+            xs = data if isinstance(data, (list, tuple)) else [data]
+            ys = labels if isinstance(labels, (list, tuple)) else [labels]
+            self._fit_batch(list(xs), list(ys))
+            return
+        for _ in range(epochs):
+            if hasattr(data, "reset"):
+                data.reset()
+            for ds in data:
+                if isinstance(ds, MultiDataSet):
+                    self._fit_batch(ds.features, ds.labels,
+                                    ds.features_masks, ds.labels_masks)
+                else:
+                    self._fit_batch([ds.features], [ds.labels],
+                                    [ds.features_mask] if ds.features_mask is not None else None,
+                                    [ds.labels_mask] if ds.labels_mask is not None else None)
+            self.epoch += 1
+
+    def _fit_batch(self, xs, ys, fmasks=None, lmasks=None) -> None:
+        xs = [jnp.asarray(x) for x in xs]
+        ys = [jnp.asarray(y) for y in ys]
+        fmasks = [jnp.asarray(m) for m in fmasks] if fmasks else None
+        lmasks = [jnp.asarray(m) for m in lmasks] if lmasks else None
+        step = self._jit("train_step", make_graph_train_step(self.conf))
+        for _ in range(max(1, self.conf.global_conf.iterations)):
+            (self.params_list, self.state_list, self.updater_state,
+             loss) = step(self.params_list, self.state_list, self.updater_state,
+                          xs, ys, self._next_rng(), jnp.int32(self.iteration),
+                          fmasks, lmasks)
+            self.score_value = float(loss)
+            self.iteration += 1
+            for listener in self.listeners:
+                listener.iteration_done(self, self.iteration)
+
+    # ------------------------------------------------------------------ evaluation
+    def evaluate(self, iterator):
+        from deeplearning4j_tpu.eval.evaluation import Evaluation
+
+        ev = Evaluation()
+        if hasattr(iterator, "reset"):
+            iterator.reset()
+        for ds in iterator:
+            feats = ds.features if isinstance(ds, MultiDataSet) else [ds.features]
+            labels = ds.labels if isinstance(ds, MultiDataSet) else [ds.labels]
+            outs = self.output(*feats)
+            ev.eval(np.asarray(labels[0]), np.asarray(outs[0]))
+        return ev
+
+    def gradient_and_score(self, xs, ys):
+        xs = [jnp.asarray(x) for x in xs]
+        ys = [jnp.asarray(y) for y in ys]
+
+        def lf(p):
+            loss, _ = graph_loss(self.conf, p, self.state_list, xs, ys, None)
+            return loss
+
+        loss, grads = jax.value_and_grad(lf)(self.params_list)
+        return grads, float(loss)

@@ -273,6 +273,9 @@ class MultiLayerNetwork:
 
     # ------------------------------------------------------------------ training
     def _next_rng(self):
+        if self._rng is None:
+            raise RuntimeError("Network not initialized — call net.init() before "
+                               "fit/output (reference MultiLayerNetwork.init:386)")
         self._rng, sub = jax.random.split(self._rng)
         return sub
 

@@ -1,0 +1,105 @@
+"""Device-mesh construction and sharding rules.
+
+This is the TPU-native replacement for the reference's entire distribution transport
+stack (SURVEY.md §2.4): Spark RDD tree-aggregation, the Aeron parameter server, and
+in-process P2P parameter averaging all become XLA collectives over a
+``jax.sharding.Mesh`` — psum over ICI inside a slice, DCN across slices via
+jax.distributed. Axis conventions:
+
+  data  — data parallelism (ParallelWrapper / ParameterAveragingTrainingMaster)
+  model — tensor parallelism (new TPU-native capability, absent in reference)
+  seq   — sequence/context parallelism for long sequences (ring attention)
+
+Multi-host: call ``init_distributed()`` (jax.distributed.initialize) before building
+the mesh; jax.devices() then spans all hosts and the same code scales out — the
+replacement for the reference's Spark cluster setup.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def init_distributed(coordinator_address: Optional[str] = None,
+                     num_processes: Optional[int] = None,
+                     process_id: Optional[int] = None) -> None:
+    """Multi-host init (replaces Spark driver/executor RPC + Aeron media driver,
+    reference ParameterServerParallelWrapper.java:159-161)."""
+    jax.distributed.initialize(coordinator_address=coordinator_address,
+                               num_processes=num_processes, process_id=process_id)
+
+
+def build_mesh(axes: dict[str, int], devices: Optional[Sequence] = None) -> Mesh:
+    """Build a named mesh, e.g. build_mesh({"data": 4, "model": 2})."""
+    devices = list(devices if devices is not None else jax.devices())
+    sizes = list(axes.values())
+    total = int(np.prod(sizes))
+    if total > len(devices):
+        raise ValueError(f"Mesh needs {total} devices, have {len(devices)}")
+    arr = np.array(devices[:total]).reshape(sizes)
+    return Mesh(arr, tuple(axes.keys()))
+
+
+def data_parallel_mesh(n: Optional[int] = None,
+                       devices: Optional[Sequence] = None) -> Mesh:
+    devices = list(devices if devices is not None else jax.devices())
+    n = n or len(devices)
+    return build_mesh({"data": n}, devices)
+
+
+# --------------------------------------------------------------------- shardings
+def batch_sharding(mesh: Mesh):
+    """Shard leading (batch) dim over 'data'."""
+    return NamedSharding(mesh, P("data"))
+
+
+def replicated(mesh: Mesh):
+    return NamedSharding(mesh, P())
+
+
+def param_pspec(layer, param_name: str, shape: Sequence[int],
+                model_axis: str = "model", axis_size: int = 1) -> P:
+    """Tensor-parallel partition spec for one parameter.
+
+    Rules (Megatron-style column parallelism on dense-like weights): shard the output
+    dim of 2-D weights and conv n_out over 'model'; replicate small vectors, norm
+    params, and anything not divisible by the axis. XLA GSPMD inserts the
+    all-gathers/reduce-scatters that the sharding implies — nothing manual.
+    """
+    def ok(dim):
+        return axis_size > 0 and shape[dim] % axis_size == 0
+
+    if len(shape) == 2 and param_name in ("W", "RW", "FW", "FRW", "BW", "BRW") and ok(1):
+        return P(None, model_axis)
+    if len(shape) == 4 and param_name == "W" and ok(3):  # conv HWIO: shard out chans
+        return P(None, None, None, model_axis)
+    if len(shape) == 1 and param_name in ("b", "Fb", "Bb") and shape[0] >= 8 and ok(0):
+        return P(model_axis)
+    return P()
+
+
+def shard_params_for_tp(params_tree, conf, mesh: Mesh, model_axis: str = "model"):
+    """Apply tensor-parallel shardings to a params pytree (list- or dict-style)."""
+    axis_size = mesh.shape.get(model_axis, 1)
+
+    def spec_tree(layer, params):
+        return {name: NamedSharding(mesh, param_pspec(layer, name, p.shape,
+                                                      model_axis, axis_size))
+                for name, p in params.items()}
+
+    if isinstance(params_tree, list):  # MultiLayerNetwork
+        return [jax.device_put(p, spec_tree(layer, p))
+                if p else p
+                for layer, p in zip(conf.layers, params_tree)]
+    out = {}
+    for name, p in params_tree.items():  # ComputationGraph
+        vertex = conf.vertices[name]
+        layer = getattr(vertex, "layer", None)
+        if layer is not None and p:
+            out[name] = jax.device_put(p, spec_tree(layer, p))
+        else:
+            out[name] = p
+    return out

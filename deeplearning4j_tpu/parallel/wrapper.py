@@ -1,0 +1,250 @@
+"""ParallelWrapper: data-parallel training over a device mesh.
+
+Reference: deeplearning4j-scaleout ParallelWrapper.java:44 — clones the model into one
+trainer thread per device, round-robin feeds minibatches, averages params every
+``averaging_frequency`` iterations via Nd4j.averageAndPropagate (:179) and optionally
+averages updater state (:198-212).
+
+TPU-native redesign — no threads, no clones, no explicit averaging transport:
+
+* averaging_frequency == 1 (synchronous DP): ONE jit-compiled train step whose batch
+  input is sharded over the 'data' mesh axis and whose params are replicated. The loss
+  is the global-batch mean, so autodiff's gradients are automatically all-reduced by
+  XLA (psum over ICI) — bitwise the same math as lockstep parameter averaging every
+  iteration, with the collective fused into the step.
+
+* averaging_frequency == N > 1 (local SGD, the reference's actual semantics): params
+  carry a leading per-replica axis sharded over 'data'; a shard_map train step updates
+  each replica locally from its shard of the batch, and every N iterations a psum-mean
+  resynchronizes params (and optionally updater state) across replicas.
+
+The same wrapper covers the Spark ParameterAveragingTrainingMaster use-case
+(SURVEY.md §2.4): multi-host, the mesh just spans hosts via jax.distributed and the
+collectives ride DCN.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+from deeplearning4j_tpu.datasets.iterators import AsyncDataSetIterator
+from deeplearning4j_tpu.parallel.mesh import data_parallel_mesh
+
+
+class ParallelWrapperBuilder:
+    """Mirrors reference ParallelWrapper.Builder (:483+)."""
+
+    def __init__(self, model):
+        self._model = model
+        self._workers: Optional[int] = None
+        self._prefetch = 2
+        self._avg_freq = 1
+        self._average_updaters = True
+        self._report_score = False
+        self._mesh: Optional[Mesh] = None
+
+    def workers(self, n: int) -> "ParallelWrapperBuilder":
+        self._workers = n
+        return self
+
+    def prefetch_buffer(self, n: int) -> "ParallelWrapperBuilder":
+        self._prefetch = n
+        return self
+
+    def averaging_frequency(self, n: int) -> "ParallelWrapperBuilder":
+        self._avg_freq = max(1, n)
+        return self
+
+    def average_updaters(self, flag: bool) -> "ParallelWrapperBuilder":
+        self._average_updaters = flag
+        return self
+
+    def report_score_after_averaging(self, flag: bool) -> "ParallelWrapperBuilder":
+        self._report_score = flag
+        return self
+
+    def mesh(self, mesh: Mesh) -> "ParallelWrapperBuilder":
+        self._mesh = mesh
+        return self
+
+    def build(self) -> "ParallelWrapper":
+        return ParallelWrapper(self._model, workers=self._workers,
+                               prefetch=self._prefetch,
+                               averaging_frequency=self._avg_freq,
+                               average_updaters=self._average_updaters,
+                               report_score=self._report_score, mesh=self._mesh)
+
+
+class ParallelWrapper:
+    def __init__(self, model, workers: Optional[int] = None, prefetch: int = 2,
+                 averaging_frequency: int = 1, average_updaters: bool = True,
+                 report_score: bool = False, mesh: Optional[Mesh] = None):
+        self.model = model
+        self.mesh = mesh or data_parallel_mesh(workers)
+        self.n_workers = self.mesh.shape["data"]
+        self.prefetch = prefetch
+        self.averaging_frequency = averaging_frequency
+        self.average_updaters = average_updaters
+        self.report_score = report_score
+        self._sync_step = None
+        self._local_step = None
+        self._avg_fn = None
+        self._local = None  # stacked per-replica (params, states, upd) for local-SGD
+
+    @staticmethod
+    def builder(model) -> ParallelWrapperBuilder:
+        return ParallelWrapperBuilder(model)
+
+    # ------------------------------------------------------------------ public API
+    def fit(self, iterator, epochs: int = 1) -> None:
+        """Reference fit(DataSetIterator):322. Batches are sharded over the mesh;
+        each global batch must be divisible by the number of workers."""
+        if self.prefetch:
+            iterator = AsyncDataSetIterator(iterator, queue_size=self.prefetch)
+        if self.averaging_frequency == 1:
+            self._fit_sync(iterator, epochs)
+        else:
+            self._fit_local_sgd(iterator, epochs)
+
+    # ------------------------------------------------------- synchronous DP (freq=1)
+    def _make_sync_step(self):
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork, make_train_step
+
+        net = self.model
+        mesh = self.mesh
+        repl = NamedSharding(mesh, P())
+        batch_sh = NamedSharding(mesh, P("data"))
+        if isinstance(net, MultiLayerNetwork):
+            base = make_train_step(net.conf)
+        else:
+            from deeplearning4j_tpu.nn.graph_network import make_graph_train_step
+            base = make_graph_train_step(net.conf)
+
+        def step(params, states, upd, x, y, rng, it):
+            return base(params, states, upd, x, y, rng, it)
+
+        return jax.jit(
+            step,
+            in_shardings=(repl, repl, repl, batch_sh, batch_sh, repl, repl),
+            out_shardings=(repl, repl, repl, repl),
+        )
+
+    def _fit_sync(self, iterator, epochs: int) -> None:
+        net = self.model
+        if self._sync_step is None:
+            self._sync_step = self._make_sync_step()
+        from deeplearning4j_tpu.nn.graph_network import ComputationGraph
+
+        is_graph = isinstance(net, ComputationGraph)
+        for _ in range(epochs):
+            if hasattr(iterator, "reset"):
+                iterator.reset()
+            for ds in iterator:
+                if is_graph:
+                    x = [jnp.asarray(f) for f in ([ds.features] if not isinstance(ds.features, list) else ds.features)]
+                    y = [jnp.asarray(l) for l in ([ds.labels] if not isinstance(ds.labels, list) else ds.labels)]
+                else:
+                    x, y = jnp.asarray(ds.features), jnp.asarray(ds.labels)
+                (net.params_list, net.state_list, net.updater_state, loss) = \
+                    self._sync_step(net.params_list, net.state_list,
+                                    net.updater_state, x, y, net._next_rng(),
+                                    jnp.int32(net.iteration))
+                net.score_value = float(loss)
+                net.iteration += 1
+                for listener in net.listeners:
+                    listener.iteration_done(net, net.iteration)
+
+    # --------------------------------------------------- local SGD (freq=N>1)
+    def _make_local_sgd_fns(self):
+        """shard_map local step over stacked per-replica params + psum-mean averager
+        (reference averaging loop ParallelWrapper.java:179-212)."""
+        from deeplearning4j_tpu.nn.graph_network import ComputationGraph, make_graph_train_step
+        from deeplearning4j_tpu.nn.multilayer import make_train_step
+
+        net = self.model
+        mesh = self.mesh
+        if isinstance(net, ComputationGraph):
+            if len(net.conf.network_inputs) != 1 or len(net.conf.network_outputs) != 1:
+                raise NotImplementedError(
+                    "local-SGD averaging supports single-input/single-output "
+                    "ComputationGraphs; use averaging_frequency=1 for multi-IO graphs")
+            graph_base = make_graph_train_step(net.conf)
+            base = lambda p, s, u, x, y, r, it: graph_base(p, s, u, [x], [y], r, it)
+        else:
+            base = make_train_step(net.conf)
+        stacked = P("data")
+        repl = P()
+
+        def local_step(params, states, upd, x, y, rng, it):
+            # inside shard_map: leading axis is this replica's slice (size 1); drop it
+            sq = functools.partial(jax.tree_util.tree_map, lambda a: a[0])
+            ex = functools.partial(jax.tree_util.tree_map, lambda a: a[None])
+            p, s, u = sq(params), sq(states), sq(upd)
+            rng_local = jax.random.fold_in(rng, jax.lax.axis_index("data"))
+            p2, s2, u2, loss = base(p, s, u, x, y, rng_local, it)
+            return ex(p2), ex(s2), ex(u2), jax.lax.pmean(loss, "data")
+
+        local = jax.jit(shard_map(
+            local_step, mesh=mesh,
+            in_specs=(stacked, stacked, stacked, stacked, stacked, repl, repl),
+            out_specs=(stacked, stacked, stacked, repl),
+        ))
+
+        def average(params, upd, states):
+            mean_bcast = lambda a: jnp.broadcast_to(
+                jnp.mean(a, axis=0, keepdims=True), a.shape)
+            avg = jax.tree_util.tree_map(mean_bcast, params)
+            if self.average_updaters:
+                upd = jax.tree_util.tree_map(mean_bcast, upd)
+            # model state (batchnorm running stats) is averaged too — the reference
+            # keeps BN stats inside params, which averageAndPropagate averages
+            states = jax.tree_util.tree_map(mean_bcast, states)
+            return avg, upd, states
+
+        avg_fn = jax.jit(average)
+        return local, avg_fn
+
+    def _fit_local_sgd(self, iterator, epochs: int) -> None:
+        net = self.model
+        D = self.n_workers
+        if self._local_step is None:
+            self._local_step, self._avg_fn = self._make_local_sgd_fns()
+        stack = functools.partial(
+            jax.tree_util.tree_map,
+            lambda a: jnp.broadcast_to(a[None], (D,) + a.shape))
+        sharding = NamedSharding(self.mesh, P("data"))
+        params = jax.device_put(stack(net.params_list), sharding) \
+            if jax.tree_util.tree_leaves(net.params_list) else net.params_list
+        states = stack(net.state_list)
+        upd = stack(net.updater_state)
+        batch_sh = NamedSharding(self.mesh, P("data"))
+        since_avg = 0
+        for _ in range(epochs):
+            if hasattr(iterator, "reset"):
+                iterator.reset()
+            for ds in iterator:
+                x = jax.device_put(jnp.asarray(ds.features), batch_sh)
+                y = jax.device_put(jnp.asarray(ds.labels), batch_sh)
+                params, states, upd, loss = self._local_step(
+                    params, states, upd, x, y, net._next_rng(),
+                    jnp.int32(net.iteration))
+                net.score_value = float(loss)
+                net.iteration += 1
+                since_avg += 1
+                if since_avg >= self.averaging_frequency:
+                    params, upd, states = self._avg_fn(params, upd, states)
+                    since_avg = 0
+                for listener in net.listeners:
+                    listener.iteration_done(net, net.iteration)
+        # final sync + unstack back into the model
+        params, upd, states = self._avg_fn(params, upd, states)
+        unstack = functools.partial(jax.tree_util.tree_map, lambda a: a[0])
+        net.params_list = unstack(params)
+        net.state_list = unstack(states)
+        net.updater_state = unstack(upd)

@@ -1,0 +1,138 @@
+"""Model serialization: single-file zip checkpoint with config + params + updater state.
+
+Reference: util/ModelSerializer.java:41-118 — zip container with configuration.json,
+coefficients.bin, updaterState.bin, normalizer.bin. Same container layout here (npz
+streams instead of raw ND4J buffers), so training resumes bit-identically: optimizer
+state is saved alongside parameters, and batchnorm running stats ride in a state entry
+(the reference keeps them inside params; here they are a separate pytree).
+
+Also provides ModelGuesser-style load-anything (reference core util/ModelGuesser.java).
+"""
+from __future__ import annotations
+
+import io
+import json
+import zipfile
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+CONFIG_ENTRY = "configuration.json"
+PARAMS_ENTRY = "coefficients.npz"
+UPDATER_ENTRY = "updaterState.npz"
+MODEL_STATE_ENTRY = "modelState.npz"
+NORMALIZER_ENTRY = "normalizer.npz"
+META_ENTRY = "meta.json"
+
+
+def _tree_to_npz_bytes(tree) -> bytes:
+    """Serialize a pytree of arrays to npz with path-encoded keys."""
+    leaves_with_paths = jax.tree_util.tree_flatten_with_path(tree)[0]
+    arrays = {}
+    for path, leaf in leaves_with_paths:
+        key = "/".join(str(getattr(p, "key", getattr(p, "idx", p))) for p in path)
+        arrays[key] = np.asarray(leaf)
+    buf = io.BytesIO()
+    np.savez(buf, **arrays)
+    return buf.getvalue()
+
+
+def _npz_bytes_to_tree(template, data: bytes):
+    """Restore a pytree from npz using ``template`` for structure."""
+    npz = np.load(io.BytesIO(data))
+    paths, treedef = jax.tree_util.tree_flatten_with_path(template)
+    leaves = []
+    for path, leaf in paths:
+        key = "/".join(str(getattr(p, "key", getattr(p, "idx", p))) for p in path)
+        arr = npz[key]
+        leaves.append(jnp.asarray(arr, leaf.dtype if hasattr(leaf, "dtype") else None))
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+def write_model(net, path: str, save_updater: bool = True,
+                normalizer=None) -> None:
+    """Write a model zip (reference ModelSerializer.writeModel:55-118)."""
+    with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as zf:
+        zf.writestr(CONFIG_ENTRY, net.conf.to_json())
+        zf.writestr(PARAMS_ENTRY, _tree_to_npz_bytes(net.params_list))
+        zf.writestr(MODEL_STATE_ENTRY, _tree_to_npz_bytes(net.state_list))
+        if save_updater and net.updater_state is not None:
+            zf.writestr(UPDATER_ENTRY, _tree_to_npz_bytes(net.updater_state))
+        if normalizer is not None:
+            zf.writestr(NORMALIZER_ENTRY, _tree_to_npz_bytes(normalizer.to_arrays()))
+        meta = {"iteration": net.iteration, "epoch": getattr(net, "epoch", 0),
+                "model_type": type(net).__name__,
+                "framework": "deeplearning4j_tpu", "format_version": 1}
+        zf.writestr(META_ENTRY, json.dumps(meta))
+
+
+def restore_multi_layer_network(path: str, load_updater: bool = True):
+    """Reference ModelSerializer.restoreMultiLayerNetwork."""
+    from deeplearning4j_tpu.nn.conf.multilayer import MultiLayerConfiguration
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    with zipfile.ZipFile(path) as zf:
+        conf = MultiLayerConfiguration.from_json(zf.read(CONFIG_ENTRY).decode())
+        net = MultiLayerNetwork(conf)
+        net.init()
+        net.params_list = _npz_bytes_to_tree(net.params_list, zf.read(PARAMS_ENTRY))
+        if MODEL_STATE_ENTRY in zf.namelist():
+            net.state_list = _npz_bytes_to_tree(net.state_list,
+                                                zf.read(MODEL_STATE_ENTRY))
+        if load_updater and UPDATER_ENTRY in zf.namelist():
+            net.updater_state = _npz_bytes_to_tree(net.updater_state,
+                                                   zf.read(UPDATER_ENTRY))
+        if META_ENTRY in zf.namelist():
+            meta = json.loads(zf.read(META_ENTRY).decode())
+            net.iteration = meta.get("iteration", 0)
+            net.epoch = meta.get("epoch", 0)
+    return net
+
+
+def restore_computation_graph(path: str, load_updater: bool = True):
+    """Reference ModelSerializer.restoreComputationGraph."""
+    from deeplearning4j_tpu.nn.conf.graphconf import ComputationGraphConfiguration
+    from deeplearning4j_tpu.nn.graph_network import ComputationGraph
+
+    with zipfile.ZipFile(path) as zf:
+        conf = ComputationGraphConfiguration.from_json(zf.read(CONFIG_ENTRY).decode())
+        net = ComputationGraph(conf)
+        net.init()
+        net.params_list = _npz_bytes_to_tree(net.params_list, zf.read(PARAMS_ENTRY))
+        if MODEL_STATE_ENTRY in zf.namelist():
+            net.state_list = _npz_bytes_to_tree(net.state_list,
+                                                zf.read(MODEL_STATE_ENTRY))
+        if load_updater and UPDATER_ENTRY in zf.namelist():
+            net.updater_state = _npz_bytes_to_tree(net.updater_state,
+                                                   zf.read(UPDATER_ENTRY))
+        if META_ENTRY in zf.namelist():
+            meta = json.loads(zf.read(META_ENTRY).decode())
+            net.iteration = meta.get("iteration", 0)
+            net.epoch = meta.get("epoch", 0)
+    return net
+
+
+def restore_normalizer(path: str):
+    from deeplearning4j_tpu.datasets.dataset import NormalizerStandardize
+
+    with zipfile.ZipFile(path) as zf:
+        if NORMALIZER_ENTRY not in zf.namelist():
+            return None
+        npz = np.load(io.BytesIO(zf.read(NORMALIZER_ENTRY)))
+        return NormalizerStandardize.from_arrays({k: npz[k] for k in npz.files})
+
+
+def guess_model(path: str):
+    """Load whichever model type the file contains (reference util/ModelGuesser.java)."""
+    with zipfile.ZipFile(path) as zf:
+        if META_ENTRY in zf.namelist():
+            meta = json.loads(zf.read(META_ENTRY).decode())
+            if meta.get("model_type") == "ComputationGraph":
+                return restore_computation_graph(path)
+            return restore_multi_layer_network(path)
+        config = json.loads(zf.read(CONFIG_ENTRY).decode())
+        if config.get("@type") == "ComputationGraphConfiguration":
+            return restore_computation_graph(path)
+        return restore_multi_layer_network(path)

@@ -1,0 +1,225 @@
+"""ComputationGraph tests: DAG topology, multi-input/output, gradient flow.
+
+Reference analog: deeplearning4j-core TestComputationGraphNetwork +
+GradientCheckTestsComputationGraph.
+"""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn.conf.builders import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.graphconf import ComputationGraphConfiguration
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import (
+    ConvolutionLayer, DenseLayer, GravesLSTM, OutputLayer, SubsamplingLayer,
+)
+from deeplearning4j_tpu.nn.conf.vertices import (
+    ElementWiseVertex, L2NormalizeVertex, LastTimeStepVertex, MergeVertex,
+    ScaleVertex, StackVertex, SubsetVertex, UnstackVertex,
+)
+from deeplearning4j_tpu.nn.graph_network import ComputationGraph, MultiDataSet
+
+
+def test_simple_chain_equals_mlp():
+    conf = (NeuralNetConfiguration.builder()
+            .seed(1).learning_rate(0.1)
+            .graph_builder()
+            .add_inputs("in")
+            .add_layer("dense", DenseLayer(n_in=4, n_out=8, activation="tanh"), "in")
+            .add_layer("out", OutputLayer(n_in=8, n_out=3, loss="mcxent",
+                                          activation="softmax"), "dense")
+            .set_outputs("out")
+            .build())
+    net = ComputationGraph(conf).init()
+    x = np.random.default_rng(0).normal(size=(5, 4)).astype(np.float32)
+    outs = net.output(x)
+    assert len(outs) == 1
+    assert outs[0].shape == (5, 3)
+    np.testing.assert_allclose(np.asarray(jnp.sum(outs[0], -1)), 1.0, rtol=1e-5)
+
+
+def test_merge_vertex_two_towers():
+    conf = (NeuralNetConfiguration.builder()
+            .seed(2).learning_rate(0.1)
+            .graph_builder()
+            .add_inputs("in1", "in2")
+            .add_layer("d1", DenseLayer(n_in=3, n_out=4, activation="relu"), "in1")
+            .add_layer("d2", DenseLayer(n_in=5, n_out=6, activation="relu"), "in2")
+            .add_vertex("merge", MergeVertex(), "d1", "d2")
+            .add_layer("out", OutputLayer(n_in=10, n_out=2, loss="mcxent",
+                                          activation="softmax"), "merge")
+            .set_outputs("out")
+            .build())
+    net = ComputationGraph(conf).init()
+    rng = np.random.default_rng(1)
+    x1 = rng.normal(size=(4, 3)).astype(np.float32)
+    x2 = rng.normal(size=(4, 5)).astype(np.float32)
+    outs = net.output(x1, x2)
+    assert outs[0].shape == (4, 2)
+    # training decreases loss
+    y = np.zeros((4, 2), np.float32)
+    y[:, 0] = 1
+    mds = MultiDataSet([x1, x2], [y])
+    s0 = net.score(mds)
+    for _ in range(30):
+        net.fit(mds)
+    assert net.score(mds) < s0
+
+
+def test_residual_elementwise_add():
+    conf = (NeuralNetConfiguration.builder()
+            .seed(3).learning_rate(0.05)
+            .graph_builder()
+            .add_inputs("in")
+            .add_layer("d1", DenseLayer(n_in=6, n_out=6, activation="relu"), "in")
+            .add_vertex("residual", ElementWiseVertex(op="add"), "d1", "in")
+            .add_layer("out", OutputLayer(n_in=6, n_out=2, loss="mse",
+                                          activation="identity"), "residual")
+            .set_outputs("out")
+            .build())
+    net = ComputationGraph(conf).init()
+    x = np.random.default_rng(0).normal(size=(3, 6)).astype(np.float32)
+    assert net.output(x)[0].shape == (3, 2)
+
+
+def test_multi_output():
+    conf = (NeuralNetConfiguration.builder()
+            .seed(4).learning_rate(0.1)
+            .graph_builder()
+            .add_inputs("in")
+            .add_layer("shared", DenseLayer(n_in=4, n_out=8, activation="tanh"), "in")
+            .add_layer("out1", OutputLayer(n_in=8, n_out=3, loss="mcxent",
+                                           activation="softmax"), "shared")
+            .add_layer("out2", OutputLayer(n_in=8, n_out=1, loss="mse",
+                                           activation="identity"), "shared")
+            .set_outputs("out1", "out2")
+            .build())
+    net = ComputationGraph(conf).init()
+    rng = np.random.default_rng(2)
+    x = rng.normal(size=(6, 4)).astype(np.float32)
+    y1 = np.zeros((6, 3), np.float32)
+    y1[:, 1] = 1
+    y2 = rng.normal(size=(6, 1)).astype(np.float32)
+    outs = net.output(x)
+    assert outs[0].shape == (6, 3) and outs[1].shape == (6, 1)
+    mds = MultiDataSet([x], [y1, y2])
+    s0 = net.score(mds)
+    for _ in range(40):
+        net.fit(mds)
+    assert net.score(mds) < s0
+
+
+def test_cnn_input_type_propagation():
+    conf = (NeuralNetConfiguration.builder()
+            .seed(5).learning_rate(0.01)
+            .graph_builder()
+            .add_inputs("in")
+            .add_layer("conv", ConvolutionLayer(n_out=4, kernel_size=(3, 3),
+                                                activation="relu"), "in")
+            .add_layer("pool", SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2)), "conv")
+            .add_layer("dense", DenseLayer(n_out=16, activation="relu"), "pool")
+            .add_layer("out", OutputLayer(n_out=3, loss="mcxent",
+                                          activation="softmax"), "dense")
+            .set_outputs("out")
+            .set_input_types(InputType.convolutional(10, 10, 2))
+            .build())
+    # conv n_in inferred from channels; dense n_in from flattened pool output 4*4*4
+    assert conf.vertices["conv"].layer.n_in == 2
+    assert conf.vertices["dense"].layer.n_in == 4 * 4 * 4
+    net = ComputationGraph(conf).init()
+    x = np.random.default_rng(0).normal(size=(2, 10, 10, 2)).astype(np.float32)
+    assert net.output(x)[0].shape == (2, 3)
+
+
+def test_last_time_step_vertex():
+    conf = (NeuralNetConfiguration.builder()
+            .seed(6).learning_rate(0.05)
+            .graph_builder()
+            .add_inputs("in")
+            .add_layer("lstm", GravesLSTM(n_in=3, n_out=5, activation="tanh"), "in")
+            .add_vertex("last", LastTimeStepVertex(), "lstm")
+            .add_layer("out", OutputLayer(n_in=5, n_out=2, loss="mcxent",
+                                          activation="softmax"), "last")
+            .set_outputs("out")
+            .build())
+    net = ComputationGraph(conf).init()
+    x = np.random.default_rng(0).normal(size=(4, 7, 3)).astype(np.float32)
+    assert net.output(x)[0].shape == (4, 2)
+
+
+def test_stack_unstack_shared_weights():
+    conf = (NeuralNetConfiguration.builder()
+            .seed(7).learning_rate(0.05)
+            .graph_builder()
+            .add_inputs("a", "b")
+            .add_vertex("stack", StackVertex(), "a", "b")
+            .add_layer("shared", DenseLayer(n_in=4, n_out=6, activation="tanh"), "stack")
+            .add_vertex("ua", UnstackVertex(index=0, num_stacks=2), "shared")
+            .add_vertex("ub", UnstackVertex(index=1, num_stacks=2), "shared")
+            .add_vertex("merged", MergeVertex(), "ua", "ub")
+            .add_layer("out", OutputLayer(n_in=12, n_out=2, loss="mse",
+                                          activation="identity"), "merged")
+            .set_outputs("out")
+            .build())
+    net = ComputationGraph(conf).init()
+    rng = np.random.default_rng(3)
+    a = rng.normal(size=(3, 4)).astype(np.float32)
+    b = rng.normal(size=(3, 4)).astype(np.float32)
+    assert net.output(a, b)[0].shape == (3, 2)
+
+
+def test_graph_json_roundtrip():
+    conf = (NeuralNetConfiguration.builder()
+            .seed(8).learning_rate(0.1).updater("adam")
+            .graph_builder()
+            .add_inputs("in")
+            .add_layer("d", DenseLayer(n_in=4, n_out=8, activation="relu"), "in")
+            .add_vertex("norm", L2NormalizeVertex(), "d")
+            .add_vertex("scaled", ScaleVertex(scale=2.0), "norm")
+            .add_layer("out", OutputLayer(n_in=8, n_out=3, loss="mcxent",
+                                          activation="softmax"), "scaled")
+            .set_outputs("out")
+            .build())
+    s = conf.to_json()
+    conf2 = ComputationGraphConfiguration.from_json(s)
+    assert conf2.to_json() == s
+    net = ComputationGraph(conf2).init()
+    x = np.random.default_rng(0).normal(size=(2, 4)).astype(np.float32)
+    assert net.output(x)[0].shape == (2, 3)
+
+
+def test_graph_gradients_match_numeric():
+    """Spot gradient check on a small DAG (reference GradientCheckTestsComputationGraph)."""
+    conf = (NeuralNetConfiguration.builder()
+            .seed(9).learning_rate(0.1)
+            .graph_builder()
+            .add_inputs("in")
+            .add_layer("d1", DenseLayer(n_in=3, n_out=4, activation="tanh"), "in")
+            .add_layer("d2", DenseLayer(n_in=3, n_out=4, activation="sigmoid"), "in")
+            .add_vertex("sum", ElementWiseVertex(op="add"), "d1", "d2")
+            .add_layer("out", OutputLayer(n_in=4, n_out=2, loss="mcxent",
+                                          activation="softmax"), "sum")
+            .set_outputs("out")
+            .build())
+    net = ComputationGraph(conf).init()
+    rng = np.random.default_rng(5)
+    x = rng.normal(size=(4, 3)).astype(np.float32)
+    y = np.zeros((4, 2), np.float32)
+    y[np.arange(4), rng.integers(0, 2, 4)] = 1
+    grads, score = net.gradient_and_score([x], [y])
+    # numeric check on a few params of d1.W
+    import jax
+
+    eps = 1e-3
+    w = np.asarray(net.params_list["d1"]["W"]).copy()
+    for (i, j) in [(0, 0), (1, 2), (2, 3)]:
+        wp = w.copy(); wp[i, j] += eps
+        wm = w.copy(); wm[i, j] -= eps
+        net.params_list["d1"]["W"] = jnp.asarray(wp)
+        _, sp = net.gradient_and_score([x], [y])
+        net.params_list["d1"]["W"] = jnp.asarray(wm)
+        _, sm = net.gradient_and_score([x], [y])
+        net.params_list["d1"]["W"] = jnp.asarray(w)
+        numeric = (sp - sm) / (2 * eps)
+        analytic = float(grads["d1"]["W"][i, j])
+        assert abs(numeric - analytic) < 5e-3 * max(1.0, abs(analytic)), (numeric, analytic)
