@@ -6,13 +6,21 @@ the same tests validate single-device math and multi-device sharding without TPU
 hardware. MUST set env vars before jax import.
 """
 import os
+import re
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+                os.environ.get("XLA_FLAGS", ""))
+os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
 
 import jax  # noqa: E402
+
+# A sitecustomize-registered accelerator plugin may force jax_platforms after env
+# parsing; re-force CPU so the suite always runs on the virtual 8-device mesh.
+jax.config.update("jax_platforms", "cpu")
+assert jax.default_backend() == "cpu" and len(jax.devices()) == 8, (
+    "test suite requires the virtual 8-device CPU mesh; backends were initialized "
+    f"before conftest could force them (got {jax.devices()})")
 
 import pytest  # noqa: E402
 
