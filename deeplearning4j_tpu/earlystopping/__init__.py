@@ -1,0 +1,24 @@
+from deeplearning4j_tpu.earlystopping.config import (
+    EarlyStoppingConfiguration, EarlyStoppingResult, TerminationReason,
+)
+from deeplearning4j_tpu.earlystopping.savers import (
+    InMemoryModelSaver, LocalFileModelSaver,
+)
+from deeplearning4j_tpu.earlystopping.scorecalc import (
+    DataSetLossCalculator, ScoreCalculator,
+)
+from deeplearning4j_tpu.earlystopping.termination import (
+    BestScoreEpochTerminationCondition, InvalidScoreIterationTerminationCondition,
+    MaxEpochsTerminationCondition, MaxScoreIterationTerminationCondition,
+    MaxTimeIterationTerminationCondition, ScoreImprovementEpochTerminationCondition,
+)
+from deeplearning4j_tpu.earlystopping.trainer import EarlyStoppingTrainer
+
+__all__ = [
+    "EarlyStoppingConfiguration", "EarlyStoppingResult", "TerminationReason",
+    "InMemoryModelSaver", "LocalFileModelSaver", "ScoreCalculator",
+    "DataSetLossCalculator", "MaxEpochsTerminationCondition",
+    "MaxTimeIterationTerminationCondition", "MaxScoreIterationTerminationCondition",
+    "InvalidScoreIterationTerminationCondition", "ScoreImprovementEpochTerminationCondition",
+    "BestScoreEpochTerminationCondition", "EarlyStoppingTrainer",
+]

@@ -1,0 +1,110 @@
+"""EarlyStoppingConfiguration + result types.
+
+Reference: earlystopping/EarlyStoppingConfiguration.java (builder with
+epoch/iteration termination conditions, score calculator, model saver,
+saveLastModel, evaluateEveryNEpochs) and EarlyStoppingResult.java.
+"""
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Dict, List, Optional
+
+from deeplearning4j_tpu.earlystopping.savers import (
+    EarlyStoppingModelSaver, InMemoryModelSaver,
+)
+from deeplearning4j_tpu.earlystopping.scorecalc import ScoreCalculator
+from deeplearning4j_tpu.earlystopping.termination import (
+    EpochTerminationCondition, IterationTerminationCondition,
+)
+
+
+class TerminationReason(enum.Enum):
+    ERROR = "Error"
+    ITERATION_TERMINATION_CONDITION = "IterationTerminationCondition"
+    EPOCH_TERMINATION_CONDITION = "EpochTerminationCondition"
+
+
+@dataclasses.dataclass
+class EarlyStoppingResult:
+    termination_reason: TerminationReason
+    termination_details: str
+    score_vs_epoch: Dict[int, float]
+    best_model_epoch: int
+    best_model_score: float
+    total_epochs: int
+    best_model: object
+
+    def __repr__(self):
+        return (f"EarlyStoppingResult(terminationReason={self.termination_reason},"
+                f" details={self.termination_details},"
+                f" bestModelEpoch={self.best_model_epoch},"
+                f" bestModelScore={self.best_model_score},"
+                f" totalEpochs={self.total_epochs})")
+
+
+class EarlyStoppingConfiguration:
+    def __init__(self, epoch_termination_conditions=None,
+                 iteration_termination_conditions=None,
+                 score_calculator: Optional[ScoreCalculator] = None,
+                 model_saver: Optional[EarlyStoppingModelSaver] = None,
+                 save_last_model: bool = False,
+                 evaluate_every_n_epochs: int = 1):
+        self.epoch_termination_conditions: List[EpochTerminationCondition] = (
+            list(epoch_termination_conditions or []))
+        self.iteration_termination_conditions: List[IterationTerminationCondition] = (
+            list(iteration_termination_conditions or []))
+        self.score_calculator = score_calculator
+        self.model_saver = model_saver or InMemoryModelSaver()
+        self.save_last_model = save_last_model
+        self.evaluate_every_n_epochs = evaluate_every_n_epochs
+
+    @staticmethod
+    def builder() -> "EarlyStoppingConfigurationBuilder":
+        return EarlyStoppingConfigurationBuilder()
+
+
+class EarlyStoppingConfigurationBuilder:
+    """Fluent builder (reference EarlyStoppingConfiguration.Builder:64)."""
+
+    def __init__(self):
+        self._epoch: list = []
+        self._iteration: list = []
+        self._score_calculator = None
+        self._saver = None
+        self._save_last = False
+        self._every_n = 1
+
+    def epoch_termination_conditions(self, *conds) -> "EarlyStoppingConfigurationBuilder":
+        self._epoch.extend(conds)
+        return self
+
+    def iteration_termination_conditions(self, *conds) -> "EarlyStoppingConfigurationBuilder":
+        self._iteration.extend(conds)
+        return self
+
+    def score_calculator(self, calc) -> "EarlyStoppingConfigurationBuilder":
+        self._score_calculator = calc
+        return self
+
+    def model_saver(self, saver) -> "EarlyStoppingConfigurationBuilder":
+        self._saver = saver
+        return self
+
+    def save_last_model(self, flag: bool = True) -> "EarlyStoppingConfigurationBuilder":
+        self._save_last = flag
+        return self
+
+    def evaluate_every_n_epochs(self, n: int) -> "EarlyStoppingConfigurationBuilder":
+        self._every_n = n
+        return self
+
+    def build(self) -> EarlyStoppingConfiguration:
+        return EarlyStoppingConfiguration(
+            epoch_termination_conditions=self._epoch,
+            iteration_termination_conditions=self._iteration,
+            score_calculator=self._score_calculator,
+            model_saver=self._saver,
+            save_last_model=self._save_last,
+            evaluate_every_n_epochs=self._every_n,
+        )

@@ -1,0 +1,89 @@
+"""Model savers for early stopping (reference earlystopping/saver/*.java)."""
+from __future__ import annotations
+
+import copy
+import os
+from typing import Optional
+
+
+class EarlyStoppingModelSaver:
+    def save_best_model(self, model, score: float) -> None:
+        raise NotImplementedError
+
+    def save_latest_model(self, model, score: float) -> None:
+        raise NotImplementedError
+
+    def get_best_model(self):
+        raise NotImplementedError
+
+    def get_latest_model(self):
+        raise NotImplementedError
+
+
+class InMemoryModelSaver(EarlyStoppingModelSaver):
+    """Keeps deep copies in memory (reference saver/InMemoryModelSaver.java)."""
+
+    def __init__(self):
+        self._best = None
+        self._latest = None
+
+    @staticmethod
+    def _snapshot(model):
+        # jax arrays are immutable and train steps replace rather than mutate
+        # them, so a structural copy holding the same leaves is a safe snapshot
+        import jax
+
+        snap = copy.copy(model)
+        ident = lambda tree: jax.tree_util.tree_map(lambda a: a, tree)
+        snap.params_list = ident(model.params_list)
+        snap.state_list = ident(model.state_list)
+        snap.updater_state = ident(model.updater_state)
+        return snap
+
+    def save_best_model(self, model, score: float) -> None:
+        self._best = self._snapshot(model)
+
+    def save_latest_model(self, model, score: float) -> None:
+        self._latest = self._snapshot(model)
+
+    def get_best_model(self):
+        return self._best
+
+    def get_latest_model(self):
+        return self._latest
+
+
+class LocalFileModelSaver(EarlyStoppingModelSaver):
+    """Writes bestModel/latestModel checkpoint archives in a directory
+    (reference saver/LocalFileModelSaver.java + LocalFileGraphSaver.java —
+    one class here; the container format already distinguishes model kinds)."""
+
+    BEST = "bestModel.dl4jtpu.zip"
+    LATEST = "latestModel.dl4jtpu.zip"
+
+    def __init__(self, directory: str):
+        self.directory = directory
+        os.makedirs(directory, exist_ok=True)
+
+    def _write(self, model, name: str) -> None:
+        from deeplearning4j_tpu.utils.model_serializer import write_model
+
+        write_model(model, os.path.join(self.directory, name))
+
+    def _read(self, name: str):
+        from deeplearning4j_tpu.utils.model_serializer import guess_model
+
+        path = os.path.join(self.directory, name)
+        return guess_model(path) if os.path.exists(path) else None
+
+    def save_best_model(self, model, score: float) -> None:
+        self._write(model, self.BEST)
+
+    def save_latest_model(self, model, score: float) -> None:
+        self._write(model, self.LATEST)
+
+    def get_best_model(self):
+        return self._read(self.BEST)
+
+    def get_latest_model(self):
+        return self._read(self.LATEST)

@@ -1,0 +1,41 @@
+"""Score calculators (reference earlystopping/scorecalc/DataSetLossCalculator.java).
+
+One class serves both MultiLayerNetwork and ComputationGraph (the reference needs
+DataSetLossCalculator vs DataSetLossCalculatorCG because of Java generics only).
+"""
+from __future__ import annotations
+
+
+class ScoreCalculator:
+    def calculate_score(self, model) -> float:
+        raise NotImplementedError
+
+
+class DataSetLossCalculator(ScoreCalculator):
+    """Average loss over a held-out iterator, weighted by example count when
+    ``average=True`` (reference DataSetLossCalculator.java:55-77)."""
+
+    def __init__(self, iterator, average: bool = True):
+        self.iterator = iterator
+        self.average = average
+
+    def calculate_score(self, model) -> float:
+        from deeplearning4j_tpu.nn.graph_network import ComputationGraph, MultiDataSet
+
+        if hasattr(self.iterator, "reset"):
+            self.iterator.reset()
+        total, n = 0.0, 0
+        for ds in self.iterator:
+            if isinstance(model, ComputationGraph):
+                mds = (ds if isinstance(ds, MultiDataSet)
+                       else MultiDataSet([ds.features], [ds.labels]))
+                score = model.score(mds)
+                examples = mds.num_examples()
+            else:
+                score = model.score(ds.features, ds.labels)
+                examples = int(ds.features.shape[0])
+            total += score * examples
+            n += examples
+        if n == 0:
+            return 0.0
+        return total / n if self.average else total

@@ -1,0 +1,123 @@
+"""Early stopping trainer loop.
+
+Reference: earlystopping/trainer/BaseEarlyStoppingTrainer.java:76 (fit()) —
+per-minibatch iteration termination checks, per-epoch score calculation every
+``evaluate_every_n_epochs``, best-model tracking/saving, listener hooks. One
+trainer serves MultiLayerNetwork and ComputationGraph (the reference splits
+EarlyStoppingTrainer / EarlyStoppingGraphTrainer over Java generics only).
+"""
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+from deeplearning4j_tpu.earlystopping.config import (
+    EarlyStoppingConfiguration, EarlyStoppingResult, TerminationReason,
+)
+
+log = logging.getLogger(__name__)
+
+
+class EarlyStoppingListener:
+    def on_start(self, config, model) -> None:
+        pass
+
+    def on_epoch(self, epoch: int, score: float, config, model) -> None:
+        pass
+
+    def on_completion(self, result: EarlyStoppingResult) -> None:
+        pass
+
+
+class EarlyStoppingTrainer:
+    def __init__(self, config: EarlyStoppingConfiguration, model, iterator,
+                 listener: Optional[EarlyStoppingListener] = None):
+        self.config = config
+        self.model = model
+        self.iterator = iterator
+        self.listener = listener
+
+    def _fit_one(self, ds) -> None:
+        from deeplearning4j_tpu.nn.graph_network import ComputationGraph, MultiDataSet
+
+        if isinstance(self.model, ComputationGraph):
+            self.model.fit(ds if isinstance(ds, MultiDataSet)
+                           else MultiDataSet([ds.features], [ds.labels]))
+        else:
+            self.model.fit(ds.features, ds.labels)
+
+    def fit(self) -> EarlyStoppingResult:
+        cfg = self.config
+        for c in cfg.iteration_termination_conditions:
+            c.initialize()
+        for c in cfg.epoch_termination_conditions:
+            c.initialize()
+        if self.listener:
+            self.listener.on_start(cfg, self.model)
+
+        score_vs_epoch: dict = {}
+        best_score = float("inf")
+        best_epoch = -1
+        epoch = 0
+        while True:
+            if hasattr(self.iterator, "reset"):
+                self.iterator.reset()
+            terminate_reason = None
+            try:
+                for ds in self.iterator:
+                    self._fit_one(ds)
+                    last = self.model.score_value
+                    for c in cfg.iteration_termination_conditions:
+                        if c.terminate(last):
+                            terminate_reason = c
+                            break
+                    if terminate_reason is not None:
+                        break
+            except Exception as e:  # reference returns Error result, not raise
+                log.warning("early stopping terminated by exception at epoch %d: %s",
+                            epoch, e)
+                result = EarlyStoppingResult(
+                    TerminationReason.ERROR, str(e), score_vs_epoch, best_epoch,
+                    best_score, epoch, cfg.model_saver.get_best_model())
+                if self.listener:
+                    self.listener.on_completion(result)
+                return result
+
+            if terminate_reason is not None:
+                if cfg.save_last_model:
+                    cfg.model_saver.save_latest_model(self.model, 0.0)
+                result = EarlyStoppingResult(
+                    TerminationReason.ITERATION_TERMINATION_CONDITION,
+                    repr(terminate_reason), score_vs_epoch, best_epoch,
+                    best_score, epoch, cfg.model_saver.get_best_model())
+                if self.listener:
+                    self.listener.on_completion(result)
+                return result
+
+            epoch += 1
+            if (epoch - 1) % cfg.evaluate_every_n_epochs == 0:
+                sc = cfg.score_calculator
+                score = sc.calculate_score(self.model) if sc else 0.0
+                score_vs_epoch[epoch - 1] = score
+                if sc is not None and score < best_score:
+                    best_score = score
+                    best_epoch = epoch - 1
+                    cfg.model_saver.save_best_model(self.model, score)
+                if cfg.save_last_model:
+                    cfg.model_saver.save_latest_model(self.model, score)
+                if self.listener:
+                    self.listener.on_epoch(epoch - 1, score, cfg, self.model)
+
+                for c in cfg.epoch_termination_conditions:
+                    if c.terminate(epoch - 1, score):
+                        result = EarlyStoppingResult(
+                            TerminationReason.EPOCH_TERMINATION_CONDITION,
+                            repr(c), score_vs_epoch, best_epoch, best_score,
+                            epoch, cfg.model_saver.get_best_model())
+                        if self.listener:
+                            self.listener.on_completion(result)
+                        return result
+
+
+# Back-compat aliases mirroring the reference class names.
+EarlyStoppingGraphTrainer = EarlyStoppingTrainer

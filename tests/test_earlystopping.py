@@ -1,0 +1,157 @@
+"""Early stopping tests (reference deeplearning4j-core TestEarlyStopping.java)."""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.iterators import ListDataSetIterator
+from deeplearning4j_tpu.earlystopping import (
+    BestScoreEpochTerminationCondition, DataSetLossCalculator,
+    EarlyStoppingConfiguration, EarlyStoppingTrainer, InMemoryModelSaver,
+    InvalidScoreIterationTerminationCondition, LocalFileModelSaver,
+    MaxEpochsTerminationCondition, MaxScoreIterationTerminationCondition,
+    MaxTimeIterationTerminationCondition, ScoreImprovementEpochTerminationCondition,
+    TerminationReason,
+)
+from deeplearning4j_tpu.nn.conf.builders import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+
+def _iris_like(n=60, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, 4)).astype(np.float32)
+    labels = (x[:, 0] + x[:, 1] > 0).astype(int)
+    y = np.zeros((n, 3), np.float32)
+    y[np.arange(n), labels] = 1
+    return [DataSet(x[i:i + 10], y[i:i + 10]) for i in range(0, n, 10)]
+
+
+def _net(lr=0.05):
+    conf = (NeuralNetConfiguration.builder()
+            .seed(12).learning_rate(lr)
+            .list()
+            .layer(DenseLayer(n_in=4, n_out=8, activation="tanh"))
+            .layer(OutputLayer(n_in=8, n_out=3, loss="mcxent", activation="softmax"))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def test_max_epochs_termination():
+    data = _iris_like()
+    it = ListDataSetIterator(data)
+    conf = (EarlyStoppingConfiguration.builder()
+            .epoch_termination_conditions(MaxEpochsTerminationCondition(5))
+            .score_calculator(DataSetLossCalculator(ListDataSetIterator(data)))
+            .model_saver(InMemoryModelSaver())
+            .build())
+    result = EarlyStoppingTrainer(conf, _net(), it).fit()
+    assert result.termination_reason == TerminationReason.EPOCH_TERMINATION_CONDITION
+    assert result.total_epochs == 5
+    assert result.best_model is not None
+    assert len(result.score_vs_epoch) == 5
+    # training on a learnable problem: best score should beat the first epoch's
+    assert result.best_model_score <= result.score_vs_epoch[0] + 1e-9
+
+
+def test_invalid_score_termination():
+    data = _iris_like()
+    it = ListDataSetIterator(data)
+    net = _net(lr=1e9)  # diverges to NaN quickly
+    conf = (EarlyStoppingConfiguration.builder()
+            .epoch_termination_conditions(MaxEpochsTerminationCondition(500))
+            .iteration_termination_conditions(
+                InvalidScoreIterationTerminationCondition(),
+                MaxScoreIterationTerminationCondition(50.0))
+            .score_calculator(DataSetLossCalculator(ListDataSetIterator(data)))
+            .build())
+    result = EarlyStoppingTrainer(conf, net, it).fit()
+    assert result.termination_reason == TerminationReason.ITERATION_TERMINATION_CONDITION
+    assert result.total_epochs < 500
+
+
+def test_max_time_termination():
+    data = _iris_like()
+    it = ListDataSetIterator(data)
+    conf = (EarlyStoppingConfiguration.builder()
+            .epoch_termination_conditions(MaxEpochsTerminationCondition(100000))
+            .iteration_termination_conditions(
+                MaxTimeIterationTerminationCondition(1.5))
+            .score_calculator(DataSetLossCalculator(ListDataSetIterator(data)))
+            .build())
+    result = EarlyStoppingTrainer(conf, _net(), it).fit()
+    assert result.termination_reason == TerminationReason.ITERATION_TERMINATION_CONDITION
+    assert "MaxTime" in result.termination_details
+
+
+def test_score_improvement_termination():
+    data = _iris_like()
+    it = ListDataSetIterator(data)
+    # lr=0 -> score never improves -> stops after N no-improvement epochs
+    conf = (EarlyStoppingConfiguration.builder()
+            .epoch_termination_conditions(
+                ScoreImprovementEpochTerminationCondition(3),
+                MaxEpochsTerminationCondition(500))
+            .score_calculator(DataSetLossCalculator(ListDataSetIterator(data)))
+            .build())
+    result = EarlyStoppingTrainer(conf, _net(lr=0.0), it).fit()
+    assert result.termination_reason == TerminationReason.EPOCH_TERMINATION_CONDITION
+    assert "ScoreImprovement" in result.termination_details
+    assert result.total_epochs <= 6
+
+
+def test_best_score_termination():
+    data = _iris_like()
+    it = ListDataSetIterator(data)
+    conf = (EarlyStoppingConfiguration.builder()
+            .epoch_termination_conditions(
+                BestScoreEpochTerminationCondition(10.0),  # any score < 10 stops
+                MaxEpochsTerminationCondition(100))
+            .score_calculator(DataSetLossCalculator(ListDataSetIterator(data)))
+            .build())
+    result = EarlyStoppingTrainer(conf, _net(), it).fit()
+    assert result.termination_reason == TerminationReason.EPOCH_TERMINATION_CONDITION
+    assert result.total_epochs == 1
+
+
+def test_local_file_saver_roundtrip(tmp_path):
+    data = _iris_like()
+    it = ListDataSetIterator(data)
+    saver = LocalFileModelSaver(str(tmp_path))
+    conf = (EarlyStoppingConfiguration.builder()
+            .epoch_termination_conditions(MaxEpochsTerminationCondition(3))
+            .score_calculator(DataSetLossCalculator(ListDataSetIterator(data)))
+            .model_saver(saver)
+            .save_last_model(True)
+            .build())
+    result = EarlyStoppingTrainer(conf, _net(), it).fit()
+    best = saver.get_best_model()
+    latest = saver.get_latest_model()
+    assert best is not None and latest is not None
+    x = data[0].features
+    np.testing.assert_allclose(np.asarray(best.output(x)),
+                               np.asarray(result.best_model.output(x)), rtol=1e-5)
+
+
+def test_early_stopping_computation_graph():
+    from deeplearning4j_tpu.nn.graph_network import ComputationGraph
+
+    data = _iris_like()
+    it = ListDataSetIterator(data)
+    conf = (NeuralNetConfiguration.builder()
+            .seed(12).learning_rate(0.05)
+            .graph_builder()
+            .add_inputs("in")
+            .add_layer("d", DenseLayer(n_in=4, n_out=8, activation="tanh"), "in")
+            .add_layer("out", OutputLayer(n_in=8, n_out=3, loss="mcxent",
+                                          activation="softmax"), "d")
+            .set_outputs("out")
+            .build())
+    net = ComputationGraph(conf).init()
+    es = (EarlyStoppingConfiguration.builder()
+          .epoch_termination_conditions(MaxEpochsTerminationCondition(3))
+          .score_calculator(DataSetLossCalculator(ListDataSetIterator(data)))
+          .build())
+    result = EarlyStoppingTrainer(es, net, it).fit()
+    assert result.termination_reason == TerminationReason.EPOCH_TERMINATION_CONDITION
+    assert result.total_epochs == 3
+    assert result.best_model is not None

@@ -1,0 +1,85 @@
+"""Solver tests (reference optimize/solvers + TestOptimizers.java)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn.conf.builders import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.optimize.solvers import (
+    Solver, minimize_cg, minimize_lbfgs, minimize_line_gd,
+)
+
+
+def _rosenbrock(v):
+    x, y = v[0], v[1]
+    return (1 - x) ** 2 + 100.0 * (y - x ** 2) ** 2
+
+
+def _quadratic(v):
+    # ill-conditioned convex quadratic
+    scales = jnp.array([1.0, 10.0, 100.0, 3.0])
+    return jnp.sum(scales * (v - jnp.arange(4.0)) ** 2)
+
+
+def test_lbfgs_rosenbrock():
+    x0 = jnp.array([-1.2, 1.0])
+    res = jax.jit(lambda x: minimize_lbfgs(_rosenbrock, x, max_iters=200))(x0)
+    assert float(res.loss) < 1e-6
+    np.testing.assert_allclose(np.asarray(res.x), [1.0, 1.0], atol=1e-3)
+
+
+def test_cg_quadratic():
+    x0 = jnp.zeros(4)
+    res = jax.jit(lambda x: minimize_cg(_quadratic, x, max_iters=200))(x0)
+    assert float(res.loss) < 1e-5
+    np.testing.assert_allclose(np.asarray(res.x), np.arange(4.0), atol=1e-2)
+
+
+def test_line_gd_quadratic():
+    x0 = jnp.zeros(4)
+    res = jax.jit(lambda x: minimize_line_gd(_quadratic, x, max_iters=300))(x0)
+    assert float(res.loss) < 1e-3
+
+
+def _net(algo):
+    conf = (NeuralNetConfiguration.builder()
+            .seed(42).learning_rate(0.1).optimization_algo(algo)
+            .list()
+            .layer(DenseLayer(n_in=4, n_out=8, activation="tanh"))
+            .layer(OutputLayer(n_in=8, n_out=3, loss="mcxent", activation="softmax"))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _data(n=64, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, 4)).astype(np.float32)
+    labels = (x[:, 0] + 0.5 * x[:, 1] > 0).astype(int) + (x[:, 2] > 1).astype(int)
+    y = np.zeros((n, 3), np.float32)
+    y[np.arange(n), labels] = 1
+    return x, y
+
+
+@pytest.mark.parametrize("algo", ["lbfgs", "conjugate_gradient",
+                                  "line_gradient_descent"])
+def test_solver_trains_network(algo):
+    net = _net(algo)
+    x, y = _data()
+    s0 = net.score(x, y)
+    solver = Solver(net, max_iters=50)
+    s1 = solver.optimize(x, y)
+    assert s1 < s0 * 0.7, (s0, s1)
+    # solver should beat a handful of plain SGD steps on the full batch
+    sgd = _net("stochastic_gradient_descent")
+    for _ in range(10):
+        sgd.fit(x, y)
+    assert s1 < sgd.score(x, y)
+
+
+def test_lbfgs_beats_short_sgd():
+    net = _net("lbfgs")
+    x, y = _data()
+    Solver(net, max_iters=100).optimize(x, y)
+    assert net.score(x, y) < 0.35
