@@ -1,0 +1,104 @@
+"""KMeans clustering.
+
+Reference: deeplearning4j-core clustering/kmeans/KMeansClustering.java (+
+clustering/algorithm/BaseClusteringAlgorithm: iterationsation strategy with max
+iterations / distance-variation convergence).
+
+TPU-native: kmeans++ seeding on host, then Lloyd iterations as ONE jitted
+``lax.while_loop`` — assignment (pairwise distances on the MXU) and centroid
+update (segment mean) both stay on device.
+"""
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class ClusterSet(NamedTuple):
+    centers: jax.Array        # (k, d)
+    assignments: jax.Array    # (n,)
+    iterations: jax.Array
+    inertia: jax.Array
+
+
+def _plus_plus_init(x: np.ndarray, k: int, rng: np.random.Generator) -> np.ndarray:
+    n = x.shape[0]
+    centers = [x[rng.integers(0, n)]]
+    for _ in range(1, k):
+        d2 = np.min(
+            ((x[:, None, :] - np.stack(centers)[None]) ** 2).sum(-1), axis=1)
+        probs = d2 / max(d2.sum(), 1e-12)
+        centers.append(x[rng.choice(n, p=probs)])
+    return np.stack(centers)
+
+
+class KMeansClustering:
+    def __init__(self, k: int, max_iterations: int = 100, tol: float = 1e-4,
+                 seed: int = 0, distance: str = "euclidean"):
+        if distance not in ("euclidean", "cosine", "manhattan"):
+            raise ValueError(f"Unknown distance: {distance}")
+        self.k = k
+        self.max_iterations = max_iterations
+        self.tol = tol
+        self.seed = seed
+        self.distance = distance
+
+    @staticmethod
+    def setup(k: int, max_iterations: int = 100, distance: str = "euclidean",
+              seed: int = 0) -> "KMeansClustering":
+        """reference KMeansClustering.setup(clusterCount, maxIterations, distanceFunction)"""
+        return KMeansClustering(k, max_iterations, distance=distance, seed=seed)
+
+    def _distances(self, x, centers):
+        if self.distance == "euclidean":
+            return ((x[:, None, :] - centers[None]) ** 2).sum(-1)
+        if self.distance == "manhattan":
+            return jnp.abs(x[:, None, :] - centers[None]).sum(-1)
+        xn = x / jnp.maximum(jnp.linalg.norm(x, axis=1, keepdims=True), 1e-12)
+        cn = centers / jnp.maximum(jnp.linalg.norm(centers, axis=1, keepdims=True), 1e-12)
+        return 1.0 - xn @ cn.T
+
+    def apply_to(self, points) -> ClusterSet:
+        x_np = np.asarray(points, np.float32)
+        init = _plus_plus_init(x_np, self.k, np.random.default_rng(self.seed))
+        x = jnp.asarray(x_np)
+        k, tol, max_it = self.k, self.tol, self.max_iterations
+
+        def assign(centers):
+            return jnp.argmin(self._distances(x, centers), axis=1)
+
+        def update(assignments):
+            onehot = jax.nn.one_hot(assignments, k, dtype=x.dtype)  # (n, k)
+            sums = onehot.T @ x                                     # (k, d)
+            counts = onehot.sum(0)[:, None]
+            return jnp.where(counts > 0, sums / jnp.maximum(counts, 1), 0.0)
+
+        def cond(st):
+            centers, prev, it, moved = st
+            return jnp.logical_and(it < max_it, moved > tol)
+
+        def body(st):
+            centers, _, it, _ = st
+            a = assign(centers)
+            new_centers = update(a)
+            moved = jnp.max(jnp.abs(new_centers - centers))
+            return new_centers, a, it + 1, moved
+
+        @jax.jit
+        def run(centers0):
+            a0 = assign(centers0)
+            centers, a, it, _ = jax.lax.while_loop(
+                cond, body, (centers0, a0, jnp.int32(0), jnp.float32(jnp.inf)))
+            a = assign(centers)
+            d = self._distances(x, centers)
+            inertia = jnp.sum(jnp.min(d, axis=1))
+            return ClusterSet(centers, a, it, inertia)
+
+        return run(jnp.asarray(init))
+
+    def predict(self, cluster_set: ClusterSet, points) -> np.ndarray:
+        x = jnp.asarray(np.asarray(points, np.float32))
+        return np.asarray(jnp.argmin(self._distances(x, cluster_set.centers), axis=1))

@@ -1,0 +1,8 @@
+from deeplearning4j_tpu.graph.deepwalk import DeepWalk
+from deeplearning4j_tpu.graph.graph import Edge, Graph, Vertex
+from deeplearning4j_tpu.graph.walkers import (
+    RandomWalkIterator, WeightedRandomWalkIterator,
+)
+
+__all__ = ["Graph", "Vertex", "Edge", "RandomWalkIterator",
+           "WeightedRandomWalkIterator", "DeepWalk"]

@@ -1,0 +1,6 @@
+from deeplearning4j_tpu.nlp.glove import Glove
+from deeplearning4j_tpu.nlp.paragraph_vectors import ParagraphVectors
+from deeplearning4j_tpu.nlp.sequencevectors import SequenceVectors
+from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+
+__all__ = ["Word2Vec", "ParagraphVectors", "Glove", "SequenceVectors"]

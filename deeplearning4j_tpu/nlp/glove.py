@@ -1,0 +1,161 @@
+"""GloVe embeddings.
+
+Reference: models/glove/Glove.java (438 LoC) + glove/count/ (cooccurrence
+counting). Host-side symmetric-window cooccurrence counting with 1/distance
+weighting, then jit-compiled AdaGrad updates on shuffled (i, j, Xij) batches —
+the reference's per-pair AdaGrad loop becomes one batched device step.
+"""
+from __future__ import annotations
+
+from collections import defaultdict
+from typing import Iterable, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nlp.sequencevectors import SequenceVectors
+from deeplearning4j_tpu.nlp.vocab import VocabConstructor
+
+
+class Glove(SequenceVectors):
+    def __init__(self, *, x_max: float = 100.0, alpha: float = 0.75,
+                 learning_rate: float = 0.05, symmetric: bool = True, **kwargs):
+        kwargs.setdefault("learning_rate", learning_rate)
+        kwargs.setdefault("use_hierarchic_softmax", False)
+        super().__init__(**kwargs)
+        self.x_max = x_max
+        self.alpha = alpha
+        self.symmetric = symmetric
+        self.bias: Optional[jax.Array] = None
+        self.bias_ctx: Optional[jax.Array] = None
+        self.ctx_vectors: Optional[jax.Array] = None
+
+    # ------------------------------------------------------------------ builder
+    class Builder:
+        def __init__(self):
+            self._kw = {}
+
+        def layer_size(self, n: int):
+            self._kw["vector_length"] = n
+            return self
+
+        def window_size(self, n: int):
+            self._kw["window"] = n
+            return self
+
+        def learning_rate(self, lr: float):
+            self._kw["learning_rate"] = lr
+            return self
+
+        def epochs(self, n: int):
+            self._kw["epochs"] = n
+            return self
+
+        def min_word_frequency(self, n: int):
+            self._kw["min_word_frequency"] = n
+            return self
+
+        def x_max(self, v: float):
+            self._kw["x_max"] = v
+            return self
+
+        def alpha(self, v: float):
+            self._kw["alpha"] = v
+            return self
+
+        def symmetric(self, flag: bool):
+            self._kw["symmetric"] = flag
+            return self
+
+        def seed(self, s: int):
+            self._kw["seed"] = s
+            return self
+
+        def batch_size(self, n: int):
+            self._kw["batch_size"] = n
+            return self
+
+        def build(self) -> "Glove":
+            return Glove(**self._kw)
+
+    @staticmethod
+    def builder() -> "Glove.Builder":
+        return Glove.Builder()
+
+    # ------------------------------------------------------------------ training
+    def _count_cooccurrences(self, seqs: List[List[int]]):
+        counts: dict = defaultdict(float)
+        for seq in seqs:
+            for pos, w in enumerate(seq):
+                lo = max(0, pos - self.window)
+                for j in range(lo, pos):
+                    c = seq[j]
+                    weight = 1.0 / (pos - j)
+                    counts[(w, c)] += weight
+                    if self.symmetric:
+                        counts[(c, w)] += weight
+        return counts
+
+    def fit(self, sequences: Iterable[Sequence[str]], labels=None) -> None:
+        seq_list = [list(s) for s in sequences]
+        if self.vocab is None:
+            self.build_vocab(seq_list)
+        cache = self.vocab
+        n, d = cache.num_words(), self.vector_length
+        idx_seqs = [[cache.index_of(t) for t in s] for s in seq_list]
+        idx_seqs = [[i for i in s if i >= 0] for s in idx_seqs]
+        counts = self._count_cooccurrences(idx_seqs)
+        if not counts:
+            return
+        pairs = np.array(list(counts.keys()), np.int32)
+        xij = np.array(list(counts.values()), np.float32)
+
+        rng = np.random.default_rng(self.seed)
+        w = jnp.asarray((rng.random((n, d), np.float32) - 0.5) / d)
+        wc = jnp.asarray((rng.random((n, d), np.float32) - 0.5) / d)
+        b = jnp.zeros((n,), jnp.float32)
+        bc = jnp.zeros((n,), jnp.float32)
+        hist = (jnp.ones((n, d), jnp.float32), jnp.ones((n, d), jnp.float32),
+                jnp.ones((n,), jnp.float32), jnp.ones((n,), jnp.float32))
+
+        x_max, alpha, lr = self.x_max, self.alpha, self.learning_rate
+
+        @jax.jit
+        def glove_step(w, wc, b, bc, hist, wi, ci, x):
+            hw, hwc, hb, hbc = hist
+            vi, vj = w[wi], wc[ci]                  # (B, D)
+            diff = (jnp.sum(vi * vj, -1) + b[wi] + bc[ci] - jnp.log(x))
+            fx = jnp.minimum((x / x_max) ** alpha, 1.0)
+            g = fx * diff                            # (B,)
+            loss = 0.5 * jnp.mean(fx * diff * diff)
+            gw = g[:, None] * vj
+            gwc = g[:, None] * vi
+            # AdaGrad: accumulate squared grads then scale
+            hw = hw.at[wi].add(gw * gw)
+            hwc = hwc.at[ci].add(gwc * gwc)
+            hb = hb.at[wi].add(g * g)
+            hbc = hbc.at[ci].add(g * g)
+            w = w.at[wi].add(-lr * gw / jnp.sqrt(hw[wi]))
+            wc = wc.at[ci].add(-lr * gwc / jnp.sqrt(hwc[ci]))
+            b = b.at[wi].add(-lr * g / jnp.sqrt(hb[wi]))
+            bc = bc.at[ci].add(-lr * g / jnp.sqrt(hbc[ci]))
+            return w, wc, b, bc, (hw, hwc, hb, hbc), loss
+
+        B = self.batch_size
+        n_pairs = pairs.shape[0]
+        for _ in range(self.epochs):
+            order = rng.permutation(n_pairs)
+            for s in range(0, n_pairs, B):
+                sel = order[s:s + B]
+                if len(sel) < B:  # pad to fixed shape, weight 0 ⇒ no-op via x=1,f=0
+                    pad = rng.integers(0, n_pairs, B - len(sel))
+                    sel = np.concatenate([sel, pad])
+                wi = jnp.asarray(pairs[sel, 0])
+                ci = jnp.asarray(pairs[sel, 1])
+                x = jnp.asarray(xij[sel])
+                w, wc, b, bc, hist, loss = glove_step(w, wc, b, bc, hist, wi, ci, x)
+
+        self.lookup.syn0 = w + wc  # GloVe convention: sum of word+context vectors
+        self.ctx_vectors = wc
+        self.bias, self.bias_ctx = b, bc

@@ -1,0 +1,60 @@
+"""Embedding lookup table.
+
+Reference: models/embeddings/inmemory/InMemoryLookupTable.java:55 — syn0 (input
+vectors), syn1 (hierarchical-softmax inner nodes), syn1neg (negative-sampling
+output vectors), plus the unigram^0.75 sampling table. The reference's expTable
+(precomputed sigmoid) is unnecessary here — sigmoid runs exact on the VPU.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nlp.vocab import VocabCache
+
+
+class InMemoryLookupTable:
+    def __init__(self, cache: VocabCache, vector_length: int, seed: int = 42,
+                 use_hs: bool = True, negative: int = 0):
+        self.cache = cache
+        self.vector_length = vector_length
+        self.seed = seed
+        self.use_hs = use_hs
+        self.negative = negative
+        self.syn0: Optional[jax.Array] = None
+        self.syn1: Optional[jax.Array] = None
+        self.syn1neg: Optional[jax.Array] = None
+        self.cum_table: Optional[jax.Array] = None
+
+    def reset_weights(self) -> None:
+        """Uniform(-0.5,0.5)/dim init, zero outputs (reference resetWeights)."""
+        n = self.cache.num_words()
+        d = self.vector_length
+        rng = np.random.default_rng(self.seed)
+        self.syn0 = jnp.asarray(
+            (rng.random((n, d), np.float32) - 0.5) / d)
+        if self.use_hs:
+            self.syn1 = jnp.zeros((max(n - 1, 1), d), jnp.float32)
+        if self.negative > 0:
+            self.syn1neg = jnp.zeros((n, d), jnp.float32)
+            counts = np.array([vw.count for vw in self.cache.vocab_words()],
+                              np.float64)
+            probs = counts ** 0.75
+            probs /= probs.sum()
+            self.cum_table = jnp.asarray(np.cumsum(probs).astype(np.float32))
+
+    # ------------------------------------------------------------------ vectors API
+    def vector(self, word: str) -> Optional[np.ndarray]:
+        idx = self.cache.index_of(word)
+        if idx < 0 or self.syn0 is None:
+            return None
+        return np.asarray(self.syn0[idx])
+
+    def set_vector(self, word: str, vec) -> None:
+        idx = self.cache.index_of(word)
+        if idx < 0:
+            raise KeyError(word)
+        self.syn0 = self.syn0.at[idx].set(jnp.asarray(vec))

@@ -1,0 +1,3 @@
+from deeplearning4j_tpu.plot.tsne import BarnesHutTsne, Tsne
+
+__all__ = ["Tsne", "BarnesHutTsne"]

@@ -1,0 +1,245 @@
+"""t-SNE embedding.
+
+Reference: deeplearning4j-core plot/Tsne.java (exact) + plot/BarnesHutTsne.java:64
+(theta-approximated, VPTree input neighbors + SpTree repulsive forces).
+
+TPU-native split: the exact O(n²) variant runs the full gradient loop as jitted
+device steps (pairwise ops are MXU/VPU-friendly); the Barnes-Hut variant keeps
+the reference's host-side tree approximation (irregular pointer-chasing that
+XLA cannot tile) over numpy, with the same builder surface.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.clustering.quadtree import SPTree
+from deeplearning4j_tpu.clustering.vptree import VPTree
+
+
+def _binary_search_betas(d2: np.ndarray, perplexity: float, tol: float = 1e-5,
+                         max_tries: int = 50) -> np.ndarray:
+    """Per-point precision search so each conditional distribution hits the
+    target perplexity (reference Tsne.hBeta loop)."""
+    n = d2.shape[0]
+    betas = np.ones(n)
+    log_u = np.log(perplexity)
+    P = np.zeros_like(d2)
+    for i in range(n):
+        beta, beta_min, beta_max = 1.0, -np.inf, np.inf
+        row = np.delete(d2[i], i)
+        for _ in range(max_tries):
+            p = np.exp(-row * beta)
+            s = max(p.sum(), 1e-12)
+            h = np.log(s) + beta * float((row * p).sum()) / s
+            diff = h - log_u
+            if abs(diff) < tol:
+                break
+            if diff > 0:
+                beta_min = beta
+                beta = beta * 2 if beta_max == np.inf else (beta + beta_max) / 2
+            else:
+                beta_max = beta
+                beta = beta / 2 if beta_min == -np.inf else (beta + beta_min) / 2
+        p = np.exp(-row * beta)
+        P[i] = np.insert(p / max(p.sum(), 1e-12), i, 0.0)
+        betas[i] = beta
+    return P
+
+
+class Tsne:
+    """Exact t-SNE (reference plot/Tsne.java) with a jitted update loop."""
+
+    def __init__(self, n_components: int = 2, perplexity: float = 30.0,
+                 learning_rate: float = 200.0, max_iter: int = 500,
+                 momentum: float = 0.5, final_momentum: float = 0.8,
+                 switch_momentum_iteration: int = 250,
+                 stop_lying_iteration: int = 100, exaggeration: float = 12.0,
+                 seed: int = 42):
+        self.n_components = n_components
+        self.perplexity = perplexity
+        self.learning_rate = learning_rate
+        self.max_iter = max_iter
+        self.momentum = momentum
+        self.final_momentum = final_momentum
+        self.switch_momentum_iteration = switch_momentum_iteration
+        self.stop_lying_iteration = stop_lying_iteration
+        self.exaggeration = exaggeration
+        self.seed = seed
+
+    def fit_transform(self, x) -> np.ndarray:
+        x = np.asarray(x, np.float64)
+        n = x.shape[0]
+        d2 = ((x[:, None] - x[None]) ** 2).sum(-1)
+        P = _binary_search_betas(d2, min(self.perplexity, (n - 1) / 3))
+        P = (P + P.T) / (2 * n)
+        P = np.maximum(P, 1e-12)
+
+        rng = np.random.default_rng(self.seed)
+        y0 = jnp.asarray(rng.normal(0, 1e-4, (n, self.n_components)))
+        Pj = jnp.asarray(P)
+
+        lr = self.learning_rate
+
+        @jax.jit
+        def grad_step(y, vel, gains, P_eff, mom):
+            d = y[:, None] - y[None]                       # (n, n, c)
+            num = 1.0 / (1.0 + (d ** 2).sum(-1))
+            num = num * (1.0 - jnp.eye(y.shape[0]))
+            Q = jnp.maximum(num / jnp.maximum(num.sum(), 1e-12), 1e-12)
+            PQ = (P_eff - Q) * num                         # (n, n)
+            g = 4.0 * jnp.einsum("ij,ijc->ic", PQ, d)
+            same_sign = (g > 0) == (vel > 0)
+            gains = jnp.clip(jnp.where(same_sign, gains * 0.8, gains + 0.2),
+                             0.01, None)
+            vel = mom * vel - lr * gains * g
+            y = y + vel
+            return y - y.mean(0), vel, gains
+
+        y = y0
+        vel = jnp.zeros_like(y)
+        gains = jnp.ones_like(y)
+        for it in range(self.max_iter):
+            mom = (self.momentum if it < self.switch_momentum_iteration
+                   else self.final_momentum)
+            P_eff = Pj * self.exaggeration if it < self.stop_lying_iteration else Pj
+            y, vel, gains = grad_step(y, vel, gains, P_eff, mom)
+        return np.asarray(y)
+
+
+class BarnesHutTsne:
+    """theta-approximated t-SNE (reference plot/BarnesHutTsne.java:64).
+
+    Builder mirrors the reference: setMaxIter, theta, perplexity,
+    numDimension, etc.
+    """
+
+    def __init__(self, n_components: int = 2, theta: float = 0.5,
+                 perplexity: float = 30.0, learning_rate: float = 200.0,
+                 max_iter: int = 300, seed: int = 42):
+        self.n_components = n_components
+        self.theta = theta
+        self.perplexity = perplexity
+        self.learning_rate = learning_rate
+        self.max_iter = max_iter
+        self.seed = seed
+        self.embedding: Optional[np.ndarray] = None
+
+    class Builder:
+        def __init__(self):
+            self._kw = {}
+
+        def theta(self, t: float):
+            self._kw["theta"] = t
+            return self
+
+        def perplexity(self, p: float):
+            self._kw["perplexity"] = p
+            return self
+
+        def set_max_iter(self, n: int):
+            self._kw["max_iter"] = n
+            return self
+
+        def num_dimension(self, d: int):
+            self._kw["n_components"] = d
+            return self
+
+        def learning_rate(self, lr: float):
+            self._kw["learning_rate"] = lr
+            return self
+
+        def seed(self, s: int):
+            self._kw["seed"] = s
+            return self
+
+        def build(self) -> "BarnesHutTsne":
+            return BarnesHutTsne(**self._kw)
+
+    @staticmethod
+    def builder() -> "BarnesHutTsne.Builder":
+        return BarnesHutTsne.Builder()
+
+    def fit(self, x) -> np.ndarray:
+        x = np.asarray(x, np.float64)
+        n = x.shape[0]
+        if self.theta <= 0 or n < 64:
+            self.embedding = Tsne(
+                n_components=self.n_components, perplexity=self.perplexity,
+                learning_rate=self.learning_rate, max_iter=self.max_iter,
+                seed=self.seed).fit_transform(x)
+            return self.embedding
+
+        # sparse input similarities from 3*perplexity nearest neighbors (VPTree)
+        k = min(n - 1, int(3 * self.perplexity))
+        tree = VPTree(x)
+        rows, cols, d2 = [], [], []
+        for i in range(n):
+            nbrs = tree.knn(x[i], k + 1)
+            for j, dist in nbrs:
+                if j != i:
+                    rows.append(i)
+                    cols.append(j)
+                    d2.append(dist * dist)
+        rows = np.array(rows)
+        cols = np.array(cols)
+        d2 = np.array(d2)
+        # per-row beta search on the sparse neighborhoods
+        P = np.zeros(len(rows))
+        log_u = np.log(min(self.perplexity, k))
+        for i in range(n):
+            sel = rows == i
+            row = d2[sel]
+            beta, bmin, bmax = 1.0, -np.inf, np.inf
+            for _ in range(50):
+                p = np.exp(-row * beta)
+                s = max(p.sum(), 1e-12)
+                h = np.log(s) + beta * (row * p).sum() / s
+                diff = h - log_u
+                if abs(diff) < 1e-5:
+                    break
+                if diff > 0:
+                    bmin, beta = beta, (beta * 2 if bmax == np.inf else (beta + bmax) / 2)
+                else:
+                    bmax, beta = beta, (beta / 2 if bmin == -np.inf else (beta + bmin) / 2)
+            p = np.exp(-row * beta)
+            P[sel] = p / max(p.sum(), 1e-12)
+        # symmetrize sparse P
+        sym: dict = {}
+        for r, c, v in zip(rows, cols, P):
+            sym[(r, c)] = sym.get((r, c), 0.0) + v / (2 * n)
+            sym[(c, r)] = sym.get((c, r), 0.0) + v / (2 * n)
+        e_rows = np.array([rc[0] for rc in sym])
+        e_cols = np.array([rc[1] for rc in sym])
+        e_vals = np.array(list(sym.values()))
+
+        rng = np.random.default_rng(self.seed)
+        y = rng.normal(0, 1e-4, (n, self.n_components))
+        vel = np.zeros_like(y)
+        gains = np.ones_like(y)
+        for it in range(self.max_iter):
+            exag = 12.0 if it < min(100, self.max_iter // 3) else 1.0
+            # attractive forces over the sparse edges
+            d = y[e_rows] - y[e_cols]
+            q_num = 1.0 / (1.0 + (d ** 2).sum(-1))
+            w = (exag * e_vals * q_num)[:, None] * d
+            pos_f = np.zeros_like(y)
+            np.add.at(pos_f, e_rows, w)
+            # repulsive forces via SPTree
+            stree = SPTree(y)
+            neg_f = np.zeros_like(y)
+            z = 0.0
+            for i in range(n):
+                z += stree.compute_non_edge_forces(i, self.theta, neg_f[i])
+            grad = pos_f - neg_f / max(z, 1e-12)
+            same_sign = (grad > 0) == (vel > 0)
+            gains = np.clip(np.where(same_sign, gains * 0.8, gains + 0.2), 0.01, None)
+            mom = 0.5 if it < self.max_iter // 2 else 0.8
+            vel = mom * vel - self.learning_rate * gains * grad
+            y = y + vel
+            y -= y.mean(0)
+        self.embedding = y
+        return y

@@ -1,0 +1,8 @@
+from deeplearning4j_tpu.ui.server import UIServer
+from deeplearning4j_tpu.ui.stats import StatsListener, StatsReport
+from deeplearning4j_tpu.ui.storage import (
+    FileStatsStorage, InMemoryStatsStorage, StatsStorage,
+)
+
+__all__ = ["UIServer", "StatsListener", "StatsReport", "StatsStorage",
+           "InMemoryStatsStorage", "FileStatsStorage"]

@@ -1,0 +1,243 @@
+"""Training UI web server.
+
+Reference: deeplearning4j-play ui/play/PlayUIServer.java — a web server with
+pluggable modules (TrainModule overview/model/system pages,
+RemoteReceiverModule POST endpoint) attached to StatsStorage instances via
+listeners. Here: stdlib http.server in a daemon thread serving JSON endpoints
+plus one self-contained HTML page (inline canvas charts, no external assets —
+the environment has zero egress), and the remote-receiver POST route.
+"""
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import List, Optional
+from urllib.parse import parse_qs, urlparse
+
+from deeplearning4j_tpu.ui.stats import StatsReport
+from deeplearning4j_tpu.ui.storage import StatsStorage
+
+_PAGE = """<!DOCTYPE html>
+<html><head><title>DL4J-TPU Training UI</title>
+<style>
+body { font-family: sans-serif; margin: 20px; background: #fafafa; }
+h2 { color: #333; } .chart { background: #fff; border: 1px solid #ddd;
+margin-bottom: 16px; padding: 8px; }
+</style></head>
+<body>
+<h2>Training overview</h2>
+<div class="chart"><canvas id="score" width="900" height="260"></canvas></div>
+<div class="chart"><canvas id="ratio" width="900" height="260"></canvas></div>
+<script>
+function drawSeries(canvasId, xs, ys, label, color) {
+  const c = document.getElementById(canvasId), ctx = c.getContext('2d');
+  ctx.clearRect(0, 0, c.width, c.height);
+  if (!ys.length) return;
+  const ymin = Math.min(...ys), ymax = Math.max(...ys), pad = 36;
+  const sx = (c.width - 2*pad) / Math.max(xs.length - 1, 1);
+  const sy = (c.height - 2*pad) / Math.max(ymax - ymin, 1e-9);
+  ctx.strokeStyle = '#999'; ctx.strokeRect(pad, pad, c.width-2*pad, c.height-2*pad);
+  ctx.fillStyle = '#333'; ctx.fillText(label + ' (last: ' +
+      ys[ys.length-1].toPrecision(5) + ')', pad, pad - 6);
+  ctx.strokeStyle = color; ctx.beginPath();
+  ys.forEach((y, i) => { const px = pad + i*sx,
+      py = c.height - pad - (y - ymin)*sy;
+      i ? ctx.lineTo(px, py) : ctx.moveTo(px, py); });
+  ctx.stroke();
+}
+async function refresh() {
+  const r = await fetch('/train/overview/data'); const d = await r.json();
+  drawSeries('score', d.iterations, d.scores, 'Model score vs iteration', '#c33');
+  drawSeries('ratio', d.iterations, d.updateRatios,
+             'Mean update:parameter ratio (log10)', '#36c');
+}
+refresh(); setInterval(refresh, 2000);
+</script>
+</body></html>
+"""
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "DL4JTPUUIServer/1.0"
+    ui: "UIServer" = None
+
+    def log_message(self, fmt, *args):  # silence request logging
+        pass
+
+    def _json(self, obj, code=200):
+        body = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        path = urlparse(self.path).path
+        if path in ("/", "/train", "/train/overview"):
+            body = _PAGE.encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "text/html")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+        elif path == "/train/overview/data":
+            self._json(self.ui.overview_data())
+        elif path == "/train/sessions":
+            self._json(self.ui.sessions())
+        elif path == "/train/model/data":
+            q = parse_qs(urlparse(self.path).query)
+            self._json(self.ui.model_data(q.get("session", [None])[0]))
+        elif path == "/train/system/data":
+            self._json(self.ui.system_data())
+        else:
+            self._json({"error": "not found"}, 404)
+
+    def do_POST(self):
+        path = urlparse(self.path).path
+        if path == "/remoteReceive":
+            # RemoteReceiverModule equivalent: accept encoded StatsReports
+            length = int(self.headers.get("Content-Length", "0"))
+            data = self.rfile.read(length)
+            try:
+                report = StatsReport.decode(data)
+            except Exception as e:
+                self._json({"status": "error", "detail": str(e)}, 400)
+                return
+            self.ui.post_remote(report)
+            self._json({"status": "ok"})
+        else:
+            self._json({"error": "not found"}, 404)
+
+
+class UIServer:
+    """reference UIServer.getInstance() + attach(statsStorage)"""
+
+    _instance: Optional["UIServer"] = None
+
+    def __init__(self, port: int = 9000):
+        self.port = port
+        self._storages: List[StatsStorage] = []
+        self._remote_storage: Optional[StatsStorage] = None
+        handler = type("BoundHandler", (_Handler,), {"ui": self})
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", port), handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+
+    @classmethod
+    def get_instance(cls, port: int = 9000) -> "UIServer":
+        if cls._instance is None:
+            cls._instance = UIServer(port)
+        return cls._instance
+
+    def attach(self, storage: StatsStorage) -> None:
+        self._storages.append(storage)
+
+    def detach(self, storage: StatsStorage) -> None:
+        self._storages.remove(storage)
+
+    def enable_remote_listener(self, storage: Optional[StatsStorage] = None) -> None:
+        from deeplearning4j_tpu.ui.storage import InMemoryStatsStorage
+
+        self._remote_storage = storage or InMemoryStatsStorage()
+        self.attach(self._remote_storage)
+
+    def post_remote(self, report: StatsReport) -> None:
+        if self._remote_storage is None:
+            self.enable_remote_listener()
+        self._remote_storage.put_update(report)
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if UIServer._instance is self:
+            UIServer._instance = None
+
+    # ------------------------------------------------------------------ data API
+    def _all_reports(self, session: Optional[str] = None) -> List[StatsReport]:
+        out: List[StatsReport] = []
+        for storage in self._storages:
+            for sid in storage.list_session_ids():
+                if session and sid != session:
+                    continue
+                for wid in storage.list_worker_ids_for_session(sid):
+                    for blob in storage.get_all_updates_after(
+                            sid, StatsReport.TYPE_ID, wid, -1):
+                        try:
+                            out.append(StatsReport.decode(blob))
+                        except ValueError:
+                            pass
+        out.sort(key=lambda r: (r.timestamp, r.iteration))
+        return out
+
+    def sessions(self) -> List[str]:
+        out: List[str] = []
+        for storage in self._storages:
+            out.extend(storage.list_session_ids())
+        return sorted(set(out))
+
+    def overview_data(self) -> dict:
+        reports = self._all_reports()
+        import math
+
+        ratios = []
+        for r in reports:
+            pairs = [(r.update_stats[k][0], r.param_stats[k][0])
+                     for k in r.update_stats if k in r.param_stats]
+            vals = [u / p for u, p in pairs if p > 0 and u > 0]
+            ratios.append(math.log10(sum(vals) / len(vals)) if vals else -10.0)
+        return {
+            "iterations": [r.iteration for r in reports],
+            "scores": [r.score for r in reports],
+            "updateRatios": ratios,
+            "iterationTimesMs": [r.iteration_time_ms for r in reports],
+        }
+
+    def model_data(self, session: Optional[str] = None) -> dict:
+        reports = self._all_reports(session)
+        if not reports:
+            return {"layers": {}}
+        last = reports[-1]
+        return {
+            "layers": {
+                name: {"meanMagnitude": mm, "histogram": hist,
+                       "range": list(rng)}
+                for name, (mm, hist, rng) in last.param_stats.items()
+            },
+            "gradients": {
+                name: {"meanMagnitude": mm}
+                for name, (mm, _, _) in last.gradient_stats.items()
+            },
+        }
+
+    def system_data(self) -> dict:
+        reports = self._all_reports()
+        return {
+            "memRssBytes": [r.mem_rss_bytes for r in reports],
+            "deviceMemBytes": [r.device_mem_bytes for r in reports],
+            "timestamps": [r.timestamp for r in reports],
+        }
+
+
+class RemoteUIStatsStorageRouter:
+    """HTTP client posting stats to a remote UIServer
+    (reference core api/storage/impl/RemoteUIStatsStorageRouter.java)."""
+
+    def __init__(self, url: str):
+        self.url = url.rstrip("/")
+
+    def put_update(self, record) -> None:
+        import urllib.request
+
+        req = urllib.request.Request(
+            self.url + "/remoteReceive", data=record.encode(),
+            headers={"Content-Type": "application/octet-stream"})
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            if resp.status != 200:
+                raise IOError(f"Remote post failed: {resp.status}")
+
+    def put_static_info(self, record) -> None:
+        self.put_update(record)

@@ -1,0 +1,200 @@
+"""StatsListener: per-iteration training statistics.
+
+Reference: ui-model ui/stats/BaseStatsListener.java:43 — iterationDone:273
+collects score, timings, JVM/off-heap memory:324, GC counts:356, and
+param/gradient/update histograms + mean magnitudes:508, encoded with SBE
+(ui/stats/sbe/*). Here the wire format is a compact struct-packed binary codec
+(flat little-endian records in place of generated SBE codecs) and memory stats
+come from the Python runtime + jax device stats.
+"""
+from __future__ import annotations
+
+import json
+import resource
+import struct
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from deeplearning4j_tpu.ui.storage import Persistable, StatsStorageRouter
+
+_MAGIC = b"DLTS"
+_VERSION = 1
+
+
+class StatsReport(Persistable):
+    """One iteration's stats record (reference SbeStatsReport)."""
+
+    TYPE_ID = "StatsListener"
+
+    def __init__(self, session_id: str = "", worker_id: str = "main",
+                 timestamp: int = 0):
+        self.session_id = session_id
+        self.worker_id = worker_id
+        self.timestamp = timestamp
+        self.iteration = 0
+        self.score = 0.0
+        self.iteration_time_ms = 0.0
+        self.samples_per_sec = 0.0
+        self.mem_rss_bytes = 0
+        self.device_mem_bytes = 0
+        # name -> (mean_magnitude, histogram counts, (min, max))
+        self.param_stats: Dict[str, Tuple[float, List[int], Tuple[float, float]]] = {}
+        self.gradient_stats: Dict[str, Tuple[float, List[int], Tuple[float, float]]] = {}
+        self.update_stats: Dict[str, Tuple[float, List[int], Tuple[float, float]]] = {}
+
+    # ------------------------------------------------------------------ Persistable
+    def get_session_id(self) -> str:
+        return self.session_id
+
+    def get_type_id(self) -> str:
+        return self.TYPE_ID
+
+    def get_worker_id(self) -> str:
+        return self.worker_id
+
+    def get_timestamp(self) -> int:
+        return self.timestamp
+
+    def encode(self) -> bytes:
+        """Compact binary: fixed header + JSON-free packed stats sections."""
+        out = bytearray()
+        out += _MAGIC
+        out += struct.pack("<H", _VERSION)
+        sid = self.session_id.encode()
+        wid = self.worker_id.encode()
+        out += struct.pack("<H", len(sid)) + sid
+        out += struct.pack("<H", len(wid)) + wid
+        out += struct.pack("<qid dd qq", self.timestamp, self.iteration,
+                           self.score, self.iteration_time_ms,
+                           self.samples_per_sec, self.mem_rss_bytes,
+                           self.device_mem_bytes)
+        for section in (self.param_stats, self.gradient_stats, self.update_stats):
+            out += struct.pack("<H", len(section))
+            for name, (mm, hist, (lo, hi)) in section.items():
+                nb = name.encode()
+                out += struct.pack("<H", len(nb)) + nb
+                out += struct.pack("<ddd", mm, lo, hi)
+                out += struct.pack("<H", len(hist))
+                out += struct.pack(f"<{len(hist)}i", *hist)
+        return bytes(out)
+
+    @classmethod
+    def decode(cls, data: bytes) -> "StatsReport":
+        if data[:4] != _MAGIC:
+            raise ValueError("Not a StatsReport record")
+        off = 6
+        def take(fmt):
+            nonlocal off
+            size = struct.calcsize(fmt)
+            vals = struct.unpack_from(fmt, data, off)
+            off += size
+            return vals
+        (slen,) = take("<H")
+        sid = data[off:off + slen].decode(); off += slen
+        (wlen,) = take("<H")
+        wid = data[off:off + wlen].decode(); off += wlen
+        r = cls(sid, wid)
+        (r.timestamp, r.iteration, r.score, r.iteration_time_ms,
+         r.samples_per_sec, r.mem_rss_bytes, r.device_mem_bytes) = take("<qid dd qq")
+        for section in (r.param_stats, r.gradient_stats, r.update_stats):
+            (n,) = take("<H")
+            for _ in range(n):
+                (nlen,) = take("<H")
+                name = data[off:off + nlen].decode(); off += nlen
+                mm, lo, hi = take("<ddd")
+                (hlen,) = take("<H")
+                hist = list(take(f"<{hlen}i"))
+                section[name] = (mm, hist, (lo, hi))
+        return r
+
+    def to_json(self) -> str:
+        return json.dumps({
+            "sessionID": self.session_id, "workerID": self.worker_id,
+            "timestamp": self.timestamp, "iteration": self.iteration,
+            "score": self.score, "iterationTimeMs": self.iteration_time_ms,
+            "samplesPerSec": self.samples_per_sec,
+            "memRssBytes": self.mem_rss_bytes,
+            "deviceMemBytes": self.device_mem_bytes,
+            "paramMeanMagnitudes": {k: v[0] for k, v in self.param_stats.items()},
+            "gradientMeanMagnitudes": {k: v[0] for k, v in self.gradient_stats.items()},
+            "updateMeanMagnitudes": {k: v[0] for k, v in self.update_stats.items()},
+        })
+
+
+def _array_stats(arr: np.ndarray, bins: int) -> Tuple[float, List[int], Tuple[float, float]]:
+    flat = np.ravel(np.asarray(arr, np.float64))
+    if flat.size == 0:
+        return 0.0, [0] * bins, (0.0, 0.0)
+    mm = float(np.mean(np.abs(flat)))
+    lo, hi = float(flat.min()), float(flat.max())
+    hist, _ = np.histogram(flat, bins=bins,
+                           range=(lo, hi if hi > lo else lo + 1e-12))
+    return mm, hist.astype(int).tolist(), (lo, hi)
+
+
+class StatsListener:
+    """Collects stats per iteration and routes them to storage
+    (reference BaseStatsListener.iterationDone:273)."""
+
+    def __init__(self, router: StatsStorageRouter, session_id: Optional[str] = None,
+                 worker_id: str = "main", frequency: int = 1,
+                 collect_histograms: bool = True, histogram_bins: int = 20):
+        self.router = router
+        self.session_id = session_id or f"session_{int(time.time()*1000)}"
+        self.worker_id = worker_id
+        self.frequency = max(1, frequency)
+        self.collect_histograms = collect_histograms
+        self.histogram_bins = histogram_bins
+        self._last_time: Optional[float] = None
+        self._last_params: Optional[dict] = None
+
+    def iteration_done(self, model, iteration: int) -> None:
+        if iteration % self.frequency != 0:
+            return
+        now = time.time()
+        r = StatsReport(self.session_id, self.worker_id, int(now * 1000))
+        r.iteration = iteration
+        r.score = float(model.score_value)
+        if self._last_time is not None:
+            r.iteration_time_ms = (now - self._last_time) * 1000 / self.frequency
+        self._last_time = now
+        r.mem_rss_bytes = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024
+
+        params = getattr(model, "params_list", None)
+        if self.collect_histograms and params is not None:
+            flat = _flatten_named(params)
+            for name, arr in flat.items():
+                r.param_stats[name] = _array_stats(arr, self.histogram_bins)
+            if self._last_params is not None:
+                for name, arr in flat.items():
+                    prev = self._last_params.get(name)
+                    if prev is not None and prev.shape == np.shape(arr):
+                        r.update_stats[name] = _array_stats(
+                            np.asarray(arr) - prev, self.histogram_bins)
+            self._last_params = {k: np.asarray(v).copy() for k, v in flat.items()}
+        self.router.put_update(r)
+
+    def on_epoch_start(self, model) -> None:
+        pass
+
+    def on_epoch_end(self, model) -> None:
+        pass
+
+
+def _flatten_named(params, prefix="") -> Dict[str, np.ndarray]:
+    out: Dict[str, np.ndarray] = {}
+    if isinstance(params, dict):
+        items = params.items()
+    elif isinstance(params, (list, tuple)):
+        items = enumerate(params)
+    else:
+        return {prefix or "param": np.asarray(params)}
+    for k, v in items:
+        name = f"{prefix}{k}"
+        if isinstance(v, (dict, list, tuple)):
+            out.update(_flatten_named(v, name + "_"))
+        elif v is not None and hasattr(v, "shape"):
+            out[name] = np.asarray(v)
+    return out

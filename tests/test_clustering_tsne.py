@@ -1,0 +1,108 @@
+"""Clustering + t-SNE tests (reference deeplearning4j-core clustering tests +
+TsneTest)."""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.clustering import KDTree, KMeansClustering, SPTree, VPTree
+from deeplearning4j_tpu.plot import BarnesHutTsne, Tsne
+
+
+def _blobs(n_per=50, seed=0):
+    rng = np.random.default_rng(seed)
+    centers = np.array([[0, 0, 0], [10, 10, 10], [-10, 10, -10]], np.float64)
+    pts = np.concatenate([c + rng.normal(0, 1.0, (n_per, 3)) for c in centers])
+    labels = np.repeat(np.arange(3), n_per)
+    return pts, labels
+
+
+def test_kmeans_recovers_blobs():
+    pts, labels = _blobs()
+    km = KMeansClustering.setup(3, max_iterations=50, seed=4)
+    cs = km.apply_to(pts)
+    a = np.asarray(cs.assignments)
+    # each true cluster maps to exactly one predicted cluster
+    for c in range(3):
+        vals, counts = np.unique(a[labels == c], return_counts=True)
+        assert counts.max() / counts.sum() > 0.98
+    # predict on new points near a center lands in that center's cluster
+    pred = km.predict(cs, pts[:5])
+    assert len(set(pred.tolist())) == 1
+
+
+def test_kmeans_distances():
+    pts, _ = _blobs(20)
+    for dist in ("euclidean", "manhattan", "cosine"):
+        cs = KMeansClustering.setup(3, 30, distance=dist, seed=1).apply_to(pts)
+        assert np.isfinite(float(cs.inertia))
+    with pytest.raises(ValueError):
+        KMeansClustering(3, distance="hamming")
+
+
+def test_kdtree_matches_bruteforce():
+    rng = np.random.default_rng(7)
+    pts = rng.normal(size=(200, 5))
+    tree = KDTree(pts)
+    for _ in range(10):
+        q = rng.normal(size=5)
+        d = np.linalg.norm(pts - q, axis=1)
+        expect = set(np.argsort(d)[:4].tolist())
+        got = {i for i, _ in tree.knn(q, 4)}
+        assert got == expect
+
+
+def test_vptree_matches_bruteforce():
+    rng = np.random.default_rng(8)
+    pts = rng.normal(size=(150, 4))
+    tree = VPTree(pts)
+    for _ in range(10):
+        q = rng.normal(size=4)
+        d = np.linalg.norm(pts - q, axis=1)
+        expect = set(np.argsort(d)[:5].tolist())
+        got = {i for i, _ in tree.knn(q, 5)}
+        assert got == expect
+
+
+def test_sptree_forces_match_exact():
+    """theta=0 Barnes-Hut forces == exact repulsive forces."""
+    rng = np.random.default_rng(9)
+    y = rng.normal(size=(40, 2))
+    tree = SPTree(y)
+    neg_f = np.zeros_like(y)
+    z = 0.0
+    for i in range(40):
+        z += tree.compute_non_edge_forces(i, 0.0, neg_f[i])
+    # exact computation
+    d = y[:, None] - y[None]
+    q = 1.0 / (1.0 + (d ** 2).sum(-1))
+    np.fill_diagonal(q, 0.0)
+    z_exact = q.sum()
+    neg_exact = np.einsum("ij,ijc->ic", q * q, d)
+    assert abs(z - z_exact) / z_exact < 1e-9
+    np.testing.assert_allclose(neg_f, neg_exact, rtol=1e-9)
+
+
+def test_tsne_separates_clusters():
+    pts, labels = _blobs(30, seed=3)
+    emb = Tsne(perplexity=10, max_iter=250, seed=5).fit_transform(pts)
+    assert emb.shape == (90, 2)
+    # mean within-cluster distance far below between-cluster distance
+    cents = np.stack([emb[labels == c].mean(0) for c in range(3)])
+    within = np.mean([np.linalg.norm(emb[labels == c] - cents[c], axis=1).mean()
+                      for c in range(3)])
+    between = np.mean([np.linalg.norm(cents[a] - cents[b])
+                       for a in range(3) for b in range(a + 1, 3)])
+    assert between > 3 * within, (within, between)
+
+
+def test_barnes_hut_tsne_separates_clusters():
+    pts, labels = _blobs(40, seed=6)
+    bh = (BarnesHutTsne.builder().theta(0.5).perplexity(10)
+          .set_max_iter(250).seed(2).build())
+    emb = bh.fit(pts)
+    assert emb.shape == (120, 2)
+    cents = np.stack([emb[labels == c].mean(0) for c in range(3)])
+    within = np.mean([np.linalg.norm(emb[labels == c] - cents[c], axis=1).mean()
+                      for c in range(3)])
+    between = np.mean([np.linalg.norm(cents[a] - cents[b])
+                       for a in range(3) for b in range(a + 1, 3)])
+    assert between > 2 * within, (within, between)

@@ -1,0 +1,90 @@
+"""deeplearning4j-graph equivalents: graph structure, random walks, DeepWalk
+(reference TestGraph, TestRandomWalkIterator, DeepWalkGradientCheck/TestDeepWalk)."""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.graph import (
+    DeepWalk, Graph, RandomWalkIterator, WeightedRandomWalkIterator,
+)
+from deeplearning4j_tpu.graph.walkers import EXCEPTION_ON_DISCONNECTED
+
+
+def _two_cliques(k=6):
+    """Two k-cliques joined by one bridge edge: walks stay mostly inside a clique."""
+    g = Graph(2 * k)
+    for a in range(k):
+        for b in range(a + 1, k):
+            g.add_edge(a, b)
+            g.add_edge(k + a, k + b)
+    g.add_edge(0, k)  # bridge
+    return g
+
+
+def test_graph_structure():
+    g = Graph(4)
+    g.add_edge(0, 1)
+    g.add_edge(1, 2, directed=True)
+    assert g.num_vertices() == 4
+    assert set(g.get_connected_vertex_indices(0)) == {1}
+    assert set(g.get_connected_vertex_indices(1)) == {0, 2}
+    assert g.get_connected_vertex_indices(2) == []  # directed edge: no back edge
+    assert g.get_vertex_degree(1) == 2
+
+
+def test_edge_list_loader(tmp_path):
+    p = tmp_path / "edges.txt"
+    p.write_text("# comment\n0 1\n1 2\n2 3\n")
+    g = Graph.load_edge_list(str(p), 4)
+    assert g.get_connected_vertex_indices(1) == [0, 2]
+
+
+def test_adjacency_list_loader(tmp_path):
+    p = tmp_path / "adj.txt"
+    p.write_text("0 1 2\n1 0\n2 0\n")
+    g = Graph.load_adjacency_list(str(p))
+    assert set(g.get_connected_vertex_indices(0)) == {1, 2}
+
+
+def test_random_walks_stay_on_edges():
+    g = _two_cliques()
+    it = RandomWalkIterator(g, walk_length=10, seed=1)
+    walks = list(it)
+    assert len(walks) == g.num_vertices()
+    for walk in walks:
+        assert len(walk) == 11
+        for a, b in zip(walk, walk[1:]):
+            assert b in g.get_connected_vertex_indices(a) or a == b
+
+
+def test_disconnected_vertex_handling():
+    g = Graph(3)
+    g.add_edge(0, 1)
+    # vertex 2 disconnected: self-loop mode walks in place
+    walk = RandomWalkIterator(g, 5, seed=0).walk_from(2)
+    assert walk == [2] * 6
+    with pytest.raises(ValueError):
+        RandomWalkIterator(g, 5, no_edge_handling=EXCEPTION_ON_DISCONNECTED).walk_from(2)
+
+
+def test_weighted_walks_follow_weights():
+    g = Graph(3)
+    g.add_edge(0, 1, weight=1000.0)
+    g.add_edge(0, 2, weight=0.001)
+    it = WeightedRandomWalkIterator(g, 1, seed=3)
+    hits = sum(it.walk_from(0)[1] == 1 for _ in range(50))
+    assert hits >= 48  # overwhelmingly follows the heavy edge
+
+
+def test_deepwalk_embeds_cliques():
+    g = _two_cliques(6)
+    dw = (DeepWalk.builder().vector_size(24).window_size(4)
+          .learning_rate(0.05).epochs(5).seed(11).build())
+    dw.fit(g, walk_length=20, walks_per_vertex=4)
+    # same-clique pairs more similar than cross-clique pairs on average
+    same = np.mean([dw.similarity(1, 2), dw.similarity(2, 3),
+                    dw.similarity(7, 8), dw.similarity(8, 9)])
+    cross = np.mean([dw.similarity(1, 7), dw.similarity(2, 8),
+                     dw.similarity(3, 9), dw.similarity(4, 10)])
+    assert same > cross, (same, cross)
+    vec = dw.get_vertex_vector(0)
+    assert vec.shape == (24,) and np.all(np.isfinite(vec))

@@ -1,0 +1,146 @@
+"""NLP tests (reference deeplearning4j-nlp Word2VecTests, ParagraphVectorsTest,
+GloveTest, TsneTest corpora — small synthetic corpus here)."""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nlp import Glove, ParagraphVectors, SequenceVectors, Word2Vec
+from deeplearning4j_tpu.nlp.bagofwords import BagOfWordsVectorizer, TfidfVectorizer
+from deeplearning4j_tpu.nlp.iterators import (
+    CollectionSentenceIterator, LabelAwareListSentenceIterator, LabelledDocument,
+    SimpleLabelAwareIterator,
+)
+from deeplearning4j_tpu.nlp.serializer import read_word_vectors, write_word_vectors
+from deeplearning4j_tpu.nlp.tokenization import (
+    CommonPreprocessor, DefaultTokenizerFactory, NGramTokenizerFactory,
+)
+from deeplearning4j_tpu.nlp.vocab import VocabConstructor, build_huffman
+
+
+def _corpus(n_reps=40):
+    """Two topic clusters: animals and numbers; co-occurring words should embed
+    closer than cross-topic words."""
+    base = [
+        "the cat sat on the mat with the dog",
+        "a dog chased the cat around the house",
+        "cat and dog are friendly animals in the house",
+        "one two three four five six seven",
+        "two plus three equals five numbers",
+        "seven six five four three two one numbers count",
+    ]
+    return base * n_reps
+
+
+def test_tokenizer_and_preprocess():
+    tf = DefaultTokenizerFactory()
+    tf.set_token_pre_processor(CommonPreprocessor())
+    toks = tf.create("The CAT, sat. (on) a MAT!?").get_tokens()
+    assert toks == ["the", "cat", "sat", "on", "a", "mat"]
+    ng = NGramTokenizerFactory(DefaultTokenizerFactory(), 1, 2)
+    toks = ng.create("a b c").get_tokens()
+    assert "a b" in toks and "b c" in toks and "a" in toks
+
+
+def test_vocab_and_huffman():
+    seqs = [s.split() for s in _corpus(2)]
+    cache = VocabConstructor(min_word_frequency=2).build_joint_vocabulary(seqs)
+    assert cache.num_words() > 5
+    assert cache.index_of("the") == 0  # most frequent word gets index 0
+    # Huffman: every word has a code; code lengths satisfy Kraft equality
+    kraft = sum(2.0 ** -len(vw.code) for vw in cache.vocab_words())
+    assert abs(kraft - 1.0) < 1e-9
+    # frequent words get shorter codes
+    the = cache.word_for("the")
+    rare = cache.vocab_words()[-1]
+    assert len(the.code) <= len(rare.code)
+
+
+@pytest.mark.parametrize("mode", ["hs", "neg"])
+def test_word2vec_topic_similarity(mode):
+    w2v = (Word2Vec.builder()
+           .layer_size(32).window_size(4).min_word_frequency(2)
+           .learning_rate(0.05).epochs(3).seed(7)
+           .use_hierarchic_softmax(mode == "hs")
+           .negative_sample(5 if mode == "neg" else 0)
+           .iterate(CollectionSentenceIterator(_corpus()))
+           .build())
+    w2v.fit()
+    assert w2v.get_word_vector("cat") is not None
+    sim_in = w2v.similarity("cat", "dog")
+    sim_cross = w2v.similarity("cat", "five")
+    assert sim_in > sim_cross, (sim_in, sim_cross)
+    nearest = w2v.words_nearest("two", top_n=5)
+    number_words = {"one", "three", "four", "five", "six", "seven", "numbers"}
+    assert len(number_words.intersection(nearest)) >= 2, nearest
+
+
+def test_word2vec_cbow_trains():
+    w2v = (Word2Vec.builder()
+           .layer_size(24).window_size(4).min_word_frequency(2)
+           .elements_learning_algorithm("CBOW").epochs(3).seed(3)
+           .iterate(CollectionSentenceIterator(_corpus()))
+           .build())
+    w2v.fit()
+    assert w2v.similarity("cat", "dog") > w2v.similarity("cat", "five")
+
+
+def test_word_vector_serialization_roundtrip(tmp_path):
+    w2v = (Word2Vec.builder()
+           .layer_size(16).min_word_frequency(2).epochs(1).seed(1)
+           .iterate(CollectionSentenceIterator(_corpus(5)))
+           .build())
+    w2v.fit()
+    for binary in (False, True):
+        p = str(tmp_path / f"vecs.{'bin' if binary else 'txt'}")
+        write_word_vectors(w2v, p, binary=binary)
+        loaded = read_word_vectors(p, binary=binary)
+        v0 = w2v.get_word_vector("cat")
+        v1 = loaded.get_word_vector("cat")
+        np.testing.assert_allclose(v0, v1, atol=1e-5)
+        assert set(loaded.vocab.words()) == set(w2v.vocab.words())
+
+
+def test_paragraph_vectors_dbow_and_infer():
+    docs = ([LabelledDocument(s, [f"ANIMAL_{i}"]) for i, s in
+             enumerate(_corpus(10)[:3] * 10)]
+            + [LabelledDocument(s, [f"NUM_{i}"]) for i, s in
+               enumerate(_corpus(10)[3:6] * 10)])
+    pv = (ParagraphVectors.builder()
+          .layer_size(24).window_size(4).min_word_frequency(2)
+          .learning_rate(0.05).epochs(2).seed(11)
+          .iterate(SimpleLabelAwareIterator(docs))
+          .build())
+    pv.fit()
+    # label vectors exist
+    assert pv.get_word_vector("ANIMAL_0") is not None
+    # inference produces a finite vector of the right size
+    vec = pv.infer_vector("the cat sat with the dog")
+    assert vec.shape == (24,) and np.all(np.isfinite(vec))
+
+
+def test_glove_trains_and_embeds():
+    glove = (Glove.builder()
+             .layer_size(24).window_size(4).min_word_frequency(2)
+             .learning_rate(0.1).epochs(8).seed(5)
+             .build())
+    glove.fit([s.split() for s in _corpus()])
+    sim_in = glove.similarity("cat", "dog")
+    sim_cross = glove.similarity("cat", "five")
+    assert sim_in > sim_cross, (sim_in, sim_cross)
+
+
+def test_bow_and_tfidf():
+    docs = ["the cat sat", "the dog sat", "numbers one two three"]
+    bow = BagOfWordsVectorizer().fit(docs)
+    row = bow.transform("the cat and the dog")
+    assert row[bow.vocab.index_of("the")] == 2.0
+    assert row[bow.vocab.index_of("cat")] == 1.0
+    tfidf = TfidfVectorizer().fit(docs)
+    r = tfidf.transform("the cat sat")
+    # 'the' appears in 2/3 docs -> lower idf than 'cat' (1/3 docs)
+    assert r[tfidf.vocab.index_of("cat")] > r[tfidf.vocab.index_of("the")]
+
+
+def test_label_aware_iterator_labels():
+    it = LabelAwareListSentenceIterator(["a b", "c d"])
+    docs = list(it)
+    assert docs[0].labels == ["DOC_0"] and docs[1].labels == ["DOC_1"]

@@ -1,0 +1,125 @@
+"""Observability tests (reference TestStatsListener, TestPlayUI,
+TestRemoteReceiver — headless equivalents)."""
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn.conf.builders import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.ui import (
+    FileStatsStorage, InMemoryStatsStorage, StatsListener, StatsReport, UIServer,
+)
+from deeplearning4j_tpu.ui.server import RemoteUIStatsStorageRouter
+
+
+def _trained_net_with_listener(storage, iters=5):
+    conf = (NeuralNetConfiguration.builder()
+            .seed(0).learning_rate(0.1)
+            .list()
+            .layer(DenseLayer(n_in=4, n_out=8, activation="tanh"))
+            .layer(OutputLayer(n_in=8, n_out=3, loss="mcxent", activation="softmax"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    net.set_listeners(StatsListener(storage, session_id="test_session"))
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(16, 4)).astype(np.float32)
+    y = np.zeros((16, 3), np.float32)
+    y[np.arange(16), rng.integers(0, 3, 16)] = 1
+    for _ in range(iters):
+        net.fit(x, y)
+    return net
+
+
+def test_stats_report_codec_roundtrip():
+    r = StatsReport("sess", "w0", 12345)
+    r.iteration = 7
+    r.score = 1.25
+    r.iteration_time_ms = 3.5
+    r.mem_rss_bytes = 1 << 30
+    r.param_stats["l0_W"] = (0.25, [1, 2, 3, 4], (-1.0, 1.0))
+    r.gradient_stats["l0_W"] = (0.01, [4, 3, 2, 1], (-0.1, 0.1))
+    out = StatsReport.decode(r.encode())
+    assert out.session_id == "sess" and out.worker_id == "w0"
+    assert out.iteration == 7 and out.score == 1.25
+    assert out.param_stats["l0_W"][0] == 0.25
+    assert out.param_stats["l0_W"][1] == [1, 2, 3, 4]
+    assert out.gradient_stats["l0_W"][2] == (-0.1, 0.1)
+
+
+def test_listener_populates_storage():
+    storage = InMemoryStatsStorage()
+    _trained_net_with_listener(storage)
+    assert storage.list_session_ids() == ["test_session"]
+    updates = storage.get_all_updates_after("test_session", StatsReport.TYPE_ID,
+                                            "main", -1)
+    assert len(updates) == 5
+    reports = [StatsReport.decode(u) for u in updates]
+    assert all(np.isfinite(r.score) for r in reports)
+    assert any(r.param_stats for r in reports)
+    # update stats appear from the second iteration on
+    assert reports[-1].update_stats
+
+
+def test_file_stats_storage_roundtrip(tmp_path):
+    path = str(tmp_path / "stats.db")
+    storage = FileStatsStorage(path)
+    _trained_net_with_listener(storage, iters=3)
+    storage.close()
+    re = FileStatsStorage(path)
+    assert re.list_session_ids() == ["test_session"]
+    assert re.get_num_updates("test_session", StatsReport.TYPE_ID, "main") == 3
+    latest = StatsReport.decode(
+        re.get_latest_update("test_session", StatsReport.TYPE_ID, "main"))
+    assert latest.iteration == 3
+    re.close()
+
+
+def test_storage_listener_events():
+    storage = InMemoryStatsStorage()
+    events = []
+    storage.register_stats_storage_listener(events.append)
+    _trained_net_with_listener(storage, iters=2)
+    kinds = [e.kind for e in events]
+    assert "PostUpdate" in kinds
+
+
+def test_ui_server_endpoints():
+    server = UIServer(port=0)
+    try:
+        storage = InMemoryStatsStorage()
+        server.attach(storage)
+        _trained_net_with_listener(storage, iters=4)
+        base = f"http://127.0.0.1:{server.port}"
+        with urllib.request.urlopen(base + "/train/overview") as r:
+            assert r.status == 200 and b"Training overview" in r.read()
+        with urllib.request.urlopen(base + "/train/overview/data") as r:
+            data = json.loads(r.read())
+        assert len(data["scores"]) == 4
+        assert data["iterations"] == [1, 2, 3, 4]
+        with urllib.request.urlopen(base + "/train/model/data") as r:
+            model = json.loads(r.read())
+        assert any("W" in k for k in model["layers"])
+        with urllib.request.urlopen(base + "/train/system/data") as r:
+            system = json.loads(r.read())
+        assert len(system["memRssBytes"]) == 4
+    finally:
+        server.stop()
+
+
+def test_remote_router_posts_to_server():
+    server = UIServer(port=0)
+    try:
+        server.enable_remote_listener()
+        router = RemoteUIStatsStorageRouter(f"http://127.0.0.1:{server.port}")
+        r = StatsReport("remote_sess", "w1", 99)
+        r.iteration = 1
+        r.score = 0.5
+        router.put_update(r)
+        data = server.overview_data()
+        assert data["scores"] == [0.5]
+        assert "remote_sess" in server.sessions()
+    finally:
+        server.stop()
