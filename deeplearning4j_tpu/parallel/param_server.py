@@ -1,0 +1,121 @@
+"""Asynchronous parameter-server training.
+
+Reference: deeplearning4j-scaleout ParameterServerParallelWrapper.java — embeds
+an Aeron media driver + ParameterServerNode (:159-161); worker threads
+pushNDArray(model.params()) (:328) and re-fetch the global array (:305),
+training asynchronously between syncs.
+
+TPU-native redesign: the UDP media driver becomes an in-process server object
+holding the canonical param pytree behind a lock (multi-host deployments would
+put this behind jax.distributed; the push/pull semantics are identical).
+Workers run in threads, each training a model replica; every
+``push_frequency`` iterations a worker pushes its params (server soft-averages
+them into the global copy) and pulls the fresh global state.
+"""
+from __future__ import annotations
+
+import threading
+from typing import List, Optional
+
+import jax
+import numpy as np
+
+from deeplearning4j_tpu.utils.pytree import tree_average
+
+
+class ParameterServer:
+    """In-process async parameter store (reference ParameterServerNode role)."""
+
+    def __init__(self, initial_params):
+        self._params = jax.tree_util.tree_map(np.asarray, initial_params)
+        self._lock = threading.Lock()
+        self.pushes = 0
+
+    def push(self, params) -> None:
+        """Soft-average the pushed params into the global copy
+        (the reference's PS averages concurrent worker pushes the same way)."""
+        incoming = jax.tree_util.tree_map(np.asarray, params)
+        with self._lock:
+            self._params = jax.tree_util.tree_map(
+                lambda a, b: (a + b) / 2.0, self._params, incoming)
+            self.pushes += 1
+
+    def pull(self):
+        with self._lock:
+            return jax.tree_util.tree_map(np.copy, self._params)
+
+
+class ParameterServerParallelWrapper:
+    """Async-DP trainer (reference ParameterServerParallelWrapper.java)."""
+
+    def __init__(self, model, workers: int = 2, push_frequency: int = 4,
+                 prefetch: int = 2):
+        self.model = model
+        self.workers = workers
+        self.push_frequency = max(1, push_frequency)
+        self.prefetch = prefetch
+
+    class Builder:
+        def __init__(self, model):
+            self._model = model
+            self._kw = {}
+
+        def workers(self, n: int):
+            self._kw["workers"] = n
+            return self
+
+        def push_frequency(self, n: int):
+            self._kw["push_frequency"] = n
+            return self
+
+        def build(self) -> "ParameterServerParallelWrapper":
+            return ParameterServerParallelWrapper(self._model, **self._kw)
+
+    @staticmethod
+    def builder(model) -> "ParameterServerParallelWrapper.Builder":
+        return ParameterServerParallelWrapper.Builder(model)
+
+    def fit(self, iterator, epochs: int = 1) -> None:
+        import queue as _queue
+
+        model = self.model
+        server = ParameterServer(model.params_list)
+        q: _queue.Queue = _queue.Queue(maxsize=self.workers * self.prefetch)
+
+        def make_worker(worker_id: int):
+            def run():
+                replica = model.clone() if hasattr(model, "clone") else model
+                local_iters = 0
+                while True:
+                    ds = q.get()
+                    if ds is None:
+                        q.task_done()
+                        break
+                    replica.params_list = jax.tree_util.tree_map(
+                        jax.numpy.asarray, server.pull()) \
+                        if local_iters % self.push_frequency == 0 \
+                        else replica.params_list
+                    replica.fit(ds.features, ds.labels)
+                    local_iters += 1
+                    if local_iters % self.push_frequency == 0:
+                        server.push(replica.params_list)
+                    q.task_done()
+                server.push(replica.params_list)
+            return threading.Thread(target=run, daemon=True)
+
+        threads: List[threading.Thread] = [make_worker(i)
+                                           for i in range(self.workers)]
+        for t in threads:
+            t.start()
+        for _ in range(epochs):
+            if hasattr(iterator, "reset"):
+                iterator.reset()
+            for ds in iterator:
+                q.put(ds)
+        for _ in threads:
+            q.put(None)
+        for t in threads:
+            t.join()
+        model.params_list = jax.tree_util.tree_map(jax.numpy.asarray,
+                                                   server.pull())
+        model.score_value = float(model.score_value)

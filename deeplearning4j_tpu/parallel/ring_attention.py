@@ -1,0 +1,146 @@
+"""Long-context attention: ring (context-parallel) and Ulysses (all-to-all).
+
+The reference scales sequence length only via truncated BPTT + masking
+(SURVEY.md §5; reference MultiLayerNetwork.doTruncatedBPTT:1140) — sequence/
+context parallelism does not exist there. These are the TPU-native long-context
+mechanisms required as first-class components:
+
+* ``ring_attention``: queries stay resident; K/V shards rotate around the ICI
+  ring via ``ppermute`` while each device accumulates its attention output with
+  an online (flash-style) softmax — memory per device stays O(T/N), and the
+  K/V transfer overlaps the local block computation in XLA's schedule.
+* ``ulysses_attention``: all-to-all swaps the sequence shard for a head shard,
+  computes full-sequence attention on 1/N of the heads, then swaps back.
+
+Both are exact: outputs match single-device softmax attention to fp tolerance.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+Array = jax.Array
+_NEG = -1e30
+
+
+def attention_reference(q: Array, k: Array, v: Array, causal: bool = False) -> Array:
+    """Plain full-sequence softmax attention (the correctness oracle).
+
+    Shapes: q,k,v = (B, T, H, D) -> (B, T, H, D).
+    """
+    d = q.shape[-1]
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(jnp.float32(d))
+    if causal:
+        tq, tk = s.shape[-2], s.shape[-1]
+        mask = jnp.arange(tq)[:, None] >= jnp.arange(tk)[None, :]
+        s = jnp.where(mask, s, _NEG)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+
+def _online_block(q, k, v, m_prev, l_prev, o_prev, q_off, kv_off, causal):
+    """One flash-attention accumulation step against a K/V block.
+
+    q: (B, Tq, H, D); k,v: (B, Tk, H, D); m,l: (B, H, Tq); o: (B, Tq, H, D).
+    """
+    d = q.shape[-1]
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(jnp.float32(d))
+    if causal:
+        tq, tk = s.shape[-2], s.shape[-1]
+        q_pos = q_off + jnp.arange(tq)
+        kv_pos = kv_off + jnp.arange(tk)
+        mask = q_pos[:, None] >= kv_pos[None, :]
+        s = jnp.where(mask[None, None], s, _NEG)
+    m_blk = jnp.max(s, axis=-1)                      # (B, H, Tq)
+    m_new = jnp.maximum(m_prev, m_blk)
+    p = jnp.exp(s - m_new[..., None])                # (B, H, Tq, Tk)
+    # fully-masked blocks: keep them exactly zero
+    p = jnp.where(s <= _NEG, 0.0, p)
+    scale = jnp.exp(m_prev - m_new)                  # (B, H, Tq)
+    l_new = l_prev * scale + jnp.sum(p, axis=-1)
+    o_scaled = o_prev * jnp.transpose(scale, (0, 2, 1))[..., None]
+    o_new = o_scaled + jnp.einsum("bhqk,bkhd->bqhd", p, v)
+    return m_new, l_new, o_new
+
+
+def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool):
+    """Per-shard body: rotate K/V around the ring, accumulate online softmax."""
+    n_dev = lax.psum(1, axis_name)
+    my_idx = lax.axis_index(axis_name)
+    B, Tq, H, D = q.shape
+    Tk = k.shape[1]
+    q_off = my_idx * Tq
+
+    # accumulators are device-varying (they depend on this shard's q) — mark
+    # them so the fori_loop carry types line up under shard_map
+    vary = lambda x: lax.pcast(x, (axis_name,), to="varying")
+    m = vary(jnp.full((B, H, Tq), _NEG, q.dtype))
+    l = vary(jnp.zeros((B, H, Tq), q.dtype))
+    o = jnp.zeros_like(q)
+    perm = [(i, (i + 1) % n_dev) for i in range(n_dev)]
+
+    def body(step, carry):
+        m, l, o, k_cur, v_cur = carry
+        # K/V chunk currently resident arrived from (my_idx - step) % n_dev
+        src = (my_idx - step) % n_dev
+        kv_off = src * Tk
+        m, l, o = _online_block(q, k_cur, v_cur, m, l, o, q_off, kv_off, causal)
+        # rotate for the next step (last rotation is redundant but keeps the
+        # loop shape static; XLA overlaps it with the block compute)
+        k_nxt = lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = lax.ppermute(v_cur, axis_name, perm)
+        return m, l, o, k_nxt, v_nxt
+
+    m, l, o, _, _ = lax.fori_loop(0, n_dev, body, (m, l, o, k, v))
+    l_t = jnp.transpose(l, (0, 2, 1))[..., None]     # (B, Tq, H, 1)
+    return o / jnp.maximum(l_t, 1e-20)
+
+
+def ring_attention(q: Array, k: Array, v: Array, mesh: Mesh,
+                   axis_name: str = "sp", causal: bool = False) -> Array:
+    """Exact context-parallel attention over the mesh's ``axis_name`` axis.
+
+    Inputs are (B, T, H, D) with T sharded over ``axis_name`` (global arrays or
+    host arrays; sharding is applied here). Returns output sharded the same way.
+    """
+    spec = P(None, axis_name)
+    fn = shard_map(
+        functools.partial(_ring_attention_local, axis_name=axis_name,
+                          causal=causal),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    sh = NamedSharding(mesh, spec)
+    q, k, v = (jax.device_put(x, sh) for x in (q, k, v))
+    return fn(q, k, v)
+
+
+def _ulysses_local(q, k, v, *, axis_name: str, causal: bool):
+    """All-to-all: (T/N, H) -> (T, H/N), full attention, swap back
+    (DeepSpeed-Ulysses sequence parallelism)."""
+    # (B, T/N, H, D) -> (B, T, H/N, D)
+    qg = lax.all_to_all(q, axis_name, split_axis=2, concat_axis=1, tiled=True)
+    kg = lax.all_to_all(k, axis_name, split_axis=2, concat_axis=1, tiled=True)
+    vg = lax.all_to_all(v, axis_name, split_axis=2, concat_axis=1, tiled=True)
+    og = attention_reference(qg, kg, vg, causal=causal)
+    return lax.all_to_all(og, axis_name, split_axis=1, concat_axis=2, tiled=True)
+
+
+def ulysses_attention(q: Array, k: Array, v: Array, mesh: Mesh,
+                      axis_name: str = "sp", causal: bool = False) -> Array:
+    """Sequence-parallel attention via head-sharding all-to-all. Requires the
+    head count to be divisible by the axis size."""
+    n = mesh.shape[axis_name]
+    if q.shape[2] % n != 0:
+        raise ValueError(f"num heads {q.shape[2]} not divisible by axis size {n}")
+    spec = P(None, axis_name)
+    fn = shard_map(
+        functools.partial(_ulysses_local, axis_name=axis_name, causal=causal),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    sh = NamedSharding(mesh, spec)
+    q, k, v = (jax.device_put(x, sh) for x in (q, k, v))
+    return fn(q, k, v)

@@ -1,0 +1,73 @@
+"""Ring/Ulysses attention == single-device attention on the virtual 8-device mesh
+(the equivalence-test pattern of reference
+TestCompareParameterAveragingSparkVsSingleMachine applied to context parallelism)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from deeplearning4j_tpu.parallel.ring_attention import (
+    attention_reference, ring_attention, ulysses_attention,
+)
+
+
+def _mesh(n=8, name="sp"):
+    return Mesh(np.array(jax.devices()[:n]), (name,))
+
+
+def _qkv(B=2, T=64, H=8, D=16, seed=0):
+    rng = np.random.default_rng(seed)
+    mk = lambda: jnp.asarray(rng.normal(size=(B, T, H, D)).astype(np.float32))
+    return mk(), mk(), mk()
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_attention_matches_reference(causal):
+    q, k, v = _qkv()
+    expect = attention_reference(q, k, v, causal=causal)
+    got = ring_attention(q, k, v, _mesh(), causal=causal)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expect),
+                               rtol=2e-4, atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ulysses_attention_matches_reference(causal):
+    q, k, v = _qkv()
+    expect = attention_reference(q, k, v, causal=causal)
+    got = ulysses_attention(q, k, v, _mesh(), causal=causal)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expect),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_ring_attention_gradients_match():
+    q, k, v = _qkv(B=1, T=32, H=4, D=8, seed=3)
+    mesh = _mesh(4)
+
+    def loss_ring(q, k, v):
+        return jnp.sum(ring_attention(q, k, v, mesh, causal=True) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(attention_reference(q, k, v, causal=True) ** 2)
+
+    g_ring = jax.grad(loss_ring, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_ring, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-4, atol=5e-5)
+
+
+def test_ulysses_rejects_indivisible_heads():
+    q, k, v = _qkv(H=6)
+    with pytest.raises(ValueError):
+        ulysses_attention(q, k, v, _mesh(8))
+
+
+def test_ring_attention_long_sequence_sharded_memory():
+    """Each device only ever holds T/N keys — run a longer sequence through and
+    check output correctness end-to-end."""
+    q, k, v = _qkv(B=1, T=256, H=4, D=16, seed=9)
+    expect = attention_reference(q, k, v, causal=True)
+    got = ring_attention(q, k, v, _mesh(), causal=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expect),
+                               rtol=2e-4, atol=2e-5)

@@ -1,0 +1,104 @@
+"""Distributed-training tests (reference dl4j-spark
+TestCompareParameterAveragingSparkVsSingleMachine + ParameterServerParallelWrapperTest,
+run on the virtual 8-device CPU mesh instead of Spark local[N])."""
+import jax
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.iterators import ListDataSetIterator
+from deeplearning4j_tpu.nn.conf.builders import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.parallel.param_server import ParameterServerParallelWrapper
+from deeplearning4j_tpu.parallel.training_master import (
+    DistributedMultiLayer, ParameterAveragingTrainingMaster,
+)
+
+
+def _net(updater="sgd", lr=0.1, seed=12345):
+    conf = (NeuralNetConfiguration.builder()
+            .seed(seed).learning_rate(lr).updater(updater)
+            .list()
+            .layer(DenseLayer(n_in=4, n_out=8, activation="tanh"))
+            .layer(OutputLayer(n_in=8, n_out=3, loss="mcxent", activation="softmax"))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _batches(n_batches=16, batch=8, seed=0):
+    rng = np.random.default_rng(seed)
+    out = []
+    for _ in range(n_batches):
+        x = rng.normal(size=(batch, 4)).astype(np.float32)
+        labels = (x[:, 0] + x[:, 1] > 0).astype(int)
+        y = np.zeros((batch, 3), np.float32)
+        y[np.arange(batch), labels] = 1
+        out.append(DataSet(x, y))
+    return out
+
+
+def test_param_averaging_freq1_equals_single_machine():
+    """With averaging_frequency=1 and plain SGD, training D workers on D
+    minibatches then averaging == training one machine on the concatenated
+    global batch (the reference's gold-standard equivalence)."""
+    D = 4
+    data = _batches(n_batches=D, batch=8)
+
+    dist_net = _net("sgd")
+    master = (ParameterAveragingTrainingMaster.Builder(D)
+              .averaging_frequency(1).build())
+    DistributedMultiLayer(dist_net, master).fit(data)
+
+    single_net = _net("sgd")
+    gx = np.concatenate([ds.features for ds in data])
+    gy = np.concatenate([ds.labels for ds in data])
+    single_net.fit(gx, gy)
+
+    for a, b in zip(jax.tree_util.tree_leaves(dist_net.params_list),
+                    jax.tree_util.tree_leaves(single_net.params_list)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_param_averaging_multiple_rounds_trains():
+    data = _batches(n_batches=32)
+    net = _net("adam", lr=0.05)
+    master = (ParameterAveragingTrainingMaster.Builder(8)
+              .averaging_frequency(2).collect_training_stats(True).build())
+    front = DistributedMultiLayer(net, master)
+    s0 = net.score(np.concatenate([d.features for d in data]),
+                   np.concatenate([d.labels for d in data]))
+    front.fit(data, epochs=3)
+    s1 = net.score(np.concatenate([d.features for d in data]),
+                   np.concatenate([d.labels for d in data]))
+    assert s1 < s0 * 0.8, (s0, s1)
+    stats = master.get_training_stats()
+    assert stats is not None
+    assert "WorkerFit" in stats.phases()
+    assert "AverageParameters" in stats.phases()
+
+
+def test_training_stats_html_export(tmp_path):
+    data = _batches(n_batches=8)
+    net = _net()
+    master = (ParameterAveragingTrainingMaster.Builder(4)
+              .collect_training_stats(True).build())
+    DistributedMultiLayer(net, master).fit(data)
+    path = str(tmp_path / "stats.html")
+    master.get_training_stats().export_html(path)
+    html = open(path).read()
+    assert "svg" in html and "WorkerFit" in html
+
+
+def test_parameter_server_async_trains():
+    data = _batches(n_batches=24)
+    net = _net("sgd", lr=0.05)
+    gx = np.concatenate([d.features for d in data])
+    gy = np.concatenate([d.labels for d in data])
+    s0 = net.score(gx, gy)
+    wrapper = (ParameterServerParallelWrapper.builder(net)
+               .workers(2).push_frequency(2).build())
+    wrapper.fit(ListDataSetIterator(data), epochs=3)
+    s1 = net.score(gx, gy)
+    assert s1 < s0 * 0.9, (s0, s1)
