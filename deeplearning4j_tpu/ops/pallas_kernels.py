@@ -1,0 +1,187 @@
+"""Pallas TPU kernels for hot ops, with XLA fallbacks.
+
+Reference seam: deeplearning4j-cuda helpers (SURVEY.md §2.3) are reflection-
+loaded per layer (ConvolutionLayer.java:69-76) so an accelerator backend can
+take over fwd/bwd transparently. Here the seam is ``use_pallas()``: on TPU the
+pallas kernels run; elsewhere (or when disabled) the mathematically identical
+XLA path runs. Tests exercise the kernels in interpret mode on CPU.
+
+Kernels:
+* flash_attention — tiled online-softmax attention (forward), custom VJP with
+  a recompute backward (standard flash-attention practice: trade FLOPs for HBM).
+* softmax_cross_entropy — fused row-softmax + NLL loss per row.
+"""
+from __future__ import annotations
+
+import functools
+import os
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+Array = jax.Array
+_NEG = -1e30
+
+
+def use_pallas() -> bool:
+    """Backend seam (reference helper loading seam)."""
+    if os.environ.get("DL4J_TPU_DISABLE_PALLAS") == "1":
+        return False
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
+# ---------------------------------------------------------------- flash attention
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, blk_k: int, causal: bool,
+                      blk_q: int, seq_k: int, scale: float):
+    """One (batch*head, q-block) program: stream K/V blocks, online softmax.
+
+    q_ref: (blk_q, D); k_ref/v_ref: (seq_k, D); o_ref: (blk_q, D).
+    """
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * scale      # block is (1, blk_q, D)
+    d = q.shape[-1]
+    m = jnp.full((blk_q,), _NEG, jnp.float32)
+    l = jnp.zeros((blk_q,), jnp.float32)
+    acc = jnp.zeros((blk_q, d), jnp.float32)
+    n_k = seq_k // blk_k
+
+    def body(j, carry):
+        m, l, acc = carry
+        k_blk = k_ref[0, pl.ds(j * blk_k, blk_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(j * blk_k, blk_k), :].astype(jnp.float32)
+        s = q @ k_blk.T                                   # (blk_q, blk_k)
+        if causal:
+            q_pos = qi * blk_q + jax.lax.broadcasted_iota(
+                jnp.int32, (blk_q, blk_k), 0)
+            k_pos = j * blk_k + jax.lax.broadcasted_iota(
+                jnp.int32, (blk_q, blk_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, _NEG)
+        m_blk = jnp.max(s, axis=1)
+        m_new = jnp.maximum(m, m_blk)
+        p = jnp.exp(s - m_new[:, None])
+        p = jnp.where(s <= _NEG, 0.0, p)
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=1)
+        acc_new = acc * alpha[:, None] + p @ v_blk
+        return m_new, l_new, acc_new
+
+    m, l, acc = jax.lax.fori_loop(0, n_k, body, (m, l, acc))
+    o_ref[0] = (acc / jnp.maximum(l, 1e-20)[:, None]).astype(o_ref.dtype)
+
+
+def _flash_forward(q: Array, k: Array, v: Array, causal: bool,
+                   blk_q: int = 128, blk_k: int = 128,
+                   interpret: bool = False) -> Array:
+    """q,k,v: (B, T, H, D) -> (B, T, H, D)."""
+    B, Tq, H, D = q.shape
+    Tk = k.shape[1]
+    blk_q = min(blk_q, Tq)
+    blk_k = min(blk_k, Tk)
+    if Tq % blk_q or Tk % blk_k:
+        raise ValueError(f"sequence lengths ({Tq},{Tk}) must be divisible by "
+                         f"block sizes ({blk_q},{blk_k})")
+    scale = 1.0 / (D ** 0.5)
+    # (B, T, H, D) -> (B*H, T, D)
+    qr = q.transpose(0, 2, 1, 3).reshape(B * H, Tq, D)
+    kr = k.transpose(0, 2, 1, 3).reshape(B * H, Tk, D)
+    vr = v.transpose(0, 2, 1, 3).reshape(B * H, Tk, D)
+
+    kernel = functools.partial(_flash_fwd_kernel, blk_k=blk_k, causal=causal,
+                               blk_q=blk_q, seq_k=Tk, scale=scale)
+    out = pl.pallas_call(
+        kernel,
+        grid=(B * H, Tq // blk_q),
+        in_specs=[
+            pl.BlockSpec((1, blk_q, D), lambda bh, i: (bh, i, 0)),
+            pl.BlockSpec((1, Tk, D), lambda bh, i: (bh, 0, 0)),
+            pl.BlockSpec((1, Tk, D), lambda bh, i: (bh, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, blk_q, D), lambda bh, i: (bh, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((B * H, Tq, D), q.dtype),
+        interpret=interpret,
+    )(qr, kr, vr)
+    return out.reshape(B, H, Tq, D).transpose(0, 2, 1, 3)
+
+
+def _attention_xla(q, k, v, causal):
+    d = q.shape[-1]
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(jnp.float32(d))
+    if causal:
+        tq, tk = s.shape[-2], s.shape[-1]
+        mask = jnp.arange(tq)[:, None] >= jnp.arange(tk)[None, :]
+        s = jnp.where(mask, s, _NEG)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v).astype(q.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def flash_attention(q: Array, k: Array, v: Array, causal: bool = False,
+                    interpret: bool = False) -> Array:
+    """Tiled attention: pallas forward on TPU, XLA math elsewhere. Backward
+    recomputes attention weights (flash-attention style) via the XLA path."""
+    if use_pallas() or interpret:
+        return _flash_forward(q, k, v, causal, interpret=interpret)
+    return _attention_xla(q, k, v, causal)
+
+
+def _flash_fwd_rule(q, k, v, causal, interpret):
+    return flash_attention(q, k, v, causal, interpret), (q, k, v)
+
+
+def _flash_bwd_rule(causal, interpret, res, g):
+    q, k, v = res
+    _, vjp = jax.vjp(lambda q, k, v: _attention_xla(q, k, v, causal), q, k, v)
+    return vjp(g)
+
+
+flash_attention.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+# ------------------------------------------------------- fused softmax-xent
+def _sm_xent_kernel(logits_ref, labels_ref, loss_ref, grad_ref):
+    """Row-fused log-softmax + NLL + gradient: one pass over the logits block."""
+    x = logits_ref[:].astype(jnp.float32)
+    y = labels_ref[:].astype(jnp.float32)
+    m = jnp.max(x, axis=1, keepdims=True)
+    e = jnp.exp(x - m)
+    z = jnp.sum(e, axis=1, keepdims=True)
+    logp = x - m - jnp.log(z)
+    loss_ref[:] = -jnp.sum(y * logp, axis=1, keepdims=True).astype(loss_ref.dtype)
+    grad_ref[:] = (e / z - y).astype(grad_ref.dtype)
+
+
+def softmax_cross_entropy(logits: Array, labels: Array, blk: int = 256,
+                          interpret: bool = False):
+    """Fused per-row loss + dlogits. Returns (loss (N,), grad (N, C)).
+    Pallas on TPU; identical XLA math elsewhere."""
+    N, C = logits.shape
+    if (use_pallas() or interpret) and N % min(blk, N) == 0:
+        blk = min(blk, N)
+        loss, grad = pl.pallas_call(
+            _sm_xent_kernel,
+            grid=(N // blk,),
+            in_specs=[
+                pl.BlockSpec((blk, C), lambda i: (i, 0)),
+                pl.BlockSpec((blk, C), lambda i: (i, 0)),
+            ],
+            out_specs=[
+                pl.BlockSpec((blk, 1), lambda i: (i, 0)),
+                pl.BlockSpec((blk, C), lambda i: (i, 0)),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((N, 1), jnp.float32),
+                jax.ShapeDtypeStruct((N, C), logits.dtype),
+            ],
+            interpret=interpret,
+        )(logits, labels)
+        return loss[:, 0], grad
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    loss = -jnp.sum(labels * logp, axis=-1)
+    grad = (jnp.exp(logp) - labels).astype(logits.dtype)
+    return loss, grad
