@@ -35,7 +35,13 @@ _FILES = {
 
 
 def _read_idx(path: Path) -> np.ndarray:
-    """Parse an IDX file (reference MnistDbFile.java header handling)."""
+    """Parse an IDX file (reference MnistDbFile.java header handling). Plain
+    (non-gz) files go through the native C++ parser when available."""
+    if path.suffix != ".gz":
+        from deeplearning4j_tpu import nativert
+        arr = nativert.read_idx(str(path))
+        if arr is not None:
+            return arr
     opener = gzip.open if path.suffix == ".gz" else open
     with opener(path, "rb") as f:
         magic = struct.unpack(">i", f.read(4))[0]

@@ -1,0 +1,317 @@
+"""Native runtime bindings (C++ host-side IO/staging pipeline).
+
+The reference reaches all native code through JavaCPP bindings (SURVEY.md
+§2.10): libnd4j tensor backends, cuDNN helpers, HDF5. Its data path runs
+through AsyncDataSetIterator (background prefetch thread + blocking queue,
+reference deeplearning4j-nn datasets/iterator/AsyncDataSetIterator.java:36)
+and MagicQueue (parallelism/MagicQueue.java:21). Here the equivalent host
+runtime is ``native/src/dl4j_runtime.cpp`` — IDX/CIFAR parsers, an async
+producer-thread batch loader, a numeric CSV reader, and the binary stats
+codec (SBE-codec equivalent, reference ui-model ui/stats/sbe/*) — consumed
+via ctypes. Device compute stays in XLA; this layer only stages host memory.
+
+The shared library is built on demand with g++ (toolchain is baked into the
+image); every entry point degrades to ``None``/pure-Python when the build is
+unavailable so the framework never hard-requires the native path.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from pathlib import Path
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+_REPO_ROOT = Path(__file__).resolve().parent.parent.parent
+_NATIVE_DIR = _REPO_ROOT / "native"
+_LIB_PATH = _NATIVE_DIR / "libdl4j_runtime.so"
+_SRC_PATH = _NATIVE_DIR / "src" / "dl4j_runtime.cpp"
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_load_attempted = False
+
+c_i64 = ctypes.c_int64
+c_f32p = ctypes.POINTER(ctypes.c_float)
+c_u8p = ctypes.POINTER(ctypes.c_uint8)
+c_i32p = ctypes.POINTER(ctypes.c_int32)
+c_i64p = ctypes.POINTER(ctypes.c_int64)
+
+
+def _build() -> bool:
+    if not _SRC_PATH.exists():
+        return False
+    try:
+        subprocess.run(
+            ["g++", "-O3", "-std=c++17", "-fPIC", "-shared", "-pthread",
+             str(_SRC_PATH), "-o", str(_LIB_PATH)],
+            check=True, capture_output=True, timeout=120)
+        return _LIB_PATH.exists()
+    except (OSError, subprocess.SubprocessError):
+        return False
+
+
+def _declare(lib: ctypes.CDLL) -> None:
+    lib.dl4j_idx_open.restype = ctypes.c_void_p
+    lib.dl4j_idx_open.argtypes = [ctypes.c_char_p]
+    lib.dl4j_idx_ndim.restype = ctypes.c_int
+    lib.dl4j_idx_ndim.argtypes = [ctypes.c_void_p]
+    lib.dl4j_idx_dims.argtypes = [ctypes.c_void_p, c_i64p]
+    lib.dl4j_idx_read.argtypes = [ctypes.c_void_p, c_u8p]
+    lib.dl4j_idx_close.argtypes = [ctypes.c_void_p]
+
+    lib.dl4j_loader_create_from_arrays.restype = ctypes.c_void_p
+    lib.dl4j_loader_create_from_arrays.argtypes = [
+        c_u8p, c_u8p, c_i64, c_i64, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        ctypes.c_int, ctypes.c_uint64, ctypes.c_int]
+    lib.dl4j_mnist_loader_create.restype = ctypes.c_void_p
+    lib.dl4j_mnist_loader_create.argtypes = [
+        ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
+        ctypes.c_int, ctypes.c_uint64, ctypes.c_int]
+    lib.dl4j_cifar_loader_create.restype = ctypes.c_void_p
+    lib.dl4j_cifar_loader_create.argtypes = [
+        ctypes.POINTER(ctypes.c_char_p), ctypes.c_int, ctypes.c_int,
+        ctypes.c_int, ctypes.c_int, ctypes.c_uint64]
+    for name in ("dl4j_loader_num_examples", "dl4j_loader_feature_size"):
+        getattr(lib, name).restype = c_i64
+        getattr(lib, name).argtypes = [ctypes.c_void_p]
+    for name in ("dl4j_loader_num_classes", "dl4j_loader_batch_size"):
+        getattr(lib, name).restype = ctypes.c_int
+        getattr(lib, name).argtypes = [ctypes.c_void_p]
+    lib.dl4j_loader_next.restype = ctypes.c_int
+    lib.dl4j_loader_next.argtypes = [ctypes.c_void_p, c_f32p, c_f32p]
+    lib.dl4j_loader_reset.argtypes = [ctypes.c_void_p]
+    lib.dl4j_loader_close.argtypes = [ctypes.c_void_p]
+
+    lib.dl4j_csv_open.restype = ctypes.c_void_p
+    lib.dl4j_csv_open.argtypes = [ctypes.c_char_p, ctypes.c_char, ctypes.c_int]
+    lib.dl4j_csv_rows.restype = c_i64
+    lib.dl4j_csv_rows.argtypes = [ctypes.c_void_p]
+    lib.dl4j_csv_cols.restype = c_i64
+    lib.dl4j_csv_cols.argtypes = [ctypes.c_void_p]
+    lib.dl4j_csv_read.argtypes = [ctypes.c_void_p, c_f32p]
+    lib.dl4j_csv_close.argtypes = [ctypes.c_void_p]
+
+    lib.dl4j_stats_begin.restype = ctypes.c_void_p
+    lib.dl4j_stats_begin.argtypes = [
+        ctypes.c_char_p, ctypes.c_char_p, c_i64, ctypes.c_int32,
+        ctypes.c_double, ctypes.c_double, ctypes.c_double, c_i64, c_i64]
+    lib.dl4j_stats_add.restype = ctypes.c_int
+    lib.dl4j_stats_add.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.c_char_p, ctypes.c_double,
+        ctypes.c_double, ctypes.c_double, c_i32p, ctypes.c_int]
+    lib.dl4j_stats_finish.restype = c_i64
+    lib.dl4j_stats_finish.argtypes = [ctypes.c_void_p, c_u8p, c_i64]
+    lib.dl4j_stats_abort.argtypes = [ctypes.c_void_p]
+    lib.dl4j_runtime_version.restype = ctypes.c_int
+
+
+def get_runtime() -> Optional[ctypes.CDLL]:
+    """Load (building if needed) the native runtime; None when unavailable.
+    Set DL4J_TPU_DISABLE_NATIVE=1 to force the pure-Python paths."""
+    global _lib, _load_attempted
+    if os.environ.get("DL4J_TPU_DISABLE_NATIVE") == "1":
+        return None
+    with _lock:
+        if _load_attempted:
+            return _lib
+        _load_attempted = True
+        stale = (_LIB_PATH.exists() and _SRC_PATH.exists()
+                 and _SRC_PATH.stat().st_mtime > _LIB_PATH.stat().st_mtime)
+        if (not _LIB_PATH.exists() or stale) and not _build():
+            if not _LIB_PATH.exists():
+                return None
+        try:
+            lib = ctypes.CDLL(str(_LIB_PATH))
+            _declare(lib)
+            if lib.dl4j_runtime_version() != 1:
+                return None
+            _lib = lib
+        except OSError:
+            _lib = None
+        return _lib
+
+
+def native_available() -> bool:
+    return get_runtime() is not None
+
+
+# ---------------------------------------------------------------------------
+# IDX
+# ---------------------------------------------------------------------------
+
+def read_idx(path: str) -> Optional[np.ndarray]:
+    """Parse an IDX (MNIST-format) file with the native parser; None on any
+    failure (missing lib, bad file)."""
+    lib = get_runtime()
+    if lib is None:
+        return None
+    h = lib.dl4j_idx_open(str(path).encode())
+    if not h:
+        return None
+    try:
+        ndim = lib.dl4j_idx_ndim(h)
+        dims = np.zeros(ndim, np.int64)
+        lib.dl4j_idx_dims(h, dims.ctypes.data_as(c_i64p))
+        out = np.empty(int(dims.prod()), np.uint8)
+        lib.dl4j_idx_read(h, out.ctypes.data_as(c_u8p))
+        return out.reshape(dims.tolist())
+    finally:
+        lib.dl4j_idx_close(h)
+
+
+# ---------------------------------------------------------------------------
+# CSV
+# ---------------------------------------------------------------------------
+
+def read_csv_numeric(path: str, delimiter: str = ",",
+                     skip_lines: int = 0) -> Optional[np.ndarray]:
+    """Fast numeric CSV → float32 [rows, cols]; non-numeric fields become 0.
+    None when the native runtime is unavailable or the file can't be read."""
+    lib = get_runtime()
+    if lib is None:
+        return None
+    h = lib.dl4j_csv_open(str(path).encode(), delimiter.encode()[:1],
+                          int(skip_lines))
+    if not h:
+        return None
+    try:
+        rows, cols = lib.dl4j_csv_rows(h), lib.dl4j_csv_cols(h)
+        out = np.empty((int(rows), int(cols)), np.float32)
+        if rows and cols:
+            lib.dl4j_csv_read(h, out.ctypes.data_as(c_f32p))
+        return out
+    finally:
+        lib.dl4j_csv_close(h)
+
+
+# ---------------------------------------------------------------------------
+# Async prefetch loader
+# ---------------------------------------------------------------------------
+
+class AsyncNativeLoader:
+    """Native async batch loader: a C++ producer thread assembles normalized
+    float32 batches (one-hot labels) into a bounded queue; iteration here
+    blocks on the queue (reference AsyncDataSetIterator semantics: prefetch
+    depth = ``capacity``, reset() reshuffles and restarts the epoch)."""
+
+    def __init__(self, handle, lib):
+        if not handle:
+            raise ValueError("native loader creation failed")
+        self._h = handle
+        self._lib = lib
+        self.batch = lib.dl4j_loader_batch_size(handle)
+        self.feature_size = int(lib.dl4j_loader_feature_size(handle))
+        self.num_classes = lib.dl4j_loader_num_classes(handle)
+        self.num_examples = int(lib.dl4j_loader_num_examples(handle))
+
+    @classmethod
+    def from_arrays(cls, features: np.ndarray, labels: np.ndarray,
+                    num_classes: int, batch: int, capacity: int = 4,
+                    shuffle: bool = True, seed: int = 0,
+                    normalize: bool = True) -> "AsyncNativeLoader":
+        lib = get_runtime()
+        if lib is None:
+            raise RuntimeError("native runtime unavailable")
+        f = np.ascontiguousarray(features, np.uint8).reshape(len(features), -1)
+        l = np.ascontiguousarray(labels, np.uint8).ravel()
+        h = lib.dl4j_loader_create_from_arrays(
+            f.ctypes.data_as(c_u8p), l.ctypes.data_as(c_u8p),
+            f.shape[0], f.shape[1], num_classes, batch, capacity,
+            int(shuffle), seed, int(normalize))
+        return cls(h, lib)
+
+    @classmethod
+    def mnist(cls, images_path: str, labels_path: str, batch: int,
+              capacity: int = 4, shuffle: bool = True, seed: int = 0,
+              normalize: bool = True) -> "AsyncNativeLoader":
+        lib = get_runtime()
+        if lib is None:
+            raise RuntimeError("native runtime unavailable")
+        h = lib.dl4j_mnist_loader_create(
+            str(images_path).encode(), str(labels_path).encode(), batch,
+            capacity, int(shuffle), seed, int(normalize))
+        return cls(h, lib)
+
+    @classmethod
+    def cifar(cls, paths: Sequence[str], batch: int, capacity: int = 4,
+              shuffle: bool = True, seed: int = 0) -> "AsyncNativeLoader":
+        lib = get_runtime()
+        if lib is None:
+            raise RuntimeError("native runtime unavailable")
+        arr = (ctypes.c_char_p * len(paths))(
+            *[str(p).encode() for p in paths])
+        h = lib.dl4j_cifar_loader_create(arr, len(paths), batch, capacity,
+                                         int(shuffle), seed)
+        return cls(h, lib)
+
+    def next(self) -> Optional[tuple]:
+        """Next (features [B, F] f32, one-hot labels [B, C] f32), or None at
+        end of epoch."""
+        x = np.empty((self.batch, self.feature_size), np.float32)
+        y = np.empty((self.batch, self.num_classes), np.float32)
+        ok = self._lib.dl4j_loader_next(
+            self._h, x.ctypes.data_as(c_f32p), y.ctypes.data_as(c_f32p))
+        return (x, y) if ok else None
+
+    def reset(self) -> None:
+        self._lib.dl4j_loader_reset(self._h)
+
+    def __iter__(self):
+        while True:
+            b = self.next()
+            if b is None:
+                return
+            yield b
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.dl4j_loader_close(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+# ---------------------------------------------------------------------------
+# Stats codec
+# ---------------------------------------------------------------------------
+
+def encode_stats_native(session_id: str, worker_id: str, timestamp: int,
+                        iteration: int, score: float, iter_time_ms: float,
+                        samples_per_sec: float, mem_rss: int, device_mem: int,
+                        sections: List[dict]) -> Optional[bytes]:
+    """Encode a StatsReport with the native codec (same DLTS wire format as
+    the Python encoder in ui/stats.py). ``sections`` is
+    [params, gradients, updates], each name -> (mean_mag, hist, (lo, hi))."""
+    lib = get_runtime()
+    if lib is None:
+        return None
+    h = lib.dl4j_stats_begin(session_id.encode(), worker_id.encode(),
+                             timestamp, iteration, score, iter_time_ms,
+                             samples_per_sec, mem_rss, device_mem)
+    if not h:
+        return None
+    try:
+        for si, section in enumerate(sections[:3]):
+            for name, (mm, hist, (lo, hi)) in section.items():
+                ha = np.asarray(hist, np.int32)
+                lib.dl4j_stats_add(h, si, name.encode(), float(mm), float(lo),
+                                   float(hi), ha.ctypes.data_as(c_i32p),
+                                   len(ha))
+        n = lib.dl4j_stats_finish(h, None, 0)
+        out = np.empty(int(n), np.uint8)
+        written = lib.dl4j_stats_finish(h, out.ctypes.data_as(c_u8p), n)
+        h = None  # finish with a large-enough buffer frees the builder
+        if written != n:
+            return None
+        return out.tobytes()
+    finally:
+        if h:
+            lib.dl4j_stats_abort(h)

@@ -110,24 +110,75 @@ def _flash_forward(q: Array, k: Array, v: Array, causal: bool,
 
 
 def _attention_xla(q, k, v, causal):
-    d = q.shape[-1]
-    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(jnp.float32(d))
-    if causal:
-        tq, tk = s.shape[-2], s.shape[-1]
-        mask = jnp.arange(tq)[:, None] >= jnp.arange(tk)[None, :]
-        s = jnp.where(mask, s, _NEG)
-    p = jax.nn.softmax(s, axis=-1)
-    return jnp.einsum("bhqk,bkhd->bqhd", p, v).astype(q.dtype)
+    # Single source of truth for the reference math (also the ring-attention
+    # correctness oracle) — keep one copy so masking/scaling can't diverge.
+    from deeplearning4j_tpu.parallel.ring_attention import attention_reference
+    return attention_reference(q, k, v, causal).astype(q.dtype)
+
+
+def _tileable(tq: int, tk: int, blk_q: int = 128, blk_k: int = 128) -> bool:
+    return tq % min(blk_q, tq) == 0 and tk % min(blk_k, tk) == 0
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
 def flash_attention(q: Array, k: Array, v: Array, causal: bool = False,
                     interpret: bool = False) -> Array:
-    """Tiled attention: pallas forward on TPU, XLA math elsewhere. Backward
-    recomputes attention weights (flash-attention style) via the XLA path."""
-    if use_pallas() or interpret:
+    """Tiled attention: pallas forward on TPU (shapes that don't tile fall
+    back to the identical XLA math rather than erroring), XLA elsewhere.
+    Backward recomputes scores per query chunk (flash-attention practice:
+    trade FLOPs for HBM; peak extra memory O(blk_q·Tk), never O(Tq·Tk))."""
+    if (use_pallas() or interpret) and _tileable(q.shape[1], k.shape[1]):
         return _flash_forward(q, k, v, causal, interpret=interpret)
     return _attention_xla(q, k, v, causal)
+
+
+def _attention_bwd_chunked(q, k, v, g, causal, blk_q: int = 128):
+    """Chunked attention backward: lax.scan over query blocks, recomputing the
+    (blk_q, Tk) score tile per step. dK/dV accumulate in f32 in the carry.
+
+    Standard flash-attention backward identities: with P = softmax(S),
+    dV = Pᵀ dO, dP = dO Vᵀ, dS = P ∘ (dP − rowsum(P ∘ dP)), dQ = dS·K·scale,
+    dK = dSᵀ·Q·scale. Query rows padded up to a block multiple carry dO = 0,
+    which makes their dS exactly 0, so padding contributes nothing.
+    """
+    B, Tq, H, D = q.shape
+    Tk = k.shape[1]
+    scale = 1.0 / (D ** 0.5)
+    blk_q = min(blk_q, Tq)
+    pad = (-Tq) % blk_q
+    qp = jnp.pad(q, ((0, 0), (0, pad), (0, 0), (0, 0))) if pad else q
+    gp = jnp.pad(g, ((0, 0), (0, pad), (0, 0), (0, 0))) if pad else g
+    n = (Tq + pad) // blk_q
+    # (n, B, blk_q, H, D) chunk-major for scan
+    qs = qp.reshape(B, n, blk_q, H, D).transpose(1, 0, 2, 3, 4)
+    gs = gp.reshape(B, n, blk_q, H, D).transpose(1, 0, 2, 3, 4)
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+
+    def chunk(carry, inp):
+        dk, dv = carry
+        qc, gc, idx = inp
+        qc = qc.astype(jnp.float32)
+        gc = gc.astype(jnp.float32)
+        s = jnp.einsum("bqhd,bkhd->bhqk", qc, kf) * scale
+        if causal:
+            q_pos = idx * blk_q + jnp.arange(blk_q)
+            mask = q_pos[:, None] >= jnp.arange(Tk)[None, :]
+            s = jnp.where(mask[None, None], s, _NEG)
+        p = jax.nn.softmax(s, axis=-1)
+        dp = jnp.einsum("bqhd,bkhd->bhqk", gc, vf)
+        delta = jnp.sum(p * dp, axis=-1, keepdims=True)
+        ds = p * (dp - delta) * scale
+        dqc = jnp.einsum("bhqk,bkhd->bqhd", ds, kf)
+        dk = dk + jnp.einsum("bhqk,bqhd->bkhd", ds, qc)
+        dv = dv + jnp.einsum("bhqk,bqhd->bkhd", p, gc)
+        return (dk, dv), dqc
+
+    (dk, dv), dqs = jax.lax.scan(
+        chunk, (jnp.zeros(k.shape, jnp.float32), jnp.zeros(v.shape, jnp.float32)),
+        (qs, gs, jnp.arange(n)))
+    dq = dqs.transpose(1, 0, 2, 3, 4).reshape(B, Tq + pad, H, D)[:, :Tq]
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
 def _flash_fwd_rule(q, k, v, causal, interpret):
@@ -136,8 +187,7 @@ def _flash_fwd_rule(q, k, v, causal, interpret):
 
 def _flash_bwd_rule(causal, interpret, res, g):
     q, k, v = res
-    _, vjp = jax.vjp(lambda q, k, v: _attention_xla(q, k, v, causal), q, k, v)
-    return vjp(g)
+    return _attention_bwd_chunked(q, k, v, g, causal)
 
 
 flash_attention.defvjp(_flash_fwd_rule, _flash_bwd_rule)

@@ -58,7 +58,18 @@ class StatsReport(Persistable):
         return self.timestamp
 
     def encode(self) -> bytes:
-        """Compact binary: fixed header + JSON-free packed stats sections."""
+        """Compact binary: fixed header + JSON-free packed stats sections.
+        Uses the native C++ codec (nativert, SBE-codec equivalent) when the
+        runtime library is available; the pure-Python encoder below emits the
+        identical DLTS wire format."""
+        from deeplearning4j_tpu import nativert
+        native = nativert.encode_stats_native(
+            self.session_id, self.worker_id, self.timestamp, self.iteration,
+            self.score, self.iteration_time_ms, self.samples_per_sec,
+            self.mem_rss_bytes, self.device_mem_bytes,
+            [self.param_stats, self.gradient_stats, self.update_stats])
+        if native is not None:
+            return native
         out = bytearray()
         out += _MAGIC
         out += struct.pack("<H", _VERSION)

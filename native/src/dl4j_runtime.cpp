@@ -1,0 +1,452 @@
+// dl4j_tpu native runtime: dataset parsers, async prefetch loader, CSV reader,
+// stats wire codec.
+//
+// This is the TPU-native equivalent of the reference's native substrate
+// (SURVEY.md §2.10): where deeplearning4j reaches native code through JavaCPP
+// (libnd4j backends, cuDNN helpers, HDF5) and runs its data path through
+// AsyncDataSetIterator (background prefetch thread + blocking queue,
+// reference deeplearning4j-nn datasets/iterator/AsyncDataSetIterator.java:36)
+// and MagicQueue (per-device bucketed queue, deeplearning4j-core
+// parallelism/MagicQueue.java:21), this library provides the host-side IO +
+// staging pipeline in C++: IDX (MnistDbFile.java header handling) and
+// CIFAR-binary parsing, a producer-thread batch assembler with a bounded
+// ring queue, a numeric CSV reader (DataVec CSVRecordReader fast path), and
+// a compact binary stats codec standing in for the generated SBE codecs
+// (reference ui-model ui/stats/sbe/*). Device compute stays in XLA; this
+// library only ever touches host memory.
+//
+// C ABI only (consumed via ctypes from Python).
+
+#include <algorithm>
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <random>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+// ---------------------------------------------------------------------------
+// IDX parsing (big-endian header: magic [dtype|ndim], then ndim int32 dims)
+// ---------------------------------------------------------------------------
+
+struct IdxFile {
+  std::vector<int64_t> dims;
+  std::vector<uint8_t> data;  // raw uint8 payload
+};
+
+uint32_t be32(const uint8_t* p) {
+  return (uint32_t(p[0]) << 24) | (uint32_t(p[1]) << 16) |
+         (uint32_t(p[2]) << 8) | uint32_t(p[3]);
+}
+
+IdxFile* idx_load(const char* path) {
+  FILE* f = std::fopen(path, "rb");
+  if (!f) return nullptr;
+  uint8_t hdr[4];
+  if (std::fread(hdr, 1, 4, f) != 4) { std::fclose(f); return nullptr; }
+  uint32_t magic = be32(hdr);
+  int dtype = (magic >> 8) & 0xFF;
+  int ndim = magic & 0xFF;
+  if (dtype != 0x08 || ndim < 1 || ndim > 4) { std::fclose(f); return nullptr; }
+  // Sanity-bound the payload by the actual file size so a corrupt header
+  // can't trigger an overflowing/teradbyte resize (bad_alloc must not escape
+  // the C ABI into the ctypes caller).
+  long data_start = std::ftell(f) + 4L * ndim;
+  std::fseek(f, 0, SEEK_END);
+  long fsize = std::ftell(f);
+  std::fseek(f, data_start - 4L * ndim, SEEK_SET);
+  int64_t max_total = fsize - data_start;
+  auto* out = new IdxFile();
+  int64_t total = 1;
+  for (int i = 0; i < ndim; i++) {
+    uint8_t d[4];
+    if (std::fread(d, 1, 4, f) != 4) { std::fclose(f); delete out; return nullptr; }
+    int64_t v = int64_t(be32(d));
+    out->dims.push_back(v);
+    if (v <= 0 || (max_total > 0 && total > max_total / v)) {
+      std::fclose(f); delete out; return nullptr;
+    }
+    total *= v;
+  }
+  if (total > max_total) { std::fclose(f); delete out; return nullptr; }
+  try {
+    out->data.resize(size_t(total));
+  } catch (const std::bad_alloc&) {
+    std::fclose(f); delete out; return nullptr;
+  }
+  if (std::fread(out->data.data(), 1, size_t(total), f) != size_t(total)) {
+    std::fclose(f); delete out; return nullptr;
+  }
+  std::fclose(f);
+  return out;
+}
+
+// ---------------------------------------------------------------------------
+// Async batch loader: producer thread assembles float32 batches into a
+// bounded queue; the consumer blocks in next(). One epoch per run; reset()
+// reshuffles and restarts (AsyncDataSetIterator.reset semantics).
+// ---------------------------------------------------------------------------
+
+struct Batch {
+  std::vector<float> x;
+  std::vector<float> y;
+};
+
+struct Loader {
+  // immutable after construction
+  std::vector<uint8_t> features;  // [n, feat] uint8
+  std::vector<uint8_t> labels;    // [n] uint8 class ids
+  int64_t n = 0;
+  int64_t feat = 0;
+  int num_classes = 10;
+  int batch = 0;
+  int capacity = 4;
+  bool shuffle = true;
+  bool normalize = true;
+  uint64_t seed = 0;
+  uint64_t epoch = 0;
+
+  // queue state
+  std::deque<Batch> queue;
+  std::mutex mu;
+  std::condition_variable cv_put, cv_get;
+  bool epoch_done = false;
+  std::atomic<bool> stop{false};
+  std::thread producer;
+
+  ~Loader() { shutdown(); }
+
+  void shutdown() {
+    {
+      // Hold the mutex while setting stop so a producer that has evaluated
+      // its wait-predicate but not yet re-blocked can't miss the wakeup.
+      std::lock_guard<std::mutex> l(mu);
+      stop.store(true);
+    }
+    cv_put.notify_all();
+    cv_get.notify_all();
+    if (producer.joinable()) producer.join();
+  }
+
+  void start_epoch() {
+    shutdown();
+    stop.store(false);
+    {
+      std::lock_guard<std::mutex> l(mu);
+      queue.clear();
+      epoch_done = false;
+    }
+    producer = std::thread([this] { run_producer(); });
+  }
+
+  void run_producer() {
+    std::vector<int64_t> order(static_cast<size_t>(n));
+    for (int64_t i = 0; i < n; i++) order[size_t(i)] = i;
+    if (shuffle) {
+      std::mt19937_64 rng(seed + epoch);
+      std::shuffle(order.begin(), order.end(), rng);
+    }
+    const float scale = normalize ? 1.0f / 255.0f : 1.0f;
+    int64_t nb = n / batch;  // drop last partial (reference iterator default)
+    for (int64_t b = 0; b < nb && !stop.load(); b++) {
+      Batch bt;
+      bt.x.resize(size_t(batch) * size_t(feat));
+      bt.y.assign(size_t(batch) * size_t(num_classes), 0.0f);
+      for (int i = 0; i < batch; i++) {
+        int64_t idx = order[size_t(b * batch + i)];
+        const uint8_t* src = features.data() + idx * feat;
+        float* dst = bt.x.data() + int64_t(i) * feat;
+        for (int64_t j = 0; j < feat; j++) dst[j] = float(src[j]) * scale;
+        int cls = labels[size_t(idx)];
+        if (cls >= 0 && cls < num_classes)
+          bt.y[size_t(i) * num_classes + cls] = 1.0f;
+      }
+      std::unique_lock<std::mutex> l(mu);
+      cv_put.wait(l, [this] {
+        return stop.load() || int(queue.size()) < capacity;
+      });
+      if (stop.load()) return;
+      queue.push_back(std::move(bt));
+      cv_get.notify_one();
+    }
+    std::lock_guard<std::mutex> l(mu);
+    epoch_done = true;
+    cv_get.notify_all();
+  }
+
+  // 1 = batch written, 0 = epoch exhausted
+  int next(float* x_out, float* y_out) {
+    std::unique_lock<std::mutex> l(mu);
+    cv_get.wait(l, [this] {
+      return stop.load() || !queue.empty() || epoch_done;
+    });
+    if (queue.empty()) return 0;
+    Batch bt = std::move(queue.front());
+    queue.pop_front();
+    cv_put.notify_one();
+    l.unlock();
+    std::memcpy(x_out, bt.x.data(), bt.x.size() * sizeof(float));
+    std::memcpy(y_out, bt.y.data(), bt.y.size() * sizeof(float));
+    return 1;
+  }
+};
+
+// ---------------------------------------------------------------------------
+// CSV numeric reader
+// ---------------------------------------------------------------------------
+
+struct CsvFile {
+  int64_t rows = 0;
+  int64_t cols = 0;
+  std::vector<float> values;
+};
+
+CsvFile* csv_load(const char* path, char delim, int skip_lines) {
+  FILE* f = std::fopen(path, "rb");
+  if (!f) return nullptr;
+  std::fseek(f, 0, SEEK_END);
+  long sz = std::ftell(f);
+  std::fseek(f, 0, SEEK_SET);
+  std::string buf(size_t(sz), '\0');
+  if (std::fread(buf.data(), 1, size_t(sz), f) != size_t(sz)) {
+    std::fclose(f);
+    return nullptr;
+  }
+  std::fclose(f);
+
+  auto* out = new CsvFile();
+  size_t pos = 0;
+  int line_no = 0;
+  while (pos < buf.size()) {
+    size_t eol = buf.find('\n', pos);
+    if (eol == std::string::npos) eol = buf.size();
+    if (line_no++ < skip_lines || eol == pos) { pos = eol + 1; continue; }
+    int64_t ncol = 0;
+    size_t p = pos;
+    while (p < eol) {
+      char* end = nullptr;
+      float v = std::strtof(buf.data() + p, &end);
+      if (end == buf.data() + p) { v = 0.0f; }  // non-numeric field -> 0
+      out->values.push_back(v);
+      ncol++;
+      size_t next = buf.find(delim, p);
+      if (next == std::string::npos || next >= eol) break;
+      p = next + 1;
+    }
+    if (out->cols == 0) out->cols = ncol;
+    if (ncol < out->cols) {  // ragged short row: pad with zeros
+      while (ncol < out->cols) { out->values.push_back(0.0f); ncol++; }
+    } else if (ncol > out->cols) {  // ragged long row: truncate
+      out->values.resize(out->values.size() - size_t(ncol - out->cols));
+    }
+    out->rows++;
+    pos = eol + 1;
+  }
+  return out;
+}
+
+// ---------------------------------------------------------------------------
+// Stats codec — same DLTS wire format as the Python codec in ui/stats.py
+// (magic "DLTS", version u16, then length-prefixed strings, packed scalars,
+// three sections of named {mean-magnitude, min, max, histogram}).
+// ---------------------------------------------------------------------------
+
+struct StatsBuilder {
+  std::vector<uint8_t> buf;
+  std::vector<std::vector<uint8_t>> sections[3];
+
+  template <typename T>
+  static void put(std::vector<uint8_t>& b, T v) {
+    const uint8_t* p = reinterpret_cast<const uint8_t*>(&v);
+    b.insert(b.end(), p, p + sizeof(T));
+  }
+  static void put_str(std::vector<uint8_t>& b, const char* s) {
+    uint16_t n = uint16_t(std::strlen(s));
+    put<uint16_t>(b, n);
+    b.insert(b.end(), s, s + n);
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+// ----- IDX -----
+void* dl4j_idx_open(const char* path) { return idx_load(path); }
+int dl4j_idx_ndim(void* h) { return int(static_cast<IdxFile*>(h)->dims.size()); }
+void dl4j_idx_dims(void* h, int64_t* out) {
+  auto* f = static_cast<IdxFile*>(h);
+  for (size_t i = 0; i < f->dims.size(); i++) out[i] = f->dims[i];
+}
+void dl4j_idx_read(void* h, uint8_t* out) {
+  auto* f = static_cast<IdxFile*>(h);
+  std::memcpy(out, f->data.data(), f->data.size());
+}
+void dl4j_idx_close(void* h) { delete static_cast<IdxFile*>(h); }
+
+// ----- async loader -----
+void* dl4j_loader_create_from_arrays(const uint8_t* features,
+                                     const uint8_t* labels, int64_t n,
+                                     int64_t feat, int num_classes, int batch,
+                                     int capacity, int shuffle,
+                                     uint64_t seed, int normalize) {
+  if (n <= 0 || feat <= 0 || batch <= 0 || batch > n) return nullptr;
+  auto* l = new Loader();
+  l->features.assign(features, features + n * feat);
+  l->labels.assign(labels, labels + n);
+  l->n = n;
+  l->feat = feat;
+  l->num_classes = num_classes;
+  l->batch = batch;
+  l->capacity = std::max(1, capacity);
+  l->shuffle = shuffle != 0;
+  l->normalize = normalize != 0;
+  l->seed = seed;
+  l->start_epoch();
+  return l;
+}
+
+void* dl4j_mnist_loader_create(const char* img_path, const char* lbl_path,
+                               int batch, int capacity, int shuffle,
+                               uint64_t seed, int normalize) {
+  IdxFile* imgs = idx_load(img_path);
+  if (!imgs) return nullptr;
+  IdxFile* lbls = idx_load(lbl_path);
+  if (!lbls) { delete imgs; return nullptr; }
+  int64_t n = imgs->dims[0];
+  int64_t feat = 1;
+  for (size_t i = 1; i < imgs->dims.size(); i++) feat *= imgs->dims[i];
+  void* l = nullptr;
+  if (lbls->dims.size() == 1 && lbls->dims[0] == n) {
+    l = dl4j_loader_create_from_arrays(imgs->data.data(), lbls->data.data(), n,
+                                       feat, 10, batch, capacity, shuffle,
+                                       seed, normalize);
+  }
+  delete imgs;
+  delete lbls;
+  return l;
+}
+
+// CIFAR-10 binary format: records of [1 label byte][3072 pixel bytes]
+void* dl4j_cifar_loader_create(const char** paths, int npaths, int batch,
+                               int capacity, int shuffle, uint64_t seed) {
+  std::vector<uint8_t> feats, lbls;
+  const int64_t rec = 3073;
+  for (int i = 0; i < npaths; i++) {
+    FILE* f = std::fopen(paths[i], "rb");
+    if (!f) return nullptr;
+    std::fseek(f, 0, SEEK_END);
+    long sz = std::ftell(f);
+    std::fseek(f, 0, SEEK_SET);
+    std::vector<uint8_t> raw(static_cast<size_t>(sz));
+    if (std::fread(raw.data(), 1, size_t(sz), f) != size_t(sz)) {
+      std::fclose(f);
+      return nullptr;
+    }
+    std::fclose(f);
+    int64_t nrec = sz / rec;
+    for (int64_t r = 0; r < nrec; r++) {
+      lbls.push_back(raw[size_t(r * rec)]);
+      feats.insert(feats.end(), raw.begin() + r * rec + 1,
+                   raw.begin() + (r + 1) * rec);
+    }
+  }
+  int64_t n = int64_t(lbls.size());
+  if (n == 0) return nullptr;
+  return dl4j_loader_create_from_arrays(feats.data(), lbls.data(), n, 3072, 10,
+                                        batch, capacity, shuffle, seed, 1);
+}
+
+int64_t dl4j_loader_num_examples(void* h) { return static_cast<Loader*>(h)->n; }
+int64_t dl4j_loader_feature_size(void* h) { return static_cast<Loader*>(h)->feat; }
+int dl4j_loader_num_classes(void* h) { return static_cast<Loader*>(h)->num_classes; }
+int dl4j_loader_batch_size(void* h) { return static_cast<Loader*>(h)->batch; }
+
+int dl4j_loader_next(void* h, float* x_out, float* y_out) {
+  return static_cast<Loader*>(h)->next(x_out, y_out);
+}
+
+void dl4j_loader_reset(void* h) {
+  auto* l = static_cast<Loader*>(h);
+  l->epoch++;
+  l->start_epoch();
+}
+
+void dl4j_loader_close(void* h) { delete static_cast<Loader*>(h); }
+
+// ----- CSV -----
+void* dl4j_csv_open(const char* path, char delim, int skip_lines) {
+  return csv_load(path, delim, skip_lines);
+}
+int64_t dl4j_csv_rows(void* h) { return static_cast<CsvFile*>(h)->rows; }
+int64_t dl4j_csv_cols(void* h) { return static_cast<CsvFile*>(h)->cols; }
+void dl4j_csv_read(void* h, float* out) {
+  auto* f = static_cast<CsvFile*>(h);
+  std::memcpy(out, f->values.data(), f->values.size() * sizeof(float));
+}
+void dl4j_csv_close(void* h) { delete static_cast<CsvFile*>(h); }
+
+// ----- stats codec -----
+void* dl4j_stats_begin(const char* session_id, const char* worker_id,
+                       int64_t timestamp, int32_t iteration, double score,
+                       double iter_time_ms, double samples_per_sec,
+                       int64_t mem_rss, int64_t device_mem) {
+  auto* b = new StatsBuilder();
+  auto& o = b->buf;
+  o.insert(o.end(), {'D', 'L', 'T', 'S'});
+  StatsBuilder::put<uint16_t>(o, 1);  // version
+  StatsBuilder::put_str(o, session_id);
+  StatsBuilder::put_str(o, worker_id);
+  StatsBuilder::put<int64_t>(o, timestamp);
+  StatsBuilder::put<int32_t>(o, iteration);
+  StatsBuilder::put<double>(o, score);
+  StatsBuilder::put<double>(o, iter_time_ms);
+  StatsBuilder::put<double>(o, samples_per_sec);
+  StatsBuilder::put<int64_t>(o, mem_rss);
+  StatsBuilder::put<int64_t>(o, device_mem);
+  return b;
+}
+
+// section: 0 = params, 1 = gradients, 2 = updates
+int dl4j_stats_add(void* h, int section, const char* name, double mean_mag,
+                   double lo, double hi, const int32_t* hist, int nhist) {
+  if (section < 0 || section > 2) return -1;
+  auto* b = static_cast<StatsBuilder*>(h);
+  std::vector<uint8_t> e;
+  StatsBuilder::put_str(e, name);
+  StatsBuilder::put<double>(e, mean_mag);
+  StatsBuilder::put<double>(e, lo);
+  StatsBuilder::put<double>(e, hi);
+  StatsBuilder::put<uint16_t>(e, uint16_t(nhist));
+  for (int i = 0; i < nhist; i++) StatsBuilder::put<int32_t>(e, hist[i]);
+  b->sections[section].push_back(std::move(e));
+  return 0;
+}
+
+int64_t dl4j_stats_finish(void* h, uint8_t* out, int64_t cap) {
+  auto* b = static_cast<StatsBuilder*>(h);
+  std::vector<uint8_t> full = b->buf;
+  for (int s = 0; s < 3; s++) {
+    StatsBuilder::put<uint16_t>(full, uint16_t(b->sections[s].size()));
+    for (auto& e : b->sections[s]) full.insert(full.end(), e.begin(), e.end());
+  }
+  int64_t n = int64_t(full.size());
+  if (out && cap >= n) {
+    std::memcpy(out, full.data(), size_t(n));
+    delete b;
+  }
+  return n;  // when out==null or cap too small: required size (builder kept)
+}
+
+void dl4j_stats_abort(void* h) { delete static_cast<StatsBuilder*>(h); }
+
+int dl4j_runtime_version(void) { return 1; }
+
+}  // extern "C"

@@ -1,0 +1,141 @@
+"""Native C++ runtime: IDX/CIFAR parsing, async prefetch loader, CSV reader,
+stats codec wire-format equivalence with the Python encoder."""
+import struct
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import nativert
+from deeplearning4j_tpu.ui.stats import StatsReport
+
+pytestmark = pytest.mark.skipif(not nativert.native_available(),
+                                reason="native runtime not built")
+
+
+def _write_idx(path, arr):
+    arr = np.asarray(arr, np.uint8)
+    with open(path, "wb") as f:
+        f.write(struct.pack(">i", 0x0800 | arr.ndim))
+        for d in arr.shape:
+            f.write(struct.pack(">i", d))
+        f.write(arr.tobytes())
+
+
+def test_idx_roundtrip(tmp_path):
+    arr = np.arange(2 * 5 * 4, dtype=np.uint8).reshape(2, 5, 4)
+    p = tmp_path / "t.idx"
+    _write_idx(p, arr)
+    out = nativert.read_idx(str(p))
+    assert out.shape == (2, 5, 4)
+    np.testing.assert_array_equal(out, arr)
+
+
+def test_idx_bad_file(tmp_path):
+    p = tmp_path / "bad.idx"
+    p.write_bytes(b"\x00\x01\x02")
+    assert nativert.read_idx(str(p)) is None
+
+
+def test_loader_ordered_batches():
+    n, feat, ncls, batch = 12, 6, 3, 4
+    feats = np.arange(n * feat, dtype=np.uint8).reshape(n, feat)
+    labels = (np.arange(n) % ncls).astype(np.uint8)
+    ld = nativert.AsyncNativeLoader.from_arrays(
+        feats, labels, ncls, batch, shuffle=False, normalize=False)
+    batches = list(ld)
+    assert len(batches) == 3
+    x0, y0 = batches[0]
+    np.testing.assert_allclose(x0, feats[:4].astype(np.float32))
+    np.testing.assert_array_equal(np.argmax(y0, axis=1), labels[:4])
+    assert y0.sum() == batch  # one-hot
+    # epoch exhausted; reset restarts
+    assert ld.next() is None
+    ld.reset()
+    assert len(list(ld)) == 3
+    ld.close()
+
+
+def test_loader_shuffle_covers_all():
+    n, feat, batch = 16, 2, 4
+    feats = np.repeat(np.arange(n, dtype=np.uint8)[:, None], feat, axis=1)
+    labels = np.zeros(n, np.uint8)
+    ld = nativert.AsyncNativeLoader.from_arrays(
+        feats, labels, 2, batch, shuffle=True, seed=7, normalize=False)
+    seen = sorted(int(x[0]) for xb, _ in ld for x in xb)
+    assert seen == list(range(n))
+    ld.close()
+
+
+def test_mnist_loader_from_idx_files(tmp_path):
+    imgs = np.random.default_rng(0).integers(0, 256, (10, 28, 28)).astype(np.uint8)
+    lbls = (np.arange(10) % 10).astype(np.uint8)
+    _write_idx(tmp_path / "img.idx", imgs)
+    _write_idx(tmp_path / "lbl.idx", lbls)
+    ld = nativert.AsyncNativeLoader.mnist(
+        str(tmp_path / "img.idx"), str(tmp_path / "lbl.idx"), batch=5,
+        shuffle=False)
+    assert ld.num_examples == 10 and ld.feature_size == 784
+    x, y = ld.next()
+    np.testing.assert_allclose(
+        x, imgs[:5].reshape(5, -1).astype(np.float32) / 255.0, atol=1e-6)
+    np.testing.assert_array_equal(np.argmax(y, axis=1), lbls[:5])
+    ld.close()
+
+
+def test_cifar_loader(tmp_path):
+    # CIFAR-10 binary: [label u8][3072 pixels u8] per record
+    rng = np.random.default_rng(1)
+    n = 6
+    recs = bytearray()
+    labels = []
+    for i in range(n):
+        lab = int(rng.integers(0, 10))
+        labels.append(lab)
+        recs.append(lab)
+        recs += rng.integers(0, 256, 3072).astype(np.uint8).tobytes()
+    p = tmp_path / "data_batch_1.bin"
+    p.write_bytes(bytes(recs))
+    ld = nativert.AsyncNativeLoader.cifar([str(p)], batch=3, shuffle=False)
+    assert ld.num_examples == n and ld.feature_size == 3072
+    _, y = ld.next()
+    np.testing.assert_array_equal(np.argmax(y, axis=1), labels[:3])
+    ld.close()
+
+
+def test_csv_reader(tmp_path):
+    p = tmp_path / "d.csv"
+    p.write_text("# header\n1.5,2,3\n4,5.25,6\n7,8,9\n")
+    out = nativert.read_csv_numeric(str(p), skip_lines=1)
+    np.testing.assert_allclose(
+        out, [[1.5, 2, 3], [4, 5.25, 6], [7, 8, 9]])
+
+
+def _sample_report():
+    r = StatsReport("sess-1", "worker-0", 1234567890123)
+    r.iteration = 42
+    r.score = 0.125
+    r.iteration_time_ms = 3.5
+    r.samples_per_sec = 1000.25
+    r.mem_rss_bytes = 1 << 30
+    r.device_mem_bytes = 2 << 30
+    r.param_stats["layer0_W"] = (0.5, [1, 2, 3, 4], (-1.0, 1.0))
+    r.gradient_stats["layer0_W"] = (0.01, [4, 3, 2, 1], (-0.1, 0.1))
+    r.update_stats["layer0_b"] = (0.001, [7], (0.0, 0.002))
+    return r
+
+
+def test_stats_codec_matches_python(monkeypatch):
+    r = _sample_report()
+    native_bytes = r.encode()
+    monkeypatch.setenv("DL4J_TPU_DISABLE_NATIVE", "1")
+    python_bytes = r.encode()
+    assert native_bytes == python_bytes
+
+
+def test_stats_codec_decode_roundtrip():
+    r = _sample_report()
+    d = StatsReport.decode(r.encode())
+    assert d.session_id == "sess-1" and d.worker_id == "worker-0"
+    assert d.iteration == 42 and d.score == 0.125
+    assert d.param_stats["layer0_W"] == (0.5, [1, 2, 3, 4], (-1.0, 1.0))
+    assert d.update_stats["layer0_b"] == (0.001, [7], (0.0, 0.002))

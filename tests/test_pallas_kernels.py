@@ -66,3 +66,28 @@ def test_softmax_xent_matches_xla():
                                rtol=1e-5, atol=1e-6)
     np.testing.assert_allclose(np.asarray(grad_p), np.asarray(grad_x),
                                rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("T", [64, 130])  # 130: not a block multiple
+def test_chunked_backward_matches_reference(causal, T):
+    q, k, v = _qkv(B=1, T=T, H=2, D=16, seed=3)
+    g = jnp.ones_like(q)
+    from deeplearning4j_tpu.ops.pallas_kernels import _attention_bwd_chunked
+    got = _attention_bwd_chunked(q, k, v, g, causal, blk_q=32)
+    _, vjp = jax.vjp(lambda a, b, c: attention_reference(a, b, c, causal),
+                     q, k, v)
+    expect = vjp(g)
+    for a, b in zip(got, expect):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-4, atol=5e-5)
+
+
+def test_flash_attention_non_tileable_falls_back():
+    # Public entry must not error on ragged sequence lengths even when the
+    # pallas path is selected (interpret=True routes it): T=130 falls back.
+    q, k, v = _qkv(B=1, T=130, H=2, D=16, seed=4)
+    got = flash_attention(q, k, v, False, True)
+    expect = attention_reference(q, k, v, causal=False)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expect),
+                               rtol=2e-4, atol=2e-5)
