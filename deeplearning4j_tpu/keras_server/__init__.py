@@ -1,0 +1,169 @@
+"""Keras gateway server: train Keras-exported models in this runtime.
+
+Reference: deeplearning4j-keras (SURVEY.md §2.8) — a py4j ``GatewayServer``
+(keras/Server.java:18) exposes ``DeepLearning4jEntryPoint.fit()``
+(DeepLearning4jEntryPoint.java:21), which loads a Keras-exported model plus an
+HDF5 minibatch dataset iterator (HDF5MiniBatchDataSetIterator.java) and trains
+in the JVM. Here the gateway is a newline-delimited-JSON TCP server (py4j's
+wire role) and the entry point drives the TPU training path on the imported
+network.
+"""
+from __future__ import annotations
+
+import json
+import re
+import socket
+import socketserver
+import threading
+from pathlib import Path
+from typing import List, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.modelimport.hdf5 import H5File
+
+
+class HDF5MiniBatchDataSetIterator:
+    """Iterates a directory of per-batch HDF5 files, each holding one array
+    under ``data`` (reference HDF5MiniBatchDataSetIterator.java). Files are
+    ordered by the integer in their name (0.h5, 1.h5, ...)."""
+
+    def __init__(self, directory: str, dataset_name: str = "data"):
+        self.directory = Path(directory)
+        self.dataset_name = dataset_name
+        def batch_no(p: Path):
+            m = re.search(r"(\d+)", p.stem)
+            return int(m.group(1)) if m else 0
+        self.files: List[Path] = sorted(
+            (p for p in self.directory.iterdir() if p.suffix == ".h5"),
+            key=batch_no)
+        if not self.files:
+            raise FileNotFoundError(f"no .h5 batch files in {directory}")
+
+    def __len__(self) -> int:
+        return len(self.files)
+
+    def read(self, i: int) -> np.ndarray:
+        with H5File(str(self.files[i])) as f:
+            return f.read_dataset(f"/{self.dataset_name}")
+
+    def __iter__(self):
+        for i in range(len(self.files)):
+            yield self.read(i)
+
+
+class DeepLearning4jEntryPoint:
+    """The RPC surface (reference DeepLearning4jEntryPoint.java:21)."""
+
+    def __init__(self):
+        self._models: dict = {}
+
+    # -- reference: fit(params) with model file + train directories
+    def fit(self, model_file_path: str, nb_epoch: int,
+            train_features_directory: str, train_labels_directory: str,
+            dim_ordering: str = "tf", model_type: str = "sequential") -> dict:
+        from deeplearning4j_tpu.modelimport.keras_import import KerasModelImport
+
+        if model_type != "sequential":
+            raise ValueError("only sequential models supported (reference "
+                             "DeepLearning4jEntryPoint parity)")
+        net = KerasModelImport.import_keras_sequential_model_and_weights(
+            model_file_path)
+        xs = HDF5MiniBatchDataSetIterator(train_features_directory)
+        ys = HDF5MiniBatchDataSetIterator(train_labels_directory)
+        if len(xs) != len(ys):
+            raise ValueError("feature/label batch counts differ")
+        for _ in range(int(nb_epoch)):
+            for x, y in zip(xs, ys):
+                net.fit(np.asarray(x, np.float32), np.asarray(y, np.float32))
+        self._models[model_file_path] = net
+        return {"batches": len(xs), "epochs": int(nb_epoch),
+                "score": float(net.score_value)}
+
+    def evaluate(self, model_file_path: str, features_directory: str,
+                 labels_directory: str) -> dict:
+        net = self._models.get(model_file_path)
+        if net is None:
+            from deeplearning4j_tpu.modelimport.keras_import import KerasModelImport
+            net = KerasModelImport.import_keras_sequential_model_and_weights(
+                model_file_path)
+        xs = HDF5MiniBatchDataSetIterator(features_directory)
+        ys = HDF5MiniBatchDataSetIterator(labels_directory)
+        correct = total = 0
+        for x, y in zip(xs, ys):
+            pred = np.argmax(np.asarray(net.output(np.asarray(x, np.float32))),
+                             axis=-1)
+            correct += int(np.sum(pred == np.argmax(y, axis=-1)))
+            total += len(y)
+        return {"accuracy": correct / max(total, 1), "examples": total}
+
+    def predict(self, model_file_path: str, features: list) -> dict:
+        net = self._models.get(model_file_path)
+        if net is None:
+            from deeplearning4j_tpu.modelimport.keras_import import KerasModelImport
+            net = KerasModelImport.import_keras_sequential_model_and_weights(
+                model_file_path)
+            self._models[model_file_path] = net
+        out = net.output(np.asarray(features, np.float32))
+        return {"predictions": np.asarray(out).tolist()}
+
+
+class _Handler(socketserver.StreamRequestHandler):
+    def handle(self):
+        for line in self.rfile:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                req = json.loads(line)
+                method = getattr(self.server.entry_point, req["method"])
+                result = method(**req.get("params", {}))
+                resp = {"ok": True, "result": result}
+            except Exception as e:  # report, keep serving
+                resp = {"ok": False, "error": f"{type(e).__name__}: {e}"}
+            self.wfile.write((json.dumps(resp) + "\n").encode())
+            self.wfile.flush()
+
+
+class Server:
+    """JSON-lines TCP gateway (reference keras/Server.java:18 py4j
+    GatewayServer equivalent). ``start()`` serves on a background thread."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 entry_point: Optional[DeepLearning4jEntryPoint] = None):
+        self._srv = socketserver.ThreadingTCPServer(
+            (host, port), _Handler, bind_and_activate=True)
+        self._srv.daemon_threads = True
+        self._srv.entry_point = entry_point or DeepLearning4jEntryPoint()
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self._srv.server_address[1]
+
+    def start(self) -> "Server":
+        self._thread = threading.Thread(target=self._srv.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._srv.shutdown()
+        self._srv.server_close()
+
+
+def call(host: str, port: int, method: str, **params):
+    """Convenience client for the gateway protocol."""
+    with socket.create_connection((host, port)) as s:
+        s.sendall((json.dumps({"method": method, "params": params}) + "\n")
+                  .encode())
+        buf = b""
+        while not buf.endswith(b"\n"):
+            chunk = s.recv(65536)
+            if not chunk:
+                break
+            buf += chunk
+    resp = json.loads(buf.decode())
+    if not resp.get("ok"):
+        raise RuntimeError(resp.get("error", "gateway call failed"))
+    return resp["result"]

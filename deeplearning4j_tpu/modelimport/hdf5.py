@@ -1,0 +1,434 @@
+"""Minimal HDF5 reader/writer over the system C library via ctypes.
+
+The reference reaches HDF5 natively through JavaCPP (`Loader.load(hdf5.class)`,
+reference deeplearning4j-modelimport keras/KerasModelImport.java:64); h5py is
+not in this image, so the same capability is provided by binding
+``libhdf5_serial`` directly. Covers exactly what Keras archives need: groups,
+float/int datasets, scalar string attributes and string-array attributes
+(fixed- and variable-length), plus writing the same so tests can produce
+fixtures and models can be exported.
+"""
+from __future__ import annotations
+
+import ctypes
+import ctypes.util
+import functools
+import threading
+from typing import Dict, List, Optional, Union
+
+import numpy as np
+
+# The Debian libhdf5_serial build is NOT thread-safe; every libhdf5 call in
+# this module runs under one process-wide lock (the gateway server calls in
+# from handler threads).
+_h5_lock = threading.RLock()
+
+
+def _locked(fn):
+    @functools.wraps(fn)
+    def wrapper(*a, **kw):
+        with _h5_lock:
+            return fn(*a, **kw)
+    return wrapper
+
+hid_t = ctypes.c_int64
+herr_t = ctypes.c_int
+hsize_t = ctypes.c_uint64
+htri_t = ctypes.c_int
+
+H5F_ACC_RDONLY = 0
+H5F_ACC_TRUNC = 2
+H5P_DEFAULT = 0
+H5S_ALL = 0
+H5S_SCALAR = 0
+H5_INDEX_NAME = 0
+H5_ITER_INC = 0
+H5T_DIR_ASCEND = 1
+H5T_VARIABLE = ctypes.c_size_t(-1).value
+# H5T_class_t
+H5T_INTEGER, H5T_FLOAT, H5T_STRING = 0, 1, 3
+H5T_SGN_NONE = 0
+
+_LIB_CANDIDATES = [
+    "libhdf5_serial.so.103", "libhdf5_serial.so", "libhdf5.so.103",
+    "libhdf5.so.200", "libhdf5.so",
+]
+
+_lib: Optional[ctypes.CDLL] = None
+_types: Dict[str, int] = {}
+
+
+class _H5GInfo(ctypes.Structure):
+    _fields_ = [("storage_type", ctypes.c_int), ("nlinks", hsize_t),
+                ("max_corder", ctypes.c_int64), ("mounted", ctypes.c_int)]
+
+
+def _load() -> ctypes.CDLL:
+    global _lib
+    if _lib is not None:
+        return _lib
+    lib = None
+    names = list(_LIB_CANDIDATES)
+    found = ctypes.util.find_library("hdf5_serial") or ctypes.util.find_library("hdf5")
+    if found:
+        names.insert(0, found)
+    for name in names:
+        try:
+            lib = ctypes.CDLL(name)
+            break
+        except OSError:
+            continue
+    if lib is None:
+        raise RuntimeError("libhdf5 not found on this system")
+
+    lib.H5open.restype = herr_t
+    lib.H5open()
+    # Failed probes (exists/open) are part of normal control flow here; leave
+    # no entries on the auto error stack — accumulated error-message ids
+    # otherwise trip "infinite loop closing library" in H5close at exit.
+    lib.H5Eset_auto2.restype = herr_t
+    lib.H5Eset_auto2.argtypes = [hid_t, ctypes.c_void_p, ctypes.c_void_p]
+    lib.H5Eset_auto2(0, None, None)
+
+    def sig(name, restype, argtypes):
+        fn = getattr(lib, name)
+        fn.restype = restype
+        fn.argtypes = argtypes
+        return fn
+
+    sig("H5Fopen", hid_t, [ctypes.c_char_p, ctypes.c_uint, hid_t])
+    sig("H5Fcreate", hid_t, [ctypes.c_char_p, ctypes.c_uint, hid_t, hid_t])
+    sig("H5Fclose", herr_t, [hid_t])
+    sig("H5Gopen2", hid_t, [hid_t, ctypes.c_char_p, hid_t])
+    sig("H5Gcreate2", hid_t, [hid_t, ctypes.c_char_p, hid_t, hid_t, hid_t])
+    sig("H5Gget_info", herr_t, [hid_t, ctypes.POINTER(_H5GInfo)])
+    sig("H5Gclose", herr_t, [hid_t])
+    sig("H5Lexists", htri_t, [hid_t, ctypes.c_char_p, hid_t])
+    sig("H5Lget_name_by_idx", ctypes.c_ssize_t,
+        [hid_t, ctypes.c_char_p, ctypes.c_int, ctypes.c_int, hsize_t,
+         ctypes.c_char_p, ctypes.c_size_t, hid_t])
+    sig("H5Oopen", hid_t, [hid_t, ctypes.c_char_p, hid_t])
+    sig("H5Oclose", herr_t, [hid_t])
+    sig("H5Dopen2", hid_t, [hid_t, ctypes.c_char_p, hid_t])
+    sig("H5Dcreate2", hid_t,
+        [hid_t, ctypes.c_char_p, hid_t, hid_t, hid_t, hid_t, hid_t])
+    sig("H5Dget_space", hid_t, [hid_t])
+    sig("H5Dget_type", hid_t, [hid_t])
+    sig("H5Dread", herr_t, [hid_t, hid_t, hid_t, hid_t, hid_t, ctypes.c_void_p])
+    sig("H5Dwrite", herr_t, [hid_t, hid_t, hid_t, hid_t, hid_t, ctypes.c_void_p])
+    sig("H5Dclose", herr_t, [hid_t])
+    sig("H5Screate", hid_t, [ctypes.c_int])
+    sig("H5Screate_simple", hid_t,
+        [ctypes.c_int, ctypes.POINTER(hsize_t), ctypes.POINTER(hsize_t)])
+    sig("H5Sget_simple_extent_ndims", ctypes.c_int, [hid_t])
+    sig("H5Sget_simple_extent_dims", ctypes.c_int,
+        [hid_t, ctypes.POINTER(hsize_t), ctypes.POINTER(hsize_t)])
+    sig("H5Sget_simple_extent_npoints", ctypes.c_int64, [hid_t])
+    sig("H5Sclose", herr_t, [hid_t])
+    sig("H5Aexists", htri_t, [hid_t, ctypes.c_char_p])
+    sig("H5Aopen", hid_t, [hid_t, ctypes.c_char_p, hid_t])
+    sig("H5Acreate2", hid_t, [hid_t, ctypes.c_char_p, hid_t, hid_t, hid_t, hid_t])
+    sig("H5Aget_type", hid_t, [hid_t])
+    sig("H5Aget_space", hid_t, [hid_t])
+    sig("H5Aread", herr_t, [hid_t, hid_t, ctypes.c_void_p])
+    sig("H5Awrite", herr_t, [hid_t, hid_t, ctypes.c_void_p])
+    sig("H5Aclose", herr_t, [hid_t])
+    sig("H5Tcopy", hid_t, [hid_t])
+    sig("H5Tset_size", herr_t, [hid_t, ctypes.c_size_t])
+    sig("H5Tget_size", ctypes.c_size_t, [hid_t])
+    sig("H5Tget_class", ctypes.c_int, [hid_t])
+    sig("H5Tget_sign", ctypes.c_int, [hid_t])
+    sig("H5Tis_variable_str", htri_t, [hid_t])
+    sig("H5Tget_native_type", hid_t, [hid_t, ctypes.c_int])
+    sig("H5Tclose", herr_t, [hid_t])
+    try:
+        sig("H5free_memory", herr_t, [ctypes.c_void_p])
+    except AttributeError:
+        pass
+
+    for pyname, gname in [
+        ("c_s1", "H5T_C_S1_g"),
+        ("f32", "H5T_NATIVE_FLOAT_g"), ("f64", "H5T_NATIVE_DOUBLE_g"),
+        ("i8", "H5T_NATIVE_SCHAR_g"), ("u8", "H5T_NATIVE_UCHAR_g"),
+        ("i16", "H5T_NATIVE_SHORT_g"), ("i32", "H5T_NATIVE_INT_g"),
+        ("i64", "H5T_NATIVE_LLONG_g"), ("u64", "H5T_NATIVE_ULLONG_g"),
+    ]:
+        _types[pyname] = hid_t.in_dll(lib, gname).value
+    _lib = lib
+    return lib
+
+
+def hdf5_available() -> bool:
+    try:
+        _load()
+        return True
+    except (RuntimeError, OSError):
+        return False
+
+
+_NP_TO_H5 = {
+    np.dtype(np.float32): "f32", np.dtype(np.float64): "f64",
+    np.dtype(np.int8): "i8", np.dtype(np.uint8): "u8",
+    np.dtype(np.int16): "i16", np.dtype(np.int32): "i32",
+    np.dtype(np.int64): "i64", np.dtype(np.uint64): "u64",
+}
+
+
+def _native_np_dtype(lib, type_id) -> np.dtype:
+    cls = lib.H5Tget_class(type_id)
+    size = lib.H5Tget_size(type_id)
+    if cls == H5T_FLOAT:
+        return np.dtype(np.float64 if size == 8 else np.float32)
+    if cls == H5T_INTEGER:
+        unsigned = lib.H5Tget_sign(type_id) == H5T_SGN_NONE
+        return np.dtype(f"{'u' if unsigned else 'i'}{size}")
+    raise ValueError(f"unsupported HDF5 dataset class {cls}")
+
+
+class H5File:
+    """Tiny h5py-shaped facade over the C library. Paths are '/'-separated."""
+
+    @_locked
+    def __init__(self, path: str, mode: str = "r"):
+        self._lib = _load()
+        if mode == "r":
+            self._fid = self._lib.H5Fopen(str(path).encode(), H5F_ACC_RDONLY,
+                                          H5P_DEFAULT)
+        elif mode == "w":
+            self._fid = self._lib.H5Fcreate(str(path).encode(), H5F_ACC_TRUNC,
+                                            H5P_DEFAULT, H5P_DEFAULT)
+        else:
+            raise ValueError("mode must be 'r' or 'w'")
+        if self._fid < 0:
+            raise OSError(f"cannot open HDF5 file {path!r} (mode={mode})")
+
+    # ------------------------------------------------------------------ lifecycle
+    @_locked
+    def close(self) -> None:
+        if getattr(self, "_fid", -1) >= 0:
+            self._lib.H5Fclose(self._fid)
+            self._fid = -1
+
+    def __enter__(self) -> "H5File":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    # ------------------------------------------------------------------ reading
+    @_locked
+    def exists(self, path: str) -> bool:
+        # every intermediate link must exist too, else H5Lexists errors
+        parts = [p for p in path.strip("/").split("/") if p]
+        sofar = ""
+        for p in parts:
+            sofar += "/" + p
+            if self._lib.H5Lexists(self._fid, sofar.encode(), H5P_DEFAULT) <= 0:
+                return False
+        return True
+
+    @_locked
+    def list_group(self, path: str = "/") -> List[str]:
+        gid = self._lib.H5Gopen2(self._fid, path.encode(), H5P_DEFAULT)
+        if gid < 0:
+            raise KeyError(f"no such group: {path}")
+        try:
+            info = _H5GInfo()
+            self._lib.H5Gget_info(gid, ctypes.byref(info))
+            names = []
+            for i in range(info.nlinks):
+                n = self._lib.H5Lget_name_by_idx(
+                    gid, b".", H5_INDEX_NAME, H5_ITER_INC, i, None, 0,
+                    H5P_DEFAULT)
+                buf = ctypes.create_string_buffer(n + 1)
+                self._lib.H5Lget_name_by_idx(
+                    gid, b".", H5_INDEX_NAME, H5_ITER_INC, i, buf, n + 1,
+                    H5P_DEFAULT)
+                names.append(buf.value.decode())
+            return names
+        finally:
+            self._lib.H5Gclose(gid)
+
+    @_locked
+    def read_dataset(self, path: str) -> np.ndarray:
+        lib = self._lib
+        did = lib.H5Dopen2(self._fid, path.encode(), H5P_DEFAULT)
+        if did < 0:
+            raise KeyError(f"no such dataset: {path}")
+        try:
+            sid = lib.H5Dget_space(did)
+            ndim = lib.H5Sget_simple_extent_ndims(sid)
+            dims = (hsize_t * max(ndim, 1))()
+            if ndim > 0:
+                lib.H5Sget_simple_extent_dims(sid, dims, None)
+            shape = tuple(int(dims[i]) for i in range(ndim))
+            lib.H5Sclose(sid)
+            tid = lib.H5Dget_type(did)
+            ntid = lib.H5Tget_native_type(tid, H5T_DIR_ASCEND)
+            dt = _native_np_dtype(lib, ntid)
+            lib.H5Tclose(ntid)
+            lib.H5Tclose(tid)
+            out = np.empty(shape if shape else (), dt)
+            if lib.H5Dread(did, _types[_NP_TO_H5[dt]], H5S_ALL, H5S_ALL,
+                           H5P_DEFAULT,
+                           out.ctypes.data_as(ctypes.c_void_p)) < 0:
+                raise OSError(f"H5Dread failed for {path}")
+            return out
+        finally:
+            lib.H5Dclose(did)
+
+    def _read_attr_handle(self, aid) -> Union[str, List[str], np.ndarray]:
+        lib = self._lib
+        tid = lib.H5Aget_type(aid)
+        sid = lib.H5Aget_space(aid)
+        try:
+            npoints = int(lib.H5Sget_simple_extent_npoints(sid))
+            cls = lib.H5Tget_class(tid)
+            if cls == H5T_STRING:
+                if lib.H5Tis_variable_str(tid) > 0:
+                    bufs = (ctypes.c_char_p * npoints)()
+                    mem = lib.H5Tcopy(_types["c_s1"])
+                    lib.H5Tset_size(mem, H5T_VARIABLE)
+                    lib.H5Aread(aid, mem, bufs)
+                    vals = [(bufs[i] or b"").decode("utf-8", "replace")
+                            for i in range(npoints)]
+                    lib.H5Tclose(mem)
+                else:
+                    size = lib.H5Tget_size(tid)
+                    raw = ctypes.create_string_buffer(size * npoints)
+                    lib.H5Aread(aid, tid, raw)
+                    vals = [raw.raw[i * size:(i + 1) * size]
+                            .split(b"\x00")[0].decode("utf-8", "replace")
+                            for i in range(npoints)]
+                return vals[0] if npoints == 1 else vals
+            ntid = lib.H5Tget_native_type(tid, H5T_DIR_ASCEND)
+            dt = _native_np_dtype(lib, ntid)
+            lib.H5Tclose(ntid)
+            out = np.empty((npoints,), dt)
+            lib.H5Aread(aid, _types[_NP_TO_H5[dt]],
+                        out.ctypes.data_as(ctypes.c_void_p))
+            return out[0] if npoints == 1 else out
+        finally:
+            lib.H5Sclose(sid)
+            lib.H5Tclose(tid)
+
+    @_locked
+    def read_attr(self, obj_path: str, name: str):
+        lib = self._lib
+        oid = lib.H5Oopen(self._fid, obj_path.encode(), H5P_DEFAULT)
+        if oid < 0:
+            raise KeyError(f"no such object: {obj_path}")
+        try:
+            if lib.H5Aexists(oid, name.encode()) <= 0:
+                raise KeyError(f"no attribute {name!r} on {obj_path}")
+            aid = lib.H5Aopen(oid, name.encode(), H5P_DEFAULT)
+            try:
+                return self._read_attr_handle(aid)
+            finally:
+                lib.H5Aclose(aid)
+        finally:
+            lib.H5Oclose(oid)
+
+    @_locked
+    def has_attr(self, obj_path: str, name: str) -> bool:
+        lib = self._lib
+        oid = lib.H5Oopen(self._fid, obj_path.encode(), H5P_DEFAULT)
+        if oid < 0:
+            return False
+        try:
+            return lib.H5Aexists(oid, name.encode()) > 0
+        finally:
+            lib.H5Oclose(oid)
+
+    # ------------------------------------------------------------------ writing
+    @_locked
+    def create_group(self, path: str) -> None:
+        parts = [p for p in path.strip("/").split("/") if p]
+        sofar = ""
+        for p in parts:
+            sofar += "/" + p
+            if self._lib.H5Lexists(self._fid, sofar.encode(), H5P_DEFAULT) <= 0:
+                gid = self._lib.H5Gcreate2(self._fid, sofar.encode(),
+                                           H5P_DEFAULT, H5P_DEFAULT, H5P_DEFAULT)
+                if gid < 0:
+                    raise OSError(f"cannot create group {sofar}")
+                self._lib.H5Gclose(gid)
+
+    @_locked
+    def write_dataset(self, path: str, arr: np.ndarray) -> None:
+        lib = self._lib
+        arr = np.ascontiguousarray(arr)
+        if arr.dtype not in _NP_TO_H5:
+            arr = arr.astype(np.float32)
+        parent = path.rsplit("/", 1)[0]
+        if parent and parent != path:
+            self.create_group(parent)
+        dims = (hsize_t * max(arr.ndim, 1))(*arr.shape) if arr.ndim else None
+        sid = (lib.H5Screate_simple(arr.ndim, dims, None) if arr.ndim
+               else lib.H5Screate(H5S_SCALAR))
+        tid = _types[_NP_TO_H5[arr.dtype]]
+        did = lib.H5Dcreate2(self._fid, path.encode(), tid, sid, H5P_DEFAULT,
+                             H5P_DEFAULT, H5P_DEFAULT)
+        if did < 0:
+            lib.H5Sclose(sid)
+            raise OSError(f"cannot create dataset {path}")
+        try:
+            if lib.H5Dwrite(did, tid, H5S_ALL, H5S_ALL, H5P_DEFAULT,
+                            arr.ctypes.data_as(ctypes.c_void_p)) < 0:
+                raise OSError(f"H5Dwrite failed for {path}")
+        finally:
+            lib.H5Dclose(did)
+            lib.H5Sclose(sid)
+
+    @_locked
+    def write_attr(self, obj_path: str, name: str,
+                   value: Union[str, List[str], np.ndarray, int, float]) -> None:
+        """Strings are written as fixed-length null-padded ASCII (the Keras-1/
+        h5py-2 convention the reference's importer reads)."""
+        lib = self._lib
+        oid = lib.H5Oopen(self._fid, obj_path.encode(), H5P_DEFAULT)
+        if oid < 0:
+            raise KeyError(f"no such object: {obj_path}")
+        try:
+            if isinstance(value, str):
+                value = [value]
+                scalar = True
+            elif isinstance(value, list) and all(isinstance(v, str) for v in value):
+                scalar = False
+            else:
+                arr = np.atleast_1d(np.asarray(value))
+                if arr.dtype not in _NP_TO_H5:
+                    arr = arr.astype(np.float64)
+                dims = (hsize_t * 1)(arr.size)
+                sid = lib.H5Screate_simple(1, dims, None)
+                tid = _types[_NP_TO_H5[arr.dtype]]
+                aid = lib.H5Acreate2(oid, name.encode(), tid, sid, H5P_DEFAULT,
+                                     H5P_DEFAULT)
+                lib.H5Awrite(aid, tid, arr.ctypes.data_as(ctypes.c_void_p))
+                lib.H5Aclose(aid)
+                lib.H5Sclose(sid)
+                return
+            enc = [v.encode() for v in value]
+            size = max(max((len(e) for e in enc), default=0) + 1, 1)
+            mem = lib.H5Tcopy(_types["c_s1"])
+            lib.H5Tset_size(mem, size)
+            buf = b"".join(e.ljust(size, b"\x00") for e in enc)
+            if scalar:
+                sid = lib.H5Screate(H5S_SCALAR)
+            else:
+                dims = (hsize_t * 1)(len(enc))
+                sid = lib.H5Screate_simple(1, dims, None)
+            aid = lib.H5Acreate2(oid, name.encode(), mem, sid, H5P_DEFAULT,
+                                 H5P_DEFAULT)
+            lib.H5Awrite(aid, mem, ctypes.c_char_p(buf))
+            lib.H5Aclose(aid)
+            lib.H5Sclose(sid)
+            lib.H5Tclose(mem)
+        finally:
+            lib.H5Oclose(oid)

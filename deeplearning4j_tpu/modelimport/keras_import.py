@@ -1,0 +1,438 @@
+"""Keras 1.x model import: HDF5 archive -> TPU-native network + weights.
+
+Reference: deeplearning4j-modelimport keras/KerasModelImport.java:60
+(`importKerasModelAndWeights`:85 -> ComputationGraph,
+`importKerasSequentialModelAndWeights`:110 -> MultiLayerNetwork) and
+keras/KerasLayer.java:39-52 — the supported layer set there is Input,
+Activation, Dropout, Dense, TimeDistributedDense, LSTM, Convolution2D,
+MaxPooling2D, AveragePooling2D, Flatten, Reshape, RepeatVector, Merge,
+BatchNormalization (+ loss pseudo-layer :125). This importer covers the same
+set (plus Embedding) and the Keras-1 weight layouts with TH/TF dim-ordering
+fixes (KerasModel weight-copy logic).
+
+Layout note: this framework is NHWC-native (XLA:TPU preferred). TH-ordered
+Keras kernels (nb_filter, stack, rows, cols) are transposed into HWIO at
+import; imported networks therefore take NHWC inputs regardless of the
+original dim_ordering.
+"""
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.modelimport.hdf5 import H5File
+from deeplearning4j_tpu.nn.conf.builders import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.graphconf import GraphBuilder
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import (
+    ActivationLayer, BatchNormalization, ConvolutionLayer, DenseLayer,
+    DropoutLayer, EmbeddingLayer, LSTM, OutputLayer, RnnOutputLayer,
+    SubsamplingLayer,
+)
+from deeplearning4j_tpu.nn.conf.vertices import ElementWiseVertex, MergeVertex
+
+_ACTIVATIONS = {
+    "linear": "identity", "hard_sigmoid": "hardsigmoid",
+    "softmax": "softmax", "relu": "relu", "tanh": "tanh",
+    "sigmoid": "sigmoid", "softplus": "softplus", "softsign": "softsign",
+    "elu": "elu", "selu": "selu",
+}
+
+_LOSSES = {
+    "categorical_crossentropy": "mcxent",
+    "sparse_categorical_crossentropy": "mcxent",
+    "binary_crossentropy": "xent",
+    "mean_squared_error": "mse", "mse": "mse",
+    "mean_absolute_error": "mae", "mae": "mae",
+    "mean_absolute_percentage_error": "mape",
+    "mean_squared_logarithmic_error": "msle",
+    "hinge": "hinge", "squared_hinge": "squaredhinge",
+    "kullback_leibler_divergence": "kld",
+    "poisson": "poisson",
+}
+
+_SUPPORTED = {
+    "InputLayer", "Activation", "Dropout", "Dense", "TimeDistributedDense",
+    "LSTM", "Convolution2D", "MaxPooling2D", "AveragePooling2D", "Flatten",
+    "Reshape", "RepeatVector", "Merge", "BatchNormalization", "Embedding",
+}
+
+
+class InvalidKerasConfigurationException(ValueError):
+    """Reference exceptions/InvalidKerasConfigurationException equivalent."""
+
+
+def _act(name: Optional[str]) -> str:
+    if not name:
+        return "identity"
+    return _ACTIVATIONS.get(name, name)
+
+
+def _input_type_from_shape(shape: List[Optional[int]],
+                           dim_ordering: str) -> InputType:
+    """batch_input_shape (leading None stripped) -> InputType."""
+    dims = [int(d) for d in shape if d is not None]
+    if len(dims) == 3:
+        if dim_ordering == "th":
+            c, h, w = dims
+        else:
+            h, w, c = dims
+        return InputType.convolutional(h, w, c)
+    if len(dims) == 2:
+        return InputType.recurrent(dims[1], dims[0])
+    if len(dims) == 1:
+        return InputType.feed_forward(dims[0])
+    raise InvalidKerasConfigurationException(
+        f"unsupported input shape {shape}")
+
+
+def _keras_layers(model_config: dict) -> List[dict]:
+    cfg = model_config["config"]
+    return cfg if isinstance(cfg, list) else cfg["layers"]
+
+
+class _SequentialParse:
+    def __init__(self):
+        self.layers: List = []
+        # keras layer name -> our layer index (weight-bearing layers only)
+        self.index_of: Dict[str, int] = {}
+        self.input_type: Optional[InputType] = None
+        self.class_of: Dict[str, str] = {}
+
+
+def _parse_sequential(model_config: dict, loss: Optional[str]) -> _SequentialParse:
+    out = _SequentialParse()
+    klayers = _keras_layers(model_config)
+    pending_n: Optional[int] = None  # RepeatVector handled via preprocessor-less repeat
+
+    for pos, kl in enumerate(klayers):
+        cls = kl["class_name"]
+        cfg = kl.get("config", {})
+        name = cfg.get("name", f"layer_{pos}")
+        if cls not in _SUPPORTED:
+            raise InvalidKerasConfigurationException(
+                f"unsupported Keras layer type {cls!r} (supported: "
+                f"{sorted(_SUPPORTED)})")
+        out.class_of[name] = cls
+        if out.input_type is None and "batch_input_shape" in cfg:
+            out.input_type = _input_type_from_shape(
+                cfg["batch_input_shape"][1:], cfg.get("dim_ordering", "tf"))
+        elif out.input_type is None and "input_dim" in cfg and cfg["input_dim"]:
+            out.input_type = InputType.feed_forward(int(cfg["input_dim"]))
+
+        last = pos == len(klayers) - 1
+        lyr = _to_layer(cls, cfg, last=last, loss=loss)
+        if lyr is None:
+            continue  # shape-only layer (Input/Flatten/Reshape)
+        out.index_of[name] = len(out.layers)
+        out.layers.append(lyr)
+    if not out.layers:
+        raise InvalidKerasConfigurationException("model has no layers")
+    # Dense followed by a trailing Activation (the Keras idiom
+    # Dense(linear) + Activation(softmax)) folds into one OutputLayer so the
+    # network ends in a loss-bearing layer (reference KerasLayer loss
+    # pseudo-layer handling).
+    if (isinstance(out.layers[-1], ActivationLayer)
+            and len(out.layers) >= 2
+            and type(out.layers[-2]) is DenseLayer):
+        act = out.layers[-1].activation or "identity"
+        dense = out.layers[-2]
+        lloss = loss or ("mcxent" if act == "softmax" else "mse")
+        merged = OutputLayer(n_out=dense.n_out, activation=act, loss=lloss)
+        out.layers = out.layers[:-2] + [merged]
+        out.index_of = {n: (i if i < len(out.layers) - 1 else
+                            len(out.layers) - 1)
+                        for n, i in out.index_of.items()
+                        if i < len(out.layers) + 1}
+    return out
+
+
+def _to_layer(cls: str, cfg: dict, *, last: bool, loss: Optional[str]):
+    """One Keras layer dict -> our layer config (or None for shape-only)."""
+    act = _act(cfg.get("activation"))
+    if cls in ("InputLayer", "Flatten", "Reshape", "RepeatVector"):
+        # Rank adaptation is preprocessor territory; our builder auto-inserts
+        # preprocessors from InputType inference (reference inserts
+        # CnnToFeedForwardPreProcessor for Flatten the same way).
+        return None
+    if cls in ("Dense", "TimeDistributedDense"):
+        n_out = int(cfg["output_dim"])
+        if last:
+            lloss = loss or ("mcxent" if act == "softmax" else "mse")
+            klass = RnnOutputLayer if cls == "TimeDistributedDense" else OutputLayer
+            return klass(n_out=n_out, activation=act, loss=lloss)
+        return DenseLayer(n_out=n_out, activation=act)
+    if cls == "Activation":
+        return ActivationLayer(activation=act)
+    if cls == "Dropout":
+        return DropoutLayer(dropout=1.0 - float(cfg.get("p", 0.5)))
+    if cls == "Convolution2D":
+        border = cfg.get("border_mode", "valid")
+        sub = cfg.get("subsample", [1, 1])
+        return ConvolutionLayer(
+            n_out=int(cfg["nb_filter"]),
+            kernel_size=(int(cfg["nb_row"]), int(cfg["nb_col"])),
+            stride=(int(sub[0]), int(sub[1])),
+            convolution_mode="same" if border == "same" else "truncate",
+            activation=act)
+    if cls in ("MaxPooling2D", "AveragePooling2D"):
+        pool = cfg.get("pool_size", [2, 2])
+        strides = cfg.get("strides") or pool
+        border = cfg.get("border_mode", "valid")
+        return SubsamplingLayer(
+            pooling_type="max" if cls == "MaxPooling2D" else "avg",
+            kernel_size=(int(pool[0]), int(pool[1])),
+            stride=(int(strides[0]), int(strides[1])),
+            convolution_mode="same" if border == "same" else "truncate")
+    if cls == "LSTM":
+        return LSTM(n_out=int(cfg["output_dim"]),
+                    activation=_act(cfg.get("activation", "tanh")),
+                    gate_activation=_act(cfg.get("inner_activation",
+                                                 "hard_sigmoid")),
+                    peephole=False)
+    if cls == "BatchNormalization":
+        # Keras BN applies no activation; pin identity so the network-level
+        # default (sigmoid in the reference's GlobalConf) doesn't leak in.
+        return BatchNormalization(eps=float(cfg.get("epsilon", 1e-5)),
+                                  decay=float(cfg.get("momentum", 0.99)),
+                                  activation="identity")
+    if cls == "Embedding":
+        return EmbeddingLayer(n_in=int(cfg["input_dim"]),
+                              n_out=int(cfg["output_dim"]))
+    raise InvalidKerasConfigurationException(f"unhandled layer {cls}")
+
+
+# ---------------------------------------------------------------------------
+# Weight copy
+# ---------------------------------------------------------------------------
+
+def _weight_root(f: H5File) -> str:
+    return "/model_weights" if f.exists("/model_weights") else "/"
+
+
+def _as_list(v) -> List[str]:
+    return [v] if isinstance(v, str) else list(v)
+
+
+def _layer_weights(f: H5File, root: str, lname: str) -> List[np.ndarray]:
+    g = f"{root.rstrip('/')}/{lname}"
+    if not f.has_attr(g, "weight_names"):
+        return []
+    names = _as_list(f.read_attr(g, "weight_names"))
+    out = []
+    for wn in names:
+        # weight_names may be bare ("dense_1_W") or nested ("dense_1/dense_1_W")
+        p = f"{g}/{wn}" if f.exists(f"{g}/{wn}") else f"{root.rstrip('/')}/{wn}"
+        out.append(f.read_dataset(p))
+    return out
+
+
+def _convert_lstm(ws: List[np.ndarray]) -> Dict[str, np.ndarray]:
+    """Keras-1 LSTM weight list [W_i,U_i,b_i, W_c,U_c,b_c, W_f,U_f,b_f,
+    W_o,U_o,b_o] -> fused {W [in,4H], RW [H,4H], b [4H]} in this framework's
+    gate order (input, forget, cell, output)."""
+    if len(ws) != 12:
+        raise InvalidKerasConfigurationException(
+            f"expected 12 LSTM weight arrays (Keras 1 layout), got {len(ws)}")
+    wi, ui, bi, wc, uc, bc, wf, uf, bf, wo, uo, bo = ws
+    return {
+        "W": np.concatenate([wi, wf, wc, wo], axis=1),
+        "RW": np.concatenate([ui, uf, uc, uo], axis=1),
+        "b": np.concatenate([bi, bf, bc, bo]),
+    }
+
+
+def _convert_conv(w: np.ndarray, dim_ordering: str) -> np.ndarray:
+    if dim_ordering == "th" or (w.ndim == 4 and w.shape[2] > w.shape[0]
+                                and dim_ordering == "auto"):
+        # (nb_filter, stack, rows, cols) -> (rows, cols, stack, nb_filter)
+        return np.transpose(w, (2, 3, 1, 0))
+    return w  # tf ordering == HWIO already
+
+
+def _set_layer_params(cls: str, cfg: dict, params: dict, state: dict,
+                      ws: List[np.ndarray]) -> None:
+    if not ws:
+        return
+    if cls in ("Dense", "TimeDistributedDense", "Embedding"):
+        params["W"] = jnp.asarray(ws[0], jnp.float32)
+        if len(ws) > 1:
+            params["b"] = jnp.asarray(ws[1], jnp.float32)
+        elif "b" in params:
+            params["b"] = jnp.zeros_like(params["b"])
+    elif cls == "Convolution2D":
+        params["W"] = jnp.asarray(
+            _convert_conv(ws[0], cfg.get("dim_ordering", "th")), jnp.float32)
+        if len(ws) > 1:
+            params["b"] = jnp.asarray(ws[1], jnp.float32)
+    elif cls == "LSTM":
+        for k, v in _convert_lstm(ws).items():
+            params[k] = jnp.asarray(v, jnp.float32)
+    elif cls == "BatchNormalization":
+        params["gamma"] = jnp.asarray(ws[0], jnp.float32)
+        params["beta"] = jnp.asarray(ws[1], jnp.float32)
+        if len(ws) > 2:
+            state["mean"] = jnp.asarray(ws[2], jnp.float32)
+        if len(ws) > 3:
+            # Keras 1 stores the running *variance* under the name running_std
+            state["var"] = jnp.asarray(ws[3], jnp.float32)
+    else:
+        raise InvalidKerasConfigurationException(
+            f"no weight mapping for layer class {cls}")
+
+
+# ---------------------------------------------------------------------------
+# Public API (reference KerasModelImport facade)
+# ---------------------------------------------------------------------------
+
+class KerasModelImport:
+    """Static facade, mirroring reference KerasModelImport.java:60."""
+
+    @staticmethod
+    def import_keras_sequential_model_and_weights(
+            path: str, *, enforce_training_config: bool = False):
+        """Keras Sequential HDF5 archive -> initialized MultiLayerNetwork with
+        copied weights (reference importSequentialModelAndWeights:110)."""
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+        with H5File(path) as f:
+            model_config = json.loads(f.read_attr("/", "model_config"))
+            if model_config.get("class_name") != "Sequential":
+                raise InvalidKerasConfigurationException(
+                    "not a Sequential model; use import_keras_model_and_weights")
+            loss = _training_loss(f, enforce_training_config)
+            parse = _parse_sequential(model_config, loss)
+            conf = _build_mln_conf(parse)
+            net = MultiLayerNetwork(conf).init()
+            root = _weight_root(f)
+            klayers = _keras_layers(model_config)
+            for kl in klayers:
+                cfg = kl.get("config", {})
+                name = cfg.get("name")
+                if name not in parse.index_of:
+                    continue
+                idx = parse.index_of[name]
+                ws = _layer_weights(f, root, name)
+                _set_layer_params(kl["class_name"], cfg, net.params_list[idx],
+                                  net.state_list[idx], ws)
+        return net
+
+    @staticmethod
+    def import_keras_model_and_weights(path: str):
+        """Keras functional-API HDF5 archive -> initialized ComputationGraph
+        (reference importModelAndWeights:85). Merge -> MergeVertex (concat) or
+        ElementWiseVertex (sum/mul)."""
+        from deeplearning4j_tpu.nn.graph_network import ComputationGraph
+
+        with H5File(path) as f:
+            model_config = json.loads(f.read_attr("/", "model_config"))
+            if model_config.get("class_name") == "Sequential":
+                raise InvalidKerasConfigurationException(
+                    "Sequential model; use "
+                    "import_keras_sequential_model_and_weights")
+            loss = _training_loss(f, False)
+            conf, class_of, cfg_of = _build_graph_conf(model_config, loss)
+            net = ComputationGraph(conf).init()
+            root = _weight_root(f)
+            for name, cls in class_of.items():
+                if name not in net.params_list:
+                    continue
+                ws = _layer_weights(f, root, name)
+                _set_layer_params(cls, cfg_of[name], net.params_list[name],
+                                  net.state_list.get(name, {}), ws)
+        return net
+
+    @staticmethod
+    def import_keras_model_configuration(path_or_json: str):
+        """Model-config JSON (file path or raw string) -> configuration only
+        (reference importKerasModelConfiguration)."""
+        s = path_or_json
+        if not s.lstrip().startswith("{"):
+            with open(s) as fh:
+                s = fh.read()
+        model_config = json.loads(s)
+        if model_config.get("class_name") == "Sequential":
+            return _build_mln_conf(_parse_sequential(model_config, None))
+        return _build_graph_conf(model_config, None)[0]
+
+
+def _training_loss(f: H5File, enforce: bool) -> Optional[str]:
+    if not f.has_attr("/", "training_config"):
+        if enforce:
+            raise InvalidKerasConfigurationException(
+                "model has no training_config (was it compiled before "
+                "saving?)")
+        return None
+    tc = json.loads(f.read_attr("/", "training_config"))
+    kloss = tc.get("loss")
+    if isinstance(kloss, dict):
+        kloss = next(iter(kloss.values()), None)
+    if kloss is None:
+        return None
+    if kloss not in _LOSSES:
+        raise InvalidKerasConfigurationException(
+            f"unsupported Keras loss {kloss!r}")
+    return _LOSSES[kloss]
+
+
+def _build_mln_conf(parse: _SequentialParse):
+    lb = NeuralNetConfiguration.builder().list()
+    for lyr in parse.layers:
+        lb.layer(lyr)
+    if parse.input_type is not None:
+        lb.set_input_type(parse.input_type)
+    return lb.build()
+
+
+def _build_graph_conf(model_config: dict, loss: Optional[str]):
+    cfg = model_config["config"]
+    klayers = cfg["layers"]
+    output_names = {ol[0] for ol in cfg["output_layers"]}
+    gb = NeuralNetConfiguration.builder().graph_builder()
+    input_types = []
+    class_of: Dict[str, str] = {}
+    cfg_of: Dict[str, dict] = {}
+    for kl in klayers:
+        cls = kl["class_name"]
+        lcfg = kl.get("config", {})
+        name = lcfg.get("name")
+        class_of[name] = cls
+        cfg_of[name] = lcfg
+        inbound = [n[0] for node in kl.get("inbound_nodes", []) for n in node]
+        if cls == "InputLayer":
+            gb.add_inputs(name)
+            input_types.append(_input_type_from_shape(
+                lcfg["batch_input_shape"][1:],
+                lcfg.get("dim_ordering", "tf")))
+            continue
+        if cls == "Merge":
+            mode = lcfg.get("mode", "concat")
+            if mode == "concat":
+                gb.add_vertex(name, MergeVertex(), *inbound)
+            elif mode in ("sum", "ave", "mul", "max"):
+                op = {"sum": "add", "ave": "average", "mul": "product",
+                      "max": "max"}[mode]
+                gb.add_vertex(name, ElementWiseVertex(op=op), *inbound)
+            else:
+                raise InvalidKerasConfigurationException(
+                    f"unsupported Merge mode {mode!r}")
+            continue
+        lyr = _to_layer(cls, lcfg, last=name in output_names, loss=loss)
+        if lyr is None:
+            # shape-only (Flatten/Reshape): collapse onto the inbound name
+            class_of.pop(name)
+            # map consumers of this name to its input
+            for other in klayers:
+                for node in other.get("inbound_nodes", []):
+                    for n in node:
+                        if n[0] == name:
+                            n[0] = inbound[0]
+            continue
+        gb.add_layer(name, lyr, *inbound)
+    gb.set_outputs(*[ol[0] for ol in cfg["output_layers"]])
+    if input_types:
+        gb.set_input_types(*input_types)
+    return gb.build(), class_of, cfg_of

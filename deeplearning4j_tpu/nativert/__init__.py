@@ -251,6 +251,8 @@ class AsyncNativeLoader:
     def next(self) -> Optional[tuple]:
         """Next (features [B, F] f32, one-hot labels [B, C] f32), or None at
         end of epoch."""
+        if not self._h:
+            raise ValueError("loader is closed")
         x = np.empty((self.batch, self.feature_size), np.float32)
         y = np.empty((self.batch, self.num_classes), np.float32)
         ok = self._lib.dl4j_loader_next(
@@ -258,6 +260,8 @@ class AsyncNativeLoader:
         return (x, y) if ok else None
 
     def reset(self) -> None:
+        if not self._h:
+            raise ValueError("loader is closed")
         self._lib.dl4j_loader_reset(self._h)
 
     def __iter__(self):

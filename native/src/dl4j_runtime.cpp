@@ -230,15 +230,25 @@ CsvFile* csv_load(const char* path, char delim, int skip_lines) {
     if (line_no++ < skip_lines || eol == pos) { pos = eol + 1; continue; }
     int64_t ncol = 0;
     size_t p = pos;
-    while (p < eol) {
-      char* end = nullptr;
-      float v = std::strtof(buf.data() + p, &end);
-      if (end == buf.data() + p) { v = 0.0f; }  // non-numeric field -> 0
-      out->values.push_back(v);
-      ncol++;
+    while (true) {
       size_t next = buf.find(delim, p);
-      if (next == std::string::npos || next >= eol) break;
-      p = next + 1;
+      size_t fend = (next == std::string::npos || next >= eol) ? eol : next;
+      // Null-terminate the field in place so strtof can't scan past it
+      // (e.g. steal a number from the next line through the '\n').
+      float v = 0.0f;
+      if (fend > p) {
+        char saved = '\0';
+        bool restore = fend < buf.size();
+        if (restore) { saved = buf[fend]; buf[fend] = '\0'; }
+        char* end = nullptr;
+        v = std::strtof(buf.data() + p, &end);
+        if (end == buf.data() + p) v = 0.0f;  // non-numeric field -> 0
+        if (restore) buf[fend] = saved;
+      }
+      out->values.push_back(v);  // empty field (incl. trailing delim) -> 0
+      ncol++;
+      if (fend == eol) break;
+      p = fend + 1;
     }
     if (out->cols == 0) out->cols = ncol;
     if (ncol < out->cols) {  // ragged short row: pad with zeros

@@ -1,0 +1,243 @@
+"""Keras 1.x HDF5 import: fixtures are written with the framework's own
+libhdf5 ctypes binding in the exact archive layout Keras 1 produces
+(model_config/training_config root attrs, model_weights group with
+layer_names/weight_names attrs)."""
+import json
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.modelimport.hdf5 import H5File, hdf5_available
+from deeplearning4j_tpu.modelimport.keras_import import (
+    InvalidKerasConfigurationException, KerasModelImport,
+)
+
+pytestmark = pytest.mark.skipif(not hdf5_available(),
+                                reason="libhdf5 not present")
+
+
+def _write_archive(path, model_config, weights, training_config=None):
+    """weights: {layer_name: [(weight_name, array), ...]}"""
+    with H5File(str(path), "w") as f:
+        f.write_attr("/", "model_config", json.dumps(model_config))
+        if training_config is not None:
+            f.write_attr("/", "training_config", json.dumps(training_config))
+        f.create_group("/model_weights")
+        f.write_attr("/model_weights", "layer_names", list(weights))
+        for lname, ws in weights.items():
+            f.create_group(f"/model_weights/{lname}")
+            f.write_attr(f"/model_weights/{lname}", "weight_names",
+                         [wn for wn, _ in ws])
+            for wn, arr in ws:
+                f.write_dataset(f"/model_weights/{lname}/{wn}", arr)
+
+
+def _seq(layers):
+    return {"class_name": "Sequential",
+            "config": [{"class_name": c, "config": cfg}
+                       for c, cfg in layers]}
+
+
+def test_dense_sequential_forward_matches_numpy(tmp_path):
+    rng = np.random.default_rng(0)
+    w1 = rng.normal(size=(4, 8)).astype(np.float32)
+    b1 = rng.normal(size=(8,)).astype(np.float32)
+    w2 = rng.normal(size=(8, 3)).astype(np.float32)
+    b2 = rng.normal(size=(3,)).astype(np.float32)
+    mc = _seq([
+        ("Dense", {"name": "dense_1", "output_dim": 8, "activation": "relu",
+                   "batch_input_shape": [None, 4]}),
+        ("Dense", {"name": "dense_2", "output_dim": 3,
+                   "activation": "softmax"}),
+    ])
+    p = tmp_path / "m.h5"
+    _write_archive(p, mc, {
+        "dense_1": [("dense_1_W", w1), ("dense_1_b", b1)],
+        "dense_2": [("dense_2_W", w2), ("dense_2_b", b2)],
+    }, training_config={"loss": "categorical_crossentropy"})
+
+    net = KerasModelImport.import_keras_sequential_model_and_weights(str(p))
+    x = rng.normal(size=(5, 4)).astype(np.float32)
+    got = np.asarray(net.output(x))
+    h = np.maximum(x @ w1 + b1, 0)
+    z = h @ w2 + b2
+    e = np.exp(z - z.max(axis=1, keepdims=True))
+    expect = e / e.sum(axis=1, keepdims=True)
+    np.testing.assert_allclose(got, expect, rtol=1e-5, atol=1e-6)
+
+
+def test_dense_plus_activation_folds_to_output_layer(tmp_path):
+    rng = np.random.default_rng(1)
+    w = rng.normal(size=(4, 3)).astype(np.float32)
+    b = np.zeros(3, np.float32)
+    mc = _seq([
+        ("Dense", {"name": "dense_1", "output_dim": 3,
+                   "activation": "linear", "batch_input_shape": [None, 4]}),
+        ("Activation", {"name": "activation_1", "activation": "softmax"}),
+    ])
+    p = tmp_path / "m.h5"
+    _write_archive(p, mc, {"dense_1": [("dense_1_W", w), ("dense_1_b", b)]})
+    net = KerasModelImport.import_keras_sequential_model_and_weights(str(p))
+    assert net.conf.n_layers == 1
+    assert type(net.conf.layers[0]).__name__ == "OutputLayer"
+    out = np.asarray(net.output(rng.normal(size=(2, 4)).astype(np.float32)))
+    np.testing.assert_allclose(out.sum(axis=1), 1.0, rtol=1e-5)
+
+
+def test_conv_th_ordering_transposed(tmp_path):
+    rng = np.random.default_rng(2)
+    # th kernel layout: (nb_filter, stack, rows, cols)
+    w = rng.normal(size=(2, 1, 3, 3)).astype(np.float32)
+    b = np.zeros(2, np.float32)
+    wd = rng.normal(size=(2 * 13 * 13, 5)).astype(np.float32)
+    bd = np.zeros(5, np.float32)
+    mc = _seq([
+        ("Convolution2D", {"name": "conv_1", "nb_filter": 2, "nb_row": 3,
+                           "nb_col": 3, "dim_ordering": "th",
+                           "activation": "relu", "border_mode": "valid",
+                           "batch_input_shape": [None, 1, 28, 28]}),
+        ("MaxPooling2D", {"name": "pool_1", "pool_size": [2, 2],
+                          "dim_ordering": "th"}),
+        ("Flatten", {"name": "flat_1"}),
+        ("Dense", {"name": "dense_1", "output_dim": 5,
+                   "activation": "softmax"}),
+    ])
+    p = tmp_path / "m.h5"
+    _write_archive(p, mc, {
+        "conv_1": [("conv_1_W", w), ("conv_1_b", b)],
+        "dense_1": [("dense_1_W", wd), ("dense_1_b", bd)],
+    }, training_config={"loss": "categorical_crossentropy"})
+    net = KerasModelImport.import_keras_sequential_model_and_weights(str(p))
+    # kernel must land as HWIO = transpose(2,3,1,0) of the th layout
+    np.testing.assert_allclose(np.asarray(net.params_list[0]["W"]),
+                               np.transpose(w, (2, 3, 1, 0)))
+    out = net.output(rng.normal(size=(2, 28, 28, 1)).astype(np.float32))
+    assert out.shape == (2, 5)
+
+
+def test_lstm_weight_fusion(tmp_path):
+    rng = np.random.default_rng(3)
+    n_in, h = 6, 4
+    gates = {g: (rng.normal(size=(n_in, h)).astype(np.float32),
+                 rng.normal(size=(h, h)).astype(np.float32),
+                 rng.normal(size=(h,)).astype(np.float32))
+             for g in "icfo"}
+    ws = []
+    for g in "icfo":  # Keras 1 serialization order: i, c, f, o
+        W, U, b = gates[g]
+        ws += [(f"lstm_1_W_{g}", W), (f"lstm_1_U_{g}", U),
+               (f"lstm_1_b_{g}", b)]
+    wd = rng.normal(size=(h, 2)).astype(np.float32)
+    mc = _seq([
+        ("LSTM", {"name": "lstm_1", "output_dim": h, "activation": "tanh",
+                  "inner_activation": "sigmoid", "return_sequences": True,
+                  "batch_input_shape": [None, 7, n_in]}),
+        ("TimeDistributedDense", {"name": "td_1", "output_dim": 2,
+                                  "activation": "softmax"}),
+    ])
+    p = tmp_path / "m.h5"
+    _write_archive(p, mc, {
+        "lstm_1": ws,
+        "td_1": [("td_1_W", wd), ("td_1_b", np.zeros(2, np.float32))],
+    }, training_config={"loss": "categorical_crossentropy"})
+    net = KerasModelImport.import_keras_sequential_model_and_weights(str(p))
+    # our gate order: i, f, c(g), o
+    expect_W = np.concatenate([gates["i"][0], gates["f"][0], gates["c"][0],
+                               gates["o"][0]], axis=1)
+    expect_RW = np.concatenate([gates["i"][1], gates["f"][1], gates["c"][1],
+                                gates["o"][1]], axis=1)
+    np.testing.assert_allclose(np.asarray(net.params_list[0]["W"]), expect_W)
+    np.testing.assert_allclose(np.asarray(net.params_list[0]["RW"]), expect_RW)
+    out = net.output(rng.normal(size=(2, 7, n_in)).astype(np.float32))
+    assert out.shape == (2, 7, 2)
+    assert np.all(np.isfinite(np.asarray(out)))
+
+
+def test_batchnorm_state_mapping(tmp_path):
+    rng = np.random.default_rng(4)
+    gamma = rng.normal(size=(4,)).astype(np.float32)
+    beta = rng.normal(size=(4,)).astype(np.float32)
+    mean = rng.normal(size=(4,)).astype(np.float32)
+    var = np.abs(rng.normal(size=(4,))).astype(np.float32)
+    wd = rng.normal(size=(4, 2)).astype(np.float32)
+    mc = _seq([
+        ("BatchNormalization", {"name": "bn_1", "epsilon": 1e-3,
+                                "momentum": 0.95,
+                                "batch_input_shape": [None, 4]}),
+        ("Dense", {"name": "dense_1", "output_dim": 2,
+                   "activation": "softmax"}),
+    ])
+    p = tmp_path / "m.h5"
+    _write_archive(p, mc, {
+        "bn_1": [("bn_1_gamma", gamma), ("bn_1_beta", beta),
+                 ("bn_1_running_mean", mean), ("bn_1_running_std", var)],
+        "dense_1": [("dense_1_W", wd), ("dense_1_b", np.zeros(2, np.float32))],
+    }, training_config={"loss": "categorical_crossentropy"})
+    net = KerasModelImport.import_keras_sequential_model_and_weights(str(p))
+    np.testing.assert_allclose(np.asarray(net.params_list[0]["gamma"]), gamma)
+    np.testing.assert_allclose(np.asarray(net.state_list[0]["mean"]), mean)
+    np.testing.assert_allclose(np.asarray(net.state_list[0]["var"]), var)
+    # inference uses imported running stats
+    x = rng.normal(size=(3, 4)).astype(np.float32)
+    out = np.asarray(net.feed_forward(x)[0])
+    expect = gamma * (x - mean) / np.sqrt(var + 1e-3) + beta
+    np.testing.assert_allclose(out, expect, rtol=1e-4, atol=1e-5)
+
+
+def test_functional_merge_model(tmp_path):
+    rng = np.random.default_rng(5)
+    wa = rng.normal(size=(3, 4)).astype(np.float32)
+    wb = rng.normal(size=(5, 4)).astype(np.float32)
+    wo = rng.normal(size=(8, 2)).astype(np.float32)
+    mc = {"class_name": "Model", "config": {
+        "name": "model_1",
+        "layers": [
+            {"class_name": "InputLayer", "config": {
+                "name": "in_a", "batch_input_shape": [None, 3]},
+             "inbound_nodes": []},
+            {"class_name": "InputLayer", "config": {
+                "name": "in_b", "batch_input_shape": [None, 5]},
+             "inbound_nodes": []},
+            {"class_name": "Dense", "config": {
+                "name": "da", "output_dim": 4, "activation": "relu"},
+             "inbound_nodes": [[["in_a", 0, 0]]]},
+            {"class_name": "Dense", "config": {
+                "name": "db", "output_dim": 4, "activation": "relu"},
+             "inbound_nodes": [[["in_b", 0, 0]]]},
+            {"class_name": "Merge", "config": {
+                "name": "merge_1", "mode": "concat"},
+             "inbound_nodes": [[["da", 0, 0], ["db", 0, 0]]]},
+            {"class_name": "Dense", "config": {
+                "name": "out", "output_dim": 2, "activation": "softmax"},
+             "inbound_nodes": [[["merge_1", 0, 0]]]},
+        ],
+        "input_layers": [["in_a", 0, 0], ["in_b", 0, 0]],
+        "output_layers": [["out", 0, 0]],
+    }}
+    p = tmp_path / "m.h5"
+    _write_archive(p, mc, {
+        "da": [("da_W", wa), ("da_b", np.zeros(4, np.float32))],
+        "db": [("db_W", wb), ("db_b", np.zeros(4, np.float32))],
+        "out": [("out_W", wo), ("out_b", np.zeros(2, np.float32))],
+    }, training_config={"loss": "categorical_crossentropy"})
+    net = KerasModelImport.import_keras_model_and_weights(str(p))
+    xa = rng.normal(size=(6, 3)).astype(np.float32)
+    xb = rng.normal(size=(6, 5)).astype(np.float32)
+    out = np.asarray(net.output(xa, xb)[0])
+    ha = np.maximum(xa @ wa, 0)
+    hb = np.maximum(xb @ wb, 0)
+    z = np.concatenate([ha, hb], axis=1) @ wo
+    e = np.exp(z - z.max(axis=1, keepdims=True))
+    np.testing.assert_allclose(out, e / e.sum(axis=1, keepdims=True),
+                               rtol=1e-4, atol=1e-6)
+
+
+def test_config_only_import_and_unsupported_layer():
+    mc = _seq([("Dense", {"name": "d", "output_dim": 3,
+                          "activation": "softmax",
+                          "batch_input_shape": [None, 4]})])
+    conf = KerasModelImport.import_keras_model_configuration(json.dumps(mc))
+    assert conf.n_layers == 1
+    bad = _seq([("LocallyConnected2D", {"name": "x"})])
+    with pytest.raises(InvalidKerasConfigurationException):
+        KerasModelImport.import_keras_model_configuration(json.dumps(bad))

@@ -1,0 +1,101 @@
+"""Keras gateway server: HDF5 minibatch iterator + fit/evaluate/predict over
+the JSON-lines TCP gateway (reference deeplearning4j-keras module)."""
+import json
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.modelimport.hdf5 import H5File, hdf5_available
+from deeplearning4j_tpu.keras_server import (
+    DeepLearning4jEntryPoint, HDF5MiniBatchDataSetIterator, Server, call,
+)
+
+pytestmark = pytest.mark.skipif(not hdf5_available(),
+                                reason="libhdf5 not present")
+
+
+def _model_archive(path):
+    rng = np.random.default_rng(0)
+    mc = {"class_name": "Sequential", "config": [
+        {"class_name": "Dense", "config": {
+            "name": "dense_1", "output_dim": 8, "activation": "relu",
+            "batch_input_shape": [None, 4]}},
+        {"class_name": "Dense", "config": {
+            "name": "dense_2", "output_dim": 3, "activation": "softmax"}},
+    ]}
+    with H5File(str(path), "w") as f:
+        f.write_attr("/", "model_config", json.dumps(mc))
+        f.write_attr("/", "training_config",
+                     json.dumps({"loss": "categorical_crossentropy"}))
+        f.create_group("/model_weights")
+        f.write_attr("/model_weights", "layer_names", ["dense_1", "dense_2"])
+        for lname, shape in [("dense_1", (4, 8)), ("dense_2", (8, 3))]:
+            f.create_group(f"/model_weights/{lname}")
+            f.write_attr(f"/model_weights/{lname}", "weight_names",
+                         [f"{lname}_W", f"{lname}_b"])
+            f.write_dataset(f"/model_weights/{lname}/{lname}_W",
+                            rng.normal(0, 0.3, shape).astype(np.float32))
+            f.write_dataset(f"/model_weights/{lname}/{lname}_b",
+                            np.zeros(shape[1], np.float32))
+
+
+def _batches(tmp_path):
+    # separable 3-class data in 4-D
+    rng = np.random.default_rng(1)
+    xdir, ydir = tmp_path / "x", tmp_path / "y"
+    xdir.mkdir(), ydir.mkdir()
+    for b in range(4):
+        labels = rng.integers(0, 3, 32)
+        x = rng.normal(0, 0.3, (32, 4)).astype(np.float32)
+        x[np.arange(32), labels] += 2.0
+        y = np.eye(3, dtype=np.float32)[labels]
+        with H5File(str(xdir / f"{b}.h5"), "w") as f:
+            f.write_dataset("/data", x)
+        with H5File(str(ydir / f"{b}.h5"), "w") as f:
+            f.write_dataset("/data", y)
+    return str(xdir), str(ydir)
+
+
+def test_minibatch_iterator_orders_numerically(tmp_path):
+    d = tmp_path / "b"
+    d.mkdir()
+    for i in [10, 2, 0]:
+        with H5File(str(d / f"{i}.h5"), "w") as f:
+            f.write_dataset("/data", np.full((2, 2), i, np.float32))
+    it = HDF5MiniBatchDataSetIterator(str(d))
+    vals = [int(a[0, 0]) for a in it]
+    assert vals == [0, 2, 10]
+
+
+def test_entry_point_fit_and_evaluate(tmp_path):
+    model = tmp_path / "model.h5"
+    _model_archive(model)
+    xdir, ydir = _batches(tmp_path)
+    ep = DeepLearning4jEntryPoint()
+    r = ep.fit(str(model), nb_epoch=12, train_features_directory=xdir,
+               train_labels_directory=ydir)
+    assert r["batches"] == 4
+    ev = ep.evaluate(str(model), xdir, ydir)
+    assert ev["accuracy"] > 0.8
+
+
+def test_gateway_over_tcp(tmp_path):
+    model = tmp_path / "model.h5"
+    _model_archive(model)
+    xdir, ydir = _batches(tmp_path)
+    srv = Server().start()
+    try:
+        r = call("127.0.0.1", srv.port, "fit", model_file_path=str(model),
+                 nb_epoch=3, train_features_directory=xdir,
+                 train_labels_directory=ydir)
+        assert r["epochs"] == 3
+        p = call("127.0.0.1", srv.port, "predict",
+                 model_file_path=str(model),
+                 features=[[2.0, 0.0, 0.0, 0.0]])
+        assert len(p["predictions"][0]) == 3
+        with pytest.raises(RuntimeError):
+            call("127.0.0.1", srv.port, "fit", model_file_path="/nope.h5",
+                 nb_epoch=1, train_features_directory=xdir,
+                 train_labels_directory=ydir)
+    finally:
+        srv.stop()

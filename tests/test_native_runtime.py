@@ -139,3 +139,25 @@ def test_stats_codec_decode_roundtrip():
     assert d.iteration == 42 and d.score == 0.125
     assert d.param_stats["layer0_W"] == (0.5, [1, 2, 3, 4], (-1.0, 1.0))
     assert d.update_stats["layer0_b"] == (0.001, [7], (0.0, 0.002))
+
+
+def test_csv_trailing_delim_and_whitespace_fields(tmp_path):
+    p = tmp_path / "e.csv"
+    p.write_text("1,2,\n4,5,6\n")   # trailing empty field on row 1
+    out = nativert.read_csv_numeric(str(p))
+    np.testing.assert_allclose(out, [[1, 2, 0], [4, 5, 6]])
+    p2 = tmp_path / "w.csv"
+    p2.write_text("1, \n2,3\n")     # whitespace field must not eat next line
+    out2 = nativert.read_csv_numeric(str(p2))
+    np.testing.assert_allclose(out2, [[1, 0], [2, 3]])
+
+
+def test_loader_use_after_close_raises():
+    feats = np.zeros((4, 2), np.uint8)
+    ld = nativert.AsyncNativeLoader.from_arrays(
+        feats, np.zeros(4, np.uint8), 2, 2, shuffle=False)
+    ld.close()
+    with pytest.raises(ValueError):
+        ld.next()
+    with pytest.raises(ValueError):
+        ld.reset()
