@@ -1,0 +1,99 @@
+"""Cloud storage + provisioning equivalents.
+
+Reference: deeplearning4j-aws (SURVEY.md §2.4) — S3Uploader/S3Downloader for
+artifact transfer and Ec2BoxCreator for box provisioning. The TPU-native
+equivalents keep the same SPI shapes: a ``StorageProvider`` with a local-
+filesystem backend (always available; object-store backends plug in behind
+the same interface but are gated — this image has zero egress), and a
+``TpuProvisioner`` that renders the accelerator-pool request the way
+Ec2BoxCreator rendered EC2 run-instance requests.
+"""
+from __future__ import annotations
+
+import dataclasses
+import shutil
+from pathlib import Path
+from typing import List, Optional
+
+
+class StorageProvider:
+    """Artifact up/download SPI (reference S3Uploader/S3Downloader)."""
+
+    def upload(self, local_path: str, remote_path: str) -> str:
+        raise NotImplementedError
+
+    def download(self, remote_path: str, local_path: str) -> str:
+        raise NotImplementedError
+
+    def list(self, remote_prefix: str) -> List[str]:
+        raise NotImplementedError
+
+
+class LocalFileSystemProvider(StorageProvider):
+    """Filesystem-backed store (the always-available backend; doubles as the
+    mount-point backend for NFS/GCS-FUSE style deployments)."""
+
+    def __init__(self, root: str):
+        self.root = Path(root)
+        self.root.mkdir(parents=True, exist_ok=True)
+
+    def _resolve(self, remote_path: str) -> Path:
+        p = (self.root / remote_path.lstrip("/")).resolve()
+        if not p.is_relative_to(self.root.resolve()):
+            raise ValueError(f"remote path escapes store root: {remote_path}")
+        return p
+
+    def upload(self, local_path: str, remote_path: str) -> str:
+        dst = self._resolve(remote_path)
+        dst.parent.mkdir(parents=True, exist_ok=True)
+        shutil.copy2(local_path, dst)
+        return str(dst)
+
+    def download(self, remote_path: str, local_path: str) -> str:
+        src = self._resolve(remote_path)
+        Path(local_path).parent.mkdir(parents=True, exist_ok=True)
+        shutil.copy2(src, local_path)
+        return local_path
+
+    def list(self, remote_prefix: str = "") -> List[str]:
+        base = self._resolve(remote_prefix) if remote_prefix else self.root
+        if not base.exists():
+            return []
+        return sorted(str(p.relative_to(self.root))
+                      for p in base.rglob("*") if p.is_file())
+
+
+class S3Provider(StorageProvider):
+    """Gated object-store backend (reference S3Uploader/S3Downloader). This
+    image has no egress and no boto3; constructing raises with instructions
+    rather than failing at first use."""
+
+    def __init__(self, bucket: str):
+        raise RuntimeError(
+            "S3/object-store transfer requires network egress and an S3 "
+            "client, neither of which is available in this environment. Use "
+            "LocalFileSystemProvider against a mounted path, or deploy with "
+            f"an object-store client to reach bucket {bucket!r}.")
+
+
+@dataclasses.dataclass
+class TpuProvisioner:
+    """Accelerator-pool request builder (reference aws Ec2BoxCreator renders
+    EC2 RunInstances; the TPU equivalent renders a queued-resource request).
+    ``render()`` produces the request dict a deployment tool would submit."""
+
+    accelerator_type: str = "v5litepod-16"
+    runtime_version: str = "tpu-ubuntu2204-base"
+    zone: str = "us-central1-a"
+    num_slices: int = 1
+    preemptible: bool = False
+
+    def render(self, name: str) -> dict:
+        return {
+            "name": name,
+            "accelerator_type": self.accelerator_type,
+            "runtime_version": self.runtime_version,
+            "zone": self.zone,
+            "num_slices": self.num_slices,
+            "spot": self.preemptible,
+        }

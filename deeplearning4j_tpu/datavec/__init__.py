@@ -1,0 +1,16 @@
+"""DataVec bridge: record readers + record->DataSet iterators (SURVEY.md §2.2)."""
+from deeplearning4j_tpu.datavec.records import (
+    CollectionRecordReader, CSVRecordReader, CSVSequenceRecordReader,
+    ImageRecordReader, LineRecordReader, RecordReader,
+)
+from deeplearning4j_tpu.datavec.iterators import (
+    RecordReaderDataSetIterator, RecordReaderMultiDataSetIterator,
+    SequenceRecordReaderDataSetIterator,
+)
+
+__all__ = [
+    "CollectionRecordReader", "CSVRecordReader", "CSVSequenceRecordReader",
+    "ImageRecordReader", "LineRecordReader", "RecordReader",
+    "RecordReaderDataSetIterator", "RecordReaderMultiDataSetIterator",
+    "SequenceRecordReaderDataSetIterator",
+]

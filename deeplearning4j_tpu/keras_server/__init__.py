@@ -87,6 +87,7 @@ class DeepLearning4jEntryPoint:
             from deeplearning4j_tpu.modelimport.keras_import import KerasModelImport
             net = KerasModelImport.import_keras_sequential_model_and_weights(
                 model_file_path)
+            self._models[model_file_path] = net
         xs = HDF5MiniBatchDataSetIterator(features_directory)
         ys = HDF5MiniBatchDataSetIterator(labels_directory)
         correct = total = 0
@@ -108,6 +109,9 @@ class DeepLearning4jEntryPoint:
         return {"predictions": np.asarray(out).tolist()}
 
 
+_RPC_METHODS = frozenset({"fit", "evaluate", "predict"})
+
+
 class _Handler(socketserver.StreamRequestHandler):
     def handle(self):
         for line in self.rfile:
@@ -116,7 +120,11 @@ class _Handler(socketserver.StreamRequestHandler):
                 continue
             try:
                 req = json.loads(line)
-                method = getattr(self.server.entry_point, req["method"])
+                name = req["method"]
+                if name not in _RPC_METHODS:
+                    raise ValueError(f"unknown method {name!r} "
+                                     f"(allowed: {sorted(_RPC_METHODS)})")
+                method = getattr(self.server.entry_point, name)
                 result = method(**req.get("params", {}))
                 resp = {"ok": True, "result": result}
             except Exception as e:  # report, keep serving

@@ -126,6 +126,7 @@ def _load() -> ctypes.CDLL:
     sig("H5Sget_simple_extent_npoints", ctypes.c_int64, [hid_t])
     sig("H5Sclose", herr_t, [hid_t])
     sig("H5Aexists", htri_t, [hid_t, ctypes.c_char_p])
+    sig("H5Adelete", herr_t, [hid_t, ctypes.c_char_p])
     sig("H5Aopen", hid_t, [hid_t, ctypes.c_char_p, hid_t])
     sig("H5Acreate2", hid_t, [hid_t, ctypes.c_char_p, hid_t, hid_t, hid_t, hid_t])
     sig("H5Aget_type", hid_t, [hid_t])
@@ -150,7 +151,8 @@ def _load() -> ctypes.CDLL:
         ("c_s1", "H5T_C_S1_g"),
         ("f32", "H5T_NATIVE_FLOAT_g"), ("f64", "H5T_NATIVE_DOUBLE_g"),
         ("i8", "H5T_NATIVE_SCHAR_g"), ("u8", "H5T_NATIVE_UCHAR_g"),
-        ("i16", "H5T_NATIVE_SHORT_g"), ("i32", "H5T_NATIVE_INT_g"),
+        ("i16", "H5T_NATIVE_SHORT_g"), ("u16", "H5T_NATIVE_USHORT_g"),
+        ("i32", "H5T_NATIVE_INT_g"), ("u32", "H5T_NATIVE_UINT_g"),
         ("i64", "H5T_NATIVE_LLONG_g"), ("u64", "H5T_NATIVE_ULLONG_g"),
     ]:
         _types[pyname] = hid_t.in_dll(lib, gname).value
@@ -169,7 +171,8 @@ def hdf5_available() -> bool:
 _NP_TO_H5 = {
     np.dtype(np.float32): "f32", np.dtype(np.float64): "f64",
     np.dtype(np.int8): "i8", np.dtype(np.uint8): "u8",
-    np.dtype(np.int16): "i16", np.dtype(np.int32): "i32",
+    np.dtype(np.int16): "i16", np.dtype(np.uint16): "u16",
+    np.dtype(np.int32): "i32", np.dtype(np.uint32): "u32",
     np.dtype(np.int64): "i64", np.dtype(np.uint64): "u64",
 }
 
@@ -177,12 +180,18 @@ _NP_TO_H5 = {
 def _native_np_dtype(lib, type_id) -> np.dtype:
     cls = lib.H5Tget_class(type_id)
     size = lib.H5Tget_size(type_id)
-    if cls == H5T_FLOAT:
-        return np.dtype(np.float64 if size == 8 else np.float32)
-    if cls == H5T_INTEGER:
+    if cls == H5T_FLOAT and size in (4, 8):
+        dt = np.dtype(np.float64 if size == 8 else np.float32)
+    elif cls == H5T_INTEGER and size in (1, 2, 4, 8):
         unsigned = lib.H5Tget_sign(type_id) == H5T_SGN_NONE
-        return np.dtype(f"{'u' if unsigned else 'i'}{size}")
-    raise ValueError(f"unsupported HDF5 dataset class {cls}")
+        dt = np.dtype(f"{'u' if unsigned else 'i'}{size}")
+    else:
+        raise ValueError(
+            f"unsupported HDF5 type (class {cls}, {size} bytes) — supported: "
+            "f32/f64 and 1/2/4/8-byte integers")
+    if dt not in _NP_TO_H5:
+        raise ValueError(f"unsupported HDF5-mapped dtype {dt}")
+    return dt
 
 
 class H5File:
@@ -396,6 +405,9 @@ class H5File:
         if oid < 0:
             raise KeyError(f"no such object: {obj_path}")
         try:
+            # overwrite semantics: replace an existing attribute
+            if lib.H5Aexists(oid, name.encode()) > 0:
+                lib.H5Adelete(oid, name.encode())
             if isinstance(value, str):
                 value = [value]
                 scalar = True
@@ -410,9 +422,14 @@ class H5File:
                 tid = _types[_NP_TO_H5[arr.dtype]]
                 aid = lib.H5Acreate2(oid, name.encode(), tid, sid, H5P_DEFAULT,
                                      H5P_DEFAULT)
-                lib.H5Awrite(aid, tid, arr.ctypes.data_as(ctypes.c_void_p))
-                lib.H5Aclose(aid)
+                ok = aid >= 0 and lib.H5Awrite(
+                    aid, tid, arr.ctypes.data_as(ctypes.c_void_p)) >= 0
+                if aid >= 0:
+                    lib.H5Aclose(aid)
                 lib.H5Sclose(sid)
+                if not ok:
+                    raise OSError(f"cannot write attribute {name!r} on "
+                                  f"{obj_path}")
                 return
             enc = [v.encode() for v in value]
             size = max(max((len(e) for e in enc), default=0) + 1, 1)
@@ -426,9 +443,13 @@ class H5File:
                 sid = lib.H5Screate_simple(1, dims, None)
             aid = lib.H5Acreate2(oid, name.encode(), mem, sid, H5P_DEFAULT,
                                  H5P_DEFAULT)
-            lib.H5Awrite(aid, mem, ctypes.c_char_p(buf))
-            lib.H5Aclose(aid)
+            ok = aid >= 0 and lib.H5Awrite(aid, mem,
+                                           ctypes.c_char_p(buf)) >= 0
+            if aid >= 0:
+                lib.H5Aclose(aid)
             lib.H5Sclose(sid)
             lib.H5Tclose(mem)
+            if not ok:
+                raise OSError(f"cannot write attribute {name!r} on {obj_path}")
         finally:
             lib.H5Oclose(oid)

@@ -246,8 +246,7 @@ def _convert_lstm(ws: List[np.ndarray]) -> Dict[str, np.ndarray]:
 
 
 def _convert_conv(w: np.ndarray, dim_ordering: str) -> np.ndarray:
-    if dim_ordering == "th" or (w.ndim == 4 and w.shape[2] > w.shape[0]
-                                and dim_ordering == "auto"):
+    if w.ndim == 4 and dim_ordering == "th":
         # (nb_filter, stack, rows, cols) -> (rows, cols, stack, nb_filter)
         return np.transpose(w, (2, 3, 1, 0))
     return w  # tf ordering == HWIO already
@@ -264,8 +263,10 @@ def _set_layer_params(cls: str, cfg: dict, params: dict, state: dict,
         elif "b" in params:
             params["b"] = jnp.zeros_like(params["b"])
     elif cls == "Convolution2D":
+        # default must agree with _input_type_from_shape's default ("tf") so
+        # a config missing the key gets one consistent interpretation
         params["W"] = jnp.asarray(
-            _convert_conv(ws[0], cfg.get("dim_ordering", "th")), jnp.float32)
+            _convert_conv(ws[0], cfg.get("dim_ordering", "tf")), jnp.float32)
         if len(ws) > 1:
             params["b"] = jnp.asarray(ws[1], jnp.float32)
     elif cls == "LSTM":

@@ -1,0 +1,98 @@
+"""Japanese/Korean tokenizer factories + stopwords + moving window.
+
+Reference: deeplearning4j-nlp-japanese (a bundled kuromoji fork, 6.9k LoC) and
+deeplearning4j-nlp-korean (SURVEY.md §2.5), plus StopWords and the
+moving-window iterator in deeplearning4j-nlp text/.
+
+The reference ships dictionary-based morphological analyzers; this image has
+no such dictionaries, so these tokenizers are script-aware segmenters: they
+split on Unicode-script boundaries (kanji/hiragana/katakana/latin runs for
+Japanese; hangul syllable runs + common particle stripping for Korean). The
+TokenizerFactory seam is identical, so a dictionary-backed implementation can
+replace them without touching callers.
+"""
+from __future__ import annotations
+
+import re
+from typing import Iterator, List, Sequence
+
+from deeplearning4j_tpu.nlp.tokenization import Tokenizer, TokenizerFactory
+
+# Common English stopwords (reference stopwords resource file)
+STOP_WORDS = frozenset("""a an and are as at be but by for if in into is it no
+not of on or such that the their then there these they this to was will with
+he she his her him i me my we our you your had has have were been being do
+does did so than too very can could should would may might must shall
+""".split())
+
+
+class StopWords:
+    """Reference org.deeplearning4j.text.stopwords.StopWords."""
+
+    @staticmethod
+    def get_stop_words() -> List[str]:
+        return sorted(STOP_WORDS)
+
+    @staticmethod
+    def is_stop_word(w: str) -> bool:
+        return w.lower() in STOP_WORDS
+
+
+_JA_RUNS = re.compile(
+    "([一-鿿]+"      # kanji
+    "|[぀-ゟ]+"      # hiragana
+    "|[゠-ヿー]+"  # katakana
+    "|[A-Za-z0-9]+"
+    "|[^一-鿿぀-ゟ゠-ヿーA-Za-z0-9\\s]+)")
+
+
+class JapaneseTokenizerFactory(TokenizerFactory):
+    """Script-run segmentation for Japanese text (kuromoji-seam equivalent).
+
+    Adjacent runs of the same script class become one token; trailing
+    hiragana after a kanji run (okurigana/particles) stays separate, which
+    approximates bunsetsu boundaries well enough for embedding pipelines."""
+
+    def create(self, text: str) -> Tokenizer:
+        tokens = [m.group(0) for m in _JA_RUNS.finditer(text)]
+        return Tokenizer(self._apply_pre(tokens))
+
+
+_KO_PARTICLES = ("은", "는", "이", "가", "을", "를", "에", "의", "로", "과",
+                 "와", "도", "만", "에서", "까지", "부터", "하고")
+_KO_RUNS = re.compile("([가-힯]+|[A-Za-z0-9]+|[^가-힯"
+                      "A-Za-z0-9\\s]+)")
+
+
+class KoreanTokenizerFactory(TokenizerFactory):
+    """Hangul-run segmentation with common particle stripping (open-korean-
+    text-seam equivalent)."""
+
+    def __init__(self, strip_particles: bool = True):
+        super().__init__()
+        self.strip_particles = strip_particles
+
+    def create(self, text: str) -> Tokenizer:
+        tokens = []
+        for m in _KO_RUNS.finditer(text):
+            tok = m.group(0)
+            if self.strip_particles and len(tok) > 1:
+                for p in sorted(_KO_PARTICLES, key=len, reverse=True):
+                    if tok.endswith(p) and len(tok) > len(p):
+                        tok = tok[: -len(p)]
+                        break
+            tokens.append(tok)
+        return Tokenizer(self._apply_pre(tokens))
+
+
+class Windows:
+    """Moving context windows over a token sequence (reference
+    text/movingwindow/Windows.java): fixed-size windows centered on each
+    token, padded with <s>/</s> edge markers."""
+
+    @staticmethod
+    def windows(tokens: Sequence[str], window_size: int = 5) -> Iterator[List[str]]:
+        half = window_size // 2
+        padded = ["<s>"] * half + list(tokens) + ["</s>"] * half
+        for i in range(len(tokens)):
+            yield padded[i:i + 2 * half + 1]

@@ -1,0 +1,100 @@
+"""Streaming routes: online training and model serving over queues.
+
+Reference: dl4j-streaming (SURVEY.md §2.4) — Camel+Kafka routes feeding
+online training/serving (`CamelKafkaRouteBuilder`, `DL4jServeRouteBuilder`).
+The TPU-native equivalent keeps the route abstraction but replaces the
+Camel/Kafka transport with in-process bounded queues: a ``Route`` consumes
+messages on a background thread and hands them to the model. A Kafka-style
+broker maps onto the same ``Route`` API by replacing the queue with a
+consumer poll loop — the seam is `source.get()`.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Any, Callable, List, Optional
+
+import numpy as np
+
+
+class Route:
+    """A consume loop on a background thread (reference Camel route)."""
+
+    def __init__(self, source: "queue.Queue", handler: Callable[[Any], None]):
+        self.source = source
+        self.handler = handler
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.processed = 0
+        self.errors: List[str] = []
+
+    def start(self) -> "Route":
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                msg = self.source.get(timeout=0.05)
+            except queue.Empty:
+                continue
+            try:
+                self.handler(msg)
+                self.processed += 1
+            except Exception as e:  # route keeps consuming
+                self.errors.append(f"{type(e).__name__}: {e}")
+            finally:
+                self.source.task_done()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=30)
+
+    def drain(self, timeout: float = 30.0) -> None:
+        """Block until every queued message has been fully handled (not just
+        popped — uses the queue's task accounting, so a handler mid-fit still
+        counts as pending)."""
+        deadline = time.time() + timeout
+        with self.source.all_tasks_done:
+            while self.source.unfinished_tasks and time.time() < deadline:
+                self.source.all_tasks_done.wait(0.05)
+
+
+class TrainingRoute(Route):
+    """Online training: (features, labels) messages -> model.fit (reference
+    CamelKafkaRouteBuilder feeding training)."""
+
+    def __init__(self, model, capacity: int = 64):
+        self.model = model
+        super().__init__(queue.Queue(maxsize=capacity), self._train)
+
+    def _train(self, msg) -> None:
+        x, y = msg
+        self.model.fit(np.asarray(x, np.float32), np.asarray(y, np.float32))
+
+    def send(self, features, labels, timeout: float = 10.0) -> None:
+        self.source.put((features, labels), timeout=timeout)
+
+
+class ServingRoute(Route):
+    """Model serving: feature messages -> predictions on the output queue
+    (reference DL4jServeRouteBuilder)."""
+
+    def __init__(self, model, capacity: int = 64):
+        self.model = model
+        self.output: "queue.Queue" = queue.Queue()
+        super().__init__(queue.Queue(maxsize=capacity), self._serve)
+
+    def _serve(self, msg) -> None:
+        request_id, features = msg
+        out = self.model.output(np.asarray(features, np.float32))
+        self.output.put((request_id, np.asarray(out)))
+
+    def send(self, request_id, features, timeout: float = 10.0) -> None:
+        self.source.put((request_id, features), timeout=timeout)
+
+    def receive(self, timeout: float = 10.0):
+        return self.output.get(timeout=timeout)

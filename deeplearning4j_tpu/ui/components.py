@@ -1,0 +1,244 @@
+"""Declarative report components rendered to standalone HTML (inline SVG).
+
+Reference: deeplearning4j-ui-parent ui-components (SURVEY.md §2.9) — chart/
+table/text components rendered to JS(d3), used for standalone HTML reports
+(EvaluationTools ROC export, spark stats HTML). Here components render to
+self-contained HTML with inline SVG — no JS dependency — following the
+dataviz method: categorical hues in fixed validated order (slots below are
+the documented reference palette, adjacent-pairs CVD-safe light+dark), 2px
+line marks, recessive grid, legend for >=2 series, native tooltips via
+<title>, text in ink tokens never series colors.
+"""
+from __future__ import annotations
+
+import html as _html
+from typing import List, Optional, Sequence
+
+# Reference palette (validated fixed order; see dataviz references/palette.md)
+SERIES_LIGHT = ["#2a78d6", "#eb6834", "#1baf7a", "#eda100",
+                "#e87ba4", "#008300", "#4a3aa7", "#e34948"]
+_CSS = """
+.viz-root { color-scheme: light; font-family: system-ui, sans-serif;
+  --surface-1: #fcfcfb; --text-primary: #0b0b0b; --text-secondary: #52514e;
+  --grid: #e4e3df; background: var(--surface-1); color: var(--text-primary);
+  padding: 16px; }
+@media (prefers-color-scheme: dark) {
+  :root:where(:not([data-theme="light"])) .viz-root { color-scheme: dark;
+    --surface-1: #1a1a19; --text-primary: #ffffff;
+    --text-secondary: #c3c2b7; --grid: #3a3935; } }
+.viz-root h2 { font-size: 15px; font-weight: 600; margin: 18px 0 6px; }
+.viz-root table { border-collapse: collapse; font-size: 12px; }
+.viz-root td, .viz-root th { border: 1px solid var(--grid); padding: 4px 10px;
+  text-align: left; }
+.viz-root th { color: var(--text-secondary); font-weight: 600; }
+.viz-legend { font-size: 12px; color: var(--text-secondary);
+  margin: 4px 0 10px; }
+.viz-legend span.swatch { display: inline-block; width: 10px; height: 10px;
+  border-radius: 2px; margin: 0 4px 0 12px; }
+"""
+
+
+class Component:
+    def render(self) -> str:
+        raise NotImplementedError
+
+
+class ComponentText(Component):
+    def __init__(self, text: str, heading: bool = False):
+        self.text = text
+        self.heading = heading
+
+    def render(self) -> str:
+        tag = "h2" if self.heading else "p"
+        return f"<{tag}>{_html.escape(self.text)}</{tag}>"
+
+
+class ComponentTable(Component):
+    def __init__(self, header: Sequence[str], rows: Sequence[Sequence],
+                 title: Optional[str] = None):
+        self.header = list(header)
+        self.rows = [list(r) for r in rows]
+        self.title = title
+
+    def render(self) -> str:
+        out = []
+        if self.title:
+            out.append(f"<h2>{_html.escape(self.title)}</h2>")
+        out.append("<table><tr>")
+        out += [f"<th>{_html.escape(str(h))}</th>" for h in self.header]
+        out.append("</tr>")
+        for r in self.rows:
+            out.append("<tr>" + "".join(
+                f"<td>{_html.escape(_fmt(v))}</td>" for v in r) + "</tr>")
+        out.append("</table>")
+        return "".join(out)
+
+
+def _fmt(v) -> str:
+    if isinstance(v, float):
+        return f"{v:.4g}"
+    return str(v)
+
+
+class _Chart(Component):
+    W, H = 560, 300
+    ML, MR, MT, MB = 56, 16, 16, 40
+
+    def __init__(self, title: str, x_label: str = "", y_label: str = ""):
+        self.title = title
+        self.x_label = x_label
+        self.y_label = y_label
+
+    def _frame(self, body: str, legend: List[str], x_rng, y_rng) -> str:
+        W, H, ML, MR, MT, MB = (self._geom())
+        pw, ph = W - ML - MR, H - MT - MB
+        grid, labels = [], []
+        for i in range(5):
+            fy = MT + ph * i / 4
+            val = y_rng[1] - (y_rng[1] - y_rng[0]) * i / 4
+            grid.append(f'<line x1="{ML}" y1="{fy:.1f}" x2="{W - MR}" '
+                        f'y2="{fy:.1f}" stroke="var(--grid)" stroke-width="1"/>')
+            labels.append(f'<text x="{ML - 6}" y="{fy + 4:.1f}" '
+                          f'text-anchor="end" font-size="11" '
+                          f'fill="var(--text-secondary)">{val:.3g}</text>')
+        for i in range(5):
+            fx = ML + pw * i / 4
+            val = x_rng[0] + (x_rng[1] - x_rng[0]) * i / 4
+            labels.append(f'<text x="{fx:.1f}" y="{H - MB + 16}" '
+                          f'text-anchor="middle" font-size="11" '
+                          f'fill="var(--text-secondary)">{val:.3g}</text>')
+        if self.x_label:
+            labels.append(f'<text x="{ML + pw / 2}" y="{H - 6}" '
+                          f'text-anchor="middle" font-size="12" '
+                          f'fill="var(--text-secondary)">'
+                          f'{_html.escape(self.x_label)}</text>')
+        if self.y_label:
+            labels.append(f'<text x="14" y="{MT + ph / 2}" font-size="12" '
+                          f'fill="var(--text-secondary)" text-anchor="middle" '
+                          f'transform="rotate(-90 14 {MT + ph / 2})">'
+                          f'{_html.escape(self.y_label)}</text>')
+        leg = ""
+        if len(legend) >= 2:
+            leg = '<div class="viz-legend">' + "".join(
+                f'<span class="swatch" style="background:{SERIES_LIGHT[i % 8]}">'
+                f'</span>{_html.escape(n)}' for i, n in enumerate(legend)) + "</div>"
+        return (f"<h2>{_html.escape(self.title)}</h2>{leg}"
+                f'<svg viewBox="0 0 {W} {H}" width="{W}" height="{H}" '
+                f'role="img" aria-label="{_html.escape(self.title)}">'
+                + "".join(grid) + body + "".join(labels) + "</svg>")
+
+    def _geom(self):
+        return self.W, self.H, self.ML, self.MR, self.MT, self.MB
+
+    @staticmethod
+    def _ranges(xss, yss):
+        xs = [x for s in xss for x in s]
+        ys = [y for s in yss for y in s]
+        x0, x1 = (min(xs), max(xs)) if xs else (0, 1)
+        y0, y1 = (min(ys), max(ys)) if ys else (0, 1)
+        if x1 == x0:
+            x1 = x0 + 1
+        if y1 == y0:
+            y1 = y0 + 1
+        return (x0, x1), (y0, y1)
+
+
+class ChartLine(_Chart):
+    """Multi-series line chart (reference ui-components ChartLine)."""
+
+    def __init__(self, title: str, x_label: str = "", y_label: str = ""):
+        super().__init__(title, x_label, y_label)
+        self.series: List[tuple] = []
+
+    def add_series(self, name: str, x: Sequence[float],
+                   y: Sequence[float]) -> "ChartLine":
+        self.series.append((name, list(x), list(y)))
+        return self
+
+    def render(self) -> str:
+        W, H, ML, MR, MT, MB = self._geom()
+        pw, ph = W - ML - MR, H - MT - MB
+        x_rng, y_rng = self._ranges([s[1] for s in self.series],
+                                    [s[2] for s in self.series])
+        body = []
+        for i, (name, xs, ys) in enumerate(self.series):
+            pts = " ".join(
+                f"{ML + (x - x_rng[0]) / (x_rng[1] - x_rng[0]) * pw:.1f},"
+                f"{MT + ph - (y - y_rng[0]) / (y_rng[1] - y_rng[0]) * ph:.1f}"
+                for x, y in zip(xs, ys))
+            color = SERIES_LIGHT[i % 8]
+            body.append(f'<polyline points="{pts}" fill="none" '
+                        f'stroke="{color}" stroke-width="2">'
+                        f"<title>{_html.escape(name)}</title></polyline>")
+        return self._frame("".join(body), [s[0] for s in self.series],
+                           x_rng, y_rng)
+
+
+class ChartScatter(ChartLine):
+    """Scatter (reference ChartScatter); series cap 3 per all-pairs rule."""
+
+    def render(self) -> str:
+        W, H, ML, MR, MT, MB = self._geom()
+        pw, ph = W - ML - MR, H - MT - MB
+        x_rng, y_rng = self._ranges([s[1] for s in self.series],
+                                    [s[2] for s in self.series])
+        body = []
+        for i, (name, xs, ys) in enumerate(self.series[:3]):
+            color = SERIES_LIGHT[i % 8]
+            for x, y in zip(xs, ys):
+                cx = ML + (x - x_rng[0]) / (x_rng[1] - x_rng[0]) * pw
+                cy = MT + ph - (y - y_rng[0]) / (y_rng[1] - y_rng[0]) * ph
+                body.append(f'<circle cx="{cx:.1f}" cy="{cy:.1f}" r="4" '
+                            f'fill="{color}" stroke="var(--surface-1)" '
+                            f'stroke-width="2"><title>'
+                            f"{_html.escape(name)}: ({x:.4g}, {y:.4g})"
+                            f"</title></circle>")
+        return self._frame("".join(body), [s[0] for s in self.series[:3]],
+                           x_rng, y_rng)
+
+
+class ChartHistogram(_Chart):
+    """Histogram (reference ChartHistogram): bin edges + counts."""
+
+    def __init__(self, title: str, lower: Sequence[float],
+                 upper: Sequence[float], counts: Sequence[float],
+                 x_label: str = "", y_label: str = "count"):
+        super().__init__(title, x_label, y_label)
+        self.lower, self.upper = list(lower), list(upper)
+        self.counts = list(counts)
+
+    def render(self) -> str:
+        W, H, ML, MR, MT, MB = self._geom()
+        pw, ph = W - ML - MR, H - MT - MB
+        x_rng = (min(self.lower), max(self.upper)) if self.lower else (0, 1)
+        y_rng = (0, max(self.counts) or 1)
+        body = []
+        for lo, hi, c in zip(self.lower, self.upper, self.counts):
+            x0 = ML + (lo - x_rng[0]) / (x_rng[1] - x_rng[0]) * pw
+            x1 = ML + (hi - x_rng[0]) / (x_rng[1] - x_rng[0]) * pw
+            bh = (c / y_rng[1]) * ph
+            # 2px surface gap between adjacent bars; 4px rounded data end
+            body.append(
+                f'<rect x="{x0 + 1:.1f}" y="{MT + ph - bh:.1f}" '
+                f'width="{max(x1 - x0 - 2, 1):.1f}" height="{bh:.1f}" '
+                f'rx="4" fill="{SERIES_LIGHT[0]}">'
+                f"<title>[{lo:.4g}, {hi:.4g}): {c:.4g}</title></rect>")
+        return self._frame("".join(body), [], x_rng, y_rng)
+
+
+class ComponentDiv(Component):
+    def __init__(self, *children: Component):
+        self.children = list(children)
+
+    def render(self) -> str:
+        return "<div>" + "".join(c.render() for c in self.children) + "</div>"
+
+
+def render_page(title: str, *components: Component) -> str:
+    """Standalone HTML document from components (reference ui-components
+    rendering into an HTML file)."""
+    body = "".join(c.render() for c in components)
+    return (f"<!DOCTYPE html><html><head><meta charset='utf-8'>"
+            f"<title>{_html.escape(title)}</title><style>{_CSS}</style></head>"
+            f"<body><div class='viz-root'><h1 style='font-size:18px'>"
+            f"{_html.escape(title)}</h1>{body}</div></body></html>")

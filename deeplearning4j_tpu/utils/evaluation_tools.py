@@ -1,0 +1,66 @@
+"""EvaluationTools: standalone HTML reports for evaluation results.
+
+Reference: deeplearning4j-core evaluation/EvaluationTools.java — exports ROC
+charts (+ AUC) and evaluation summaries as self-contained HTML via the
+ui-components renderer (SURVEY.md §2.2/§2.9).
+"""
+from __future__ import annotations
+
+from deeplearning4j_tpu.ui.components import (
+    ChartLine, ComponentTable, ComponentText, render_page,
+)
+
+
+def roc_chart(roc, name: str = "ROC") -> ChartLine:
+    fpr, tpr = [], []
+    for point in roc.get_roc_curve():
+        fpr.append(float(point[0]))
+        tpr.append(float(point[1]))
+    chart = ChartLine(f"{name} (AUC = {roc.calculate_auc():.4f})",
+                      x_label="false positive rate",
+                      y_label="true positive rate")
+    chart.add_series(name, fpr, tpr)
+    chart.add_series("chance", [0.0, 1.0], [0.0, 1.0])
+    return chart
+
+
+def export_roc_charts_to_html_file(roc, path: str) -> None:
+    """Reference EvaluationTools.exportRocChartsToHtmlFile(ROC, File)."""
+    html = render_page("ROC report", roc_chart(roc))
+    with open(path, "w") as f:
+        f.write(html)
+
+
+def export_roc_multi_class_to_html_file(roc_mc, path: str) -> None:
+    """One chart per class + AUC summary table (reference
+    exportRocChartsToHtmlFile(ROCMultiClass, File))."""
+    charts = []
+    rows = []
+    for c in sorted(roc_mc.per_class):
+        roc = roc_mc.per_class[c]
+        charts.append(roc_chart(roc, name=f"class {c}"))
+        rows.append([c, roc_mc.calculate_auc(c)])
+    summary = ComponentTable(["class", "AUC"], rows, title="AUC per class")
+    avg = ComponentText(
+        f"average AUC: {roc_mc.calculate_average_auc():.4f}")
+    with open(path, "w") as f:
+        f.write(render_page("ROC (multi-class) report", summary, avg, *charts))
+
+
+def export_evaluation_to_html_file(evaluation, path: str) -> None:
+    """Confusion matrix + headline metrics as HTML."""
+    cm = evaluation.confusion.matrix
+    n = len(cm)
+    table = ComponentTable(
+        ["actual \\ predicted"] + [str(i) for i in range(n)],
+        [[i] + [int(v) for v in row] for i, row in enumerate(cm)],
+        title="Confusion matrix")
+    metrics = ComponentTable(
+        ["metric", "value"],
+        [["accuracy", evaluation.accuracy()],
+         ["precision", evaluation.precision()],
+         ["recall", evaluation.recall()],
+         ["f1", evaluation.f1()]],
+        title="Metrics")
+    with open(path, "w") as f:
+        f.write(render_page("Evaluation report", metrics, table))

@@ -1,0 +1,72 @@
+"""Cloud storage SPI + streaming training/serving routes."""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.cloud import (
+    LocalFileSystemProvider, S3Provider, TpuProvisioner,
+)
+from deeplearning4j_tpu.streaming import ServingRoute, TrainingRoute
+
+
+def _net():
+    from deeplearning4j_tpu import NeuralNetConfiguration, MultiLayerNetwork
+    from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+    conf = (NeuralNetConfiguration.builder().seed(0).learning_rate(0.1)
+            .list().layer(DenseLayer(n_in=4, n_out=8, activation="relu"))
+            .layer(OutputLayer(n_in=8, n_out=2, loss="mcxent",
+                               activation="softmax")).build())
+    return MultiLayerNetwork(conf).init()
+
+
+def test_local_storage_roundtrip(tmp_path):
+    store = LocalFileSystemProvider(str(tmp_path / "store"))
+    src = tmp_path / "artifact.bin"
+    src.write_bytes(b"\x01\x02\x03")
+    store.upload(str(src), "models/run1/artifact.bin")
+    assert store.list("models") == ["models/run1/artifact.bin"]
+    dst = tmp_path / "restored.bin"
+    store.download("models/run1/artifact.bin", str(dst))
+    assert dst.read_bytes() == b"\x01\x02\x03"
+    with pytest.raises(ValueError):
+        store.upload(str(src), "../escape.bin")
+
+
+def test_s3_provider_gated():
+    with pytest.raises(RuntimeError):
+        S3Provider("bucket")
+
+
+def test_provisioner_render():
+    req = TpuProvisioner(accelerator_type="v5litepod-16",
+                         num_slices=2).render("trainer")
+    assert req["accelerator_type"] == "v5litepod-16"
+    assert req["num_slices"] == 2 and req["name"] == "trainer"
+
+
+def test_training_route_fits_online():
+    net = _net()
+    route = TrainingRoute(net).start()
+    rng = np.random.default_rng(0)
+    try:
+        for _ in range(5):
+            labels = rng.integers(0, 2, 16)
+            x = rng.normal(0, 0.3, (16, 4)).astype(np.float32)
+            x[np.arange(16), labels] += 2.0
+            y = np.eye(2, dtype=np.float32)[labels]
+            route.send(x, y)
+        route.drain()
+    finally:
+        route.stop()
+    assert route.processed == 5 and not route.errors
+
+
+def test_serving_route_predicts():
+    net = _net()
+    route = ServingRoute(net).start()
+    try:
+        route.send("req-1", np.ones((3, 4), np.float32))
+        rid, out = route.receive()
+    finally:
+        route.stop()
+    assert rid == "req-1" and out.shape == (3, 2)
+    np.testing.assert_allclose(out.sum(axis=1), 1.0, rtol=1e-5)

@@ -1,0 +1,117 @@
+"""DataVec bridge (record readers + DataSet iterators) and dataset fetchers."""
+import numpy as np
+
+from deeplearning4j_tpu.datavec import (
+    CollectionRecordReader, CSVRecordReader, CSVSequenceRecordReader,
+    ImageRecordReader, RecordReaderDataSetIterator,
+    RecordReaderMultiDataSetIterator, SequenceRecordReaderDataSetIterator,
+)
+from deeplearning4j_tpu.datasets.fetchers import (
+    CifarDataSetIterator, CurvesDataSetIterator, LFWDataSetIterator,
+)
+
+
+def test_csv_record_reader_numeric_fast_path(tmp_path):
+    p = tmp_path / "d.csv"
+    p.write_text("1,2,3\n4,5,6\n7,8,9\n10,11,12\n")
+    recs = list(CSVRecordReader(p))
+    assert recs == [[1, 2, 3], [4, 5, 6], [7, 8, 9], [10, 11, 12]]
+
+
+def test_csv_record_reader_mixed_fields(tmp_path):
+    p = tmp_path / "d.csv"
+    p.write_text("a,1,2\nb,3,4\n")
+    recs = list(CSVRecordReader(p))
+    assert recs == [["a", 1.0, 2.0], ["b", 3.0, 4.0]]
+
+
+def test_record_reader_dataset_iterator_classification(tmp_path):
+    p = tmp_path / "iris-like.csv"
+    rows = ["%f,%f,%d" % (i * 0.1, i * 0.2, i % 3) for i in range(10)]
+    p.write_text("\n".join(rows) + "\n")
+    it = RecordReaderDataSetIterator(CSVRecordReader(p), batch=4,
+                                     label_index=2, num_classes=3)
+    batches = list(it)
+    assert [b.num_examples() for b in batches] == [4, 4, 2]
+    b0 = batches[0]
+    assert b0.features.shape == (4, 2) and b0.labels.shape == (4, 3)
+    np.testing.assert_array_equal(np.argmax(b0.labels, 1), [0, 1, 2, 0])
+
+
+def test_record_reader_dataset_iterator_regression():
+    recs = [[1.0, 2.0, 3.0, 4.0]] * 6
+    it = RecordReaderDataSetIterator(CollectionRecordReader(recs), batch=3,
+                                     label_index=2, label_index_to=3,
+                                     regression=True)
+    b = next(iter(it))
+    assert b.features.shape == (3, 2) and b.labels.shape == (3, 2)
+    np.testing.assert_allclose(b.labels[0], [3.0, 4.0])
+
+
+def test_sequence_record_reader_iterator(tmp_path):
+    fdir, ldir = tmp_path / "f", tmp_path / "l"
+    fdir.mkdir(), ldir.mkdir()
+    lengths = [3, 5, 2]
+    for i, L in enumerate(lengths):
+        (fdir / f"{i}.csv").write_text(
+            "\n".join(f"{t},{t * 2}" for t in range(L)) + "\n")
+        (ldir / f"{i}.csv").write_text(
+            "\n".join(str(t % 2) for t in range(L)) + "\n")
+    it = SequenceRecordReaderDataSetIterator(
+        CSVSequenceRecordReader(fdir), batch=3,
+        labels=CSVSequenceRecordReader(ldir), num_classes=2)
+    ds = next(iter(it))
+    assert ds.features.shape == (3, 5, 2)
+    assert ds.labels.shape == (3, 5, 2)
+    np.testing.assert_array_equal(ds.features_mask.sum(axis=1), lengths)
+    # padded steps are zero
+    assert ds.features[2, 2:].sum() == 0
+
+
+def test_multi_dataset_iterator():
+    recs = [[i, i + 1, i % 2] for i in range(8)]
+    it = (RecordReaderMultiDataSetIterator(batch=4)
+          .add_reader("r", CollectionRecordReader(recs))
+          .add_input("r", 0, 1)
+          .add_output_one_hot("r", 2, 2))
+    ins, outs = next(iter(it))
+    assert ins[0].shape == (4, 2) and outs[0].shape == (4, 2)
+    np.testing.assert_array_equal(np.argmax(outs[0], 1), [0, 1, 0, 1])
+
+
+def test_image_record_reader(tmp_path):
+    from PIL import Image
+    for person, color in [("alice", 200), ("bob", 50)]:
+        d = tmp_path / person
+        d.mkdir()
+        for i in range(2):
+            Image.fromarray(
+                np.full((10, 8, 3), color, np.uint8)).save(d / f"{i}.png")
+    rr = ImageRecordReader(tmp_path, height=4, width=4, channels=1)
+    assert rr.labels == ["alice", "bob"]
+    recs = list(rr)
+    assert len(recs) == 4 and len(recs[0]) == 17  # 4*4 pixels + label
+    assert recs[0][-1] == 0.0 and recs[-1][-1] == 1.0
+
+
+def test_cifar_iterator_shapes():
+    it = CifarDataSetIterator(batch=8, num_examples=32)
+    ds = next(iter(it))
+    assert ds.features.shape == (8, 32, 32, 3)
+    assert ds.labels.shape == (8, 10)
+    assert 0.0 <= ds.features.min() and ds.features.max() <= 1.0
+
+
+def test_lfw_iterator_shapes():
+    it = LFWDataSetIterator(batch=10, num_examples=40, num_labels=5,
+                            image_size=16)
+    ds = next(iter(it))
+    assert ds.features.shape == (10, 16, 16, 1)
+    assert ds.labels.shape == (10, 5)
+
+
+def test_curves_iterator_autoencoder_labels():
+    it = CurvesDataSetIterator(batch=16, num_examples=32)
+    ds = next(iter(it))
+    np.testing.assert_array_equal(ds.features, ds.labels)
+    assert ds.features.shape == (16, 784)

@@ -241,3 +241,17 @@ def test_config_only_import_and_unsupported_layer():
     bad = _seq([("LocallyConnected2D", {"name": "x"})])
     with pytest.raises(InvalidKerasConfigurationException):
         KerasModelImport.import_keras_model_configuration(json.dumps(bad))
+
+
+def test_attr_overwrite_and_uint_dataset(tmp_path):
+    import numpy as np
+    p = tmp_path / "o.h5"
+    with H5File(str(p), "w") as f:
+        f.write_attr("/", "model_config", "old")
+        f.write_attr("/", "model_config", "new")  # must overwrite
+        f.write_dataset("/labels", np.arange(4, dtype=np.uint32))
+    with H5File(str(p)) as f:
+        assert f.read_attr("/", "model_config") == "new"
+        out = f.read_dataset("/labels")
+        assert out.dtype == np.uint32
+        np.testing.assert_array_equal(out, [0, 1, 2, 3])

@@ -1,0 +1,45 @@
+"""Distributed (parameter-averaging) Word2Vec + TextPipeline."""
+import numpy as np
+
+from deeplearning4j_tpu.nlp.distributed import SparkWord2Vec, TextPipeline
+
+
+CORPUS = ([f"the king sits on the royal throne {i}" for i in range(10)]
+          + [f"the queen sits on the royal throne {i}" for i in range(10)]
+          + [f"dogs chase cats in the garden {i}" for i in range(10)]
+          + [f"cats flee from dogs in the garden {i}" for i in range(10)])
+
+
+def test_text_pipeline_tokenize_and_vocab():
+    p = TextPipeline(num_workers=3, min_word_frequency=2)
+    seqs = p.tokenize(["The king! The KING.", "a queen?"])
+    assert seqs[0][0] == seqs[0][2] == "the"
+    counts = p.word_counts(seqs)
+    assert counts["the"] == 2 and counts["king"] == 2
+    cache = p.build_vocab(seqs)
+    assert cache.word_for("the") is not None
+    assert cache.word_for("queen") is None  # below min frequency
+
+
+def test_spark_word2vec_learns_cooccurrence():
+    w2v = SparkWord2Vec(num_workers=3, averaging_rounds=2,
+                        vector_length=24, window=3, min_word_frequency=2,
+                        seed=7, use_hierarchic_softmax=False,
+                        negative=5, learning_rate=0.05)
+    w2v.fit(CORPUS)
+    assert w2v.get_word_vector("king").shape == (24,)
+    # words from the same topic should be closer than cross-topic words
+    royal = w2v.similarity("king", "queen")
+    cross = w2v.similarity("king", "garden")
+    assert np.isfinite(royal) and np.isfinite(cross)
+    assert royal > cross
+    assert "king" not in w2v.words_nearest("king", 3)
+
+
+def test_averaging_is_deterministic():
+    kw = dict(num_workers=2, vector_length=8, window=2, seed=3,
+              min_word_frequency=1, use_hierarchic_softmax=True)
+    a = SparkWord2Vec(**kw).fit(CORPUS[:8])
+    b = SparkWord2Vec(**kw).fit(CORPUS[:8])
+    np.testing.assert_allclose(np.asarray(a.master.lookup.syn0),
+                               np.asarray(b.master.lookup.syn0))

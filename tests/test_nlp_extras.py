@@ -1,0 +1,59 @@
+"""NLP extras: annotator pipeline, language tokenizers, stopwords, windows."""
+from deeplearning4j_tpu.nlp.annotators import (
+    AnnotatorPipeline, StemmerAnnotator,
+)
+from deeplearning4j_tpu.nlp.languages import (
+    JapaneseTokenizerFactory, KoreanTokenizerFactory, StopWords, Windows,
+)
+
+
+def test_annotator_pipeline_sentences_tokens_pos():
+    cas = AnnotatorPipeline().annotate(
+        "The quick dog runs. She quickly chased the playful cats!")
+    assert len(cas.sentences) == 2
+    s0 = cas.sentences[0]
+    texts = [t.text for t in s0.tokens]
+    assert texts == ["The", "quick", "dog", "runs", "."]
+    tags = {t.text: t.pos for t in s0.tokens}
+    assert tags["The"] == "DET"
+    assert tags["dog"] == "NOUN"
+    assert tags["."] == "PUNCT"
+    s1 = cas.sentences[1]
+    tags1 = {t.text: t.pos for t in s1.tokens}
+    assert tags1["She"] == "PRON"
+    assert tags1["quickly"] == "ADV"
+    # offsets index into the original document
+    tok = s1.tokens[0]
+    assert cas.text[tok.begin:tok.end] == "She"
+
+
+def test_stemmer():
+    st = StemmerAnnotator.stem
+    assert st("running") == "runn"
+    assert st("ponies") == "poni"
+    assert st("cats") == "cat"
+    assert st("nation") == "nation"  # too short to strip "ation"
+
+
+def test_japanese_tokenizer_script_runs():
+    tf = JapaneseTokenizerFactory()
+    toks = tf.create("私はTPUで学習する").get_tokens()
+    assert "TPU" in toks
+    assert toks[0] == "私"  # kanji run separated from hiragana particle
+    assert "は" in toks
+
+
+def test_korean_tokenizer_particle_stripping():
+    tf = KoreanTokenizerFactory()
+    toks = tf.create("나는 학교에 간다").get_tokens()
+    assert "학교" in toks  # 에 particle stripped
+    assert "간다" in toks
+
+
+def test_stopwords_and_windows():
+    assert StopWords.is_stop_word("The")
+    assert not StopWords.is_stop_word("tensor")
+    ws = list(Windows.windows(["a", "b", "c"], window_size=3))
+    assert ws[0] == ["<s>", "a", "b"]
+    assert ws[1] == ["a", "b", "c"]
+    assert ws[2] == ["b", "c", "</s>"]

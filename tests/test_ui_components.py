@@ -1,0 +1,88 @@
+"""ui-components HTML rendering + EvaluationTools exports + ModelGuesser."""
+import numpy as np
+
+from deeplearning4j_tpu.eval.evaluation import Evaluation
+from deeplearning4j_tpu.eval.roc import ROC, ROCMultiClass
+from deeplearning4j_tpu.ui.components import (
+    ChartHistogram, ChartLine, ChartScatter, ComponentTable, ComponentText,
+    render_page,
+)
+from deeplearning4j_tpu.utils.evaluation_tools import (
+    export_evaluation_to_html_file, export_roc_charts_to_html_file,
+    export_roc_multi_class_to_html_file,
+)
+
+
+def test_render_page_line_chart():
+    c = (ChartLine("Loss", x_label="iteration", y_label="loss")
+         .add_series("train", [0, 1, 2, 3], [1.0, 0.6, 0.4, 0.3])
+         .add_series("val", [0, 1, 2, 3], [1.1, 0.8, 0.7, 0.65]))
+    html = render_page("Training report", c, ComponentText("done"))
+    assert "<!DOCTYPE html>" in html and "<svg" in html
+    assert "polyline" in html and "viz-legend" in html
+    assert "train" in html and "val" in html
+
+
+def test_single_series_has_no_legend():
+    c = ChartLine("Loss").add_series("loss", [0, 1], [1, 0])
+    assert "viz-legend" not in c.render()
+
+
+def test_histogram_and_scatter_and_table():
+    h = ChartHistogram("weights", [0, 1, 2], [1, 2, 3], [5, 9, 2])
+    s = ChartScatter("tsne").add_series("a", [0.0, 1.0], [1.0, 0.0])
+    t = ComponentTable(["k", "v"], [["acc", 0.98]], title="metrics")
+    page = render_page("r", h, s, t)
+    assert page.count("<rect") == 3
+    assert "<circle" in page and "<table>" in page and "0.98" in page
+
+
+def test_roc_html_export(tmp_path):
+    rng = np.random.default_rng(0)
+    labels = np.zeros((100, 2), np.float32)
+    cls = rng.integers(0, 2, 100)
+    labels[np.arange(100), cls] = 1
+    probs = np.clip(cls * 0.7 + rng.uniform(0, 0.5, 100), 0, 1)
+    preds = np.stack([1 - probs, probs], axis=1)
+    roc = ROC(threshold_steps=20)
+    roc.eval(labels, preds)
+    p = tmp_path / "roc.html"
+    export_roc_charts_to_html_file(roc, str(p))
+    html = p.read_text()
+    assert "AUC" in html and "<svg" in html
+
+    mc = ROCMultiClass(threshold_steps=20)
+    mc.eval(labels, preds)
+    p2 = tmp_path / "roc_mc.html"
+    export_roc_multi_class_to_html_file(mc, str(p2))
+    assert "average AUC" in p2.read_text()
+
+
+def test_evaluation_html_export(tmp_path):
+    e = Evaluation()
+    labels = np.eye(3, dtype=np.float32)[[0, 1, 2, 0, 1, 2]]
+    preds = np.eye(3, dtype=np.float32)[[0, 1, 2, 0, 1, 1]]
+    e.eval(labels, preds)
+    p = tmp_path / "eval.html"
+    export_evaluation_to_html_file(e, str(p))
+    html = p.read_text()
+    assert "Confusion matrix" in html and "accuracy" in html
+
+
+def test_model_guesser_roundtrip(tmp_path):
+    from deeplearning4j_tpu import NeuralNetConfiguration, MultiLayerNetwork
+    from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.utils.model_serializer import guess_model, write_model
+
+    conf = (NeuralNetConfiguration.builder().seed(3).list()
+            .layer(DenseLayer(n_in=4, n_out=5, activation="relu"))
+            .layer(OutputLayer(n_in=5, n_out=2, loss="mcxent",
+                               activation="softmax"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    p = str(tmp_path / "m.zip")
+    write_model(net, p)
+    loaded = guess_model(p)
+    x = np.ones((2, 4), np.float32)
+    np.testing.assert_allclose(np.asarray(loaded.output(x)),
+                               np.asarray(net.output(x)), rtol=1e-6)
