@@ -40,13 +40,15 @@ def bench_lenet(batch: int, iters: int, warmup: int = 5) -> dict:
     for i in range(warmup):
         params, states, upd, loss = step(params, states, upd, x, y, key,
                                          jnp.int32(i))
-    jax.block_until_ready(loss)
+    float(loss)  # hard sync: host read (block_until_ready alone is
+    #              unreliable through the axon relay's async dispatch)
 
     t0 = time.perf_counter()
     for i in range(iters):
         params, states, upd, loss = step(params, states, upd, x, y, key,
                                          jnp.int32(i))
-    jax.block_until_ready(loss)
+    # the donated-params chain makes this final host read wait on every step
+    float(loss)
     dt = time.perf_counter() - t0
     return {
         "samples_per_sec": batch * iters / dt,
@@ -75,12 +77,12 @@ def bench_resnet50(batch: int, iters: int, warmup: int = 3) -> dict:
     for i in range(warmup):
         params, states, upd, loss = step(params, states, upd, [x], [y], key,
                                          jnp.int32(i))
-    jax.block_until_ready(loss)
+    float(loss)  # hard sync (see bench_lenet)
     t0 = time.perf_counter()
     for i in range(iters):
         params, states, upd, loss = step(params, states, upd, [x], [y], key,
                                          jnp.int32(i))
-    jax.block_until_ready(loss)
+    float(loss)  # chain-forcing host read
     dt = time.perf_counter() - t0
     return {
         "samples_per_sec": batch * iters / dt,
@@ -95,7 +97,13 @@ def main() -> None:
     ap.add_argument("--model", default="lenet", choices=["lenet", "resnet50"])
     ap.add_argument("--batch", type=int, default=None)
     ap.add_argument("--iters", type=int, default=None)
+    ap.add_argument("--bf16", action="store_true",
+                    help="bfloat16 matmul/conv compute (f32 params)")
     args = ap.parse_args()
+
+    if args.bf16:
+        from deeplearning4j_tpu.common import bf16_matmul_policy
+        bf16_matmul_policy()
 
     if args.model == "lenet":
         r = bench_lenet(args.batch or 128, args.iters or 50)
