@@ -1,0 +1,126 @@
+"""Command-line entry points.
+
+Reference mains (SURVEY.md §2.4/§2.9): ParallelWrapperMain (deeplearning4j-
+scaleout cli main/ParallelWrapperMain.java — jcommander-parsed flags driving
+ParallelWrapper training of a serialized model) and PlayUIServer's main
+(ui/play/PlayUIServer.java --uiPort). Run as:
+
+    python -m deeplearning4j_tpu.cli ui --port 9000
+    python -m deeplearning4j_tpu.cli parallel-train --model m.zip \
+        --workers 4 --averaging-frequency 1 --epochs 1 [--dataset mnist]
+    python -m deeplearning4j_tpu.cli keras-server --port 25333
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+
+def _cmd_ui(args) -> int:
+    from deeplearning4j_tpu.ui.server import UIServer
+
+    server = UIServer.get_instance(args.port)
+    if args.enable_remote:
+        server.enable_remote_listener()
+    print(f"UI server listening on http://127.0.0.1:{server.port}")
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        return 0
+
+
+def _cmd_parallel_train(args) -> int:
+    from deeplearning4j_tpu.parallel.wrapper import ParallelWrapper
+    from deeplearning4j_tpu.utils.model_serializer import (
+        guess_model, write_model,
+    )
+
+    net = guess_model(args.model)
+    if args.dataset == "mnist":
+        from deeplearning4j_tpu.datasets.mnist import MnistDataSetIterator
+        it = MnistDataSetIterator(args.batch, train=True,
+                                  num_examples=args.num_examples)
+    elif args.dataset == "cifar":
+        from deeplearning4j_tpu.datasets.fetchers import CifarDataSetIterator
+        it = CifarDataSetIterator(args.batch, train=True, flatten=False,
+                                  num_examples=args.num_examples)
+    else:
+        from deeplearning4j_tpu.datavec import (
+            CSVRecordReader, RecordReaderDataSetIterator,
+        )
+        if args.num_classes is None and not args.regression:
+            print("error: CSV training needs --num-classes (classification) "
+                  "or --regression", file=sys.stderr)
+            return 2
+        it = RecordReaderDataSetIterator(
+            CSVRecordReader(args.dataset), args.batch,
+            label_index=args.label_index, num_classes=args.num_classes,
+            regression=args.regression)
+    wrapper = (ParallelWrapper.builder(net)
+               .workers(args.workers)
+               .averaging_frequency(args.averaging_frequency)
+               .prefetch_buffer(args.prefetch)
+               .build())
+    wrapper.fit(it, epochs=args.epochs)
+    if args.output:
+        write_model(net, args.output)
+        print(f"trained model written to {args.output}")
+    print(f"final score: {net.score_value}")
+    return 0
+
+
+def _cmd_keras_server(args) -> int:
+    from deeplearning4j_tpu.keras_server import Server
+
+    srv = Server(port=args.port).start()
+    print(f"keras gateway listening on 127.0.0.1:{srv.port}")
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        srv.stop()
+        return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="deeplearning4j_tpu")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    ui = sub.add_parser("ui", help="start the training UI server")
+    ui.add_argument("--port", type=int, default=9000)
+    ui.add_argument("--enable-remote", action="store_true",
+                    help="accept POSTed remote stats")
+    ui.set_defaults(fn=_cmd_ui)
+
+    tr = sub.add_parser("parallel-train",
+                        help="data-parallel training of a serialized model")
+    tr.add_argument("--model", required=True, help="model zip path")
+    tr.add_argument("--dataset", default="mnist",
+                    help="mnist | cifar | path to CSV")
+    tr.add_argument("--workers", type=int, default=None)
+    tr.add_argument("--averaging-frequency", type=int, default=1)
+    tr.add_argument("--prefetch", type=int, default=2)
+    tr.add_argument("--batch", type=int, default=128)
+    tr.add_argument("--epochs", type=int, default=1)
+    tr.add_argument("--num-examples", type=int, default=None)
+    tr.add_argument("--label-index", type=int, default=-1)
+    tr.add_argument("--num-classes", type=int, default=None)
+    tr.add_argument("--regression", action="store_true")
+    tr.add_argument("--output", help="write trained model zip here")
+    tr.set_defaults(fn=_cmd_parallel_train)
+
+    ks = sub.add_parser("keras-server", help="start the Keras gateway")
+    ks.add_argument("--port", type=int, default=25333)
+    ks.set_defaults(fn=_cmd_keras_server)
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -179,3 +179,12 @@ class SamplingDataSetIterator(DataSetIterator):
 
     def total_examples(self) -> int:
         return self._batch * self.total_batches
+
+
+class ExistingDataSetIterator(ListDataSetIterator):
+    """Wrap pre-built DataSets — accepts any iterable, including generators,
+    like the reference's Iterator<DataSet> constructor (reference
+    datasets/iterator/ExistingDataSetIterator.java)."""
+
+    def __init__(self, datasets, batch=None):
+        super().__init__(list(datasets), batch)

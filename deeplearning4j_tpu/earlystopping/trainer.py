@@ -46,6 +46,24 @@ class EarlyStoppingTrainer:
         else:
             self.model.fit(ds.features, ds.labels)
 
+    @staticmethod
+    def _check_iteration_termination(cfg, score):
+        for c in cfg.iteration_termination_conditions:
+            if c.terminate(score):
+                return c
+        return None
+
+    def _run_epoch(self, cfg):
+        """One epoch of training; returns the iteration termination condition
+        that fired, or None."""
+        for ds in self.iterator:
+            self._fit_one(ds)
+            fired = self._check_iteration_termination(cfg,
+                                                      self.model.score_value)
+            if fired is not None:
+                return fired
+        return None
+
     def fit(self) -> EarlyStoppingResult:
         cfg = self.config
         for c in cfg.iteration_termination_conditions:
@@ -64,15 +82,7 @@ class EarlyStoppingTrainer:
                 self.iterator.reset()
             terminate_reason = None
             try:
-                for ds in self.iterator:
-                    self._fit_one(ds)
-                    last = self.model.score_value
-                    for c in cfg.iteration_termination_conditions:
-                        if c.terminate(last):
-                            terminate_reason = c
-                            break
-                    if terminate_reason is not None:
-                        break
+                terminate_reason = self._run_epoch(cfg)
             except Exception as e:  # reference returns Error result, not raise
                 log.warning("early stopping terminated by exception at epoch %d: %s",
                             epoch, e)
@@ -117,6 +127,36 @@ class EarlyStoppingTrainer:
                         if self.listener:
                             self.listener.on_completion(result)
                         return result
+
+
+class EarlyStoppingParallelTrainer(EarlyStoppingTrainer):
+    """Early stopping with data-parallel epochs (reference deeplearning4j-
+    scaleout EarlyStoppingParallelTrainer.java:49 — each epoch trains through
+    ParallelWrapper instead of single-device fit; scoring/saving/termination
+    logic is shared with the base trainer)."""
+
+    def __init__(self, config, model, iterator, workers=None, listener=None,
+                 averaging_frequency: int = 1, mesh=None):
+        super().__init__(config, model, iterator, listener)
+        from deeplearning4j_tpu.parallel.wrapper import ParallelWrapper
+
+        self.wrapper = ParallelWrapper(
+            model, workers=workers, averaging_frequency=averaging_frequency,
+            prefetch=0, mesh=mesh)
+
+    def _run_epoch(self, cfg):
+        from deeplearning4j_tpu.datasets.iterators import ExistingDataSetIterator
+
+        # Per-minibatch termination checks (divergence guards must abort
+        # promptly, as in the base trainer): feed the wrapper one global
+        # batch at a time — the sharded step stays jit-cached across calls.
+        for ds in self.iterator:
+            self.wrapper.fit(ExistingDataSetIterator([ds]), epochs=1)
+            fired = self._check_iteration_termination(cfg,
+                                                      self.model.score_value)
+            if fired is not None:
+                return fired
+        return None
 
 
 # Back-compat aliases mirroring the reference class names.

@@ -36,12 +36,29 @@ class ConfusionMatrix:
         return str(self.matrix)
 
 
+class Prediction:
+    """Per-example prediction with attached metadata for error attribution
+    (reference eval/meta/Prediction.java)."""
+
+    __slots__ = ("actual", "predicted", "record_meta_data")
+
+    def __init__(self, actual: int, predicted: int, record_meta_data=None):
+        self.actual = actual
+        self.predicted = predicted
+        self.record_meta_data = record_meta_data
+
+    def __repr__(self) -> str:
+        return (f"Prediction(actual={self.actual}, "
+                f"predicted={self.predicted}, meta={self.record_meta_data!r})")
+
+
 class Evaluation:
     def __init__(self, n_classes: Optional[int] = None, labels: Optional[list] = None):
         self.labels = labels
         self.n_classes = n_classes or (len(labels) if labels else None)
         self.confusion: Optional[ConfusionMatrix] = None
         self.num_examples = 0
+        self._predictions: list = []
 
     def _ensure(self, n: int):
         if self.confusion is None:
@@ -49,7 +66,8 @@ class Evaluation:
             self.confusion = ConfusionMatrix(self.n_classes)
 
     def eval(self, labels: np.ndarray, predictions: np.ndarray,
-             mask: Optional[np.ndarray] = None) -> None:
+             mask: Optional[np.ndarray] = None,
+             record_meta_data: Optional[list] = None) -> None:
         """labels/predictions: one-hot/probabilities [B,C] or time series [B,T,C]."""
         labels = np.asarray(labels)
         predictions = np.asarray(predictions)
@@ -57,15 +75,43 @@ class Evaluation:
             B, T, C = labels.shape
             labels = labels.reshape(-1, C)
             predictions = predictions.reshape(-1, C)
+            if record_meta_data is not None:
+                # metadata is per example; replicate across that example's
+                # timesteps so flattened rows keep the right attribution
+                record_meta_data = [
+                    record_meta_data[b] if b < len(record_meta_data) else None
+                    for b in range(B) for _ in range(T)]
             if mask is not None:
                 keep = np.asarray(mask).reshape(-1) > 0
                 labels, predictions = labels[keep], predictions[keep]
+                if record_meta_data is not None:
+                    record_meta_data = [m for m, k in
+                                        zip(record_meta_data, keep) if k]
         self._ensure(labels.shape[-1])
         actual = labels.argmax(-1)
         guess = predictions.argmax(-1)
-        for a, g in zip(actual, guess):
+        for i, (a, g) in enumerate(zip(actual, guess)):
             self.confusion.add(int(a), int(g))
+            if record_meta_data is not None:
+                meta = record_meta_data[i] if i < len(record_meta_data) else None
+                self._predictions.append(Prediction(int(a), int(g), meta))
         self.num_examples += len(actual)
+
+    # ---------------------------------------------------- metadata attribution
+    def get_prediction_errors(self) -> list:
+        """Mispredicted examples with metadata (reference
+        Evaluation.getPredictionErrors)."""
+        return [p for p in self._predictions if p.actual != p.predicted]
+
+    def get_predictions_by_actual_class(self, cls: int) -> list:
+        return [p for p in self._predictions if p.actual == cls]
+
+    def get_predictions_by_predicted_class(self, cls: int) -> list:
+        return [p for p in self._predictions if p.predicted == cls]
+
+    def get_predictions(self, actual: int, predicted: int) -> list:
+        return [p for p in self._predictions
+                if p.actual == actual and p.predicted == predicted]
 
     # ------------------------------------------------------------------ metrics
     def true_positives(self, cls: int) -> int:

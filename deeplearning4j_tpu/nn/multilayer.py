@@ -284,12 +284,15 @@ class MultiLayerNetwork:
         from deeplearning4j_tpu.datasets.dataset import DataSet
 
         if y is None and isinstance(x, DataSet):
-            self._fit_batch(x.features, x.labels, x.features_mask, x.labels_mask)
+            for _ in range(epochs):
+                self._fit_batch(x.features, x.labels, x.features_mask,
+                                x.labels_mask)
             return
         if y is None and hasattr(x, "__iter__") and not isinstance(x, (jnp.ndarray, np.ndarray)):
             self.fit_iterator(x, epochs=epochs)
             return
-        self._fit_batch(x, y, fmask, lmask)
+        for _ in range(epochs):
+            self._fit_batch(x, y, fmask, lmask)
 
     def fit_iterator(self, iterator: Iterable, epochs: int = 1) -> None:
         for _ in range(epochs):

@@ -98,3 +98,58 @@ class TimeIterationListener(IterationListener):
             if iteration % 50 == 0:
                 print(f"iteration {iteration}/{self.total_iterations}, "
                       f"ETA {remaining:.0f}s")
+
+
+class ParamAndGradientIterationListener(IterationListener):
+    """Per-iteration parameter/update statistics (reference
+    ParamAndGradientIterationListener.java): mean magnitudes of parameters and
+    of the last applied update per named variable, optionally written to file."""
+
+    def __init__(self, iterations: int = 1, output_file: Optional[str] = None,
+                 print_mean_magnitudes: bool = True):
+        self.iterations = max(1, iterations)
+        self.output_file = output_file
+        self.print_mean_magnitudes = print_mean_magnitudes
+        self._last: Optional[dict] = None
+        self.rows: list = []
+
+    @staticmethod
+    def _flatten(params, prefix=""):
+        import numpy as np
+        out = {}
+        items = (params.items() if isinstance(params, dict)
+                 else enumerate(params))
+        for k, v in items:
+            name = f"{prefix}{k}"
+            if isinstance(v, (dict, list, tuple)):
+                out.update(ParamAndGradientIterationListener._flatten(
+                    v, name + "_"))
+            elif v is not None and hasattr(v, "shape"):
+                out[name] = np.asarray(v)
+        return out
+
+    def iteration_done(self, model, iteration: int) -> None:
+        import numpy as np
+        flat = self._flatten(getattr(model, "params_list", {}) or {})
+        log_now = iteration % self.iterations == 0
+        if log_now:
+            row = {"iteration": iteration, "score": float(model.score_value)}
+            for name, arr in flat.items():
+                row[f"param_{name}"] = float(np.mean(np.abs(arr)))
+                if self._last is not None and name in self._last \
+                        and self._last[name].shape == arr.shape:
+                    row[f"update_{name}"] = float(np.mean(np.abs(
+                        arr - self._last[name])))
+        # refresh every call so update_ deltas always span exactly one step
+        self._last = {k: v.copy() for k, v in flat.items()}
+        if not log_now:
+            return
+        self.rows.append(row)
+        if self.print_mean_magnitudes:
+            log.info("iter %d param/update mean magnitudes: %s",
+                     iteration, {k: round(v, 6) for k, v in row.items()
+                                 if k.startswith(("param_", "update_"))})
+        if self.output_file:
+            import json
+            with open(self.output_file, "a") as f:
+                f.write(json.dumps(row) + "\n")

@@ -1,0 +1,126 @@
+"""Parity extras: eval metadata, ParamAndGradient listener, berkeley-style
+collections, CLI, ExistingDataSetIterator, EarlyStoppingParallelTrainer."""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.eval.evaluation import Evaluation
+from deeplearning4j_tpu.utils.collections import Counter, PriorityQueue
+
+
+def test_evaluation_prediction_metadata():
+    e = Evaluation()
+    labels = np.eye(3, dtype=np.float32)[[0, 1, 2, 0]]
+    preds = np.eye(3, dtype=np.float32)[[0, 2, 2, 1]]
+    meta = [f"rec{i}" for i in range(4)]
+    e.eval(labels, preds, record_meta_data=meta)
+    errors = e.get_prediction_errors()
+    assert [(p.actual, p.predicted, p.record_meta_data) for p in errors] == [
+        (1, 2, "rec1"), (0, 1, "rec3")]
+    assert len(e.get_predictions_by_actual_class(0)) == 2
+    assert len(e.get_predictions(1, 2)) == 1
+
+
+def test_param_and_gradient_listener(tmp_path):
+    from deeplearning4j_tpu import NeuralNetConfiguration, MultiLayerNetwork
+    from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.optimize.listeners import (
+        ParamAndGradientIterationListener,
+    )
+    conf = (NeuralNetConfiguration.builder().seed(0).learning_rate(0.1)
+            .list().layer(DenseLayer(n_in=4, n_out=4, activation="relu"))
+            .layer(OutputLayer(n_in=4, n_out=2, loss="mcxent",
+                               activation="softmax")).build())
+    net = MultiLayerNetwork(conf).init()
+    out = tmp_path / "pg.jsonl"
+    lst = ParamAndGradientIterationListener(output_file=str(out),
+                                            print_mean_magnitudes=False)
+    net.set_listeners(lst)
+    x = np.random.default_rng(0).normal(size=(8, 4)).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[np.arange(8) % 2]
+    net.fit(x, y, epochs=3)
+    assert len(lst.rows) == 3
+    assert any(k.startswith("param_") for k in lst.rows[0])
+    assert any(k.startswith("update_") for k in lst.rows[1])
+    assert out.read_text().count("\n") == 3
+
+
+def test_counter_and_priority_queue():
+    c = Counter()
+    c.increment_count("a", 2.0)
+    c.increment_count("b", 1.0)
+    c.increment_count("a", 1.0)
+    assert c.argmax() == "a" and c.get_count("a") == 3.0
+    c.normalize()
+    assert abs(c.total_count() - 1.0) < 1e-12
+    q = PriorityQueue()
+    q.put("low", 1.0)
+    q.put("high", 9.0)
+    q.put("mid", 5.0)
+    assert q.peek() == "high" and q.get_priority() == 9.0
+    assert list(q) == ["high", "mid", "low"]
+
+
+def test_existing_dataset_iterator():
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.datasets.iterators import ExistingDataSetIterator
+    ds = [DataSet(np.ones((4, 2), np.float32), np.ones((4, 1), np.float32))]
+    assert sum(1 for _ in ExistingDataSetIterator(ds)) == 1
+
+
+def test_cli_parallel_train_and_parser(tmp_path):
+    from deeplearning4j_tpu import NeuralNetConfiguration, MultiLayerNetwork
+    from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.utils.model_serializer import write_model
+    from deeplearning4j_tpu.cli import main
+
+    conf = (NeuralNetConfiguration.builder().seed(0).learning_rate(0.05)
+            .list().layer(DenseLayer(n_in=2, n_out=8, activation="relu"))
+            .layer(OutputLayer(n_in=8, n_out=2, loss="mcxent",
+                               activation="softmax")).build())
+    net = MultiLayerNetwork(conf).init()
+    mpath = tmp_path / "m.zip"
+    write_model(net, str(mpath))
+    csv = tmp_path / "d.csv"
+    rng = np.random.default_rng(0)
+    rows = []
+    for i in range(64):
+        lab = i % 2
+        a, b = rng.normal(lab, 0.2), rng.normal(-lab, 0.2)
+        rows.append(f"{a},{b},{lab}")
+    csv.write_text("\n".join(rows) + "\n")
+    out = tmp_path / "trained.zip"
+    rc = main(["parallel-train", "--model", str(mpath), "--dataset", str(csv),
+               "--workers", "2", "--batch", "16", "--num-classes", "2",
+               "--label-index", "2", "--epochs", "2",
+               "--output", str(out)])
+    assert rc == 0 and out.exists()
+
+
+def test_early_stopping_parallel_trainer():
+    from deeplearning4j_tpu import NeuralNetConfiguration, MultiLayerNetwork
+    from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.earlystopping.config import EarlyStoppingConfiguration
+    from deeplearning4j_tpu.earlystopping.savers import InMemoryModelSaver
+    from deeplearning4j_tpu.earlystopping.scorecalc import DataSetLossCalculator
+    from deeplearning4j_tpu.earlystopping.termination import (
+        MaxEpochsTerminationCondition,
+    )
+    from deeplearning4j_tpu.earlystopping.trainer import (
+        EarlyStoppingParallelTrainer,
+    )
+    from deeplearning4j_tpu.datasets.mnist import IrisDataSetIterator
+
+    conf = (NeuralNetConfiguration.builder().seed(1).learning_rate(0.1)
+            .list().layer(DenseLayer(n_in=4, n_out=8, activation="relu"))
+            .layer(OutputLayer(n_in=8, n_out=3, loss="mcxent",
+                               activation="softmax")).build())
+    net = MultiLayerNetwork(conf).init()
+    it = IrisDataSetIterator(batch=24, num_examples=144)
+    cfg = EarlyStoppingConfiguration(
+        model_saver=InMemoryModelSaver(),
+        score_calculator=DataSetLossCalculator(it),
+        epoch_termination_conditions=[MaxEpochsTerminationCondition(3)])
+    trainer = EarlyStoppingParallelTrainer(cfg, net, it, workers=2)
+    result = trainer.fit()
+    assert result.total_epochs == 3
+    assert result.best_model is not None
