@@ -147,6 +147,13 @@ class EarlyStoppingParallelTrainer(EarlyStoppingTrainer):
     def _run_epoch(self, cfg):
         from deeplearning4j_tpu.datasets.iterators import ExistingDataSetIterator
 
+        if self.wrapper.averaging_frequency > 1:
+            # local-SGD semantics need the whole epoch in one wrapper.fit()
+            # (per-batch calls would force an averaging sync at each fit()
+            # end); divergence checks run once at epoch end here.
+            self.wrapper.fit(self.iterator, epochs=1)
+            return self._check_iteration_termination(cfg,
+                                                     self.model.score_value)
         # Per-minibatch termination checks (divergence guards must abort
         # promptly, as in the base trainer): feed the wrapper one global
         # batch at a time — the sharded step stays jit-cached across calls.

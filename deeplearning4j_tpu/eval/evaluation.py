@@ -87,6 +87,12 @@ class Evaluation:
                 if record_meta_data is not None:
                     record_meta_data = [m for m, k in
                                         zip(record_meta_data, keep) if k]
+        elif mask is not None:  # [B, C] with a per-example mask
+            keep = np.asarray(mask).reshape(-1) > 0
+            labels, predictions = labels[keep], predictions[keep]
+            if record_meta_data is not None:
+                record_meta_data = [m for m, k in
+                                    zip(record_meta_data, keep) if k]
         self._ensure(labels.shape[-1])
         actual = labels.argmax(-1)
         guess = predictions.argmax(-1)

@@ -1,0 +1,41 @@
+"""Decoder-only transformer LM (TPU-native flagship for long-context work).
+
+The reference's sequence story is the GravesLSTM char-RNN (models/char_rnn.py
+here); this is its TPU-native successor: causal TransformerBlocks over the
+flash-attention kernel, homogeneous blocks so the stack pipeline-parallelizes
+(parallel/pipeline.py) and the sequence axis shards for ring/Ulysses
+attention (parallel/ring_attention.py).
+"""
+from __future__ import annotations
+
+from deeplearning4j_tpu.nn.conf.builders import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import (
+    EmbeddingLayer, RnnOutputLayer, TransformerBlock,
+)
+from deeplearning4j_tpu.nn.conf.multilayer import MultiLayerConfiguration
+
+
+def transformer_lm(vocab_size: int, width: int = 256, n_layers: int = 4,
+                   n_heads: int = 4, ffn_multiplier: int = 4,
+                   max_len: int = 512, seed: int = 12345,
+                   learning_rate: float = 3e-4) -> MultiLayerConfiguration:
+    """Causal LM: one-hot/[B,T] ids -> embedding -> N blocks -> vocab logits.
+
+    Inputs are one-hot [B, T, V] (EmbeddingLayer consumes either ids or
+    one-hot); loss is per-timestep mcxent like the char-RNN config.
+    """
+    lb = (NeuralNetConfiguration.builder()
+          .seed(seed)
+          .learning_rate(learning_rate)
+          .updater("adam")
+          .weight_init("xavier")
+          .list())
+    lb.layer(EmbeddingLayer(n_in=vocab_size, n_out=width))
+    for _ in range(n_layers):
+        lb.layer(TransformerBlock(n_in=width, n_out=width, n_heads=n_heads,
+                                  ffn_multiplier=ffn_multiplier, causal=True))
+    lb.layer(RnnOutputLayer(n_in=width, n_out=vocab_size, loss="mcxent",
+                            activation="softmax"))
+    lb.set_input_type(InputType.recurrent(vocab_size, max_len))
+    return lb.build()

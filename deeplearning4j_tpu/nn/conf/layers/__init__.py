@@ -22,6 +22,9 @@ from deeplearning4j_tpu.nn.conf.layers.recurrent import (
     GravesLSTM, LSTM, GravesBidirectionalLSTM, RnnOutputLayer,
 )
 from deeplearning4j_tpu.nn.conf.layers.variational import VariationalAutoencoder
+from deeplearning4j_tpu.nn.conf.layers.attention import (
+    SelfAttentionLayer, TransformerBlock,
+)
 
 __all__ = [
     "Layer", "FeedForwardLayer", "PretrainLayer",
@@ -31,5 +34,5 @@ __all__ = [
     "GlobalPoolingLayer",
     "BatchNormalization", "LocalResponseNormalization",
     "GravesLSTM", "LSTM", "GravesBidirectionalLSTM", "RnnOutputLayer",
-    "VariationalAutoencoder",
+    "VariationalAutoencoder", "SelfAttentionLayer", "TransformerBlock",
 ]

@@ -1,0 +1,173 @@
+"""Attention and transformer layers (TPU-native additions).
+
+The reference's sequence modeling stops at LSTM + truncated BPTT (SURVEY.md
+§5); long-context attention is a required first-class TPU capability here.
+These layers ride the accelerated seam: ``flash_attention`` (Pallas tiled
+kernel on TPU, identical XLA math elsewhere — ops/pallas_kernels.py), and
+under a sequence-parallel mesh the same math runs as ring or Ulysses
+attention (parallel/ring_attention.py).
+
+Layout: [batch, time, features] like the recurrent layers.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.common import get_policy
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers.base import FeedForwardLayer
+from deeplearning4j_tpu.nn.conf.serde import register_config
+
+Array = jax.Array
+
+
+@register_config("SelfAttention")
+@dataclasses.dataclass
+class SelfAttentionLayer(FeedForwardLayer):
+    """Multi-head self-attention with fused QKV projection.
+
+    n_out is the model width; params: "Wqkv" [F, 3F] fused projection (one
+    MXU matmul), "Wo" [F, F], "b" [F]. The attention core is flash_attention.
+    """
+
+    n_heads: int = 4
+    causal: bool = False
+
+    def set_n_in(self, itype: InputType) -> None:
+        if not self.n_in:
+            self.n_in = itype.size if itype.kind == "recurrent" else itype.flat_size()
+        if not self.n_out:
+            self.n_out = self.n_in
+
+    def init_params(self, key, itype: InputType) -> dict:
+        if self.n_out % self.n_heads:
+            raise ValueError(f"n_out {self.n_out} not divisible by "
+                             f"n_heads {self.n_heads}")
+        k1, k2 = jax.random.split(key)
+        return {"Wqkv": self._init_w(k1, (self.n_in, 3 * self.n_out)),
+                "Wo": self._init_w(k2, (self.n_out, self.n_out)),
+                "b": self._init_b((self.n_out,))}
+
+    def regularizable_params(self):
+        return ("Wqkv", "Wo")
+
+    def output_type(self, itype: InputType) -> InputType:
+        return InputType.recurrent(self.n_out, itype.timesteps)
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        from deeplearning4j_tpu.ops.pallas_kernels import (
+            flash_attention, masked_attention,
+        )
+
+        pol = get_policy()
+        x = self.apply_dropout(x, rng, train)
+        B, T, _ = x.shape
+        H = self.n_heads
+        D = self.n_out // H
+        qkv = jnp.matmul(x.astype(pol.compute_dtype),
+                         params["Wqkv"].astype(pol.compute_dtype))
+        q, k, v = jnp.split(qkv.astype(pol.output_dtype), 3, axis=-1)
+        q = q.reshape(B, T, H, D)
+        k = k.reshape(B, T, H, D)
+        v = v.reshape(B, T, H, D)
+        if mask is not None:
+            o = masked_attention(q, k, v, mask, self.causal)
+        else:
+            o = flash_attention(q, k, v, self.causal)
+        o = o.reshape(B, T, self.n_out)
+        out = jnp.matmul(o.astype(pol.compute_dtype),
+                         params["Wo"].astype(pol.compute_dtype))
+        out = out.astype(pol.output_dtype) + params["b"]
+        return self.act_fn()(out), state
+
+
+@register_config("TransformerBlock")
+@dataclasses.dataclass
+class TransformerBlock(FeedForwardLayer):
+    """Pre-LN transformer block: LN -> MHA -> residual, LN -> MLP -> residual.
+
+    Homogeneous width (n_in == n_out == model width) so blocks stack and can
+    be pipeline-parallelized as identical stages (parallel/pipeline.py).
+    Params: ln1/ln2 scales+biases, attention Wqkv/Wo/bo, MLP W1/b1/W2/b2.
+    """
+
+    n_heads: int = 4
+    ffn_multiplier: int = 4
+    causal: bool = True
+
+    def set_n_in(self, itype: InputType) -> None:
+        if not self.n_in:
+            self.n_in = itype.size if itype.kind == "recurrent" else itype.flat_size()
+        if not self.n_out:
+            self.n_out = self.n_in
+
+    def init_params(self, key, itype: InputType) -> dict:
+        F = self.n_out
+        if F % self.n_heads:
+            raise ValueError(f"width {F} not divisible by heads {self.n_heads}")
+        ks = jax.random.split(key, 4)
+        hidden = self.ffn_multiplier * F
+        return {
+            "ln1_g": jnp.ones((F,), jnp.float32),
+            "ln1_b": jnp.zeros((F,), jnp.float32),
+            "Wqkv": self._init_w(ks[0], (F, 3 * F)),
+            "Wo": self._init_w(ks[1], (F, F)),
+            "bo": jnp.zeros((F,), jnp.float32),
+            "ln2_g": jnp.ones((F,), jnp.float32),
+            "ln2_b": jnp.zeros((F,), jnp.float32),
+            "W1": self._init_w(ks[2], (F, hidden)),
+            "b1": jnp.zeros((hidden,), jnp.float32),
+            "W2": self._init_w(ks[3], (hidden, F)),
+            "b2": jnp.zeros((F,), jnp.float32),
+        }
+
+    def regularizable_params(self):
+        return ("Wqkv", "Wo", "W1", "W2")
+
+    def output_type(self, itype: InputType) -> InputType:
+        return InputType.recurrent(self.n_out, itype.timesteps)
+
+    @staticmethod
+    def _ln(x, g, b, eps=1e-5):
+        mu = jnp.mean(x, axis=-1, keepdims=True)
+        var = jnp.var(x, axis=-1, keepdims=True)
+        return (x - mu) * jax.lax.rsqrt(var + eps) * g + b
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        from deeplearning4j_tpu.ops.pallas_kernels import (
+            flash_attention, masked_attention,
+        )
+
+        pol = get_policy()
+        B, T, F = x.shape
+        H = self.n_heads
+        D = F // H
+        h = self._ln(x, params["ln1_g"], params["ln1_b"])
+        qkv = jnp.matmul(h.astype(pol.compute_dtype),
+                         params["Wqkv"].astype(pol.compute_dtype))
+        q, k, v = jnp.split(qkv.astype(pol.output_dtype), 3, axis=-1)
+        q = q.reshape(B, T, H, D)
+        k = k.reshape(B, T, H, D)
+        v = v.reshape(B, T, H, D)
+        if mask is not None:
+            # padded keys must not absorb softmax mass (LN/MLP are per-token
+            # on the last axis, so attention is the only cross-token leak)
+            o = masked_attention(q, k, v, mask, self.causal)
+        else:
+            o = flash_attention(q, k, v, self.causal)
+        o = o.reshape(B, T, F)
+        att = jnp.matmul(o.astype(pol.compute_dtype),
+                         params["Wo"].astype(pol.compute_dtype))
+        x = x + att.astype(pol.output_dtype) + params["bo"]
+        h = self._ln(x, params["ln2_g"], params["ln2_b"])
+        h = jnp.matmul(h.astype(pol.compute_dtype),
+                       params["W1"].astype(pol.compute_dtype))
+        h = jax.nn.gelu(h.astype(pol.output_dtype) + params["b1"])
+        h = self.apply_dropout(h, rng, train)
+        h = jnp.matmul(h.astype(pol.compute_dtype),
+                       params["W2"].astype(pol.compute_dtype))
+        return x + h.astype(pol.output_dtype) + params["b2"], state

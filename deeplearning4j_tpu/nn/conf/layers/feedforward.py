@@ -128,9 +128,19 @@ class EmbeddingLayer(FeedForwardLayer):
                 "b": self._init_b((self.n_out,))}
 
     def apply(self, params, state, x, *, train=False, rng=None, mask=None):
-        idx = x.astype(jnp.int32)
-        if idx.ndim > 1 and idx.shape[-1] == 1:
-            idx = idx[..., 0]
+        # one-hot input: rank >= 3 ([B, T, V] sequences), or a floating-point
+        # [B, V] matrix — integer-id input is never mistaken for one-hot even
+        # when a sequence length coincides with the vocab size
+        one_hot = (x.shape[-1] == self.n_in and self.n_in > 1
+                   and (x.ndim >= 3
+                        or (x.ndim == 2
+                            and jnp.issubdtype(x.dtype, jnp.floating))))
+        if one_hot:
+            idx = jnp.argmax(x, axis=-1).astype(jnp.int32)
+        else:
+            idx = x.astype(jnp.int32)
+            if idx.ndim > 1 and idx.shape[-1] == 1:
+                idx = idx[..., 0]
         emb = params["W"][idx] + params["b"]
         return self.act_fn()(emb), state
 

@@ -1,0 +1,99 @@
+"""Mixture-of-Experts layer with top-1 (Switch-style) routing.
+
+Absent in the reference; part of the TPU-native parallelism surface (expert
+parallelism — SURVEY.md §2.4 note). The layer itself is mesh-agnostic: the
+dense ``apply`` computes the routed FFN on one device (every expert evaluated
+via batched einsum — fine at test scale), while
+``parallel/moe.py::ExpertParallelMoE`` runs the same parameters across an
+``expert`` mesh axis with all_to_all dispatch/combine (GShard-style) and
+matches the dense math exactly when no tokens overflow capacity.
+
+Params: "Wg" [F, E] router; experts batched on the leading axis —
+"W1" [E, F, H], "b1" [E, H], "W2" [E, H, F], "b2" [E, F].
+"""
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers.base import FeedForwardLayer
+from deeplearning4j_tpu.nn.conf.serde import register_config
+
+
+@register_config("MoE")
+@dataclasses.dataclass
+class MoELayer(FeedForwardLayer):
+    n_experts: int = 4
+    expert_hidden: int = 0          # 0 -> 4 * width
+    router_noise: float = 0.0       # jitter stddev at train time
+
+    def set_n_in(self, itype: InputType) -> None:
+        if not self.n_in:
+            self.n_in = itype.size if itype.kind == "recurrent" else itype.flat_size()
+        if not self.n_out:
+            self.n_out = self.n_in
+
+    def _hidden(self) -> int:
+        return self.expert_hidden or 4 * self.n_out
+
+    def init_params(self, key, itype: InputType) -> dict:
+        E, F, H = self.n_experts, self.n_in, self._hidden()
+        kg, k1, k2 = jax.random.split(key, 3)
+        w1 = jax.vmap(lambda k: self._init_w(k, (F, H)))(
+            jax.random.split(k1, E))
+        w2 = jax.vmap(lambda k: self._init_w(k, (H, F)))(
+            jax.random.split(k2, E))
+        return {"Wg": self._init_w(kg, (F, E)),
+                "W1": w1, "b1": jnp.zeros((E, H), jnp.float32),
+                "W2": w2, "b2": jnp.zeros((E, F), jnp.float32)}
+
+    def regularizable_params(self):
+        return ("W1", "W2")
+
+    def output_type(self, itype: InputType) -> InputType:
+        if itype is not None and itype.kind == "recurrent":
+            return InputType.recurrent(self.n_out, itype.timesteps)
+        return InputType.feed_forward(self.n_out)
+
+    def route(self, params, x2d, *, train=False, rng=None):
+        """Top-1 router: returns (expert_index [S], gate [S], probs [S, E])."""
+        logits = x2d @ params["Wg"]
+        if train and self.router_noise > 0 and rng is not None:
+            logits = logits + self.router_noise * jax.random.normal(
+                rng, logits.shape)
+        probs = jax.nn.softmax(logits, axis=-1)
+        eidx = jnp.argmax(probs, axis=-1)
+        gate = jnp.max(probs, axis=-1)
+        return eidx, gate, probs
+
+    def expert_ffn(self, params, buf):
+        """Apply every expert to its token buffer: buf [E, C, F] -> [E, C, F]."""
+        h = jnp.einsum("ecf,efh->ech", buf, params["W1"]) + params["b1"][:, None]
+        h = jax.nn.relu(h)
+        return (jnp.einsum("ech,ehf->ecf", h, params["W2"])
+                + params["b2"][:, None])
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        shape = x.shape
+        F = shape[-1]
+        x2d = x.reshape(-1, F)
+        eidx, gate, _ = self.route(params, x2d, train=train, rng=rng)
+        # dense evaluation: every expert on every token, select by routing
+        h = jnp.einsum("sf,efh->esh", x2d, params["W1"]) + params["b1"][:, None]
+        h = jax.nn.relu(h)
+        y_all = (jnp.einsum("esh,ehf->esf", h, params["W2"])
+                 + params["b2"][:, None])                    # [E, S, F]
+        sel = jax.nn.one_hot(eidx, self.n_experts, dtype=x2d.dtype)  # [S, E]
+        y = jnp.einsum("se,esf->sf", sel, y_all) * gate[:, None]
+        return self.act_fn()(y.reshape(shape)), state
+
+    def load_balance_loss(self, params, x2d) -> jax.Array:
+        """Switch-transformer auxiliary loss: E * sum_e f_e * P_e."""
+        eidx, _, probs = self.route(params, x2d)
+        E = self.n_experts
+        frac = jnp.mean(jax.nn.one_hot(eidx, E), axis=0)
+        prob = jnp.mean(probs, axis=0)
+        return E * jnp.sum(frac * prob)

@@ -120,6 +120,24 @@ def _tileable(tq: int, tk: int, blk_q: int = 128, blk_k: int = 128) -> bool:
     return tq % min(blk_q, tq) == 0 and tk % min(blk_k, tk) == 0
 
 
+def masked_attention(q: Array, k: Array, v: Array, key_mask: Array,
+                     causal: bool = False) -> Array:
+    """Attention with a {0,1} key/padding mask [B, Tk]: masked keys get -inf
+    logits (NOT zeroed k/v — zeroing still leaves them e^0 softmax mass).
+    Shapes as flash_attention: (B, T, H, D)."""
+    d = q.shape[-1]
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(jnp.float32(d))
+    s = jnp.where(key_mask[:, None, None, :] > 0, s, _NEG)
+    if causal:
+        tq, tk = s.shape[-2], s.shape[-1]
+        cm = jnp.arange(tq)[:, None] >= jnp.arange(tk)[None, :]
+        s = jnp.where(cm, s, _NEG)
+    p = jax.nn.softmax(s, axis=-1)
+    # rows whose keys are ALL masked (padded queries) -> zero output
+    p = jnp.where(jnp.max(s, axis=-1, keepdims=True) <= _NEG, 0.0, p)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v).astype(q.dtype)
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
 def flash_attention(q: Array, k: Array, v: Array, causal: bool = False,
                     interpret: bool = False) -> Array:
@@ -174,9 +192,10 @@ def _attention_bwd_chunked(q, k, v, g, causal, blk_q: int = 128):
         dv = dv + jnp.einsum("bhqk,bqhd->bkhd", p, gc)
         return (dk, dv), dqc
 
+    # derive the accumulator zeros from k/v (not fresh arrays) so their
+    # device-varying annotation matches inside shard_map bodies
     (dk, dv), dqs = jax.lax.scan(
-        chunk, (jnp.zeros(k.shape, jnp.float32), jnp.zeros(v.shape, jnp.float32)),
-        (qs, gs, jnp.arange(n)))
+        chunk, ((kf * 0.0), (vf * 0.0)), (qs, gs, jnp.arange(n)))
     dq = dqs.transpose(1, 0, 2, 3, 4).reshape(B, Tq + pad, H, D)[:, :Tq]
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 

@@ -1,0 +1,104 @@
+"""Expert parallelism: GShard-style all_to_all MoE dispatch over a mesh axis.
+
+Tokens are sharded over the ``expert`` mesh axis (it doubles as a data axis,
+the standard EP layout); experts are sharded over the same axis. Each shard
+routes its local tokens, packs them into per-expert capacity buffers with a
+one-hot dispatch tensor, all_to_alls the buffers so every device receives the
+tokens bound for ITS experts from every shard, applies its local experts'
+FFNs, all_to_alls back, and combines with the gate weights. Exactly matches
+the dense MoELayer math whenever no expert overflows its capacity
+(capacity_factor sizes the buffers; overflowing tokens are dropped, as in
+GShard/Switch).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+Array = jax.Array
+
+
+def _moe_local(router_params, expert_params, x, *, layer, axis_name: str,
+               capacity: int):
+    """Per-shard body. x: [Bl, T, F] local tokens; expert_params hold this
+    shard's experts on the leading axis [E_local, ...]."""
+    N = lax.psum(1, axis_name)
+    E_local = expert_params["W1"].shape[0]
+    E = N * E_local
+    Bl, T, F = x.shape
+    S = Bl * T
+    x2d = x.reshape(S, F)
+
+    eidx, gate, _ = layer.route(router_params, x2d)
+    sel = jax.nn.one_hot(eidx, E, dtype=x2d.dtype)              # [S, E]
+    # position of each token within its expert's capacity buffer
+    pos = (jnp.cumsum(sel, axis=0) - 1.0) * sel                 # [S, E]
+    in_cap = sel * (pos < capacity)
+    pos_oh = jax.nn.one_hot(pos.astype(jnp.int32), capacity,
+                            dtype=x2d.dtype) * in_cap[..., None]  # [S, E, C]
+    # pack: [E, C, F] buffers of this shard's tokens per destination expert
+    buf = jnp.einsum("sec,sf->ecf", pos_oh, x2d)
+    # exchange: every device gets its experts' buffers from every shard.
+    # [E, C, F] -> [N, E_local, C, F]; all_to_all over the leading shard axis.
+    buf = buf.reshape(N, E_local, capacity, F)
+    buf = lax.all_to_all(buf, axis_name, split_axis=0, concat_axis=0,
+                         tiled=False)                           # [N, El, C, F]
+    # apply local experts to tokens from all shards
+    buf = buf.transpose(1, 0, 2, 3).reshape(E_local, N * capacity, F)
+    out = layer.expert_ffn(expert_params, buf)                  # [El, N*C, F]
+    out = out.reshape(E_local, N, capacity, F).transpose(1, 0, 2, 3)
+    out = lax.all_to_all(out, axis_name, split_axis=0, concat_axis=0,
+                         tiled=False)                           # [N=E grouping back]
+    out = out.reshape(E, capacity, F)
+    # combine: gather each token's result from its (expert, slot) and gate it
+    y = jnp.einsum("sec,ecf->sf", pos_oh, out) * gate[:, None]
+    return y.reshape(Bl, T, F)
+
+
+class ExpertParallelMoE:
+    """Run a MoELayer's parameters expert-parallel over ``axis_name``."""
+
+    def __init__(self, layer, mesh: Mesh, axis_name: str = "expert",
+                 capacity_factor: float = 2.0):
+        self.layer = layer
+        self.mesh = mesh
+        self.axis_name = axis_name
+        self.capacity_factor = capacity_factor
+        n = mesh.shape[axis_name]
+        if layer.n_experts % n:
+            raise ValueError(f"{layer.n_experts} experts not divisible by "
+                             f"mesh axis size {n}")
+
+    def __call__(self, params: dict, x: Array) -> Array:
+        """x: [B, T, F] with B divisible by the axis size. Returns [B, T, F]."""
+        n = self.mesh.shape[self.axis_name]
+        B, T, F = x.shape
+        if B % n:
+            raise ValueError(f"batch {B} not divisible by axis size {n}")
+        tokens_per_shard = (B // n) * T
+        capacity = max(1, int(self.capacity_factor * tokens_per_shard
+                              / self.layer.n_experts))
+        router = {"Wg": params["Wg"]}
+        experts = {k: params[k] for k in ("W1", "b1", "W2", "b2")}
+        fn = shard_map(
+            functools.partial(_moe_local, layer=self.layer,
+                              axis_name=self.axis_name, capacity=capacity),
+            mesh=self.mesh,
+            in_specs=({"Wg": P()},
+                      {k: P(self.axis_name) for k in experts},
+                      P(self.axis_name)),
+            out_specs=P(self.axis_name),
+        )
+        router = jax.device_put(router,
+                                {"Wg": NamedSharding(self.mesh, P())})
+        experts = jax.device_put(
+            experts, {k: NamedSharding(self.mesh, P(self.axis_name))
+                      for k in experts})
+        x = jax.device_put(x, NamedSharding(self.mesh, P(self.axis_name)))
+        # same epilogue as the dense MoELayer.apply (activation after combine)
+        return self.layer.act_fn()(fn(router, experts, x))
