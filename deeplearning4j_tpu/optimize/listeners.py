@@ -153,3 +153,83 @@ class ParamAndGradientIterationListener(IterationListener):
             import json
             with open(self.output_file, "a") as f:
                 f.write(json.dumps(row) + "\n")
+
+
+class CheckpointListener(IterationListener):
+    """Periodic checkpointing for deterministic restart (SURVEY.md §5:
+    reference ModelSerializer zips include updater state so training resumes
+    bit-identically; early-stopping savers persist best/latest per epoch).
+    Writes model zips every N iterations and/or every epoch end, keeping the
+    last ``keep_last`` files plus `latest.zip`."""
+
+    def __init__(self, directory: str, every_n_iterations: Optional[int] = None,
+                 every_n_epochs: Optional[int] = 1, keep_last: int = 3):
+        import glob
+        import os
+        self.directory = directory
+        os.makedirs(directory, exist_ok=True)
+        self.every_n_iterations = every_n_iterations
+        self.every_n_epochs = every_n_epochs
+        self.keep_last = keep_last
+        # rotation must honor keep_last across restarts: seed from disk
+        self._written: list = sorted(
+            glob.glob(os.path.join(directory, "checkpoint_*.zip")),
+            key=os.path.getmtime)
+
+    def _save(self, model, tag: str) -> str:
+        import os
+        import shutil
+        from deeplearning4j_tpu.utils.model_serializer import write_model
+        path = os.path.join(self.directory, f"checkpoint_{tag}.zip")
+        tmp = path + ".tmp"
+        # atomic: a crash mid-write must never leave a truncated zip behind
+        write_model(model, tmp)
+        os.replace(tmp, path)
+        latest_tmp = os.path.join(self.directory, "latest.zip.tmp")
+        shutil.copyfile(path, latest_tmp)  # file copy, not a 2nd serialize
+        os.replace(latest_tmp, os.path.join(self.directory, "latest.zip"))
+        self._written.append(path)
+        while len(self._written) > self.keep_last:
+            old = self._written.pop(0)
+            try:
+                os.remove(old)
+            except OSError:
+                pass
+        return path
+
+    def iteration_done(self, model, iteration: int) -> None:
+        if self.every_n_iterations and iteration % self.every_n_iterations == 0:
+            self._save(model, f"iter_{iteration}")
+
+    def on_epoch_end(self, model) -> None:
+        epoch = getattr(model, "epoch", 0)
+        if self.every_n_epochs and epoch % self.every_n_epochs == 0:
+            self._save(model, f"epoch_{epoch}")
+
+    @staticmethod
+    def last_checkpoint(directory: str) -> Optional[str]:
+        import os
+        p = os.path.join(directory, "latest.zip")
+        return p if os.path.exists(p) else None
+
+
+class NanScoreWatcher(IterationListener):
+    """Failure detection: raise (or callback) the moment the score goes
+    NaN/Inf instead of training on garbage (SURVEY.md §5 — the reference's
+    only divergence guard is InvalidScoreIterationTerminationCondition in
+    early stopping; this makes it available to any fit loop)."""
+
+    def __init__(self, on_invalid=None):
+        self.on_invalid = on_invalid
+        self.triggered = False
+
+    def iteration_done(self, model, iteration: int) -> None:
+        import math
+        s = float(model.score_value)
+        if math.isnan(s) or math.isinf(s):
+            self.triggered = True
+            if self.on_invalid is not None:
+                self.on_invalid(model, iteration, s)
+            else:
+                raise FloatingPointError(
+                    f"invalid score {s} at iteration {iteration}")

@@ -91,12 +91,30 @@ class _Handler(BaseHTTPRequestHandler):
             self._json(self.ui.model_data(q.get("session", [None])[0]))
         elif path == "/train/system/data":
             self._json(self.ui.system_data())
+        elif path == "/train/histograms/data":
+            # HistogramModule equivalent: latest param/gradient/update
+            # histograms per variable
+            q = parse_qs(urlparse(self.path).query)
+            self._json(self.ui.histogram_data(q.get("session", [None])[0]))
+        elif path == "/tsne/data":
+            # TsneModule equivalent: last uploaded embedding coords
+            self._json(self.ui.tsne_data())
         else:
             self._json({"error": "not found"}, 404)
 
     def do_POST(self):
         path = urlparse(self.path).path
-        if path == "/remoteReceive":
+        if path == "/tsne/upload":
+            # TsneModule upload: JSON {"coords": [[x, y], ...], "labels": []}
+            length = int(self.headers.get("Content-Length", "0"))
+            try:
+                payload = json.loads(self.rfile.read(length))
+                self.ui.set_tsne(payload)
+            except Exception as e:
+                self._json({"status": "error", "detail": str(e)}, 400)
+                return
+            self._json({"status": "ok"})
+        elif path == "/remoteReceive":
             # RemoteReceiverModule equivalent: accept encoded StatsReports
             length = int(self.headers.get("Content-Length", "0"))
             data = self.rfile.read(length)
@@ -120,6 +138,7 @@ class UIServer:
         self.port = port
         self._storages: List[StatsStorage] = []
         self._remote_storage: Optional[StatsStorage] = None
+        self._tsne: dict = {"coords": [], "labels": []}
         handler = type("BoundHandler", (_Handler,), {"ui": self})
         self._httpd = ThreadingHTTPServer(("127.0.0.1", port), handler)
         self.port = self._httpd.server_address[1]
@@ -220,6 +239,29 @@ class UIServer:
             "deviceMemBytes": [r.device_mem_bytes for r in reports],
             "timestamps": [r.timestamp for r in reports],
         }
+
+    def histogram_data(self, session: Optional[str] = None) -> dict:
+        """Latest histograms per variable (reference HistogramModule)."""
+        reports = self._all_reports(session)
+        if not reports:
+            return {"params": {}, "gradients": {}, "updates": {}}
+        r = reports[-1]
+        def fmt(section):
+            return {name: {"meanMagnitude": mm, "bins": hist,
+                           "min": lo, "max": hi}
+                    for name, (mm, hist, (lo, hi)) in section.items()}
+        return {"iteration": r.iteration,
+                "params": fmt(r.param_stats),
+                "gradients": fmt(r.gradient_stats),
+                "updates": fmt(r.update_stats)}
+
+    def set_tsne(self, payload: dict) -> None:
+        """TsneModule upload target (coords + optional labels)."""
+        self._tsne = {"coords": payload.get("coords", []),
+                      "labels": payload.get("labels", [])}
+
+    def tsne_data(self) -> dict:
+        return self._tsne
 
 
 class RemoteUIStatsStorageRouter:

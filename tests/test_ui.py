@@ -123,3 +123,37 @@ def test_remote_router_posts_to_server():
         assert "remote_sess" in server.sessions()
     finally:
         server.stop()
+
+
+def test_histogram_and_tsne_endpoints():
+    import json as _json
+    import urllib.request
+    from deeplearning4j_tpu.ui.server import UIServer
+    from deeplearning4j_tpu.ui.storage import InMemoryStatsStorage
+    from deeplearning4j_tpu.ui.stats import StatsReport
+
+    server = UIServer(port=0)
+    try:
+        storage = InMemoryStatsStorage()
+        server.attach(storage)
+        r = StatsReport("s1", "w0", 1000)
+        r.iteration = 7
+        r.param_stats["l0_W"] = (0.5, [1, 2, 3], (-1.0, 1.0))
+        storage.put_update(r)
+        base = f"http://127.0.0.1:{server.port}"
+        with urllib.request.urlopen(f"{base}/train/histograms/data") as resp:
+            d = _json.loads(resp.read())
+        assert d["iteration"] == 7
+        assert d["params"]["l0_W"]["bins"] == [1, 2, 3]
+        # tsne upload + fetch
+        payload = _json.dumps({"coords": [[0.1, 0.2], [0.3, 0.4]],
+                               "labels": ["a", "b"]}).encode()
+        req = urllib.request.Request(f"{base}/tsne/upload", data=payload,
+                                     method="POST")
+        with urllib.request.urlopen(req) as resp:
+            assert _json.loads(resp.read())["status"] == "ok"
+        with urllib.request.urlopen(f"{base}/tsne/data") as resp:
+            t = _json.loads(resp.read())
+        assert t["labels"] == ["a", "b"] and len(t["coords"]) == 2
+    finally:
+        server.stop()
