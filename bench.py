@@ -92,9 +92,77 @@ def bench_resnet50(batch: int, iters: int, warmup: int = 3) -> dict:
     }
 
 
+def bench_char_rnn(batch: int, iters: int, warmup: int = 3,
+                   vocab: int = 64, seq: int = 50) -> dict:
+    """GravesLSTM char-RNN (BASELINE config 3): TBPTT-length sequences."""
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.models.char_rnn import char_rnn_lstm
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork, make_train_step
+
+    conf = char_rnn_lstm(vocab_size=vocab, hidden=200, tbptt_length=seq)
+    conf.backprop_type = "Standard"  # one jitted step over the tbptt window
+    net = MultiLayerNetwork(conf).init()
+    step = jax.jit(make_train_step(net.conf), donate_argnums=(0, 1, 2))
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, vocab, (batch, seq))
+    x = jnp.asarray(np.eye(vocab, dtype=np.float32)[ids])
+    y = x
+    key = jax.random.PRNGKey(0)
+    params, states, upd = net.params_list, net.state_list, net.updater_state
+    for i in range(warmup):
+        params, states, upd, loss = step(params, states, upd, x, y, key,
+                                         jnp.int32(i))
+    float(loss)
+    t0 = time.perf_counter()
+    for i in range(iters):
+        params, states, upd, loss = step(params, states, upd, x, y, key,
+                                         jnp.int32(i))
+    float(loss)
+    dt = time.perf_counter() - t0
+    return {"samples_per_sec": batch * iters / dt,
+            "chars_per_sec": batch * seq * iters / dt,
+            "step_time_ms": dt / iters * 1000, "batch": batch, "iters": iters}
+
+
+def bench_transformer(batch: int, iters: int, warmup: int = 3,
+                      vocab: int = 256, seq: int = 256) -> dict:
+    """Decoder-only transformer LM over the flash-attention kernel."""
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.models.transformer import transformer_lm
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork, make_train_step
+
+    conf = transformer_lm(vocab_size=vocab, width=256, n_layers=4, n_heads=4,
+                          max_len=seq)
+    net = MultiLayerNetwork(conf).init()
+    step = jax.jit(make_train_step(net.conf), donate_argnums=(0, 1, 2))
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, vocab, (batch, seq))
+    x = jnp.asarray(np.eye(vocab, dtype=np.float32)[ids])
+    key = jax.random.PRNGKey(0)
+    params, states, upd = net.params_list, net.state_list, net.updater_state
+    for i in range(warmup):
+        params, states, upd, loss = step(params, states, upd, x, x, key,
+                                         jnp.int32(i))
+    float(loss)
+    t0 = time.perf_counter()
+    for i in range(iters):
+        params, states, upd, loss = step(params, states, upd, x, x, key,
+                                         jnp.int32(i))
+    float(loss)
+    dt = time.perf_counter() - t0
+    return {"samples_per_sec": batch * iters / dt,
+            "tokens_per_sec": batch * seq * iters / dt,
+            "step_time_ms": dt / iters * 1000, "batch": batch, "iters": iters}
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
-    ap.add_argument("--model", default="lenet", choices=["lenet", "resnet50"])
+    ap.add_argument("--model", default="lenet",
+                    choices=["lenet", "resnet50", "char_rnn", "transformer"])
     ap.add_argument("--batch", type=int, default=None)
     ap.add_argument("--iters", type=int, default=None)
     ap.add_argument("--bf16", action="store_true",
@@ -108,6 +176,12 @@ def main() -> None:
     if args.model == "lenet":
         r = bench_lenet(args.batch or 128, args.iters or 50)
         metric = "lenet_mnist_samples_per_sec"
+    elif args.model == "char_rnn":
+        r = bench_char_rnn(args.batch or 32, args.iters or 10)
+        metric = "char_rnn_samples_per_sec"
+    elif args.model == "transformer":
+        r = bench_transformer(args.batch or 16, args.iters or 10)
+        metric = "transformer_lm_samples_per_sec"
     else:
         r = bench_resnet50(args.batch or 32, args.iters or 10)
         metric = "resnet50_samples_per_sec_per_chip"

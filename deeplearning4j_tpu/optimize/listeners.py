@@ -188,6 +188,8 @@ class CheckpointListener(IterationListener):
         latest_tmp = os.path.join(self.directory, "latest.zip.tmp")
         shutil.copyfile(path, latest_tmp)  # file copy, not a 2nd serialize
         os.replace(latest_tmp, os.path.join(self.directory, "latest.zip"))
+        if path in self._written:  # re-saved tag (e.g. resume after rollback)
+            self._written.remove(path)
         self._written.append(path)
         while len(self._written) > self.keep_last:
             old = self._written.pop(0)

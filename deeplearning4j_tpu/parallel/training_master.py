@@ -293,6 +293,7 @@ class DistributedMultiLayer:
     def __init__(self, model, training_master: TrainingMaster):
         self.model = model
         self.master = training_master
+        self._eval_fwd = None  # jitted sharded forward, built on first use
 
     def fit(self, data, epochs: int = 1):
         for _ in range(epochs):
@@ -301,7 +302,42 @@ class DistributedMultiLayer:
         return self.model
 
     def evaluate(self, iterator):
-        return self.model.evaluate(iterator)
+        """Distributed evaluation (reference impl/multilayer/evaluation/):
+        forward passes run data-sharded over the master's mesh; the confusion
+        matrix accumulates on host and merges across batches."""
+        mesh = getattr(self.master, "mesh", None)
+        if mesh is None or "data" not in mesh.shape:
+            return self.model.evaluate(iterator)
+        from deeplearning4j_tpu.eval.evaluation import Evaluation
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+        n = mesh.shape["data"]
+        net = self.model
+        if self._eval_fwd is None:  # jit caches by fn identity: build once
+            repl = NamedSharding(mesh, P())
+            batch_sh = NamedSharding(mesh, P("data"))
+            if isinstance(net, MultiLayerNetwork):
+                self._eval_fwd = jax.jit(
+                    lambda p, s, x: net._output_pure(p, s, x, train=False)[0],
+                    in_shardings=(repl, repl, batch_sh))
+            else:
+                self._eval_fwd = jax.jit(
+                    lambda p, s, x: net._output_pure(p, s, [x])[0][0],
+                    in_shardings=(repl, repl, batch_sh))
+        fwd = self._eval_fwd
+        params, states = net.params_list, net.state_list
+        e = Evaluation()
+        for ds in iterator:
+            x, y = np.asarray(ds.features), np.asarray(ds.labels)
+            pad = (-len(x)) % n
+            if pad:  # batch must divide the data axis; pad and trim below
+                x = np.concatenate([x, np.repeat(x[-1:], pad, 0)])
+            out = np.asarray(fwd(params, states, jnp.asarray(x)))
+            if pad:
+                out = out[:-pad]
+            # out is trimmed back to len(y), so the original mask aligns
+            e.eval(y, out, mask=ds.labels_mask)
+        return e
 
     def get_score(self) -> float:
         return self.model.score_value

@@ -1,0 +1,59 @@
+"""Character-level LSTM language model (reference GravesLSTMCharModelling).
+
+Run: python examples/char_rnn.py [--steps 30]
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import argparse
+
+import numpy as np
+
+from deeplearning4j_tpu.models.char_rnn import char_rnn_lstm
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+TEXT = ("the quick brown fox jumps over the lazy dog. " * 40)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=200)
+    ap.add_argument("--seq", type=int, default=32)
+    args = ap.parse_args()
+
+    chars = sorted(set(TEXT))
+    idx = {c: i for i, c in enumerate(chars)}
+    V = len(chars)
+    conf = char_rnn_lstm(vocab_size=V, hidden=128, tbptt_length=args.seq,
+                         learning_rate=0.03)
+    net = MultiLayerNetwork(conf).init()
+
+    ids = np.array([idx[c] for c in TEXT])
+    B, T = 16, args.seq
+    starts = np.random.default_rng(0).integers(0, len(ids) - T - 1, B)
+    x = np.eye(V, dtype=np.float32)[np.stack([ids[s:s + T] for s in starts])]
+    y = np.eye(V, dtype=np.float32)[np.stack([ids[s + 1:s + T + 1]
+                                              for s in starts])]
+    for step in range(args.steps):
+        net.fit(x, y)
+        if step % 10 == 0:
+            print(f"step {step}: loss {net.score_value:.4f}")
+
+    # streaming generation via rnn_time_step (reference rnnTimeStep)
+    net.rnn_clear_previous_state()
+    cur = np.zeros((1, 1, V), np.float32)
+    cur[0, 0, idx["t"]] = 1
+    out = ["t"]
+    for _ in range(60):
+        probs = np.asarray(net.rnn_time_step(cur))[0, -1]
+        nxt = int(np.argmax(probs))
+        out.append(chars[nxt])
+        cur = np.zeros((1, 1, V), np.float32)
+        cur[0, 0, nxt] = 1
+    print("sample:", "".join(out))
+
+
+if __name__ == "__main__":
+    main()

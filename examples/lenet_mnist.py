@@ -1,0 +1,35 @@
+"""LeNet-5 on MNIST — the reference's LenetMnistExample equivalent.
+
+Run: python examples/lenet_mnist.py [--epochs 1]
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import argparse
+
+from deeplearning4j_tpu.datasets.mnist import MnistDataSetIterator
+from deeplearning4j_tpu.models.lenet import lenet_mnist
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.optimize.listeners import ScoreIterationListener
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=1)
+    ap.add_argument("--batch", type=int, default=128)
+    ap.add_argument("--num-examples", type=int, default=8192)
+    args = ap.parse_args()
+
+    net = MultiLayerNetwork(lenet_mnist()).init()
+    net.set_listeners(ScoreIterationListener(10))
+    train = MnistDataSetIterator(args.batch, train=True,
+                                 num_examples=args.num_examples)
+    net.fit_iterator(train, epochs=args.epochs)
+    test = MnistDataSetIterator(args.batch, train=False, num_examples=2048)
+    print(net.evaluate(test).stats())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,34 @@
+"""Data-parallel training over the device mesh (reference ParallelWrapper /
+Spark parameter averaging). On CPU run with:
+  XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+  PALLAS_AXON_POOL_IPS= python examples/parallel_training.py
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+from deeplearning4j_tpu.datasets.mnist import MnistDataSetIterator
+from deeplearning4j_tpu.models.lenet import lenet_mnist
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.parallel.wrapper import ParallelWrapper
+
+
+def main():
+    n = min(len(jax.devices()), 8)
+    net = MultiLayerNetwork(lenet_mnist()).init()
+    wrapper = (ParallelWrapper.builder(net)
+               .workers(n)
+               .averaging_frequency(1)
+               .build())
+    it = MnistDataSetIterator(batch=16 * n, num_examples=4096)
+    wrapper.fit(it, epochs=1)
+    print(f"{n}-way DP done; score {net.score_value:.4f}")
+    test = MnistDataSetIterator(batch=256, train=False, num_examples=1024)
+    print("accuracy:", net.evaluate(test).accuracy())
+
+
+if __name__ == "__main__":
+    main()

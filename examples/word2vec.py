@@ -1,0 +1,30 @@
+"""Word2Vec skip-gram embeddings (reference Word2VecRawTextExample).
+
+Run: python examples/word2vec.py
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+
+CORPUS = (["the king rules the royal castle"] * 30
+          + ["the queen rules the royal castle"] * 30
+          + ["a dog chases a cat in the garden"] * 30
+          + ["a cat flees a dog in the garden"] * 30)
+
+
+def main():
+    w2v = (Word2Vec.Builder()
+           .layer_size(32).window_size(4).min_word_frequency(3)
+           .negative_sample(5).epochs(10).learning_rate(0.05).seed(42)
+           .build())
+    w2v.fit([s.split() for s in CORPUS])
+    print("similarity(king, queen):", w2v.similarity("king", "queen"))
+    print("similarity(king, garden):", w2v.similarity("king", "garden"))
+    print("nearest to 'castle':", w2v.words_nearest("castle", 3))
+
+
+if __name__ == "__main__":
+    main()

@@ -102,3 +102,32 @@ def test_parameter_server_async_trains():
     wrapper.fit(ListDataSetIterator(data), epochs=3)
     s1 = net.score(gx, gy)
     assert s1 < s0 * 0.9, (s0, s1)
+
+
+def test_distributed_evaluation_matches_single_device():
+    import numpy as np
+    from deeplearning4j_tpu import NeuralNetConfiguration, MultiLayerNetwork
+    from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.datasets.iterators import ArrayDataSetIterator
+    from deeplearning4j_tpu.parallel.training_master import (
+        DistributedMultiLayer, ParameterAveragingTrainingMaster,
+    )
+
+    conf = (NeuralNetConfiguration.builder().seed(5).learning_rate(0.1)
+            .list().layer(DenseLayer(n_in=4, n_out=8, activation="relu"))
+            .layer(OutputLayer(n_in=8, n_out=3, loss="mcxent",
+                               activation="softmax")).build())
+    net = MultiLayerNetwork(conf).init()
+    rng = np.random.default_rng(0)
+    labels = rng.integers(0, 3, 50)  # 50: not divisible by 4 -> pad path
+    x = rng.normal(0, 0.3, (50, 4)).astype(np.float32)
+    x[np.arange(50), labels] += 2.0
+    y = np.eye(3, dtype=np.float32)[labels]
+    it = ArrayDataSetIterator(x, y, batch=25, shuffle=False)
+    master = ParameterAveragingTrainingMaster.Builder(4).build()
+    dist = DistributedMultiLayer(net, master)
+    e_dist = dist.evaluate(it)
+    e_single = net.evaluate(it)
+    assert e_dist.accuracy() == e_single.accuracy()
+    np.testing.assert_array_equal(e_dist.confusion.matrix,
+                                  e_single.confusion.matrix)
