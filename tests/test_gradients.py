@@ -125,3 +125,43 @@ class TestGradientCheckRNN:
                            jnp.asarray(x2), jnp.asarray(y), None,
                            jnp.asarray(mask), jnp.asarray(mask))
         np.testing.assert_allclose(float(loss1), float(loss2), rtol=1e-6)
+
+
+class TestGradientCheckAttentionMoE:
+    def test_self_attention_block(self):
+        from deeplearning4j_tpu.nn.conf.layers import SelfAttentionLayer
+        net = build([SelfAttentionLayer(n_in=6, n_out=6, n_heads=2,
+                                        causal=True, activation="identity"),
+                     RnnOutputLayer(n_in=6, n_out=3, loss="mcxent",
+                                    activation="softmax")],
+                    input_type=InputType.recurrent(6, 5))
+        x = rand((2, 5, 6), seed=11)
+        y = np.zeros((2, 5, 3), np.float32)
+        y[..., 0] = 1
+        check_gradients(net, x, y)
+
+    def test_transformer_block(self):
+        from deeplearning4j_tpu.nn.conf.layers import TransformerBlock
+        net = build([TransformerBlock(n_in=6, n_out=6, n_heads=2,
+                                      ffn_multiplier=2, causal=True),
+                     RnnOutputLayer(n_in=6, n_out=3, loss="mcxent",
+                                    activation="softmax")],
+                    input_type=InputType.recurrent(6, 4))
+        x = rand((2, 4, 6), seed=12)
+        y = np.zeros((2, 4, 3), np.float32)
+        y[..., 1] = 1
+        check_gradients(net, x, y)
+
+    def test_moe_layer(self):
+        from deeplearning4j_tpu.nn.conf.layers.moe import MoELayer
+        net = build([MoELayer(n_in=6, n_out=6, n_experts=3, expert_hidden=8,
+                              activation="identity"),
+                     RnnOutputLayer(n_in=6, n_out=3, loss="mcxent",
+                                    activation="softmax")],
+                    input_type=InputType.recurrent(6, 4))
+        x = rand((2, 4, 6), seed=13)
+        y = np.zeros((2, 4, 3), np.float32)
+        y[..., 2] = 1
+        # router argmax is piecewise-constant but a.e. differentiable; with
+        # eps=1e-6 in f64 no routing flip occurs at this seed
+        check_gradients(net, x, y)
