@@ -159,7 +159,57 @@ def bench_transformer(batch: int, iters: int, warmup: int = 3,
             "step_time_ms": dt / iters * 1000, "batch": batch, "iters": iters}
 
 
+_METRICS = {
+    "lenet": "lenet_mnist_samples_per_sec",
+    "char_rnn": "char_rnn_samples_per_sec",
+    "transformer": "transformer_lm_samples_per_sec",
+    "resnet50": "resnet50_samples_per_sec_per_chip",
+}
+
+
+def _child_main(args) -> None:
+    """Run one benchmark in-process and print its JSON record."""
+    if args.bf16:
+        from deeplearning4j_tpu.common import bf16_matmul_policy
+        bf16_matmul_policy()
+
+    if args.model == "lenet":
+        r = bench_lenet(args.batch or 128, args.iters or 50)
+    elif args.model == "char_rnn":
+        r = bench_char_rnn(args.batch or 32, args.iters or 10)
+    elif args.model == "transformer":
+        r = bench_transformer(args.batch or 16, args.iters or 10)
+    else:
+        r = bench_resnet50(args.batch or 32, args.iters or 10)
+
+    vs = (r["samples_per_sec"] / BASELINE_SAMPLES_PER_SEC
+          if BASELINE_SAMPLES_PER_SEC else 1.0)
+    import jax
+    r["backend"] = jax.default_backend()
+    print(json.dumps({
+        "metric": _METRICS[args.model],
+        "value": round(r["samples_per_sec"], 2),
+        "unit": "samples/sec",
+        "vs_baseline": round(vs, 3),
+        "detail": r,
+    }), flush=True)
+
+
 def main() -> None:
+    """Parent driver: run the benchmark in a subprocess with bounded retries.
+
+    The TPU relay on this box wedges intermittently (backend init raises
+    UNAVAILABLE, or dispatch hangs indefinitely). The reference's measurement
+    surface (PerformanceListener.java:1) assumes a healthy local device; here we
+    must not — so each attempt runs in a killable subprocess with a hard
+    timeout, and after the retry budget we still print ONE valid JSON record
+    (an error record, never a stack trace) so the round always captures a
+    parseable result.
+    """
+    import os
+    import subprocess
+    import sys
+
     ap = argparse.ArgumentParser()
     ap.add_argument("--model", default="lenet",
                     choices=["lenet", "resnet50", "char_rnn", "transformer"])
@@ -167,34 +217,81 @@ def main() -> None:
     ap.add_argument("--iters", type=int, default=None)
     ap.add_argument("--bf16", action="store_true",
                     help="bfloat16 matmul/conv compute (f32 params)")
+    ap.add_argument("--child", action="store_true", help=argparse.SUPPRESS)
+    # worst case must finish inside the harness's own command timeout
+    # (round-1 artifacts show it kills at ~600s): 2 x 240s + 5s backoff < 500s
+    ap.add_argument("--attempts", type=int, default=2)
+    ap.add_argument("--attempt-timeout", type=float, default=240.0)
     args = ap.parse_args()
 
-    if args.bf16:
-        from deeplearning4j_tpu.common import bf16_matmul_policy
-        bf16_matmul_policy()
+    if args.child:
+        _child_main(args)
+        return
 
-    if args.model == "lenet":
-        r = bench_lenet(args.batch or 128, args.iters or 50)
-        metric = "lenet_mnist_samples_per_sec"
-    elif args.model == "char_rnn":
-        r = bench_char_rnn(args.batch or 32, args.iters or 10)
-        metric = "char_rnn_samples_per_sec"
-    elif args.model == "transformer":
-        r = bench_transformer(args.batch or 16, args.iters or 10)
-        metric = "transformer_lm_samples_per_sec"
-    else:
-        r = bench_resnet50(args.batch or 32, args.iters or 10)
-        metric = "resnet50_samples_per_sec_per_chip"
+    # forward our full argv so new flags can never silently drop from the
+    # child (--child's parser ignores --attempts/--attempt-timeout)
+    cmd = [sys.executable, os.path.abspath(__file__), "--child"] + sys.argv[1:]
 
-    vs = (r["samples_per_sec"] / BASELINE_SAMPLES_PER_SEC
-          if BASELINE_SAMPLES_PER_SEC else 1.0)
+    def _scan_json(stdout) -> dict | None:
+        if isinstance(stdout, bytes):
+            stdout = stdout.decode("utf-8", errors="replace")
+        for line in reversed((stdout or "").strip().splitlines()):
+            try:
+                rec = json.loads(line)
+            except (json.JSONDecodeError, ValueError):
+                continue
+            if isinstance(rec, dict) and "metric" in rec:
+                return rec
+        return None
+
+    def _tail(s) -> str:
+        if isinstance(s, bytes):
+            s = s.decode("utf-8", errors="replace")
+        return (s or "")[-600:]
+
+    last_err = ""
+    last_was_timeout = False
+    for attempt in range(args.attempts):
+        try:
+            proc = subprocess.run(cmd, capture_output=True, text=True,
+                                  timeout=args.attempt_timeout)
+            rec = _scan_json(proc.stdout)
+            if rec is None:
+                last_was_timeout = False
+                last_err = (f"attempt {attempt + 1}: rc={proc.returncode}; "
+                            + _tail(proc.stderr or proc.stdout))
+        except subprocess.TimeoutExpired as e:
+            # the child may have printed its record and then wedged in relay
+            # teardown — a timeout after a valid JSON line is still a success
+            rec = _scan_json(e.stdout)
+            if rec is None:
+                last_was_timeout = True
+                last_err = (f"attempt {attempt + 1}: timed out after "
+                            f"{args.attempt_timeout}s; stderr tail: "
+                            + _tail(e.stderr))
+        if rec is not None:
+            rec["detail"] = dict(rec.get("detail", {}), attempt=attempt + 1)
+            print(json.dumps(rec), flush=True)
+            return
+        if attempt + 1 < args.attempts:
+            time.sleep(5 * (attempt + 1))
+
+    # Retry budget exhausted: always emit a machine-readable error record.
+    # Classify by the FINAL attempt: a timeout looks like the wedging relay
+    # (retryable infra — exit 0 so the record is the signal); a child crash
+    # is a deterministic code failure and must NOT be masked as flakiness
+    # (exit 1, same record).
+    kind = ("device unreachable after retries"
+            if last_was_timeout else "benchmark child crashed on every attempt")
     print(json.dumps({
-        "metric": metric,
-        "value": round(r["samples_per_sec"], 2),
+        "metric": _METRICS[args.model],
+        "value": 0.0,
         "unit": "samples/sec",
-        "vs_baseline": round(vs, 3),
-        "detail": r,
-    }))
+        "vs_baseline": 0.0,
+        "error": kind + ": " + last_err.replace("\n", " | "),
+    }), flush=True)
+    if not last_was_timeout:
+        sys.exit(1)
 
 
 if __name__ == "__main__":
