@@ -6,157 +6,230 @@ chip — the reference's LenetMnistExample config measured by its PerformanceLis
 numbers (BASELINE.md), so vs_baseline is reported against the first empirical
 recording in BASELINE.md once established.
 
-Usage: python bench.py [--model lenet|resnet50] [--batch N] [--iters N]
+TPU-first measurement methodology:
+ - K train steps run per host dispatch (`lax.scan` inside one XLA program,
+   see make_multistep_train_step) so relay/host dispatch latency is amortized;
+ - compute dtype defaults to bfloat16 (MXU-native; pass --f32 to disable);
+ - inputs are staged device-side once (a (K, B, ...) stack in HBM);
+ - only a host read (`float(loss)`) is trusted as a sync point — through the
+   axon relay `block_until_ready` returns before remote execution completes;
+ - model FLOPs come from XLA's own cost analysis of the compiled program, and
+   MFU is reported against the chip's bf16 peak (BENCH_PEAK_FLOPS env, default
+   197e12 = TPU v5e).
+
+Usage: python bench.py [--model lenet|resnet50|char_rnn|transformer|word2vec]
+                       [--batch N] [--iters N] [--ksteps K] [--f32]
 """
 from __future__ import annotations
 
 import argparse
 import json
+import os
 import time
 
 import numpy as np
 
 BASELINE_SAMPLES_PER_SEC = None  # populated from first recorded round; see BASELINE.md
+PEAK_FLOPS = float(os.environ.get("BENCH_PEAK_FLOPS", 197e12))
 
 
-def bench_lenet(batch: int, iters: int, warmup: int = 5) -> dict:
+def _measure_multistep(conf, xs, ys, iters: int, warmup: int,
+                       graph: bool = False) -> dict:
+    """Steady-state throughput of K-step scanned training on stacked batches.
+
+    xs/ys: (K, B, ...) stacks (lists of stacks for graph nets). Each timed
+    "iter" is ONE host dispatch running K fused train steps on device. The
+    donated-params chain means the final float(loss) waits on every step.
+    """
     import jax
     import jax.numpy as jnp
 
-    from deeplearning4j_tpu.models.lenet import lenet_mnist
-    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork, make_train_step
+    if graph:
+        from deeplearning4j_tpu.nn.graph_network import (
+            ComputationGraph, make_graph_multistep_train_step)
+        net = ComputationGraph(conf).init()
+        multi = make_graph_multistep_train_step(conf)
+    else:
+        from deeplearning4j_tpu.nn.multilayer import (
+            MultiLayerNetwork, make_multistep_train_step)
+        net = MultiLayerNetwork(conf).init()
+        multi = make_multistep_train_step(conf)
 
-    net = MultiLayerNetwork(lenet_mnist()).init()
-    step = jax.jit(make_train_step(net.conf), donate_argnums=(0, 1, 2))
-
-    rng = np.random.default_rng(0)
-    x = jnp.asarray(rng.normal(size=(batch, 784)).astype(np.float32))
-    y_np = np.zeros((batch, 10), np.float32)
-    y_np[np.arange(batch), rng.integers(0, 10, batch)] = 1
-    y = jnp.asarray(y_np)
+    jit_multi = jax.jit(multi, donate_argnums=(0, 1, 2))
     key = jax.random.PRNGKey(0)
-
     params, states, upd = net.params_list, net.state_list, net.updater_state
+
+    ksteps = (xs[0].shape[0] if graph else xs.shape[0])
+    batch = (xs[0].shape[1] if graph else xs.shape[1])
+
+    # XLA's own flop count for one K-step program (per-sample = /(K*B))
+    lowered = jit_multi.lower(params, states, upd, xs, ys, key, jnp.int32(0))
+    compiled = lowered.compile()
+    cost = compiled.cost_analysis()
+    cost = cost[0] if isinstance(cost, (list, tuple)) else cost
+    flops_per_dispatch = max(0.0, float((cost or {}).get("flops", 0.0)))
+
     for i in range(warmup):
-        params, states, upd, loss = step(params, states, upd, x, y, key,
-                                         jnp.int32(i))
+        params, states, upd, loss = jit_multi(params, states, upd, xs, ys,
+                                              key, jnp.int32(i * ksteps))
     float(loss)  # hard sync: host read (block_until_ready alone is
     #              unreliable through the axon relay's async dispatch)
 
     t0 = time.perf_counter()
     for i in range(iters):
-        params, states, upd, loss = step(params, states, upd, x, y, key,
-                                         jnp.int32(i))
+        params, states, upd, loss = jit_multi(
+            params, states, upd, xs, ys, key,
+            jnp.int32((warmup + i) * ksteps))
     # the donated-params chain makes this final host read wait on every step
     float(loss)
     dt = time.perf_counter() - t0
+
+    n_steps = iters * ksteps
+    flops_per_sec = flops_per_dispatch * iters / dt if flops_per_dispatch else 0.0
     return {
-        "samples_per_sec": batch * iters / dt,
-        "step_time_ms": dt / iters * 1000,
+        "samples_per_sec": batch * n_steps / dt,
+        "step_time_ms": dt / n_steps * 1000,
         "batch": batch,
         "iters": iters,
+        "ksteps": ksteps,
+        "tflops_per_sec": round(flops_per_sec / 1e12, 3),
+        "mfu": round(flops_per_sec / PEAK_FLOPS, 4),
     }
 
 
-def bench_resnet50(batch: int, iters: int, warmup: int = 3) -> dict:
-    import jax
+def _stack(a, k: int):
+    import jax.numpy as jnp
+    return jnp.broadcast_to(a[None], (k,) + a.shape)
+
+
+def _onehot_batch(rng, batch: int, n_classes: int):
+    y = np.zeros((batch, n_classes), np.float32)
+    y[np.arange(batch), rng.integers(0, n_classes, batch)] = 1
+    return y
+
+
+def bench_lenet(batch: int, iters: int, ksteps: int, warmup: int = 2) -> dict:
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.models.lenet import lenet_mnist
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(batch, 784)).astype(np.float32))
+    y = jnp.asarray(_onehot_batch(rng, batch, 10))
+    return _measure_multistep(lenet_mnist(), _stack(x, ksteps),
+                              _stack(y, ksteps), iters, warmup)
+
+
+def bench_resnet50(batch: int, iters: int, ksteps: int, warmup: int = 2) -> dict:
     import jax.numpy as jnp
 
     from deeplearning4j_tpu.models.resnet import resnet50
-    from deeplearning4j_tpu.nn.graph_network import ComputationGraph, make_graph_train_step
 
-    net = ComputationGraph(resnet50(n_classes=1000, image_size=224)).init()
-    step = jax.jit(make_graph_train_step(net.conf), donate_argnums=(0, 1, 2))
     rng = np.random.default_rng(0)
     x = jnp.asarray(rng.normal(size=(batch, 224, 224, 3)).astype(np.float32))
-    y_np = np.zeros((batch, 1000), np.float32)
-    y_np[np.arange(batch), rng.integers(0, 1000, batch)] = 1
-    y = jnp.asarray(y_np)
-    key = jax.random.PRNGKey(0)
-    params, states, upd = net.params_list, net.state_list, net.updater_state
-    for i in range(warmup):
-        params, states, upd, loss = step(params, states, upd, [x], [y], key,
-                                         jnp.int32(i))
-    float(loss)  # hard sync (see bench_lenet)
-    t0 = time.perf_counter()
-    for i in range(iters):
-        params, states, upd, loss = step(params, states, upd, [x], [y], key,
-                                         jnp.int32(i))
-    float(loss)  # chain-forcing host read
-    dt = time.perf_counter() - t0
-    return {
-        "samples_per_sec": batch * iters / dt,
-        "step_time_ms": dt / iters * 1000,
-        "batch": batch,
-        "iters": iters,
-    }
+    y = jnp.asarray(_onehot_batch(rng, batch, 1000))
+    return _measure_multistep(resnet50(n_classes=1000, image_size=224),
+                              [_stack(x, ksteps)], [_stack(y, ksteps)],
+                              iters, warmup, graph=True)
 
 
-def bench_char_rnn(batch: int, iters: int, warmup: int = 3,
+def bench_char_rnn(batch: int, iters: int, ksteps: int, warmup: int = 2,
                    vocab: int = 64, seq: int = 50) -> dict:
     """GravesLSTM char-RNN (BASELINE config 3): TBPTT-length sequences."""
-    import jax
     import jax.numpy as jnp
 
     from deeplearning4j_tpu.models.char_rnn import char_rnn_lstm
-    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork, make_train_step
 
     conf = char_rnn_lstm(vocab_size=vocab, hidden=200, tbptt_length=seq)
     conf.backprop_type = "Standard"  # one jitted step over the tbptt window
-    net = MultiLayerNetwork(conf).init()
-    step = jax.jit(make_train_step(net.conf), donate_argnums=(0, 1, 2))
     rng = np.random.default_rng(0)
     ids = rng.integers(0, vocab, (batch, seq))
     x = jnp.asarray(np.eye(vocab, dtype=np.float32)[ids])
-    y = x
-    key = jax.random.PRNGKey(0)
-    params, states, upd = net.params_list, net.state_list, net.updater_state
-    for i in range(warmup):
-        params, states, upd, loss = step(params, states, upd, x, y, key,
-                                         jnp.int32(i))
-    float(loss)
-    t0 = time.perf_counter()
-    for i in range(iters):
-        params, states, upd, loss = step(params, states, upd, x, y, key,
-                                         jnp.int32(i))
-    float(loss)
-    dt = time.perf_counter() - t0
-    return {"samples_per_sec": batch * iters / dt,
-            "chars_per_sec": batch * seq * iters / dt,
-            "step_time_ms": dt / iters * 1000, "batch": batch, "iters": iters}
+    r = _measure_multistep(conf, _stack(x, ksteps), _stack(x, ksteps),
+                           iters, warmup)
+    r["chars_per_sec"] = r["samples_per_sec"] * seq
+    return r
 
 
-def bench_transformer(batch: int, iters: int, warmup: int = 3,
+def bench_transformer(batch: int, iters: int, ksteps: int, warmup: int = 2,
                       vocab: int = 256, seq: int = 256) -> dict:
     """Decoder-only transformer LM over the flash-attention kernel."""
-    import jax
     import jax.numpy as jnp
 
     from deeplearning4j_tpu.models.transformer import transformer_lm
-    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork, make_train_step
 
     conf = transformer_lm(vocab_size=vocab, width=256, n_layers=4, n_heads=4,
                           max_len=seq)
-    net = MultiLayerNetwork(conf).init()
-    step = jax.jit(make_train_step(net.conf), donate_argnums=(0, 1, 2))
     rng = np.random.default_rng(0)
     ids = rng.integers(0, vocab, (batch, seq))
     x = jnp.asarray(np.eye(vocab, dtype=np.float32)[ids])
-    key = jax.random.PRNGKey(0)
-    params, states, upd = net.params_list, net.state_list, net.updater_state
-    for i in range(warmup):
-        params, states, upd, loss = step(params, states, upd, x, x, key,
-                                         jnp.int32(i))
-    float(loss)
+    r = _measure_multistep(conf, _stack(x, ksteps), _stack(x, ksteps),
+                           iters, warmup)
+    r["tokens_per_sec"] = r["samples_per_sec"] * seq
+    return r
+
+
+def bench_word2vec(batch: int, iters: int, ksteps: int, warmup: int = 2,
+                   vocab: int = 10000, dim: int = 100,
+                   negative: int = 5) -> dict:
+    """SkipGram negative-sampling pair-kernel throughput (BASELINE config 4).
+
+    Measures the jitted pair update the reference measures as words/sec in
+    Word2Vec fit (reference SkipGram.java iterateSample): K scanned batches
+    of skip-gram pairs per host dispatch, 5 negatives each.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.nlp.learning import PairBatch, make_train_step
+
+    step = make_train_step(use_hs=False, negative=negative)
+    rng = np.random.default_rng(0)
+    syn0 = jnp.asarray(rng.normal(size=(vocab, dim)).astype(np.float32) * 0.01)
+    syn1 = jnp.zeros((1, dim), jnp.float32)  # HS table unused (negative sampling)
+    syn1neg = jnp.zeros((vocab, dim), jnp.float32)
+    cum_table = jnp.asarray((np.arange(1, vocab + 1) / vocab).astype(np.float32))
+
+    def mk(shape, hi):
+        return jnp.asarray(rng.integers(0, hi, shape).astype(np.int32))
+
+    batches = PairBatch(
+        ctx=mk((ksteps, batch, 1), vocab),
+        ctx_mask=jnp.ones((ksteps, batch, 1), jnp.float32),
+        target=mk((ksteps, batch), vocab),
+        points=jnp.zeros((ksteps, batch, 1), jnp.int32),
+        codes=jnp.zeros((ksteps, batch, 1), jnp.float32),
+        code_mask=jnp.zeros((ksteps, batch, 1), jnp.float32),
+        pair_mask=jnp.ones((ksteps, batch), jnp.float32),
+        update_dest=mk((ksteps, batch, 1), vocab),
+    )
+    keys = jax.random.split(jax.random.PRNGKey(0), ksteps)
+
+    def multi(syn0, syn1, syn1neg, batches, keys):
+        def body(carry, inp):
+            s0, s1, sn = carry
+            b, k = inp
+            s0, s1, sn = step(s0, s1, sn, cum_table, b, jnp.float32(0.025), k)
+            return (s0, s1, sn), None
+
+        carry, _ = jax.lax.scan(body, (syn0, syn1, syn1neg), (batches, keys))
+        return carry
+
+    jit_multi = jax.jit(multi, donate_argnums=(0, 1, 2))
+    for _ in range(warmup):
+        syn0, syn1, syn1neg = jit_multi(syn0, syn1, syn1neg, batches, keys)
+    float(syn0[0, 0])  # hard sync: host read (see module docstring)
     t0 = time.perf_counter()
-    for i in range(iters):
-        params, states, upd, loss = step(params, states, upd, x, x, key,
-                                         jnp.int32(i))
-    float(loss)
+    for _ in range(iters):
+        syn0, syn1, syn1neg = jit_multi(syn0, syn1, syn1neg, batches, keys)
+    float(syn0[0, 0])  # chain-forcing host read through donated buffers
     dt = time.perf_counter() - t0
-    return {"samples_per_sec": batch * iters / dt,
-            "tokens_per_sec": batch * seq * iters / dt,
-            "step_time_ms": dt / iters * 1000, "batch": batch, "iters": iters}
+    return {
+        "samples_per_sec": batch * ksteps * iters / dt,
+        "step_time_ms": dt / (iters * ksteps) * 1000,
+        "batch": batch, "iters": iters, "ksteps": ksteps,
+        "tflops_per_sec": 0.0, "mfu": 0.0,
+    }
 
 
 _METRICS = {
@@ -164,28 +237,39 @@ _METRICS = {
     "char_rnn": "char_rnn_samples_per_sec",
     "transformer": "transformer_lm_samples_per_sec",
     "resnet50": "resnet50_samples_per_sec_per_chip",
+    "word2vec": "word2vec_pairs_per_sec",
 }
+
+_DEFAULTS = {  # model -> (batch, iters, ksteps)
+    "lenet": (128, 20, 16),
+    "resnet50": (64, 5, 8),
+    "char_rnn": (32, 5, 8),
+    "transformer": (16, 5, 8),
+    "word2vec": (1024, 10, 32),
+}
+
+
+def _bench_fns():
+    return {"lenet": bench_lenet, "resnet50": bench_resnet50,
+            "char_rnn": bench_char_rnn, "transformer": bench_transformer,
+            "word2vec": bench_word2vec}
 
 
 def _child_main(args) -> None:
     """Run one benchmark in-process and print its JSON record."""
-    if args.bf16:
+    if not args.f32:
         from deeplearning4j_tpu.common import bf16_matmul_policy
         bf16_matmul_policy()
 
-    if args.model == "lenet":
-        r = bench_lenet(args.batch or 128, args.iters or 50)
-    elif args.model == "char_rnn":
-        r = bench_char_rnn(args.batch or 32, args.iters or 10)
-    elif args.model == "transformer":
-        r = bench_transformer(args.batch or 16, args.iters or 10)
-    else:
-        r = bench_resnet50(args.batch or 32, args.iters or 10)
+    db, di, dk = _DEFAULTS[args.model]
+    r = _bench_fns()[args.model](args.batch or db, args.iters or di,
+                                 args.ksteps or dk)
 
     vs = (r["samples_per_sec"] / BASELINE_SAMPLES_PER_SEC
           if BASELINE_SAMPLES_PER_SEC else 1.0)
     import jax
     r["backend"] = jax.default_backend()
+    r["dtype"] = "f32" if args.f32 else "bf16"
     print(json.dumps({
         "metric": _METRICS[args.model],
         "value": round(r["samples_per_sec"], 2),
@@ -206,17 +290,17 @@ def main() -> None:
     (an error record, never a stack trace) so the round always captures a
     parseable result.
     """
-    import os
     import subprocess
     import sys
 
     ap = argparse.ArgumentParser()
-    ap.add_argument("--model", default="lenet",
-                    choices=["lenet", "resnet50", "char_rnn", "transformer"])
+    ap.add_argument("--model", default="lenet", choices=sorted(_METRICS))
     ap.add_argument("--batch", type=int, default=None)
     ap.add_argument("--iters", type=int, default=None)
-    ap.add_argument("--bf16", action="store_true",
-                    help="bfloat16 matmul/conv compute (f32 params)")
+    ap.add_argument("--ksteps", type=int, default=None,
+                    help="train steps fused per host dispatch")
+    ap.add_argument("--f32", action="store_true",
+                    help="float32 compute (default is bfloat16 matmul/conv)")
     ap.add_argument("--child", action="store_true", help=argparse.SUPPRESS)
     # worst case must finish inside the harness's own command timeout
     # (round-1 artifacts show it kills at ~600s): 2 x 240s + 5s backoff < 500s

@@ -159,6 +159,31 @@ def make_graph_train_step(conf: ComputationGraphConfiguration):
     return train_step
 
 
+def make_graph_multistep_train_step(conf: ComputationGraphConfiguration):
+    """K fused graph train steps per host dispatch via `lax.scan`.
+
+    ``inputs_stack``/``labels_stack`` are lists of ``(K, B, ...)`` arrays (one
+    per graph input/output). See make_multistep_train_step in multilayer.py
+    for the rationale (dispatch amortization on TPU)."""
+    step = make_graph_train_step(conf)
+
+    def multi_step(params, states, upd_state, inputs_stack, labels_stack,
+                   rng, iteration0):
+        def body(carry, batch):
+            p, s, u, it = carry
+            xs, ys = batch
+            key = jax.random.fold_in(rng, it)
+            p, s, u, loss = step(p, s, u, xs, ys, key, it)
+            return (p, s, u, it + 1), loss
+
+        (p, s, u, _), losses = jax.lax.scan(
+            body, (params, states, upd_state, iteration0),
+            (list(inputs_stack), list(labels_stack)))
+        return p, s, u, jnp.mean(losses)
+
+    return multi_step
+
+
 class ComputationGraph:
     """Stateful shell (reference nn/graph/ComputationGraph.java)."""
 

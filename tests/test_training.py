@@ -198,3 +198,95 @@ def test_params_flat_view_roundtrip():
     net2 = MultiLayerNetwork(conf).init()
     net2.set_params(flat)
     np.testing.assert_allclose(np.asarray(net2.params()), flat)
+
+
+def test_multistep_equals_sequential_steps():
+    """K scanned steps per dispatch == K individual dispatches (bit-for-bit
+    modulo float assoc). This is the TPU dispatch-amortization path bench.py
+    measures; it must be semantically identical to the reference's
+    per-minibatch fit loop (MultiLayerNetwork.java:1540)."""
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.nn.multilayer import (
+        make_multistep_train_step, make_train_step)
+
+    conf = (NeuralNetConfiguration.builder()
+            .seed(7).learning_rate(0.05).updater("adam")
+            .list()
+            .layer(DenseLayer(n_in=4, n_out=8, activation="tanh"))
+            .layer(OutputLayer(n_in=8, n_out=3, loss="mcxent",
+                               activation="softmax"))
+            .build())
+    rng = np.random.default_rng(0)
+    K, B = 4, 16
+    xs = jnp.asarray(rng.normal(size=(K, B, 4)).astype(np.float32))
+    ys_np = np.zeros((K, B, 3), np.float32)
+    ys_np[..., 0] = 1
+    ys = jnp.asarray(ys_np)
+    key = jax.random.PRNGKey(3)
+
+    net_a = MultiLayerNetwork(conf).init()
+    multi = jax.jit(make_multistep_train_step(conf))
+    pa, sa, ua, loss_multi = multi(net_a.params_list, net_a.state_list,
+                                   net_a.updater_state, xs, ys, key,
+                                   jnp.int32(0))
+
+    net_b = MultiLayerNetwork(conf).init()
+    step = jax.jit(make_train_step(conf))
+    pb, sb, ub = net_b.params_list, net_b.state_list, net_b.updater_state
+    losses = []
+    for i in range(K):
+        pb, sb, ub, loss = step(pb, sb, ub, xs[i], ys[i],
+                                jax.random.fold_in(key, i), jnp.int32(i))
+        losses.append(float(loss))
+
+    for a, b in zip(jax.tree_util.tree_leaves(pa),
+                    jax.tree_util.tree_leaves(pb)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
+    assert abs(float(loss_multi) - float(np.mean(losses))) < 1e-5
+
+
+def test_graph_multistep_equals_sequential_steps():
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.nn.graph_network import (
+        ComputationGraph, make_graph_multistep_train_step,
+        make_graph_train_step)
+
+    conf = (NeuralNetConfiguration.builder()
+            .seed(7).learning_rate(0.05).updater("sgd")
+            .graph_builder()
+            .add_inputs("in")
+            .add_layer("d", DenseLayer(n_in=4, n_out=8, activation="tanh"), "in")
+            .add_layer("out", OutputLayer(n_in=8, n_out=3, loss="mcxent",
+                                          activation="softmax"), "d")
+            .set_outputs("out")
+            .build())
+    rng = np.random.default_rng(1)
+    K, B = 3, 8
+    xs = jnp.asarray(rng.normal(size=(K, B, 4)).astype(np.float32))
+    ys_np = np.zeros((K, B, 3), np.float32)
+    ys_np[..., 1] = 1
+    ys = jnp.asarray(ys_np)
+    key = jax.random.PRNGKey(5)
+
+    net_a = ComputationGraph(conf).init()
+    multi = jax.jit(make_graph_multistep_train_step(conf))
+    pa, _, _, loss_multi = multi(net_a.params_list, net_a.state_list,
+                                 net_a.updater_state, [xs], [ys], key,
+                                 jnp.int32(0))
+
+    net_b = ComputationGraph(conf).init()
+    step = jax.jit(make_graph_train_step(conf))
+    pb, sb, ub = net_b.params_list, net_b.state_list, net_b.updater_state
+    for i in range(K):
+        pb, sb, ub, _ = step(pb, sb, ub, [xs[i]], [ys[i]],
+                             jax.random.fold_in(key, i), jnp.int32(i))
+
+    for a, b in zip(jax.tree_util.tree_leaves(pa),
+                    jax.tree_util.tree_leaves(pb)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
