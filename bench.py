@@ -232,12 +232,73 @@ def bench_word2vec(batch: int, iters: int, ksteps: int, warmup: int = 2,
     }
 
 
+def bench_attention(batch: int, iters: int, ksteps: int, warmup: int = 2,
+                    seq: int = 2048, heads: int = 8, dim: int = 64) -> dict:
+    """flash_attention (Pallas) vs the identical XLA math, fwd+bwd, causal.
+
+    Reports both paths' timings so one BASELINE.md line can say which path ran
+    on the chip and its speedup (VERDICT round-1 item 3). `value` is the
+    tokens/sec of whichever path `use_pallas()` selects in production.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.ops import pallas_kernels as pk
+
+    kq, kk, kv = jax.random.split(jax.random.PRNGKey(0), 3)
+    shape = (batch, seq, heads, dim)
+    q = jax.random.normal(kq, shape, jnp.float32)
+    k = jax.random.normal(kk, shape, jnp.float32)
+    v = jax.random.normal(kv, shape, jnp.float32)
+
+    def time_path(fn) -> float:
+        def loss(q, k, v):
+            def body(c, _):
+                o = fn(c, k, v)
+                return o, jnp.float32(0)
+            o, _ = jax.lax.scan(body, q, None, length=ksteps)
+            return jnp.sum(o * o)
+
+        g = jax.jit(jax.grad(loss))
+        out = g(q, k, v)
+        float(jnp.ravel(out)[0])  # hard sync (see module docstring)
+        for _ in range(warmup - 1):
+            out = g(q, k, v)
+        float(jnp.ravel(out)[0])
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = g(q, k, v)
+        float(jnp.ravel(out)[0])
+        return (time.perf_counter() - t0) / (iters * ksteps)
+
+    t_xla = time_path(lambda q, k, v: pk._attention_xla(q, k, v, True))
+    pallas_engaged = pk.use_pallas()
+    t_pallas = (time_path(lambda q, k, v: pk.flash_attention(q, k, v, True))
+                if pallas_engaged else None)
+
+    t_prod = t_pallas if pallas_engaged else t_xla
+    return {
+        "samples_per_sec": batch * seq / t_prod,
+        "step_time_ms": t_prod * 1000,
+        "batch": batch, "iters": iters, "ksteps": ksteps,
+        "seq": seq, "heads": heads, "head_dim": dim,
+        "pallas_engaged": pallas_engaged,
+        "xla_ms": round(t_xla * 1000, 3),
+        "pallas_ms": (round(t_pallas * 1000, 3)
+                      if t_pallas is not None else None),
+        "pallas_speedup": (round(t_xla / t_pallas, 3)
+                           if t_pallas else None),
+        "tflops_per_sec": 0.0, "mfu": 0.0,
+    }
+
+
 _METRICS = {
     "lenet": "lenet_mnist_samples_per_sec",
     "char_rnn": "char_rnn_samples_per_sec",
     "transformer": "transformer_lm_samples_per_sec",
     "resnet50": "resnet50_samples_per_sec_per_chip",
     "word2vec": "word2vec_pairs_per_sec",
+    "attention": "flash_attention_tokens_per_sec",
 }
 
 _DEFAULTS = {  # model -> (batch, iters, ksteps)
@@ -246,13 +307,14 @@ _DEFAULTS = {  # model -> (batch, iters, ksteps)
     "char_rnn": (32, 5, 8),
     "transformer": (16, 5, 8),
     "word2vec": (1024, 10, 32),
+    "attention": (4, 5, 4),
 }
 
 
 def _bench_fns():
     return {"lenet": bench_lenet, "resnet50": bench_resnet50,
             "char_rnn": bench_char_rnn, "transformer": bench_transformer,
-            "word2vec": bench_word2vec}
+            "word2vec": bench_word2vec, "attention": bench_attention}
 
 
 def _child_main(args) -> None:

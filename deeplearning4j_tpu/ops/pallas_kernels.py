@@ -27,11 +27,22 @@ _NEG = -1e30
 
 
 def use_pallas() -> bool:
-    """Backend seam (reference helper loading seam)."""
+    """Backend seam (reference helper loading seam).
+
+    True when the default device is a TPU. The platform *name* is not enough:
+    through the axon relay ``jax.default_backend()`` reports ``"axon"`` even
+    though the device is a real TPU chip, so we inspect the device itself —
+    ``device_kind`` (e.g. "TPU v5 lite") and the platform string both count.
+    """
     if os.environ.get("DL4J_TPU_DISABLE_PALLAS") == "1":
         return False
     try:
-        return jax.default_backend() == "tpu"
+        if jax.default_backend() == "cpu":
+            return False
+        dev = jax.devices()[0]
+        kind = (getattr(dev, "device_kind", "") or "").lower()
+        plat = (getattr(dev, "platform", "") or "").lower()
+        return "tpu" in kind or plat in ("tpu", "axon")
     except Exception:
         return False
 
