@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import math
 from typing import Iterable, Optional, Sequence
 
 import jax
@@ -20,7 +21,7 @@ import numpy as np
 
 from deeplearning4j_tpu.nn.conf.graphconf import ComputationGraphConfiguration
 from deeplearning4j_tpu.nn.conf.vertices import LayerVertex
-from deeplearning4j_tpu.nn.multilayer import _updater_spec
+from deeplearning4j_tpu.nn.multilayer import LazyScore, _updater_spec
 from deeplearning4j_tpu.nn.updaters import (
     effective_lr, normalize_gradients, updater_init, updater_step,
 )
@@ -118,45 +119,164 @@ def graph_loss(conf, params, states, inputs, labels, rng, fmasks=None, lmasks=No
     return total + _graph_regularization(conf, params), new_states
 
 
-def make_graph_train_step(conf: ComputationGraphConfiguration):
-    g = conf.global_conf
+def _coerce_graph_batch(ds):
+    """Normalize a DataSet or MultiDataSet into (xs, ys, fmasks, lmasks) lists."""
+    if isinstance(ds, MultiDataSet):
+        return ds.features, ds.labels, ds.features_masks, ds.labels_masks
+    fm = [ds.features_mask] if ds.features_mask is not None else None
+    lm = [ds.labels_mask] if ds.labels_mask is not None else None
+    return [ds.features], [ds.labels], fm, lm
 
+
+def _apply_graph_updates(conf, params, grads, upd_state, iteration):
+    """Per-vertex gradient normalization + updater math (shared by the
+    standard and TBPTT train steps)."""
+    g = conf.global_conf
+    new_params = {}
+    new_upd = {}
+    for name in conf.topological_order:
+        vertex = conf.vertices[name]
+        g_v = grads.get(name, {})
+        if not g_v or not isinstance(vertex, LayerVertex):
+            new_params[name] = params.get(name, {})
+            new_upd[name] = upd_state.get(name, {})
+            continue
+        layer = vertex.layer
+        g_v = normalize_gradients(g_v, layer.gradient_normalization,
+                                  layer.gradient_normalization_threshold or 1.0)
+        spec = _updater_spec(layer)
+        lr = effective_lr(layer.learning_rate, g.lr_policy, iteration,
+                          g.lr_policy_decay_rate, g.lr_policy_power,
+                          g.lr_policy_steps, g.lr_schedule, g.max_num_iterations)
+        lr_bias = (jnp.float32(layer.bias_learning_rate)
+                   if layer.bias_learning_rate is not None else lr)
+        p_new, u_new = {}, {}
+        for pname, grad in g_v.items():
+            this_lr = lr_bias if pname in ("b", "vb", "beta") else lr
+            step, ustate = updater_step(spec, grad, upd_state[name][pname],
+                                        this_lr, iteration)
+            p_new[pname] = params[name][pname] - step
+            u_new[pname] = ustate
+        new_params[name] = p_new
+        new_upd[name] = u_new
+    return new_params, new_upd
+
+
+def make_graph_train_step(conf: ComputationGraphConfiguration):
     def train_step(params, states, upd_state, inputs, labels, rng, iteration,
                    fmasks=None, lmasks=None):
         (loss, new_states), grads = jax.value_and_grad(
             lambda p: graph_loss(conf, p, states, inputs, labels, rng, fmasks, lmasks),
             has_aux=True)(params)
-
-        new_params = {}
-        new_upd = {}
-        for name in conf.topological_order:
-            vertex = conf.vertices[name]
-            g_v = grads.get(name, {})
-            if not g_v or not isinstance(vertex, LayerVertex):
-                new_params[name] = params.get(name, {})
-                new_upd[name] = upd_state.get(name, {})
-                continue
-            layer = vertex.layer
-            g_v = normalize_gradients(g_v, layer.gradient_normalization,
-                                      layer.gradient_normalization_threshold or 1.0)
-            spec = _updater_spec(layer)
-            lr = effective_lr(layer.learning_rate, g.lr_policy, iteration,
-                              g.lr_policy_decay_rate, g.lr_policy_power,
-                              g.lr_policy_steps, g.lr_schedule, g.max_num_iterations)
-            lr_bias = (jnp.float32(layer.bias_learning_rate)
-                       if layer.bias_learning_rate is not None else lr)
-            p_new, u_new = {}, {}
-            for pname, grad in g_v.items():
-                this_lr = lr_bias if pname in ("b", "vb", "beta") else lr
-                step, ustate = updater_step(spec, grad, upd_state[name][pname],
-                                            this_lr, iteration)
-                p_new[pname] = params[name][pname] - step
-                u_new[pname] = ustate
-            new_params[name] = p_new
-            new_upd[name] = u_new
+        new_params, new_upd = _apply_graph_updates(conf, params, grads,
+                                                   upd_state, iteration)
         return new_params, new_states, new_upd, loss
 
     return train_step
+
+
+def _is_streaming_lstm(vertex) -> bool:
+    from deeplearning4j_tpu.nn.conf.layers.recurrent import LSTM
+
+    return (isinstance(vertex, LayerVertex) and isinstance(vertex.layer, LSTM)
+            and not type(vertex.layer).__name__.startswith(
+                "GravesBidirectional"))
+
+
+def _init_graph_rnn_states(conf, batch: int, dtype) -> dict:
+    states = {}
+    for name, vertex in conf.vertices.items():
+        if _is_streaming_lstm(vertex):
+            h = vertex.layer.n_out
+            states[name] = {"h": jnp.zeros((batch, h), dtype),
+                            "c": jnp.zeros((batch, h), dtype)}
+        else:
+            states[name] = {}
+    return states
+
+
+def graph_forward_streaming(conf, params, states, rnn_states, inputs, *,
+                            train: bool, rng, masks=None,
+                            collect_loss_inputs: bool = False,
+                            truncate: bool = False):
+    """DAG walk threading LSTM streaming state across calls (reference
+    ComputationGraph.rnnTimeStep:1788 / rnnActivateUsingStoredState:1955).
+
+    ``truncate=True`` stop-gradients the carried state at the chunk boundary
+    — the TBPTT truncation (reference doTruncatedBPTT semantics on graphs,
+    ComputationGraph.fit -> rnnUpdateStateWithTBPTTState:2032).
+    Returns (acts, new_states, loss_inputs, new_rnn_states).
+    """
+    acts: dict = dict(zip(conf.network_inputs, inputs))
+    mask_of: dict = {name: None for name in conf.network_inputs}
+    if masks:
+        for i, name in enumerate(conf.network_inputs):
+            if i < len(masks):
+                mask_of[name] = masks[i]
+    new_states: dict = {}
+    new_rnn: dict = {}
+    loss_inputs: dict = {}
+    order = conf.topological_order or conf.topo_sort()
+    rngs = (jax.random.split(rng, len(order)) if rng is not None
+            else [None] * len(order))
+    for i, name in enumerate(order):
+        vertex = conf.vertices[name]
+        srcs = conf.vertex_inputs[name]
+        vins = [acts[src] for src in srcs]
+        mask = next((mask_of[s] for s in srcs if mask_of.get(s) is not None),
+                    None)
+        if (collect_loss_inputs and name in conf.network_outputs
+                and isinstance(vertex, LayerVertex)
+                and vertex.layer.has_loss()):
+            loss_inputs[name] = vins[0]
+        if _is_streaming_lstm(vertex):
+            y, rs = vertex.layer.apply_streaming(
+                params.get(name, {}), rnn_states.get(name, {}), vins[0],
+                mask=mask)
+            if truncate:
+                rs = jax.tree_util.tree_map(jax.lax.stop_gradient, rs)
+            new_rnn[name] = rs
+            ns = states.get(name, {})
+        else:
+            y, ns = vertex.apply(params.get(name, {}), states.get(name, {}),
+                                 vins, train=train, rng=rngs[i], mask=mask)
+            new_rnn[name] = rnn_states.get(name, {})
+        acts[name] = y
+        new_states[name] = ns
+        mask_of[name] = mask
+    return acts, new_states, loss_inputs, new_rnn
+
+
+def make_graph_tbptt_step(conf: ComputationGraphConfiguration):
+    """TBPTT train step for graphs: threads LSTM state across time chunks,
+    truncating gradients at chunk boundaries (reference ComputationGraph
+    doTruncatedBPTT path, fit:747 -> calcBackpropGradients with tbptt)."""
+
+    def tbptt_step(params, states, upd_state, rnn_states, inputs, labels, rng,
+                   iteration, fmasks=None, lmasks=None):
+        def lf(p):
+            _, new_states, loss_inputs, new_rnn = graph_forward_streaming(
+                conf, p, states, rnn_states, inputs, train=True, rng=rng,
+                masks=fmasks, collect_loss_inputs=True, truncate=True)
+            total = jnp.float32(0.0)
+            for i, out_name in enumerate(conf.network_outputs):
+                vertex = conf.vertices[out_name]
+                if not (isinstance(vertex, LayerVertex)
+                        and vertex.layer.has_loss()):
+                    raise ValueError(
+                        f"Output vertex '{out_name}' has no loss function")
+                lmask = lmasks[i] if lmasks else None
+                total = total + vertex.layer.compute_loss(
+                    p[out_name], loss_inputs[out_name], labels[i], lmask)
+            return total + _graph_regularization(conf, p), (new_states, new_rnn)
+
+        (loss, (new_states, new_rnn)), grads = jax.value_and_grad(
+            lf, has_aux=True)(params)
+        new_params, new_upd = _apply_graph_updates(conf, params, grads,
+                                                   upd_state, iteration)
+        return new_params, new_states, new_upd, new_rnn, loss
+
+    return tbptt_step
 
 
 def make_graph_multistep_train_step(conf: ComputationGraphConfiguration):
@@ -179,12 +299,12 @@ def make_graph_multistep_train_step(conf: ComputationGraphConfiguration):
         (p, s, u, _), losses = jax.lax.scan(
             body, (params, states, upd_state, iteration0),
             (list(inputs_stack), list(labels_stack)))
-        return p, s, u, jnp.mean(losses)
+        return p, s, u, losses
 
     return multi_step
 
 
-class ComputationGraph:
+class ComputationGraph(LazyScore):
     """Stateful shell (reference nn/graph/ComputationGraph.java)."""
 
     def __init__(self, conf: ComputationGraphConfiguration):
@@ -198,6 +318,7 @@ class ComputationGraph:
         self.score_value = float("nan")
         self._rng = None
         self._jit_cache: dict = {}
+        self._rnn_state: Optional[dict] = None  # streaming rnn_time_step state
 
     # ------------------------------------------------------------------ lifecycle
     def init(self, seed: Optional[int] = None) -> "ComputationGraph":
@@ -298,20 +419,83 @@ class ComputationGraph:
             ys = labels if isinstance(labels, (list, tuple)) else [labels]
             self._fit_batch(list(xs), list(ys))
             return
+        self.fit_iterator(data, epochs=epochs)
+
+    #: train steps fused per host dispatch in fit_iterator (see
+    #: MultiLayerNetwork.dispatch_ksteps); 1 disables the K-step path
+    dispatch_ksteps: int = 8
+
+    def fit_iterator(self, iterator, epochs: int = 1,
+                     ksteps: Optional[int] = None) -> None:
+        """Iterator fit with K-step fused dispatch (TPU fast path — see
+        MultiLayerNetwork.fit_iterator; reference fit(DataSetIterator):747).
+        Falls back to per-batch dispatch for masked or ragged batches."""
+        k = self.dispatch_ksteps if ksteps is None else max(1, ksteps)
+        multistep_ok = (k > 1 and self.conf.global_conf.iterations <= 1
+                        and not self._tbptt_active())
         for _ in range(epochs):
-            if hasattr(data, "reset"):
-                data.reset()
-            for ds in data:
-                if isinstance(ds, MultiDataSet):
-                    self._fit_batch(ds.features, ds.labels,
-                                    ds.features_masks, ds.labels_masks)
-                else:
-                    self._fit_batch([ds.features], [ds.labels],
-                                    [ds.features_mask] if ds.features_mask is not None else None,
-                                    [ds.labels_mask] if ds.labels_mask is not None else None)
+            for listener in self.listeners:
+                if hasattr(listener, "on_epoch_start"):
+                    listener.on_epoch_start(self)
+            if hasattr(iterator, "reset"):
+                iterator.reset()
+            if multistep_ok:
+                self._fit_epoch_multistep(iterator, k)
+            else:
+                for ds in iterator:
+                    xs, ys, fm, lm = _coerce_graph_batch(ds)
+                    self._fit_batch(xs, ys, fm, lm)
+            for listener in self.listeners:
+                if hasattr(listener, "on_epoch_end"):
+                    listener.on_epoch_end(self)
             self.epoch += 1
 
+    def _fit_epoch_multistep(self, iterator, k: int) -> None:
+        from deeplearning4j_tpu.utils.batching import k_step_groups
+
+        def to_batch(ds):
+            xs, ys, fm, lm = _coerce_graph_batch(ds)
+            if fm is not None or lm is not None:
+                return None  # masked -> per-batch fallback
+            return ([np.asarray(x) for x in xs], [np.asarray(y) for y in ys])
+
+        for kind, item in k_step_groups(iterator, k, to_batch):
+            if kind == "single":
+                self._fit_batch(*_coerce_graph_batch(item))
+            else:
+                self._dispatch_multistep(item)
+
+    def _dispatch_multistep(self, batches: list) -> None:
+        if not batches:
+            return
+        if len(batches) == 1:
+            self._fit_batch(batches[0][0], batches[0][1])
+            return
+        n_in, n_out = len(batches[0][0]), len(batches[0][1])
+        xs = [jnp.asarray(np.stack([b[0][i] for b in batches]))
+              for i in range(n_in)]
+        ys = [jnp.asarray(np.stack([b[1][i] for b in batches]))
+              for i in range(n_out)]
+        multi = self._jit("multistep",
+                          make_graph_multistep_train_step(self.conf))
+        (self.params_list, self.state_list, self.updater_state, losses) = multi(
+            self.params_list, self.state_list, self.updater_state, xs, ys,
+            self._next_rng(), jnp.int32(self.iteration))
+        for i in range(len(batches)):
+            self.iteration += 1
+            self.score_value = (lambda ls=losses, j=i: ls[j])
+            for listener in self.listeners:
+                listener.iteration_done(self, self.iteration)
+
+    def _tbptt_active(self) -> bool:
+        return (self.conf.backprop_type == "TruncatedBPTT"
+                and any(_is_streaming_lstm(v)
+                        for v in self.conf.vertices.values()))
+
     def _fit_batch(self, xs, ys, fmasks=None, lmasks=None) -> None:
+        if self._tbptt_active():
+            self._fit_tbptt(xs, ys, fmasks, lmasks)
+            return
         xs = [jnp.asarray(x) for x in xs]
         ys = [jnp.asarray(y) for y in ys]
         fmasks = [jnp.asarray(m) for m in fmasks] if fmasks else None
@@ -322,7 +506,7 @@ class ComputationGraph:
              loss) = step(self.params_list, self.state_list, self.updater_state,
                           xs, ys, self._next_rng(), jnp.int32(self.iteration),
                           fmasks, lmasks)
-            self.score_value = float(loss)
+            self.score_value = loss  # device scalar; synced lazily (LazyScore)
             self.iteration += 1
             for listener in self.listeners:
                 listener.iteration_done(self, self.iteration)
@@ -340,6 +524,57 @@ class ComputationGraph:
             outs = self.output(*feats)
             ev.eval(np.asarray(labels[0]), np.asarray(outs[0]))
         return ev
+
+    # ------------------------------------------------------------------ TBPTT
+    def _fit_tbptt(self, xs, ys, fmasks=None, lmasks=None) -> None:
+        """Truncated BPTT on graphs (reference ComputationGraph fit with
+        BackpropType.TruncatedBPTT): slice every input/label/mask along the
+        time axis into tbptt_fwd_length chunks; LSTM-vertex state carries
+        across chunks via stop_gradient (the truncation). Time axis = 1."""
+        xs = [jnp.asarray(x) for x in xs]
+        ys = [jnp.asarray(y) for y in ys]
+        T = xs[0].shape[1]
+        L = self.conf.tbptt_fwd_length
+        n_chunks = max(1, math.ceil(T / L))
+        step = self._jit("tbptt_step", make_graph_tbptt_step(self.conf))
+        rnn_state = _init_graph_rnn_states(self.conf, xs[0].shape[0],
+                                           xs[0].dtype)
+        for c in range(n_chunks):
+            sl = slice(c * L, min((c + 1) * L, T))
+            xc = [x[:, sl] for x in xs]
+            yc = [y[:, sl] for y in ys]
+            fm = [m[:, sl] for m in fmasks] if fmasks else None
+            lm = [m[:, sl] for m in lmasks] if lmasks else None
+            (self.params_list, self.state_list, self.updater_state, rnn_state,
+             loss) = step(self.params_list, self.state_list,
+                          self.updater_state, rnn_state, xc, yc,
+                          self._next_rng(), jnp.int32(self.iteration), fm, lm)
+            self.score_value = loss  # synced lazily (LazyScore)
+            self.iteration += 1
+            for listener in self.listeners:
+                listener.iteration_done(self, self.iteration)
+
+    # ------------------------------------------------------------------ rnn API
+    def rnn_time_step(self, *inputs) -> list:
+        """Streaming inference carrying LSTM-vertex hidden state across calls
+        (reference ComputationGraph.rnnTimeStep:1788). Each input: [B,T,F]
+        (T may be 1). Returns the list of network outputs."""
+        xs = [jnp.asarray(x) for x in inputs]
+        if self._rnn_state is None:
+            self._rnn_state = _init_graph_rnn_states(self.conf, xs[0].shape[0],
+                                                     xs[0].dtype)
+        fn = self._jit("rnn_time_step", self._rnn_step_pure)
+        outs, self._rnn_state = fn(self.params_list, self.state_list,
+                                   self._rnn_state, xs)
+        return outs
+
+    def _rnn_step_pure(self, params, states, rnn_states, xs):
+        acts, _, _, new_rnn = graph_forward_streaming(
+            self.conf, params, states, rnn_states, xs, train=False, rng=None)
+        return [acts[o] for o in self.conf.network_outputs], new_rnn
+
+    def rnn_clear_previous_state(self) -> None:
+        self._rnn_state = None
 
     def gradient_and_score(self, xs, ys):
         xs = [jnp.asarray(x) for x in xs]

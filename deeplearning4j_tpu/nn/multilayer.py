@@ -170,7 +170,9 @@ def make_multistep_train_step(conf: MultiLayerConfiguration):
     dominant cost through a remote relay, cf. the reference's per-minibatch
     `MultiLayerNetwork.fit` loop at MultiLayerNetwork.java:1540 which pays a
     host round-trip every step) across K steps; inputs stay in HBM the whole
-    time. Returns the mean loss over the K steps.
+    time. Returns the per-step losses as a (K,) array — listeners that only
+    fire every N iterations can then read just the scores they need without
+    forcing a host sync per step.
     """
     step = make_train_step(conf)
 
@@ -184,12 +186,43 @@ def make_multistep_train_step(conf: MultiLayerConfiguration):
 
         (p, s, u, _), losses = jax.lax.scan(
             body, (params_list, state_list, upd_state, iteration0), (xs, ys))
-        return p, s, u, jnp.mean(losses)
+        return p, s, u, losses
 
     return multi_step
 
 
-class MultiLayerNetwork:
+class LazyScore:
+    """`score_value` that syncs device->host only when actually read.
+
+    The reference's fit loop computes `score` eagerly every iteration
+    (MultiLayerNetwork.java:1807 computeGradientAndScore) because its
+    listeners observe synchronously. On TPU — especially through a remote
+    relay — `float(loss)` is a full host round-trip, so the training loops
+    here store the device-resident loss (or a thunk indexing into a K-step
+    loss stack) and materialize it lazily: a ScoreIterationListener printing
+    every N iterations costs N times fewer syncs, and a listener-free fit
+    costs none at all. Reads are cached, so repeated access is one sync.
+    """
+
+    _score_raw = float("nan")
+
+    @property
+    def score_value(self) -> float:
+        raw = self._score_raw
+        if callable(raw):
+            raw = float(raw())
+            self._score_raw = raw
+        elif not isinstance(raw, float):
+            raw = float(raw)
+            self._score_raw = raw
+        return raw
+
+    @score_value.setter
+    def score_value(self, value) -> None:
+        self._score_raw = value
+
+
+class MultiLayerNetwork(LazyScore):
     """Stateful convenience shell over the pure functions above."""
 
     def __init__(self, conf: MultiLayerConfiguration):
@@ -322,7 +355,30 @@ class MultiLayerNetwork:
         for _ in range(epochs):
             self._fit_batch(x, y, fmask, lmask)
 
-    def fit_iterator(self, iterator: Iterable, epochs: int = 1) -> None:
+    #: train steps fused per host dispatch in fit_iterator (lax.scan); 1
+    #: disables the K-step path. Benched sweet spot for relay-attached TPUs.
+    dispatch_ksteps: int = 8
+
+    def fit_iterator(self, iterator: Iterable, epochs: int = 1,
+                     ksteps: Optional[int] = None) -> None:
+        """Fit from a DataSetIterator (reference fit(DataSetIterator):978).
+
+        TPU fast path: accumulates up to ``ksteps`` host-staged minibatches,
+        stacks them into one (K, B, ...) device transfer, and runs all K
+        train steps inside ONE XLA dispatch (make_multistep_train_step) —
+        the per-minibatch host round-trip of the reference's fit loop is paid
+        once per K steps. Listeners still observe every iteration; reading
+        `score_value` lazily indexes the on-device loss stack (LazyScore), so
+        a listener firing every N iterations costs ~K*N fewer syncs.
+        Falls back to per-batch dispatch for TBPTT, masked batches,
+        iterations>1 configs, or ragged batch shapes.
+        """
+        k = self.dispatch_ksteps if ksteps is None else max(1, ksteps)
+        multistep_ok = (
+            k > 1
+            and self.conf.global_conf.iterations <= 1
+            and not (self.conf.backprop_type == "TruncatedBPTT"
+                     and any(isinstance(l, LSTM) for l in self.conf.layers)))
         for _ in range(epochs):
             for listener in self.listeners:
                 if hasattr(listener, "on_epoch_start"):
@@ -333,12 +389,49 @@ class MultiLayerNetwork:
                 self.pretrain(iterator)
                 if hasattr(iterator, "reset"):
                     iterator.reset()
-            for ds in iterator:
-                self._fit_batch(ds.features, ds.labels, ds.features_mask, ds.labels_mask)
+            if multistep_ok:
+                self._fit_epoch_multistep(iterator, k)
+            else:
+                for ds in iterator:
+                    self._fit_batch(ds.features, ds.labels, ds.features_mask,
+                                    ds.labels_mask)
             for listener in self.listeners:
                 if hasattr(listener, "on_epoch_end"):
                     listener.on_epoch_end(self)
             self.epoch += 1
+
+    def _fit_epoch_multistep(self, iterator, k: int) -> None:
+        from deeplearning4j_tpu.utils.batching import k_step_groups
+
+        def to_batch(ds):
+            if ds.features_mask is not None or ds.labels_mask is not None:
+                return None  # masked -> per-batch fallback
+            return np.asarray(ds.features), np.asarray(ds.labels)
+
+        for kind, item in k_step_groups(iterator, k, to_batch):
+            if kind == "single":
+                self._fit_batch(item.features, item.labels,
+                                item.features_mask, item.labels_mask)
+            else:
+                self._dispatch_multistep(item)
+
+    def _dispatch_multistep(self, batches: list) -> None:
+        if not batches:
+            return
+        if len(batches) == 1:
+            self._fit_batch(batches[0][0], batches[0][1])
+            return
+        xs = jnp.asarray(np.stack([b[0] for b in batches]))
+        ys = jnp.asarray(np.stack([b[1] for b in batches]))
+        multi = self._jit("multistep", make_multistep_train_step(self.conf))
+        (self.params_list, self.state_list, self.updater_state, losses) = multi(
+            self.params_list, self.state_list, self.updater_state, xs, ys,
+            self._next_rng(), jnp.int32(self.iteration))
+        for i in range(len(batches)):
+            self.iteration += 1
+            self.score_value = (lambda ls=losses, j=i: ls[j])
+            for listener in self.listeners:
+                listener.iteration_done(self, self.iteration)
 
     def _fit_batch(self, x, y, fmask=None, lmask=None) -> None:
         if (self.conf.backprop_type == "TruncatedBPTT"
@@ -354,7 +447,7 @@ class MultiLayerNetwork:
              loss) = step(self.params_list, self.state_list, self.updater_state,
                           x, y, self._next_rng(), jnp.int32(self.iteration),
                           fmask, lmask)
-            self.score_value = float(loss)
+            self.score_value = loss  # device scalar; synced lazily (LazyScore)
             self.iteration += 1
             for listener in self.listeners:
                 listener.iteration_done(self, self.iteration)
@@ -379,7 +472,7 @@ class MultiLayerNetwork:
              loss) = step(self.params_list, self.state_list, self.updater_state,
                           rnn_state, xc, yc, self._next_rng(),
                           jnp.int32(self.iteration), fm, lm)
-            self.score_value = float(loss)
+            self.score_value = loss  # device scalar; synced lazily (LazyScore)
             self.iteration += 1
             for listener in self.listeners:
                 listener.iteration_done(self, self.iteration)
@@ -400,7 +493,7 @@ class MultiLayerNetwork:
                 (self.params_list[idx], self.updater_state[idx], loss) = step(
                     self.params_list, self.state_list, self.updater_state[idx],
                     x, self._next_rng(), jnp.int32(self.iteration))
-                self.score_value = float(loss)
+                self.score_value = loss  # synced lazily (LazyScore)
 
     # ------------------------------------------------------------------ evaluation
     def evaluate(self, iterator_or_x, y=None):

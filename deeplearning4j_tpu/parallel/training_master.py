@@ -246,11 +246,12 @@ class ParameterAveragingTrainingMaster(TrainingMaster):
             params, states, upd, loss = local(
                 params, states, upd, xs, ys, model._next_rng(),
                 jnp.int32(model.iteration))
-            loss = float(loss)
             model.iteration += F
             if self.stats:
+                # stats want the realized loss; this is the only host sync
+                # in the split and only happens when stats are collected
                 self.stats.add("WorkerFit", t1, time.time() - t1,
-                               loss=loss)
+                               loss=float(loss))
             t2 = time.time()
             params, states, upd = average(params, states, upd)
             if self.stats:

@@ -93,6 +93,7 @@ class ParallelWrapper:
         self.average_updaters = average_updaters
         self.report_score = report_score
         self._sync_step = None
+        self._sync_multi = None
         self._local_step = None
         self._avg_fn = None
         self._local = None  # stacked per-replica (params, states, upd) for local-SGD
@@ -135,30 +136,116 @@ class ParallelWrapper:
             out_shardings=(repl, repl, repl, repl),
         )
 
+    def _make_sync_multistep(self):
+        """K-step scanned train step with the stacked batch axis sharded over
+        'data' (stack axis unsharded): one host dispatch drives K synchronous
+        DP steps, so dispatch latency amortizes exactly as in the single-chip
+        fast path (MultiLayerNetwork.fit_iterator)."""
+        from deeplearning4j_tpu.nn.multilayer import (
+            MultiLayerNetwork, make_multistep_train_step)
+
+        net = self.model
+        mesh = self.mesh
+        repl = NamedSharding(mesh, P())
+        stack_sh = NamedSharding(mesh, P(None, "data"))
+        if isinstance(net, MultiLayerNetwork):
+            base = make_multistep_train_step(net.conf)
+        else:
+            from deeplearning4j_tpu.nn.graph_network import (
+                make_graph_multistep_train_step)
+            base = make_graph_multistep_train_step(net.conf)
+        return jax.jit(
+            base,
+            in_shardings=(repl, repl, repl, stack_sh, stack_sh, repl, repl),
+            out_shardings=(repl, repl, repl, repl),
+        )
+
     def _fit_sync(self, iterator, epochs: int) -> None:
         net = self.model
         if self._sync_step is None:
             self._sync_step = self._make_sync_step()
-        from deeplearning4j_tpu.nn.graph_network import ComputationGraph
+            self._sync_multi = self._make_sync_multistep()
+        from deeplearning4j_tpu.nn.conf.layers.recurrent import LSTM
+        from deeplearning4j_tpu.nn.graph_network import (
+            ComputationGraph, _coerce_graph_batch)
+        from deeplearning4j_tpu.utils.batching import k_step_groups
 
         is_graph = isinstance(net, ComputationGraph)
+        iters_cfg = max(1, net.conf.global_conf.iterations)
+        tbptt_lstm = (not is_graph
+                      and net.conf.backprop_type == "TruncatedBPTT"
+                      and any(isinstance(l, LSTM) for l in net.conf.layers))
+        k = max(1, getattr(net, "dispatch_ksteps", 8))
+
+        def to_batch(ds):
+            # Fall back to the model's own per-batch path for semantics the
+            # sharded standard step doesn't implement: masks, iterations>1,
+            # TBPTT state threading. Fallback runs unsharded — correctness
+            # over parallelism for these batches.
+            if tbptt_lstm or iters_cfg > 1:
+                return None
+            if is_graph:
+                xs, ys, fm, lm = _coerce_graph_batch(ds)
+                if fm is not None or lm is not None:
+                    return None
+                return ([np.asarray(a) for a in xs],
+                        [np.asarray(a) for a in ys])
+            if ds.features_mask is not None or ds.labels_mask is not None:
+                return None
+            return np.asarray(ds.features), np.asarray(ds.labels)
+
+        def fallback(ds):
+            if is_graph:
+                net._fit_batch(*_coerce_graph_batch(ds))
+            else:
+                net._fit_batch(ds.features, ds.labels, ds.features_mask,
+                               ds.labels_mask)
+
+        def dispatch_one(x, y):
+            if is_graph:
+                x = [jnp.asarray(a) for a in x]
+                y = [jnp.asarray(a) for a in y]
+            else:
+                x, y = jnp.asarray(x), jnp.asarray(y)
+            (net.params_list, net.state_list, net.updater_state, loss) = \
+                self._sync_step(net.params_list, net.state_list,
+                                net.updater_state, x, y, net._next_rng(),
+                                jnp.int32(net.iteration))
+            net.score_value = loss  # synced lazily (LazyScore)
+            net.iteration += 1
+            for listener in net.listeners:
+                listener.iteration_done(net, net.iteration)
+
+        def dispatch(batches):
+            if len(batches) == 1:
+                dispatch_one(*batches[0])
+                return
+            if is_graph:
+                xs = [jnp.asarray(np.stack([b[0][i] for b in batches]))
+                      for i in range(len(batches[0][0]))]
+                ys = [jnp.asarray(np.stack([b[1][i] for b in batches]))
+                      for i in range(len(batches[0][1]))]
+            else:
+                xs = jnp.asarray(np.stack([b[0] for b in batches]))
+                ys = jnp.asarray(np.stack([b[1] for b in batches]))
+            (net.params_list, net.state_list, net.updater_state, losses) = \
+                self._sync_multi(net.params_list, net.state_list,
+                                 net.updater_state, xs, ys, net._next_rng(),
+                                 jnp.int32(net.iteration))
+            for i in range(len(batches)):
+                net.iteration += 1
+                net.score_value = (lambda ls=losses, j=i: ls[j])
+                for listener in net.listeners:
+                    listener.iteration_done(net, net.iteration)
+
         for _ in range(epochs):
             if hasattr(iterator, "reset"):
                 iterator.reset()
-            for ds in iterator:
-                if is_graph:
-                    x = [jnp.asarray(f) for f in ([ds.features] if not isinstance(ds.features, list) else ds.features)]
-                    y = [jnp.asarray(l) for l in ([ds.labels] if not isinstance(ds.labels, list) else ds.labels)]
+            for kind, item in k_step_groups(iterator, k, to_batch):
+                if kind == "single":
+                    fallback(item)
                 else:
-                    x, y = jnp.asarray(ds.features), jnp.asarray(ds.labels)
-                (net.params_list, net.state_list, net.updater_state, loss) = \
-                    self._sync_step(net.params_list, net.state_list,
-                                    net.updater_state, x, y, net._next_rng(),
-                                    jnp.int32(net.iteration))
-                net.score_value = float(loss)
-                net.iteration += 1
-                for listener in net.listeners:
-                    listener.iteration_done(net, net.iteration)
+                    dispatch(item)
 
     # --------------------------------------------------- local SGD (freq=N>1)
     def _make_local_sgd_fns(self):
@@ -170,12 +257,11 @@ class ParallelWrapper:
         net = self.model
         mesh = self.mesh
         if isinstance(net, ComputationGraph):
-            if len(net.conf.network_inputs) != 1 or len(net.conf.network_outputs) != 1:
-                raise NotImplementedError(
-                    "local-SGD averaging supports single-input/single-output "
-                    "ComputationGraphs; use averaging_frequency=1 for multi-IO graphs")
-            graph_base = make_graph_train_step(net.conf)
-            base = lambda p, s, u, x, y, r, it: graph_base(p, s, u, [x], [y], r, it)
+            # multi-IO supported: xs/ys arrive as lists of arrays; the
+            # shard_map in_specs below are pytree prefixes so P("data")
+            # applies to every input/label leaf (reference ParallelWrapper
+            # handles MultiDataSet fit the same way, ParallelWrapper.java:117)
+            base = make_graph_train_step(net.conf)
         else:
             base = make_train_step(net.conf)
         stacked = P("data")
@@ -224,17 +310,26 @@ class ParallelWrapper:
         states = stack(net.state_list)
         upd = stack(net.updater_state)
         batch_sh = NamedSharding(self.mesh, P("data"))
+        from deeplearning4j_tpu.nn.graph_network import (
+            ComputationGraph, _coerce_graph_batch)
+
+        is_graph = isinstance(net, ComputationGraph)
         since_avg = 0
         for _ in range(epochs):
             if hasattr(iterator, "reset"):
                 iterator.reset()
             for ds in iterator:
-                x = jax.device_put(jnp.asarray(ds.features), batch_sh)
-                y = jax.device_put(jnp.asarray(ds.labels), batch_sh)
+                if is_graph:
+                    xs, ys, _, _ = _coerce_graph_batch(ds)
+                    x = [jax.device_put(jnp.asarray(a), batch_sh) for a in xs]
+                    y = [jax.device_put(jnp.asarray(a), batch_sh) for a in ys]
+                else:
+                    x = jax.device_put(jnp.asarray(ds.features), batch_sh)
+                    y = jax.device_put(jnp.asarray(ds.labels), batch_sh)
                 params, states, upd, loss = self._local_step(
                     params, states, upd, x, y, net._next_rng(),
                     jnp.int32(net.iteration))
-                net.score_value = float(loss)
+                net.score_value = loss  # synced lazily (LazyScore)
                 net.iteration += 1
                 since_avg += 1
                 if since_avg >= self.averaging_frequency:

@@ -1,0 +1,41 @@
+"""Pins the XLA behaviors bench.py's MFU accounting depends on.
+
+bench.py multiplies XLA's cost-analysis flop count by K for K-step scanned
+dispatches because cost analysis counts a scan body ONCE, not trip-count
+times. If an XLA upgrade changes that, this test fails and bench.py's
+`_xla_flops` callers must drop their `* ksteps`.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _cost_flops(jit_fn, *args) -> float:
+    cost = jit_fn.lower(*args).compile().cost_analysis()
+    cost = cost[0] if isinstance(cost, (list, tuple)) else cost
+    return float((cost or {}).get("flops", 0.0))
+
+
+def test_cost_analysis_counts_scan_body_once():
+    w = jnp.asarray(np.random.default_rng(0).normal(size=(64, 64)),
+                    jnp.float32)
+
+    def multi(w, xs):
+        def body(carry, x):
+            return carry, jnp.sum(jnp.dot(x, w))
+
+        _, outs = jax.lax.scan(body, 0.0, xs)
+        return outs
+
+    jit_multi = jax.jit(multi)
+    costs = []
+    for k in (1, 4):
+        xs = jnp.ones((k, 32, 64), jnp.float32)
+        costs.append(_cost_flops(jit_multi, w, xs))
+    assert costs[0] > 0
+    # body counted once: flops near-identical despite 4x the executed steps
+    # (a couple of scalar loop-counter flops may differ; 4x would mean XLA
+    # started scaling with trip count)
+    assert costs[1] < costs[0] * 1.5, (
+        "XLA cost analysis now scales scan flops with trip count; "
+        "remove the `* ksteps` factors in bench.py::_xla_flops callers")

@@ -223,3 +223,100 @@ def test_graph_gradients_match_numeric():
         numeric = (sp - sm) / (2 * eps)
         analytic = float(grads["d1"]["W"][i, j])
         assert abs(numeric - analytic) < 5e-3 * max(1.0, abs(analytic)), (numeric, analytic)
+
+
+def test_graph_rnn_time_step_streaming():
+    """CG streaming inference == full-sequence forward (reference
+    ComputationGraph.rnnTimeStep:1788), mirroring the MLN streaming test."""
+    from deeplearning4j_tpu.nn.conf.layers import GravesLSTM, RnnOutputLayer
+
+    conf = (NeuralNetConfiguration.builder()
+            .seed(5)
+            .graph_builder()
+            .add_inputs("in")
+            .add_layer("lstm", GravesLSTM(n_in=3, n_out=6, activation="tanh"),
+                       "in")
+            .add_layer("out", RnnOutputLayer(n_in=6, n_out=2, loss="mcxent",
+                                             activation="softmax"), "lstm")
+            .set_outputs("out")
+            .build())
+    net = ComputationGraph(conf).init()
+    x = np.random.default_rng(1).normal(size=(2, 6, 3)).astype(np.float32)
+    full = np.asarray(net.output(x)[0])
+    net.rnn_clear_previous_state()
+    outs = [np.asarray(net.rnn_time_step(x[:, t:t + 1])[0]) for t in range(6)]
+    streamed = np.concatenate(outs, axis=1)
+    np.testing.assert_allclose(full, streamed, atol=1e-5)
+    # clearing state restarts the stream
+    net.rnn_clear_previous_state()
+    again = np.asarray(net.rnn_time_step(x[:, :1])[0])
+    np.testing.assert_allclose(again, outs[0], atol=1e-6)
+
+
+def test_graph_tbptt_runs_and_learns():
+    """CG TBPTT chunks the time axis and carries LSTM state (reference
+    ComputationGraph fit with BackpropType.TruncatedBPTT)."""
+    from deeplearning4j_tpu.nn.conf.layers import GravesLSTM, RnnOutputLayer
+
+    rng = np.random.default_rng(0)
+    B, T, C = 8, 20, 3
+    x = rng.normal(size=(B, T, C)).astype(np.float32)
+    y = np.zeros((B, T, C), np.float32)
+    y[..., 0] = 1
+    conf = (NeuralNetConfiguration.builder()
+            .seed(5).learning_rate(0.05)
+            .graph_builder()
+            .add_inputs("in")
+            .add_layer("lstm", GravesLSTM(n_in=C, n_out=8, activation="tanh"),
+                       "in")
+            .add_layer("out", RnnOutputLayer(n_in=8, n_out=C, loss="mcxent",
+                                             activation="softmax"), "lstm")
+            .set_outputs("out")
+            .backprop_type("TruncatedBPTT")
+            .t_bptt_forward_length(5)
+            .build())
+    net = ComputationGraph(conf).init()
+    s0 = None
+    for _ in range(5):
+        net.fit([x], [y])
+        if s0 is None:
+            s0 = net.score_value
+    assert net.iteration == 20  # 5 epochs x (20 timesteps / 5 per chunk)
+    assert np.isfinite(net.score_value)
+    assert net.score_value < s0
+
+
+def test_graph_char_rnn_streaming_generation():
+    """Char-RNN-style sampling through the CG streaming API, mirroring the
+    MLN char-RNN example loop: prime with a sequence, then feed back one
+    sampled step at a time (reference GravesLSTMCharModellingExample)."""
+    from deeplearning4j_tpu.nn.conf.layers import GravesLSTM, RnnOutputLayer
+
+    V = 12
+    conf = (NeuralNetConfiguration.builder()
+            .seed(7).learning_rate(0.1)
+            .graph_builder()
+            .add_inputs("in")
+            .add_layer("lstm", GravesLSTM(n_in=V, n_out=16, activation="tanh"),
+                       "in")
+            .add_layer("out", RnnOutputLayer(n_in=16, n_out=V, loss="mcxent",
+                                             activation="softmax"), "lstm")
+            .set_outputs("out")
+            .build())
+    net = ComputationGraph(conf).init()
+    rng = np.random.default_rng(3)
+    prime = np.eye(V, dtype=np.float32)[rng.integers(0, V, (1, 5))]
+    net.rnn_clear_previous_state()
+    out = net.rnn_time_step(prime)[0]
+    generated = []
+    for _ in range(8):
+        probs = np.asarray(out)[0, -1]
+        nxt = int(np.argmax(probs))
+        generated.append(nxt)
+        onehot = np.zeros((1, 1, V), np.float32)
+        onehot[0, 0, nxt] = 1
+        out = net.rnn_time_step(onehot)[0]
+        assert out.shape == (1, 1, V)
+        np.testing.assert_allclose(np.asarray(out).sum(axis=-1), 1.0,
+                                   atol=1e-5)
+    assert len(generated) == 8
