@@ -332,8 +332,86 @@ def bench_attention(batch: int, iters: int, ksteps: int, warmup: int = 2,
     }
 
 
+def bench_fit_resnet50(batch: int, iters: int, ksteps: int,
+                       warmup: int = 1) -> dict:
+    """The PRODUCTION fit(DataSetIterator) path on ResNet-50 — not the raw
+    multistep kernel. Measures what a user of the documented API gets:
+    host-staged numpy batches, K-step grouping + stacking inside
+    fit_iterator, lazy score sync (VERDICT round-2 item 2's acceptance bar:
+    within ~15% of the raw multistep bench)."""
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.models.resnet import resnet50
+    from deeplearning4j_tpu.nn.graph_network import ComputationGraph
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(batch, 224, 224, 3)).astype(np.float32)
+    y = _onehot_batch(rng, batch, 1000)
+    conf = resnet50(n_classes=1000, image_size=224)
+    net = ComputationGraph(conf).init()
+    net.dispatch_ksteps = ksteps
+    from deeplearning4j_tpu.common import get_policy
+    if get_policy().compute_dtype == jnp.bfloat16:
+        # compute casts to bf16 anyway; halve the host->device wire bytes
+        net.stage_dtype = jnp.bfloat16
+    n_batches = iters * ksteps
+    data = [DataSet(x, y) for _ in range(n_batches)]
+
+    net.fit_iterator(iter(data[:warmup * ksteps]))  # compile + warm relay
+    float(net.score_value)  # hard sync (see module docstring)
+    t0 = time.perf_counter()
+    net.fit_iterator(iter(data))
+    float(net.score_value)  # waits on the whole param-dependency chain
+    dt = time.perf_counter() - t0
+    return {
+        "samples_per_sec": batch * n_batches / dt,
+        "step_time_ms": dt / n_batches * 1000,
+        "batch": batch, "iters": iters, "ksteps": ksteps,
+        "tflops_per_sec": 0.0, "mfu": 0.0,  # same program as resnet50 bench
+        "api": "ComputationGraph.fit_iterator",
+    }
+
+
+def bench_fit_lenet(batch: int, iters: int, ksteps: int,
+                    warmup: int = 1) -> dict:
+    """Production MultiLayerNetwork.fit_iterator throughput on LeNet."""
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.models.lenet import lenet_mnist
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(batch, 784)).astype(np.float32)
+    y = _onehot_batch(rng, batch, 10)
+    net = MultiLayerNetwork(lenet_mnist()).init()
+    net.dispatch_ksteps = ksteps
+    from deeplearning4j_tpu.common import get_policy
+    if get_policy().compute_dtype == jnp.bfloat16:
+        net.stage_dtype = jnp.bfloat16  # halve wire bytes (see resnet50 fit)
+    n_batches = iters * ksteps
+    data = [DataSet(x, y) for _ in range(n_batches)]
+
+    net.fit_iterator(iter(data[:warmup * ksteps]))
+    float(net.score_value)  # hard sync (see module docstring)
+    t0 = time.perf_counter()
+    net.fit_iterator(iter(data))
+    float(net.score_value)
+    dt = time.perf_counter() - t0
+    return {
+        "samples_per_sec": batch * n_batches / dt,
+        "step_time_ms": dt / n_batches * 1000,
+        "batch": batch, "iters": iters, "ksteps": ksteps,
+        "tflops_per_sec": 0.0, "mfu": 0.0,
+        "api": "MultiLayerNetwork.fit_iterator",
+    }
+
+
 _METRICS = {
     "lenet": "lenet_mnist_samples_per_sec",
+    "fit_lenet": "lenet_fit_api_samples_per_sec",
+    "fit_resnet50": "resnet50_fit_api_samples_per_sec",
     "char_rnn": "char_rnn_samples_per_sec",
     "transformer": "transformer_lm_samples_per_sec",
     "resnet50": "resnet50_samples_per_sec_per_chip",
@@ -343,7 +421,9 @@ _METRICS = {
 
 _DEFAULTS = {  # model -> (batch, iters, ksteps)
     "lenet": (128, 20, 16),
+    "fit_lenet": (128, 20, 16),
     "resnet50": (128, 5, 8),
+    "fit_resnet50": (64, 4, 8),
     "char_rnn": (32, 5, 8),
     "transformer": (16, 5, 8),
     "word2vec": (1024, 10, 32),
@@ -353,6 +433,7 @@ _DEFAULTS = {  # model -> (batch, iters, ksteps)
 
 def _bench_fns():
     return {"lenet": bench_lenet, "resnet50": bench_resnet50,
+            "fit_lenet": bench_fit_lenet, "fit_resnet50": bench_fit_resnet50,
             "char_rnn": bench_char_rnn, "transformer": bench_transformer,
             "word2vec": bench_word2vec, "attention": bench_attention}
 

@@ -91,15 +91,15 @@ class CifarDataSetIterator(ArrayDataSetIterator):
         if num_examples is not None:
             feats, labels = feats[:num_examples], labels[:num_examples]
         x = feats.astype(np.float32) / 255.0
+        # Canonicalize to NHWC BEFORE any flattening: CIFAR binaries are
+        # channel-major (3,32,32) while synthetic is HWC — flattening the raw
+        # layouts would give flatten=True a source-dependent pixel order.
+        if not self.synthetic:
+            x = x.reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+        else:
+            x = x.reshape(-1, 32, 32, 3)
         if flatten:
             x = x.reshape(len(x), -1)
-        else:
-            # CIFAR binaries are channel-major (3,32,32); synthetic is already
-            # HWC-flattened, so route both through a canonical reshape
-            if not self.synthetic:
-                x = x.reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
-            else:
-                x = x.reshape(-1, 32, 32, 3)
         y = np.zeros((len(labels), 10), np.float32)
         y[np.arange(len(labels)), labels] = 1.0
         super().__init__(x, y, batch, shuffle=shuffle, seed=seed)

@@ -63,22 +63,13 @@ class CSVRecordReader(RecordReader):
         self.delimiter = delimiter
 
     def _numeric_fast_path(self) -> Optional[np.ndarray]:
-        # Every field of every row must parse as a float, or the file routes
-        # through the general reader (a single 'NA' deep in the file must not
-        # be silently coerced to 0 by the native parser).
+        # ONE native pass validates while parsing (strict mode): any
+        # empty/non-numeric field or ragged row anywhere in the file returns
+        # None — a single 'NA' deep in the file must not be silently coerced
+        # to 0 — and the file routes through the general reader below.
         from deeplearning4j_tpu import nativert
-        try:
-            with open(self.path) as f:
-                for i, line in enumerate(f):
-                    if i < self.skip_lines:
-                        continue
-                    if line.strip():
-                        for field in line.rstrip("\n").split(self.delimiter):
-                            float(field)  # ValueError -> not numeric
-        except ValueError:
-            return None
         return nativert.read_csv_numeric(str(self.path), self.delimiter,
-                                         self.skip_lines)
+                                         self.skip_lines, strict=True)
 
     def records(self) -> Iterator[List]:
         fast = self._numeric_fast_path()

@@ -111,6 +111,8 @@ class DeepLearning4jEntryPoint:
 
 _RPC_METHODS = frozenset({"fit", "evaluate", "predict"})
 
+_LOOPBACK_HOSTS = frozenset({"127.0.0.1", "::1", "localhost", ""})
+
 
 class _Handler(socketserver.StreamRequestHandler):
     def handle(self):
@@ -120,6 +122,12 @@ class _Handler(socketserver.StreamRequestHandler):
                 continue
             try:
                 req = json.loads(line)
+                token = self.server.auth_token
+                if token is not None:
+                    import hmac
+                    supplied = str(req.get("token", ""))
+                    if not hmac.compare_digest(supplied, token):
+                        raise PermissionError("invalid or missing auth token")
                 name = req["method"]
                 if name not in _RPC_METHODS:
                     raise ValueError(f"unknown method {name!r} "
@@ -135,14 +143,25 @@ class _Handler(socketserver.StreamRequestHandler):
 
 class Server:
     """JSON-lines TCP gateway (reference keras/Server.java:18 py4j
-    GatewayServer equivalent). ``start()`` serves on a background thread."""
+    GatewayServer equivalent). ``start()`` serves on a background thread.
+
+    The RPC surface reads model/dataset files from caller-supplied paths, so
+    exposure beyond loopback is gated: binding a non-loopback host requires
+    an ``auth_token``, which every request must then carry as ``token``
+    (checked with a constant-time compare)."""
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
-                 entry_point: Optional[DeepLearning4jEntryPoint] = None):
+                 entry_point: Optional[DeepLearning4jEntryPoint] = None,
+                 auth_token: Optional[str] = None):
+        if host not in _LOOPBACK_HOSTS and not auth_token:
+            raise ValueError(
+                f"refusing to bind {host!r}: the gateway executes file-path "
+                "RPCs; pass auth_token= to expose it beyond loopback")
         self._srv = socketserver.ThreadingTCPServer(
             (host, port), _Handler, bind_and_activate=True)
         self._srv.daemon_threads = True
         self._srv.entry_point = entry_point or DeepLearning4jEntryPoint()
+        self._srv.auth_token = auth_token
         self._thread: Optional[threading.Thread] = None
 
     @property
@@ -160,11 +179,14 @@ class Server:
         self._srv.server_close()
 
 
-def call(host: str, port: int, method: str, **params):
+def call(host: str, port: int, method: str, token: Optional[str] = None,
+         **params):
     """Convenience client for the gateway protocol."""
+    req = {"method": method, "params": params}
+    if token is not None:
+        req["token"] = token
     with socket.create_connection((host, port)) as s:
-        s.sendall((json.dumps({"method": method, "params": params}) + "\n")
-                  .encode())
+        s.sendall((json.dumps(req) + "\n").encode())
         buf = b""
         while not buf.endswith(b"\n"):
             chunk = s.recv(65536)

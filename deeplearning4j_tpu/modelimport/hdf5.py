@@ -1,9 +1,11 @@
 """Minimal HDF5 reader/writer over the system C library via ctypes.
 
 The reference reaches HDF5 natively through JavaCPP (`Loader.load(hdf5.class)`,
-reference deeplearning4j-modelimport keras/KerasModelImport.java:64); h5py is
-not in this image, so the same capability is provided by binding
-``libhdf5_serial`` directly. Covers exactly what Keras archives need: groups,
+reference deeplearning4j-modelimport keras/KerasModelImport.java:64). This
+binding goes to ``libhdf5_serial`` directly via ctypes to mirror that
+native-first design and keep the import path dependency-free (h5py does exist
+in this image; tests use it as an independent cross-check of this reader).
+Covers exactly what Keras archives need: groups,
 float/int datasets, scalar string attributes and string-array attributes
 (fixed- and variable-length), plus writing the same so tests can produce
 fixtures and models can be exported.
@@ -301,12 +303,24 @@ class H5File:
             cls = lib.H5Tget_class(tid)
             if cls == H5T_STRING:
                 if lib.H5Tis_variable_str(tid) > 0:
-                    bufs = (ctypes.c_char_p * npoints)()
+                    # c_void_p (not c_char_p) so the library-allocated
+                    # pointers survive ctypes' bytes auto-conversion and can
+                    # be returned to libhdf5 — without H5free_memory every
+                    # vlen read leaks, which adds up in the long-lived
+                    # keras_server process
+                    bufs = (ctypes.c_void_p * npoints)()
                     mem = lib.H5Tcopy(_types["c_s1"])
                     lib.H5Tset_size(mem, H5T_VARIABLE)
                     lib.H5Aread(aid, mem, bufs)
-                    vals = [(bufs[i] or b"").decode("utf-8", "replace")
-                            for i in range(npoints)]
+                    vals = []
+                    free = getattr(lib, "H5free_memory", None)
+                    for i in range(npoints):
+                        p = bufs[i]
+                        s = (ctypes.cast(p, ctypes.c_char_p).value or b"") \
+                            if p else b""
+                        vals.append(s.decode("utf-8", "replace"))
+                        if p and free is not None:
+                            free(ctypes.c_void_p(p))
                     lib.H5Tclose(mem)
                 else:
                     size = lib.H5Tget_size(tid)

@@ -217,27 +217,55 @@ def _as_list(v) -> List[str]:
     return [v] if isinstance(v, str) else list(v)
 
 
-def _layer_weights(f: H5File, root: str, lname: str) -> List[np.ndarray]:
+def _layer_weights(f: H5File, root: str, lname: str):
+    """Read a layer's weight arrays. Returns (names, arrays) — names preserve
+    the archive's `weight_names` attribute so gate mapping can key on name
+    suffixes instead of trusting array order (reference KerasLayer maps
+    weights by name, keras/KerasLayer.java)."""
     g = f"{root.rstrip('/')}/{lname}"
     if not f.has_attr(g, "weight_names"):
-        return []
+        return [], []
     names = _as_list(f.read_attr(g, "weight_names"))
     out = []
     for wn in names:
         # weight_names may be bare ("dense_1_W") or nested ("dense_1/dense_1_W")
         p = f"{g}/{wn}" if f.exists(f"{g}/{wn}") else f"{root.rstrip('/')}/{wn}"
         out.append(f.read_dataset(p))
-    return out
+    return names, out
 
 
-def _convert_lstm(ws: List[np.ndarray]) -> Dict[str, np.ndarray]:
-    """Keras-1 LSTM weight list [W_i,U_i,b_i, W_c,U_c,b_c, W_f,U_f,b_f,
-    W_o,U_o,b_o] -> fused {W [in,4H], RW [H,4H], b [4H]} in this framework's
-    gate order (input, forget, cell, output)."""
+# Keras-1 canonical per-gate array suffixes, in the serialization order a
+# canonical archive uses (input, cell, forget, output gates).
+_LSTM_SUFFIXES = ("W_i", "U_i", "b_i", "W_c", "U_c", "b_c",
+                  "W_f", "U_f", "b_f", "W_o", "U_o", "b_o")
+
+
+def _convert_lstm(ws: List[np.ndarray],
+                  names: Optional[List[str]] = None) -> Dict[str, np.ndarray]:
+    """Keras-1 LSTM weights -> fused {W [in,4H], RW [H,4H], b [4H]} in this
+    framework's gate order (input, forget, cell, output).
+
+    Arrays are matched by their `weight_names` suffix (``*_W_i``, ``*_U_c``,
+    ...) so an archive whose weight_names order deviates from the canonical
+    [i, c, f, o] listing still imports with the right gates; purely
+    positional matching is the fallback when names are absent or don't look
+    like Keras-1 gate names."""
     if len(ws) != 12:
         raise InvalidKerasConfigurationException(
             f"expected 12 LSTM weight arrays (Keras 1 layout), got {len(ws)}")
-    wi, ui, bi, wc, uc, bc, wf, uf, bf, wo, uo, bo = ws
+    by_suffix = {}
+    if names and len(names) == 12:
+        stripped = [str(n).split("/")[-1] for n in names]
+        for suf in _LSTM_SUFFIXES:
+            hits = [i for i, n in enumerate(stripped)
+                    if n == suf or n.endswith("_" + suf)]
+            if len(hits) == 1:
+                by_suffix[suf] = ws[hits[0]]
+    if len(by_suffix) == 12:
+        ordered = [by_suffix[s] for s in _LSTM_SUFFIXES]
+    else:  # positional fallback: canonical Keras-1 ordering
+        ordered = ws
+    wi, ui, bi, wc, uc, bc, wf, uf, bf, wo, uo, bo = ordered
     return {
         "W": np.concatenate([wi, wf, wc, wo], axis=1),
         "RW": np.concatenate([ui, uf, uc, uo], axis=1),
@@ -253,7 +281,8 @@ def _convert_conv(w: np.ndarray, dim_ordering: str) -> np.ndarray:
 
 
 def _set_layer_params(cls: str, cfg: dict, params: dict, state: dict,
-                      ws: List[np.ndarray]) -> None:
+                      ws: List[np.ndarray],
+                      names: Optional[List[str]] = None) -> None:
     if not ws:
         return
     if cls in ("Dense", "TimeDistributedDense", "Embedding"):
@@ -270,7 +299,7 @@ def _set_layer_params(cls: str, cfg: dict, params: dict, state: dict,
         if len(ws) > 1:
             params["b"] = jnp.asarray(ws[1], jnp.float32)
     elif cls == "LSTM":
-        for k, v in _convert_lstm(ws).items():
+        for k, v in _convert_lstm(ws, names).items():
             params[k] = jnp.asarray(v, jnp.float32)
     elif cls == "BatchNormalization":
         params["gamma"] = jnp.asarray(ws[0], jnp.float32)
@@ -316,9 +345,9 @@ class KerasModelImport:
                 if name not in parse.index_of:
                     continue
                 idx = parse.index_of[name]
-                ws = _layer_weights(f, root, name)
+                wnames, ws = _layer_weights(f, root, name)
                 _set_layer_params(kl["class_name"], cfg, net.params_list[idx],
-                                  net.state_list[idx], ws)
+                                  net.state_list[idx], ws, wnames)
         return net
 
     @staticmethod
@@ -341,9 +370,9 @@ class KerasModelImport:
             for name, cls in class_of.items():
                 if name not in net.params_list:
                     continue
-                ws = _layer_weights(f, root, name)
+                wnames, ws = _layer_weights(f, root, name)
                 _set_layer_params(cls, cfg_of[name], net.params_list[name],
-                                  net.state_list.get(name, {}), ws)
+                                  net.state_list.get(name, {}), ws, wnames)
         return net
 
     @staticmethod

@@ -88,6 +88,9 @@ def _declare(lib: ctypes.CDLL) -> None:
 
     lib.dl4j_csv_open.restype = ctypes.c_void_p
     lib.dl4j_csv_open.argtypes = [ctypes.c_char_p, ctypes.c_char, ctypes.c_int]
+    lib.dl4j_csv_open2.restype = ctypes.c_void_p
+    lib.dl4j_csv_open2.argtypes = [ctypes.c_char_p, ctypes.c_char,
+                                   ctypes.c_int, ctypes.c_int]
     lib.dl4j_csv_rows.restype = c_i64
     lib.dl4j_csv_rows.argtypes = [ctypes.c_void_p]
     lib.dl4j_csv_cols.restype = c_i64
@@ -127,7 +130,7 @@ def get_runtime() -> Optional[ctypes.CDLL]:
         try:
             lib = ctypes.CDLL(str(_LIB_PATH))
             _declare(lib)
-            if lib.dl4j_runtime_version() != 1:
+            if lib.dl4j_runtime_version() != 2:
                 return None
             _lib = lib
         except OSError:
@@ -167,15 +170,20 @@ def read_idx(path: str) -> Optional[np.ndarray]:
 # CSV
 # ---------------------------------------------------------------------------
 
-def read_csv_numeric(path: str, delimiter: str = ",",
-                     skip_lines: int = 0) -> Optional[np.ndarray]:
-    """Fast numeric CSV → float32 [rows, cols]; non-numeric fields become 0.
-    None when the native runtime is unavailable or the file can't be read."""
+def read_csv_numeric(path: str, delimiter: str = ",", skip_lines: int = 0,
+                     strict: bool = False) -> Optional[np.ndarray]:
+    """Fast numeric CSV → float32 [rows, cols].
+
+    ``strict=False``: non-numeric fields become 0 (lenient legacy behavior).
+    ``strict=True``: one native pass validates WHILE parsing — returns None
+    on any empty/non-numeric field or ragged row so the caller can fall back
+    to its general string-preserving reader. Also None when the native
+    runtime is unavailable or the file can't be read."""
     lib = get_runtime()
     if lib is None:
         return None
-    h = lib.dl4j_csv_open(str(path).encode(), delimiter.encode()[:1],
-                          int(skip_lines))
+    h = lib.dl4j_csv_open2(str(path).encode(), delimiter.encode()[:1],
+                           int(skip_lines), 1 if strict else 0)
     if not h:
         return None
     try:

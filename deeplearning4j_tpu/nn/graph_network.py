@@ -425,6 +425,10 @@ class ComputationGraph(LazyScore):
     #: MultiLayerNetwork.dispatch_ksteps); 1 disables the K-step path
     dispatch_ksteps: int = 8
 
+    #: host-side feature staging dtype for the fused fit path (see
+    #: MultiLayerNetwork.stage_dtype); None keeps exact f32 staging
+    stage_dtype = None
+
     def fit_iterator(self, iterator, epochs: int = 1,
                      ksteps: Optional[int] = None) -> None:
         """Iterator fit with K-step fused dispatch (TPU fast path — see
@@ -472,7 +476,13 @@ class ComputationGraph(LazyScore):
             self._fit_batch(batches[0][0], batches[0][1])
             return
         n_in, n_out = len(batches[0][0]), len(batches[0][1])
-        xs = [jnp.asarray(np.stack([b[0][i] for b in batches]))
+
+        def stage(stack):
+            if self.stage_dtype is not None:
+                stack = stack.astype(self.stage_dtype)
+            return jnp.asarray(stack)
+
+        xs = [stage(np.stack([b[0][i] for b in batches]))
               for i in range(n_in)]
         ys = [jnp.asarray(np.stack([b[1][i] for b in batches]))
               for i in range(n_out)]

@@ -359,6 +359,13 @@ class MultiLayerNetwork(LazyScore):
     #: disables the K-step path. Benched sweet spot for relay-attached TPUs.
     dispatch_ksteps: int = 8
 
+    #: optional dtype (e.g. jnp.bfloat16) features are cast to on the host
+    #: BEFORE the device transfer in the fused fit path. Halves host->device
+    #: bytes — the binding constraint when the TPU is behind a network relay
+    #: (BASELINE.md round-3 fit-API analysis). Labels stay untouched. None
+    #: keeps exact f32 staging.
+    stage_dtype = None
+
     def fit_iterator(self, iterator: Iterable, epochs: int = 1,
                      ksteps: Optional[int] = None) -> None:
         """Fit from a DataSetIterator (reference fit(DataSetIterator):978).
@@ -421,7 +428,10 @@ class MultiLayerNetwork(LazyScore):
         if len(batches) == 1:
             self._fit_batch(batches[0][0], batches[0][1])
             return
-        xs = jnp.asarray(np.stack([b[0] for b in batches]))
+        xs = np.stack([b[0] for b in batches])
+        if self.stage_dtype is not None:
+            xs = xs.astype(self.stage_dtype)
+        xs = jnp.asarray(xs)
         ys = jnp.asarray(np.stack([b[1] for b in batches]))
         multi = self._jit("multistep", make_multistep_train_step(self.conf))
         (self.params_list, self.state_list, self.updater_state, losses) = multi(

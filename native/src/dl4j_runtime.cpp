@@ -208,7 +208,12 @@ struct CsvFile {
   std::vector<float> values;
 };
 
-CsvFile* csv_load(const char* path, char delim, int skip_lines) {
+// strict != 0: reject the file (return nullptr) on the first field that is
+// empty or not fully numeric, or on a ragged row — the caller then takes its
+// general (string-preserving) reader. This makes one native pass both
+// validate AND parse, replacing the old Python float()-prevalidation pass
+// that read the whole file twice.
+CsvFile* csv_load(const char* path, char delim, int skip_lines, int strict) {
   FILE* f = std::fopen(path, "rb");
   if (!f) return nullptr;
   std::fseek(f, 0, SEEK_END);
@@ -236,21 +241,32 @@ CsvFile* csv_load(const char* path, char delim, int skip_lines) {
       // Null-terminate the field in place so strtof can't scan past it
       // (e.g. steal a number from the next line through the '\n').
       float v = 0.0f;
+      bool field_ok = false;
       if (fend > p) {
         char saved = '\0';
         bool restore = fend < buf.size();
         if (restore) { saved = buf[fend]; buf[fend] = '\0'; }
         char* end = nullptr;
         v = std::strtof(buf.data() + p, &end);
-        if (end == buf.data() + p) v = 0.0f;  // non-numeric field -> 0
+        if (end == buf.data() + p) {
+          v = 0.0f;  // non-numeric field -> 0 (lenient mode)
+        } else {
+          // fully consumed modulo trailing whitespace/CR == Python float()
+          const char* q = end;
+          const char* fe = buf.data() + fend;
+          while (q < fe && (*q == ' ' || *q == '\t' || *q == '\r')) q++;
+          field_ok = (q == fe);
+        }
         if (restore) buf[fend] = saved;
       }
+      if (strict && !field_ok) { delete out; return nullptr; }
       out->values.push_back(v);  // empty field (incl. trailing delim) -> 0
       ncol++;
       if (fend == eol) break;
       p = fend + 1;
     }
     if (out->cols == 0) out->cols = ncol;
+    if (ncol != out->cols && strict) { delete out; return nullptr; }
     if (ncol < out->cols) {  // ragged short row: pad with zeros
       while (ncol < out->cols) { out->values.push_back(0.0f); ncol++; }
     } else if (ncol > out->cols) {  // ragged long row: truncate
@@ -393,7 +409,12 @@ void dl4j_loader_close(void* h) { delete static_cast<Loader*>(h); }
 
 // ----- CSV -----
 void* dl4j_csv_open(const char* path, char delim, int skip_lines) {
-  return csv_load(path, delim, skip_lines);
+  return csv_load(path, delim, skip_lines, /*strict=*/0);
+}
+// v2: strict validate-while-parsing (nullptr on any non-numeric/ragged data)
+void* dl4j_csv_open2(const char* path, char delim, int skip_lines,
+                     int strict) {
+  return csv_load(path, delim, skip_lines, strict);
 }
 int64_t dl4j_csv_rows(void* h) { return static_cast<CsvFile*>(h)->rows; }
 int64_t dl4j_csv_cols(void* h) { return static_cast<CsvFile*>(h)->cols; }
@@ -457,6 +478,6 @@ int64_t dl4j_stats_finish(void* h, uint8_t* out, int64_t cap) {
 
 void dl4j_stats_abort(void* h) { delete static_cast<StatsBuilder*>(h); }
 
-int dl4j_runtime_version(void) { return 1; }
+int dl4j_runtime_version(void) { return 2; }
 
 }  // extern "C"

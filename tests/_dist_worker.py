@@ -1,0 +1,79 @@
+"""Worker process for the 2-process jax.distributed smoke test.
+
+Run as: python tests/_dist_worker.py <process_id> <coordinator_port>
+
+Initializes the cluster through the framework's own entry point
+(parallel/mesh.py init_distributed — the replacement for the reference's
+Spark driver/executor bring-up), runs ONE synchronous-DP train step with the
+global batch sharded across the two processes' CPU devices, and prints a JSON
+record of the resulting (replicated) parameters for the parent to compare
+against a single-process step.
+"""
+import json
+import sys
+
+
+def main() -> None:
+    pid = int(sys.argv[1])
+    port = sys.argv[2]
+
+    from deeplearning4j_tpu.parallel.mesh import (
+        data_parallel_mesh, init_distributed)
+
+    init_distributed(coordinator_address=f"127.0.0.1:{port}",
+                     num_processes=2, process_id=pid)
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from deeplearning4j_tpu.nn.conf.builders import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.multilayer import (
+        MultiLayerNetwork, make_train_step)
+
+    assert jax.process_count() == 2, jax.process_count()
+    assert jax.device_count() == 2, jax.devices()
+
+    conf = (NeuralNetConfiguration.builder()
+            .seed(9).learning_rate(0.1).updater("sgd")
+            .list()
+            .layer(DenseLayer(n_in=4, n_out=8, activation="tanh"))
+            .layer(OutputLayer(n_in=8, n_out=3, loss="mcxent",
+                               activation="softmax"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+
+    rng = np.random.default_rng(0)
+    B = 8
+    x = rng.normal(size=(B, 4)).astype(np.float32)
+    y = np.zeros((B, 3), np.float32)
+    y[np.arange(B), rng.integers(0, 3, B)] = 1
+
+    mesh = data_parallel_mesh()
+    repl = NamedSharding(mesh, P())
+    bsh = NamedSharding(mesh, P("data"))
+    half = B // 2
+    gx = jax.make_array_from_process_local_data(
+        bsh, x[pid * half:(pid + 1) * half])
+    gy = jax.make_array_from_process_local_data(
+        bsh, y[pid * half:(pid + 1) * half])
+
+    step = jax.jit(make_train_step(conf),
+                   in_shardings=(repl, repl, repl, bsh, bsh, repl, repl),
+                   out_shardings=(repl, repl, repl, repl))
+    params, _, _, loss = step(net.params_list, net.state_list,
+                              net.updater_state, gx, gy,
+                              jax.random.PRNGKey(0), jnp.int32(0))
+
+    flat = np.concatenate([np.ravel(np.asarray(leaf)) for leaf in
+                           jax.tree_util.tree_leaves(params)])
+    print(json.dumps({"pid": pid, "loss": float(loss),
+                      "psum": float(flat.sum()),
+                      "head": [float(v) for v in flat[:5]]}), flush=True)
+    jax.distributed.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -115,3 +115,59 @@ def test_curves_iterator_autoencoder_labels():
     ds = next(iter(it))
     np.testing.assert_array_equal(ds.features, ds.labels)
     assert ds.features.shape == (16, 784)
+
+
+def test_cifar_flatten_layout_consistent(tmp_path, monkeypatch):
+    """flatten=True must yield HWC pixel order from BOTH sources (advisor
+    round-1 finding: real CIFAR flattened channel-major, synthetic HWC)."""
+    from deeplearning4j_tpu.datasets import fetchers
+
+    # fake real CIFAR binary: label + R/G/B planes; pixel (0,0) = (10,20,30)
+    rec = np.zeros(3073, np.uint8)
+    rec[0] = 3
+    rec[1] = 10
+    rec[1 + 1024] = 20
+    rec[1 + 2048] = 30
+    (tmp_path / "data_batch_1.bin").write_bytes(np.tile(rec, 4).tobytes())
+    monkeypatch.setattr(fetchers, "_CIFAR_DIRS", [str(tmp_path)])
+
+    flat = next(iter(CifarDataSetIterator(batch=4, shuffle=False,
+                                          flatten=True)))
+    img = next(iter(CifarDataSetIterator(batch=4, shuffle=False)))
+    np.testing.assert_allclose(np.asarray(flat.features),
+                               np.asarray(img.features).reshape(4, -1))
+    # first 3 flattened values are pixel (0,0)'s RGB — HWC, not a CHW plane
+    np.testing.assert_allclose(np.asarray(flat.features)[0, :3],
+                               np.array([10, 20, 30]) / 255.0, atol=1e-6)
+
+    # synthetic source obeys the same contract
+    monkeypatch.setattr(fetchers, "_CIFAR_DIRS", [])
+    flat_s = next(iter(CifarDataSetIterator(batch=4, shuffle=False,
+                                            flatten=True, num_examples=4)))
+    img_s = next(iter(CifarDataSetIterator(batch=4, shuffle=False,
+                                           num_examples=4)))
+    np.testing.assert_allclose(np.asarray(flat_s.features),
+                               np.asarray(img_s.features).reshape(4, -1))
+
+
+def test_csv_strict_single_pass_validation(tmp_path):
+    """The numeric fast path validates while parsing in ONE native pass
+    (advisor round-1 finding: no more float() pre-pass over the whole file).
+    A single non-numeric field routes the file to the general reader."""
+    from deeplearning4j_tpu.datavec.records import CSVRecordReader
+
+    ok = tmp_path / "ok.csv"
+    ok.write_text("1.5,2,3\n4,5e-1,6\n")
+    rows = list(CSVRecordReader(ok).records())
+    assert rows == [[1.5, 2.0, 3.0], [4.0, 0.5, 6.0]]
+
+    bad = tmp_path / "bad.csv"
+    bad.write_text("1,2,3\n4,NA,6\n")
+    rows = list(CSVRecordReader(bad).records())
+    assert rows[0] == [1.0, 2.0, 3.0]
+    assert rows[1] == [4.0, "NA", 6.0]  # preserved, not coerced to 0
+
+    empty_field = tmp_path / "empty.csv"
+    empty_field.write_text("1,2\n3,\n")
+    rows = list(CSVRecordReader(empty_field).records())
+    assert rows[1][1] == ""  # empty field survives via the general reader
