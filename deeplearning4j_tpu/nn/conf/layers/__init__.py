@@ -21,7 +21,11 @@ from deeplearning4j_tpu.nn.conf.layers.normalization import (
 from deeplearning4j_tpu.nn.conf.layers.recurrent import (
     GravesLSTM, LSTM, GravesBidirectionalLSTM, RnnOutputLayer,
 )
-from deeplearning4j_tpu.nn.conf.layers.variational import VariationalAutoencoder
+from deeplearning4j_tpu.nn.conf.layers.variational import (
+    BernoulliReconstructionDistribution, CompositeReconstructionDistribution,
+    ExponentialReconstructionDistribution, GaussianReconstructionDistribution,
+    ReconstructionDistribution, VariationalAutoencoder,
+)
 from deeplearning4j_tpu.nn.conf.layers.attention import (
     SelfAttentionLayer, TransformerBlock,
 )

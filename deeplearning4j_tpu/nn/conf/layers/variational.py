@@ -7,12 +7,15 @@ mean of q(z|x) through the encoder (reference behaviour: activate() returns the 
 mean when used as a frozen feature extractor).
 
 Encoder/decoder are MLPs given by ``encoder_layer_sizes`` / ``decoder_layer_sizes``.
-Reconstruction distributions: 'gaussian' (diagonal, learned variance), 'bernoulli'.
+Reconstruction distributions are a pluggable family (reference
+nn/conf/layers/variational/ReconstructionDistribution.java SPI with Gaussian,
+Bernoulli, Exponential, and Composite implementations): pass a distribution
+object, or the string shortcuts 'gaussian' | 'bernoulli' | 'exponential'.
 """
 from __future__ import annotations
 
 import dataclasses
-from typing import Sequence
+from typing import Any, List, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -25,14 +28,169 @@ from deeplearning4j_tpu.ops.activations import get_activation
 Array = jax.Array
 
 
+# ---------------------------------------------------------------------------
+# Reconstruction distribution family (reference nn/conf/layers/variational/)
+# ---------------------------------------------------------------------------
+
+class ReconstructionDistribution:
+    """p(x|z) SPI (reference ReconstructionDistribution.java): maps the
+    decoder's pre-output ``pre`` to a negative log-likelihood and a mean."""
+
+    def input_size(self, data_size: int) -> int:
+        """Decoder output units needed to parameterize p(x|z) for
+        ``data_size`` features (reference distributionInputSize)."""
+        raise NotImplementedError
+
+    def nll(self, x: Array, pre: Array) -> Array:
+        """Per-example negative log p(x|z), summed over features
+        (reference negLogProbability)."""
+        raise NotImplementedError
+
+    def mean(self, pre: Array) -> Array:
+        """E[x|z] (reference generateAtMean)."""
+        raise NotImplementedError
+
+
+@register_config("GaussianReconstruction")
+@dataclasses.dataclass
+class GaussianReconstructionDistribution(ReconstructionDistribution):
+    """Diagonal gaussian with learned variance (reference
+    GaussianReconstructionDistribution.java). ``pre`` packs [mean | logvar];
+    ``activation`` applies to the mean half only."""
+
+    activation: str = "identity"
+
+    def input_size(self, data_size: int) -> int:
+        return 2 * data_size
+
+    def _split(self, pre):
+        d = pre.shape[-1] // 2
+        act = get_activation(self.activation)
+        return act(pre[..., :d]), pre[..., d:]
+
+    def nll(self, x, pre):
+        rmean, rlogvar = self._split(pre)
+        return 0.5 * jnp.sum(rlogvar + (x - rmean) ** 2 / jnp.exp(rlogvar)
+                             + jnp.log(2 * jnp.pi), axis=-1)
+
+    def mean(self, pre):
+        return self._split(pre)[0]
+
+
+@register_config("BernoulliReconstruction")
+@dataclasses.dataclass
+class BernoulliReconstructionDistribution(ReconstructionDistribution):
+    """Bernoulli over logits (reference
+    BernoulliReconstructionDistribution.java, sigmoid parameterization)."""
+
+    def input_size(self, data_size: int) -> int:
+        return data_size
+
+    def nll(self, x, pre):
+        # stable cross-entropy on logits
+        return jnp.sum(x * jax.nn.softplus(-pre)
+                       + (1 - x) * jax.nn.softplus(pre), axis=-1)
+
+    def mean(self, pre):
+        return jax.nn.sigmoid(pre)
+
+
+@register_config("ExponentialReconstruction")
+@dataclasses.dataclass
+class ExponentialReconstructionDistribution(ReconstructionDistribution):
+    """Exponential with rate lambda = exp(gamma) (reference
+    ExponentialReconstructionDistribution.java): log p(x) = gamma - exp(gamma)*x
+    for x >= 0; mean = exp(-gamma)."""
+
+    activation: str = "identity"
+
+    def input_size(self, data_size: int) -> int:
+        return data_size
+
+    def nll(self, x, pre):
+        gamma = get_activation(self.activation)(pre)
+        return jnp.sum(jnp.exp(gamma) * x - gamma, axis=-1)
+
+    def mean(self, pre):
+        gamma = get_activation(self.activation)(pre)
+        return jnp.exp(-gamma)
+
+
+@register_config("CompositeReconstruction")
+@dataclasses.dataclass
+class CompositeReconstructionDistribution(ReconstructionDistribution):
+    """Different distributions over feature slices (reference
+    CompositeReconstructionDistribution.java): ``components`` is a list of
+    [data_size, distribution] pairs, in feature order."""
+
+    components: List = dataclasses.field(default_factory=list)
+
+    def add(self, data_size: int,
+            dist: ReconstructionDistribution) -> "CompositeReconstructionDistribution":
+        self.components.append([int(data_size), dist])
+        return self
+
+    def input_size(self, data_size: int) -> int:
+        total_data = sum(int(s) for s, _ in self.components)
+        if total_data != data_size:
+            raise ValueError(
+                f"composite components cover {total_data} features, "
+                f"layer has {data_size}")
+        return sum(d.input_size(int(s)) for s, d in self.components)
+
+    def nll(self, x, pre):
+        total = 0.0
+        xo = po = 0
+        for s, d in self.components:
+            s = int(s)
+            ins = d.input_size(s)
+            total = total + d.nll(x[..., xo:xo + s], pre[..., po:po + ins])
+            xo += s
+            po += ins
+        return total
+
+    def mean(self, pre):
+        outs = []
+        po = 0
+        for s, d in self.components:
+            ins = d.input_size(int(s))
+            outs.append(d.mean(pre[..., po:po + ins]))
+            po += ins
+        return jnp.concatenate(outs, axis=-1)
+
+
+_DIST_SHORTCUTS = {
+    "gaussian": GaussianReconstructionDistribution,
+    "bernoulli": BernoulliReconstructionDistribution,
+    "exponential": ExponentialReconstructionDistribution,
+}
+
+
+def resolve_reconstruction_distribution(rd) -> ReconstructionDistribution:
+    if isinstance(rd, ReconstructionDistribution):
+        return rd
+    if isinstance(rd, str):
+        if rd not in _DIST_SHORTCUTS:
+            raise ValueError(f"unknown reconstruction distribution {rd!r}; "
+                             f"known: {sorted(_DIST_SHORTCUTS)}")
+        return _DIST_SHORTCUTS[rd]()
+    raise TypeError(f"reconstruction_distribution must be a string or "
+                    f"ReconstructionDistribution, got {type(rd)}")
+
+
 @register_config("VariationalAutoencoder")
 @dataclasses.dataclass
 class VariationalAutoencoder(PretrainLayer):
     encoder_layer_sizes: Sequence[int] = (100,)
     decoder_layer_sizes: Sequence[int] = (100,)
-    reconstruction_distribution: str = "gaussian"  # gaussian | bernoulli
+    #: string shortcut or ReconstructionDistribution instance (incl. Composite)
+    reconstruction_distribution: Any = "gaussian"
     pzx_activation: str = "identity"
     num_samples: int = 1
+
+    def _dist(self) -> ReconstructionDistribution:
+        return resolve_reconstruction_distribution(
+            self.reconstruction_distribution)
 
     def regularizable_params(self):
         return tuple(k for k in self._param_names() if k.startswith("eW") or
@@ -66,7 +224,7 @@ class VariationalAutoencoder(PretrainLayer):
         for i, (a, b) in enumerate(zip(dsizes[:-1], dsizes[1:])):
             params[f"dW{i}"] = self._init_w(keys[ki], (a, b)); ki += 1
             params[f"db{i}"] = self._init_b((b,))
-        out_units = self.n_in * (2 if self.reconstruction_distribution == "gaussian" else 1)
+        out_units = self._dist().input_size(self.n_in)
         params["outW"] = self._init_w(keys[-1], (dsizes[-1], out_units))
         params["outb"] = self._init_b((out_units,))
         return params
@@ -94,28 +252,32 @@ class VariationalAutoencoder(PretrainLayer):
 
     def reconstruct(self, params, x):
         mean, _ = self._encode(params, x)
-        out = self._decode(params, mean)
-        if self.reconstruction_distribution == "gaussian":
-            return out[..., :self.n_in]
-        return jax.nn.sigmoid(out)
+        return self._dist().mean(self._decode(params, mean))
+
+    def reconstruction_log_probability(self, params, x, *, rng,
+                                       num_samples: int = None):
+        """Per-example log p(x) estimate via importance-free MC over q(z|x)
+        (reference VariationalAutoencoder.reconstructionLogProbability)."""
+        n = num_samples or self.num_samples
+        mean, logvar = self._encode(params, x)
+        dist = self._dist()
+        total = 0.0
+        for k in jax.random.split(rng, n):
+            eps = jax.random.normal(k, mean.shape, mean.dtype)
+            z = mean + jnp.exp(0.5 * logvar) * eps
+            total = total - dist.nll(x, self._decode(params, z))
+        return total / n
 
     def pretrain_loss(self, params, x, *, rng):
         """Negative ELBO = reconstruction NLL + KL(q(z|x) || N(0,I))."""
         mean, logvar = self._encode(params, x)
+        dist = self._dist()
         total = 0.0
         keys = jax.random.split(rng, self.num_samples)
         for k in keys:
             eps = jax.random.normal(k, mean.shape, mean.dtype)
             z = mean + jnp.exp(0.5 * logvar) * eps
-            out = self._decode(params, z)
-            if self.reconstruction_distribution == "gaussian":
-                rmean, rlogvar = out[..., :self.n_in], out[..., self.n_in:]
-                nll = 0.5 * jnp.sum(rlogvar + (x - rmean) ** 2 / jnp.exp(rlogvar)
-                                    + jnp.log(2 * jnp.pi), axis=-1)
-            else:
-                p = out  # logits
-                nll = jnp.sum(x * jax.nn.softplus(-p) + (1 - x) * jax.nn.softplus(p), axis=-1)
-            total = total + jnp.mean(nll)
+            total = total + jnp.mean(dist.nll(x, self._decode(params, z)))
         recon = total / self.num_samples
         kl = 0.5 * jnp.mean(jnp.sum(jnp.exp(logvar) + mean ** 2 - 1.0 - logvar, axis=-1))
         return recon + kl

@@ -39,6 +39,80 @@ def check_gradients(net, x, y, *, eps: float = 1e-6, max_rel_error: float = 1e-3
         common._POLICY = saved_policy
 
 
+def check_pretrain_gradients(net, layer_idx: int, x, *, eps: float = 1e-6,
+                             max_rel_error: float = 1e-3,
+                             min_abs_error: float = 1e-8,
+                             subset: Optional[int] = None, seed: int = 0,
+                             rng_seed: int = 5, verbose: bool = False) -> bool:
+    """Gradient-check one pretrain layer's unsupervised objective (reference
+    GradientCheckUtil.checkGradientsPretrainLayer:305): forward the input to
+    the layer, then finite-difference ``pretrain_loss`` wrt THAT layer's
+    params against autodiff, with the sampling rng held fixed so the
+    objective is a deterministic function of the parameters."""
+    from deeplearning4j_tpu import common
+
+    saved_policy = common.get_policy()
+    common.set_policy(jnp.float64, jnp.float64, jnp.float64)
+    try:
+        with jax.enable_x64(True):
+            layer = net.conf.layers[layer_idx]
+            params64 = jax.tree_util.tree_map(
+                lambda a: jnp.asarray(np.asarray(a), jnp.float64),
+                net.params_list)
+            h = jnp.asarray(np.asarray(x), jnp.float64)
+            for i in range(layer_idx):
+                pp = net.conf.preprocessor(i)
+                if pp is not None:
+                    h = pp.pre_process(h)
+                h, _ = net.conf.layers[i].apply(
+                    params64[i], net.state_list[i], h, train=False, rng=None)
+            pp = net.conf.preprocessor(layer_idx)
+            if pp is not None:
+                h = pp.pre_process(h)
+            key = jax.random.PRNGKey(rng_seed)
+
+            def score(p_layer):
+                return layer.pretrain_loss(p_layer, h, rng=key)
+
+            analytic = jax.grad(score)(params64[layer_idx])
+            flat_analytic = np.asarray(flatten_params(analytic), np.float64)
+            flat_params = np.asarray(flatten_params(params64[layer_idx]),
+                                     np.float64)
+            n = len(flat_params)
+            if subset is not None and subset < n:
+                indices = np.random.default_rng(seed).choice(n, subset,
+                                                             replace=False)
+            else:
+                indices = np.arange(n)
+            score_jit = jax.jit(lambda flat: score(
+                unflatten_params(params64[layer_idx], flat)))
+            fails = 0
+            max_err = 0.0
+            for i in indices:
+                plus = flat_params.copy()
+                plus[i] += eps
+                minus = flat_params.copy()
+                minus[i] -= eps
+                numeric = (float(score_jit(jnp.asarray(plus)))
+                           - float(score_jit(jnp.asarray(minus)))) / (2 * eps)
+                a = flat_analytic[i]
+                denom = max(abs(numeric), abs(a))
+                rel = abs(numeric - a) / denom if denom > 0 else 0.0
+                if rel > max_rel_error and abs(numeric - a) > min_abs_error:
+                    fails += 1
+                    if verbose:
+                        print(f"param {i}: analytic={a:.8g} "
+                              f"numeric={numeric:.8g} rel={rel:.3g}")
+                max_err = max(max_err,
+                              rel if abs(numeric - a) > min_abs_error else 0.0)
+            if verbose:
+                print(f"pretrain gradient check: {len(indices)} params, "
+                      f"max rel err {max_err:.3g}, {fails} failures")
+            return fails == 0
+    finally:
+        common._POLICY = saved_policy
+
+
 def _check_gradients_x64(net, x, y, *, eps, max_rel_error, min_abs_error, subset,
                          seed, verbose) -> bool:
     with jax.enable_x64(True):

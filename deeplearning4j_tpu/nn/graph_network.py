@@ -435,7 +435,8 @@ class ComputationGraph(LazyScore):
         MultiLayerNetwork.fit_iterator; reference fit(DataSetIterator):747).
         Falls back to per-batch dispatch for masked or ragged batches."""
         k = self.dispatch_ksteps if ksteps is None else max(1, ksteps)
-        multistep_ok = (k > 1 and self.conf.global_conf.iterations <= 1
+        multistep_ok = (k > 1 and self._uses_sgd()
+                        and self.conf.global_conf.iterations <= 1
                         and not self._tbptt_active())
         for _ in range(epochs):
             for listener in self.listeners:
@@ -497,12 +498,28 @@ class ComputationGraph(LazyScore):
             for listener in self.listeners:
                 listener.iteration_done(self, self.iteration)
 
+    #: Solver facade instance when optimization_algo != SGD (built lazily)
+    _solver = None
+
+    def _uses_sgd(self) -> bool:
+        algo = self.conf.global_conf.optimization_algo
+        return algo in (None, "stochastic_gradient_descent")
+
     def _tbptt_active(self) -> bool:
         return (self.conf.backprop_type == "TruncatedBPTT"
                 and any(_is_streaming_lstm(v)
                         for v in self.conf.vertices.values()))
 
     def _fit_batch(self, xs, ys, fmasks=None, lmasks=None) -> None:
+        if not self._uses_sgd():
+            # honor optimization_algo (reference Solver.java:55); see
+            # MultiLayerNetwork._fit_batch
+            from deeplearning4j_tpu.optimize.solvers import Solver
+
+            if self._solver is None:
+                self._solver = Solver(self)
+            self._solver.optimize(list(xs), list(ys))
+            return
         if self._tbptt_active():
             self._fit_tbptt(xs, ys, fmasks, lmasks)
             return

@@ -383,6 +383,7 @@ class MultiLayerNetwork(LazyScore):
         k = self.dispatch_ksteps if ksteps is None else max(1, ksteps)
         multistep_ok = (
             k > 1
+            and self._uses_sgd()
             and self.conf.global_conf.iterations <= 1
             and not (self.conf.backprop_type == "TruncatedBPTT"
                      and any(isinstance(l, LSTM) for l in self.conf.layers)))
@@ -443,7 +444,24 @@ class MultiLayerNetwork(LazyScore):
             for listener in self.listeners:
                 listener.iteration_done(self, self.iteration)
 
+    #: Solver facade instance when optimization_algo != SGD (built lazily)
+    _solver = None
+
+    def _uses_sgd(self) -> bool:
+        algo = self.conf.global_conf.optimization_algo
+        return algo in (None, "stochastic_gradient_descent")
+
     def _fit_batch(self, x, y, fmask=None, lmask=None) -> None:
+        if not self._uses_sgd():
+            # honor optimization_algo: LBFGS/CG/line-GD configs route through
+            # the Solver facade (reference Solver.java:55 getOptimizer
+            # dispatch) instead of silently training with SGD
+            from deeplearning4j_tpu.optimize.solvers import Solver
+
+            if self._solver is None:
+                self._solver = Solver(self)
+            self._solver.optimize(x, y)
+            return
         if (self.conf.backprop_type == "TruncatedBPTT"
                 and any(isinstance(l, LSTM) for l in self.conf.layers)):
             self._fit_tbptt(x, y, fmask, lmask)

@@ -1,0 +1,105 @@
+"""Generate the committed golden serialization fixtures.
+
+Run from the repo root:  python tests/golden/make_golden.py
+
+Produces model zips + a reference-outputs npz that
+tests/test_golden_serialization.py asserts against forever after — the
+regression-test pattern of the reference's RegressionTest071.java: once a
+fixture is committed, later serde changes must still load it bit-compatibly.
+Regenerating fixtures is a BREAKING schema change and must be deliberate.
+"""
+import os
+
+import numpy as np
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def build_mln():
+    from deeplearning4j_tpu.nn.conf.builders import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf.layers import (
+        DenseLayer, GravesLSTM, OutputLayer)
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    conf = (NeuralNetConfiguration.builder()
+            .seed(71).learning_rate(0.05).updater("adam")
+            .weight_init("xavier")
+            .list()
+            .layer(DenseLayer(n_in=6, n_out=10, activation="tanh"))
+            .layer(OutputLayer(n_in=10, n_out=3, loss="mcxent",
+                               activation="softmax"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    rng = np.random.default_rng(71)
+    x = rng.normal(size=(16, 6)).astype(np.float32)
+    y = np.zeros((16, 3), np.float32)
+    y[np.arange(16), rng.integers(0, 3, 16)] = 1
+    for _ in range(3):  # non-trivial updater state
+        net.fit(x, y)
+    return net, x
+
+
+def build_cg():
+    from deeplearning4j_tpu.nn.conf.builders import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.conf.vertices import MergeVertex
+    from deeplearning4j_tpu.nn.graph_network import ComputationGraph
+
+    conf = (NeuralNetConfiguration.builder()
+            .seed(72).learning_rate(0.05).updater("rmsprop")
+            .graph_builder()
+            .add_inputs("a", "b")
+            .add_layer("da", DenseLayer(n_in=4, n_out=6, activation="relu"),
+                       "a")
+            .add_layer("db", DenseLayer(n_in=3, n_out=6, activation="tanh"),
+                       "b")
+            .add_vertex("m", MergeVertex(), "da", "db")
+            .add_layer("out", OutputLayer(n_in=12, n_out=2, loss="mcxent",
+                                          activation="softmax"), "m")
+            .set_outputs("out")
+            .build())
+    net = ComputationGraph(conf).init()
+    rng = np.random.default_rng(72)
+    xa = rng.normal(size=(8, 4)).astype(np.float32)
+    xb = rng.normal(size=(8, 3)).astype(np.float32)
+    y = np.zeros((8, 2), np.float32)
+    y[np.arange(8), rng.integers(0, 2, 8)] = 1
+    for _ in range(3):
+        net.fit([xa, xb], [y])
+    return net, xa, xb
+
+
+def main():
+    from deeplearning4j_tpu.datasets.dataset import (
+        DataSet, NormalizerStandardize)
+    from deeplearning4j_tpu.utils.model_serializer import write_model
+
+    net, x = build_mln()
+    norm = NormalizerStandardize()
+    ds = DataSet(x.copy(), np.zeros((len(x), 3), np.float32))
+    norm.fit(ds)
+    norm.transform(ds)
+    write_model(net, os.path.join(HERE, "mln_golden.zip"), save_updater=True,
+                normalizer=norm)
+    out = np.asarray(net.output(ds.features))
+
+    cg, xa, xb = build_cg()
+    write_model(cg, os.path.join(HERE, "cg_golden.zip"), save_updater=True)
+    cg_out = np.asarray(cg.output(xa, xb)[0])
+
+    np.savez(os.path.join(HERE, "golden_expected.npz"),
+             mln_in=x, mln_out=out,
+             mln_updater_flat=np.asarray(
+                 _flat(net.updater_state), np.float32),
+             cg_in_a=xa, cg_in_b=xb, cg_out=cg_out,
+             cg_updater_flat=np.asarray(_flat(cg.updater_state), np.float32))
+    print("golden fixtures written to", HERE)
+
+
+def _flat(tree):
+    from deeplearning4j_tpu.utils.pytree import flatten_params
+    return flatten_params(tree, None)
+
+
+if __name__ == "__main__":
+    main()

@@ -115,3 +115,35 @@ def test_custom_layer_registration():
     restored = from_json(to_json(layer))
     assert isinstance(restored, MyCustomScale)
     assert restored.factor == 3.5
+
+
+def test_vae_composite_distribution_roundtrip():
+    """Reconstruction distributions serialize polymorphically (reference
+    CompositeReconstructionDistribution Jackson serde)."""
+    from deeplearning4j_tpu.nn.conf.layers import VariationalAutoencoder
+    from deeplearning4j_tpu.nn.conf.layers.variational import (
+        CompositeReconstructionDistribution,
+        ExponentialReconstructionDistribution,
+        GaussianReconstructionDistribution,
+    )
+
+    comp = (CompositeReconstructionDistribution()
+            .add(3, GaussianReconstructionDistribution(activation="tanh"))
+            .add(2, ExponentialReconstructionDistribution()))
+    conf = (NeuralNetConfiguration.builder()
+            .seed(1)
+            .list()
+            .layer(VariationalAutoencoder(n_in=5, n_out=2,
+                                          reconstruction_distribution=comp))
+            .layer(OutputLayer(n_in=2, n_out=2, loss="mse",
+                               activation="identity"))
+            .build())
+    conf2 = type(conf).from_json(conf.to_json())
+    rd = conf2.layers[0].reconstruction_distribution
+    assert isinstance(rd, CompositeReconstructionDistribution)
+    assert int(rd.components[0][0]) == 3
+    assert isinstance(rd.components[0][1], GaussianReconstructionDistribution)
+    assert rd.components[0][1].activation == "tanh"
+    assert isinstance(rd.components[1][1],
+                      ExponentialReconstructionDistribution)
+    assert rd.input_size(5) == 3 * 2 + 2

@@ -1,0 +1,68 @@
+"""Golden-file serialization regression tests (VERDICT round-2 item 7).
+
+The committed fixtures in tests/golden/ were produced by
+tests/golden/make_golden.py at a fixed point in time; these tests load them
+through the CURRENT serde code and assert bit-compatible behavior — the
+reference's RegressionTest071.java pattern: old checkpoints must keep
+loading, byte-for-byte, across framework changes. If a test here fails, the
+serialization schema broke; fix the code (or, for a deliberate schema
+change, version the container) rather than regenerating the fixtures.
+"""
+import os
+
+import numpy as np
+
+GOLDEN = os.path.join(os.path.dirname(__file__), "golden")
+
+
+def _expected():
+    return np.load(os.path.join(GOLDEN, "golden_expected.npz"))
+
+
+def test_mln_golden_loads_and_reproduces_outputs():
+    from deeplearning4j_tpu.utils.model_serializer import (
+        restore_multi_layer_network, restore_normalizer)
+
+    exp = _expected()
+    path = os.path.join(GOLDEN, "mln_golden.zip")
+    net = restore_multi_layer_network(path, load_updater=True)
+    norm = restore_normalizer(path)
+    assert norm is not None
+
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    ds = DataSet(exp["mln_in"].copy(),
+                 np.zeros((len(exp["mln_in"]), 3), np.float32))
+    norm.transform(ds)
+    out = np.asarray(net.output(ds.features))
+    np.testing.assert_allclose(out, exp["mln_out"], rtol=1e-6, atol=1e-7)
+
+    # updater state restored exactly (resume-compatible checkpoints)
+    from deeplearning4j_tpu.utils.pytree import flatten_params
+    got = np.asarray(flatten_params(net.updater_state, None), np.float32)
+    np.testing.assert_allclose(got, exp["mln_updater_flat"], rtol=0, atol=0)
+
+
+def test_cg_golden_loads_and_reproduces_outputs():
+    from deeplearning4j_tpu.utils.model_serializer import (
+        restore_computation_graph)
+
+    exp = _expected()
+    net = restore_computation_graph(os.path.join(GOLDEN, "cg_golden.zip"),
+                                    load_updater=True)
+    out = np.asarray(net.output(exp["cg_in_a"], exp["cg_in_b"])[0])
+    np.testing.assert_allclose(out, exp["cg_out"], rtol=1e-6, atol=1e-7)
+
+    from deeplearning4j_tpu.utils.pytree import flatten_params
+    got = np.asarray(flatten_params(net.updater_state, None), np.float32)
+    np.testing.assert_allclose(got, exp["cg_updater_flat"], rtol=0, atol=0)
+
+
+def test_guess_model_distinguishes_golden_fixtures():
+    from deeplearning4j_tpu.nn.graph_network import ComputationGraph
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.utils.model_serializer import guess_model
+
+    assert isinstance(guess_model(os.path.join(GOLDEN, "mln_golden.zip")),
+                      MultiLayerNetwork)
+    assert isinstance(guess_model(os.path.join(GOLDEN, "cg_golden.zip")),
+                      ComputationGraph)

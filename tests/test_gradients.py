@@ -165,3 +165,127 @@ class TestGradientCheckAttentionMoE:
         # router argmax is piecewise-constant but a.e. differentiable; with
         # eps=1e-6 in f64 no routing flip occurs at this seed
         check_gradients(net, x, y)
+
+
+class TestGradientCheckPretrain:
+    """Pretrain-objective gradient checks (reference VaeGradientCheckTests.java,
+    GradientCheckUtil.checkGradientsPretrainLayer:305)."""
+
+    def test_vae_gaussian(self):
+        from deeplearning4j_tpu.nn.conf.layers import VariationalAutoencoder
+        from deeplearning4j_tpu.nn.gradientcheck import check_pretrain_gradients
+        net = build([VariationalAutoencoder(
+                        n_in=5, n_out=3, encoder_layer_sizes=(6,),
+                        decoder_layer_sizes=(6,), activation="tanh",
+                        reconstruction_distribution="gaussian"),
+                     OutputLayer(n_in=3, n_out=2, loss="mcxent",
+                                 activation="softmax")])
+        assert check_pretrain_gradients(net, 0, rand((4, 5)), subset=60,
+                                        verbose=True)
+
+    def test_vae_bernoulli(self):
+        from deeplearning4j_tpu.nn.conf.layers import VariationalAutoencoder
+        from deeplearning4j_tpu.nn.gradientcheck import check_pretrain_gradients
+        net = build([VariationalAutoencoder(
+                        n_in=5, n_out=3, encoder_layer_sizes=(6,),
+                        decoder_layer_sizes=(6,), activation="tanh",
+                        reconstruction_distribution="bernoulli"),
+                     OutputLayer(n_in=3, n_out=2, loss="mcxent",
+                                 activation="softmax")])
+        x = (np.random.default_rng(3).uniform(size=(4, 5)) > 0.5) \
+            .astype(np.float32)
+        assert check_pretrain_gradients(net, 0, x, subset=60)
+
+    def test_vae_exponential_and_composite(self):
+        from deeplearning4j_tpu.nn.conf.layers import VariationalAutoencoder
+        from deeplearning4j_tpu.nn.conf.layers.variational import (
+            BernoulliReconstructionDistribution,
+            CompositeReconstructionDistribution,
+            ExponentialReconstructionDistribution,
+            GaussianReconstructionDistribution,
+        )
+        from deeplearning4j_tpu.nn.gradientcheck import check_pretrain_gradients
+
+        comp = (CompositeReconstructionDistribution()
+                .add(2, GaussianReconstructionDistribution())
+                .add(2, BernoulliReconstructionDistribution())
+                .add(2, ExponentialReconstructionDistribution()))
+        net = build([VariationalAutoencoder(
+                        n_in=6, n_out=3, encoder_layer_sizes=(5,),
+                        decoder_layer_sizes=(5,), activation="tanh",
+                        reconstruction_distribution=comp),
+                     OutputLayer(n_in=3, n_out=2, loss="mcxent",
+                                 activation="softmax")])
+        rng = np.random.default_rng(4)
+        x = np.concatenate([
+            rng.normal(size=(4, 2)),                       # gaussian slice
+            (rng.uniform(size=(4, 2)) > 0.5).astype(float),  # bernoulli
+            rng.exponential(size=(4, 2)),                  # exponential
+        ], axis=1).astype(np.float32)
+        assert check_pretrain_gradients(net, 0, x, subset=80, verbose=True)
+
+    def test_autoencoder(self):
+        from deeplearning4j_tpu.nn.conf.layers import AutoEncoder
+        from deeplearning4j_tpu.nn.gradientcheck import check_pretrain_gradients
+        net = build([AutoEncoder(n_in=5, n_out=4, activation="sigmoid",
+                                 corruption_level=0.3),
+                     OutputLayer(n_in=4, n_out=2, loss="mcxent",
+                                 activation="softmax")])
+        assert check_pretrain_gradients(net, 0, rand((6, 5)), subset=50)
+
+    def test_rbm_cd_surrogate_matches_cd_update(self):
+        """RBM's CD-1 surrogate is NOT a finite-differencable loss (the
+        Gibbs chain is data under stop_gradient); instead verify autodiff of
+        the surrogate reproduces the hand-derived CD update
+        dW = -(<v+ h+> - <v- h->)/n etc. (reference RBM.java
+        computeGradientAndScore)."""
+        import jax
+        import jax.numpy as jnp
+
+        from deeplearning4j_tpu.nn.conf.layers import RBM
+        layer = RBM(n_in=4, n_out=3, k=1, activation="sigmoid")
+        layer.weight_init = "xavier"
+        params = layer.init_params(jax.random.PRNGKey(0),
+                                   InputType.feed_forward(4))
+        rng = np.random.default_rng(5)
+        x = jnp.asarray((rng.uniform(size=(6, 4)) > 0.5).astype(np.float32))
+        key = jax.random.PRNGKey(9)
+        grads = jax.grad(lambda p: layer.pretrain_loss(p, x, rng=key))(params)
+
+        # replicate the chain deterministically (same keys, same sampling)
+        def sample(k, p):
+            return jax.random.bernoulli(k, p).astype(p.dtype)
+
+        keys = jax.random.split(key, 3)
+        ph = layer.prop_up(params, x)
+        hk = sample(keys[0], ph)
+        vk = layer.prop_down(params, hk)
+        vk = sample(keys[1], vk)
+        hk_prob = layer.prop_up(params, vk)
+        n = x.shape[0]
+        expect_dW = -(np.asarray(jnp.matmul(x.T, ph))
+                      - np.asarray(jnp.matmul(vk.T, hk_prob))) / n
+        expect_dvb = -(np.asarray(jnp.mean(x, 0)) - np.asarray(jnp.mean(vk, 0)))
+        expect_db = -(np.asarray(jnp.mean(ph, 0))
+                      - np.asarray(jnp.mean(hk_prob, 0)))
+        np.testing.assert_allclose(np.asarray(grads["W"]), expect_dW,
+                                   rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(grads["vb"]), expect_dvb,
+                                   rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(grads["b"]), expect_db,
+                                   rtol=1e-5, atol=1e-6)
+
+
+class TestGradientCheckLRN:
+    def test_lrn_in_cnn_stack(self):
+        from deeplearning4j_tpu.nn.conf.layers import (
+            LocalResponseNormalization)
+        net = build([ConvolutionLayer(n_out=4, kernel_size=(2, 2),
+                                      stride=(1, 1), activation="tanh"),
+                     LocalResponseNormalization(n=3),
+                     DenseLayer(n_out=6, activation="relu"),
+                     OutputLayer(n_out=2, loss="mcxent",
+                                 activation="softmax")],
+                    input_type=InputType.convolutional(5, 5, 2))
+        assert check_gradients(net, rand((3, 5, 5, 2)), onehot(3, 2),
+                               subset=60, verbose=True)
