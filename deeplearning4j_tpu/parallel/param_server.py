@@ -45,15 +45,30 @@ class ParameterServer:
             return jax.tree_util.tree_map(np.copy, self._params)
 
 
+class ParameterServerTrainingHook:
+    """Training-hook SPI (reference dl4j-spark-parameterserver
+    ParameterServerTrainingHook.java): callbacks around each worker's local
+    update so custom logic (gradient compression, auditing, custom sync) can
+    interpose on the async training path."""
+
+    def pre_update(self, dataset, model) -> None:
+        pass
+
+    def post_update(self, dataset, model) -> None:
+        pass
+
+
 class ParameterServerParallelWrapper:
     """Async-DP trainer (reference ParameterServerParallelWrapper.java)."""
 
     def __init__(self, model, workers: int = 2, push_frequency: int = 4,
-                 prefetch: int = 2):
+                 prefetch: int = 2,
+                 training_hooks: Optional[List[ParameterServerTrainingHook]] = None):
         self.model = model
         self.workers = workers
         self.push_frequency = max(1, push_frequency)
         self.prefetch = prefetch
+        self.training_hooks = list(training_hooks or [])
 
     class Builder:
         def __init__(self, model):
@@ -66,6 +81,10 @@ class ParameterServerParallelWrapper:
 
         def push_frequency(self, n: int):
             self._kw["push_frequency"] = n
+            return self
+
+        def training_hooks(self, *hooks):
+            self._kw["training_hooks"] = list(hooks)
             return self
 
         def build(self) -> "ParameterServerParallelWrapper":
@@ -95,7 +114,11 @@ class ParameterServerParallelWrapper:
                         jax.numpy.asarray, server.pull()) \
                         if local_iters % self.push_frequency == 0 \
                         else replica.params_list
+                    for hook in self.training_hooks:
+                        hook.pre_update(ds, replica)
                     replica.fit(ds.features, ds.labels)
+                    for hook in self.training_hooks:
+                        hook.post_update(ds, replica)
                     local_iters += 1
                     if local_iters % self.push_frequency == 0:
                         server.push(replica.params_list)

@@ -18,14 +18,25 @@ from urllib.parse import parse_qs, urlparse
 from deeplearning4j_tpu.ui.stats import StatsReport
 from deeplearning4j_tpu.ui.storage import StatsStorage
 
-_PAGE = """<!DOCTYPE html>
-<html><head><title>DL4J-TPU Training UI</title>
-<style>
+_STYLE = """<style>
 body { font-family: sans-serif; margin: 20px; background: #fafafa; }
 h2 { color: #333; } .chart { background: #fff; border: 1px solid #ddd;
 margin-bottom: 16px; padding: 8px; }
-</style></head>
+nav a { margin-right: 14px; color: #36c; text-decoration: none; }
+table { border-collapse: collapse; background: #fff; }
+td, th { border: 1px solid #ddd; padding: 4px 10px; font-size: 13px; }
+</style>"""
+
+_NAV = """<nav><a href="/train/overview">Overview</a>
+<a href="/train/model">Model</a>
+<a href="/train/system">System</a>
+<a href="/train/convolutional">Convolutional</a></nav>"""
+
+_PAGE = """<!DOCTYPE html>
+<html><head><title>DL4J-TPU Training UI</title>
+""" + _STYLE + """</head>
 <body>
+""" + _NAV + """
 <h2>Training overview</h2>
 <div class="chart"><canvas id="score" width="900" height="260"></canvas></div>
 <div class="chart"><canvas id="ratio" width="900" height="260"></canvas></div>
@@ -57,6 +68,178 @@ refresh(); setInterval(refresh, 2000);
 </body></html>
 """
 
+# Rendered model page: network flow graph (FlowModule equivalent) + per-layer
+# parameter tables and histograms (reference TrainModule model tab,
+# deeplearning4j-play TrainModule.java; FlowIterationListener flow chart).
+_MODEL_PAGE = """<!DOCTYPE html>
+<html><head><title>DL4J-TPU UI - Model</title>
+""" + _STYLE + """</head>
+<body>
+""" + _NAV + """
+<h2>Model</h2>
+<div class="chart"><b>Network graph</b><br>
+<canvas id="flow" width="900" height="220"></canvas></div>
+<div class="chart"><b>Layers</b><div id="layers"></div></div>
+<div class="chart"><b>Parameter histograms (latest iteration)</b>
+<div id="hists"></div></div>
+<script>
+function drawFlow(graph) {
+  const c = document.getElementById('flow'), ctx = c.getContext('2d');
+  ctx.clearRect(0, 0, c.width, c.height);
+  const nodes = graph.nodes || [];
+  if (!nodes.length) { ctx.fillText('no model attached', 20, 30); return; }
+  // simple layered layout: x by topological index, y staggered
+  const xy = {}, w = 120, h = 36;
+  const sx = Math.min(150, (c.width - w - 20) / Math.max(nodes.length - 1, 1));
+  nodes.forEach((n, i) => { xy[n.name] = [10 + i * sx,
+                                          30 + (i % 3) * 60]; });
+  ctx.strokeStyle = '#999';
+  (graph.edges || []).forEach(e => {
+    const a = xy[e[0]], b = xy[e[1]];
+    if (!a || !b) return;
+    ctx.beginPath(); ctx.moveTo(a[0] + w, a[1] + h / 2);
+    ctx.lineTo(b[0], b[1] + h / 2); ctx.stroke();
+  });
+  nodes.forEach(n => {
+    const [x, y] = xy[n.name];
+    ctx.fillStyle = n.type === 'input' ? '#def' : '#fff';
+    ctx.fillRect(x, y, w, h); ctx.strokeRect(x, y, w, h);
+    ctx.fillStyle = '#333';
+    ctx.fillText(n.name, x + 6, y + 14);
+    ctx.fillText(n.type + (n.nParams ? ' (' + n.nParams + ')' : ''),
+                 x + 6, y + 28);
+  });
+}
+function bar(bins, lo, hi) {
+  const cv = document.createElement('canvas');
+  cv.width = 260; cv.height = 80;
+  const ctx = cv.getContext('2d'), m = Math.max(...bins, 1);
+  const bw = (cv.width - 10) / bins.length;
+  ctx.fillStyle = '#36c';
+  bins.forEach((b, i) => {
+    const bh = (cv.height - 20) * b / m;
+    ctx.fillRect(5 + i * bw, cv.height - 15 - bh, bw - 1, bh);
+  });
+  ctx.fillStyle = '#333';
+  ctx.fillText(lo.toPrecision(3), 4, cv.height - 3);
+  ctx.fillText(hi.toPrecision(3), cv.width - 50, cv.height - 3);
+  return cv;
+}
+async function refresh() {
+  const g = await (await fetch('/train/model/graph')).json();
+  drawFlow(g);
+  const d = await (await fetch('/train/model/data')).json();
+  let html = '<table><tr><th>parameter</th><th>mean |w|</th>' +
+             '<th>mean |grad|</th></tr>';
+  for (const [name, v] of Object.entries(d.layers || {})) {
+    const gm = (d.gradients || {})[name];
+    html += '<tr><td>' + name + '</td><td>' + v.meanMagnitude.toPrecision(4)
+         + '</td><td>' + (gm ? gm.meanMagnitude.toPrecision(4) : '-')
+         + '</td></tr>';
+  }
+  document.getElementById('layers').innerHTML = html + '</table>';
+  const hs = await (await fetch('/train/histograms/data')).json();
+  const hd = document.getElementById('hists');
+  hd.innerHTML = '';
+  for (const [name, v] of Object.entries(hs.params || {})) {
+    const div = document.createElement('div');
+    div.style.display = 'inline-block'; div.style.margin = '6px';
+    div.appendChild(document.createTextNode(name));
+    div.appendChild(document.createElement('br'));
+    div.appendChild(bar(v.bins, v.min, v.max));
+    hd.appendChild(div);
+  }
+}
+refresh(); setInterval(refresh, 3000);
+</script>
+</body></html>
+"""
+
+# Rendered system page (reference TrainModule system tab: memory charts).
+_SYSTEM_PAGE = """<!DOCTYPE html>
+<html><head><title>DL4J-TPU UI - System</title>
+""" + _STYLE + """</head>
+<body>
+""" + _NAV + """
+<h2>System</h2>
+<div class="chart"><canvas id="rss" width="900" height="240"></canvas></div>
+<div class="chart"><canvas id="dev" width="900" height="240"></canvas></div>
+<script>
+function drawSeries(canvasId, ys, label, color) {
+  const c = document.getElementById(canvasId), ctx = c.getContext('2d');
+  ctx.clearRect(0, 0, c.width, c.height);
+  if (!ys.length) { ctx.fillText(label + ': no data', 20, 30); return; }
+  const ymin = Math.min(...ys), ymax = Math.max(...ys), pad = 36;
+  const sx = (c.width - 2*pad) / Math.max(ys.length - 1, 1);
+  const sy = (c.height - 2*pad) / Math.max(ymax - ymin, 1e-9);
+  ctx.strokeStyle = '#999';
+  ctx.strokeRect(pad, pad, c.width-2*pad, c.height-2*pad);
+  ctx.fillStyle = '#333';
+  ctx.fillText(label + ' (last: ' + (ys[ys.length-1]/1048576).toFixed(1)
+               + ' MB)', pad, pad - 6);
+  ctx.strokeStyle = color; ctx.beginPath();
+  ys.forEach((y, i) => { const px = pad + i*sx,
+      py = c.height - pad - (y - ymin)*sy;
+      i ? ctx.lineTo(px, py) : ctx.moveTo(px, py); });
+  ctx.stroke();
+}
+async function refresh() {
+  const d = await (await fetch('/train/system/data')).json();
+  drawSeries('rss', d.memRssBytes, 'Host RSS', '#c33');
+  drawSeries('dev', d.deviceMemBytes, 'Device memory', '#36c');
+}
+refresh(); setInterval(refresh, 3000);
+</script>
+</body></html>
+"""
+
+# Convolutional module (reference ConvolutionalListenerModule +
+# ConvolutionalIterationListener: streams conv-layer activation images).
+_CONV_PAGE = """<!DOCTYPE html>
+<html><head><title>DL4J-TPU UI - Convolutional</title>
+""" + _STYLE + """</head>
+<body>
+""" + _NAV + """
+<h2>Convolutional activations</h2>
+<div id="meta"></div><div id="maps"></div>
+<script>
+function heat(arr) {
+  const hgt = arr.length, wid = arr[0].length, scale = 4;
+  const cv = document.createElement('canvas');
+  cv.width = wid * scale; cv.height = hgt * scale;
+  const ctx = cv.getContext('2d');
+  let lo = Infinity, hi = -Infinity;
+  arr.forEach(r => r.forEach(v => { lo = Math.min(lo, v);
+                                    hi = Math.max(hi, v); }));
+  const span = Math.max(hi - lo, 1e-9);
+  arr.forEach((row, y) => row.forEach((v, x) => {
+    const t = Math.floor(255 * (v - lo) / span);
+    ctx.fillStyle = 'rgb(' + t + ',' + t + ',' + (255 - t) + ')';
+    ctx.fillRect(x * scale, y * scale, scale, scale);
+  }));
+  return cv;
+}
+async function refresh() {
+  const d = await (await fetch('/train/convolutional/data')).json();
+  document.getElementById('meta').textContent =
+      d.maps && d.maps.length ? 'iteration ' + d.iteration
+                              : 'no activations posted yet';
+  const md = document.getElementById('maps');
+  md.innerHTML = '';
+  (d.maps || []).forEach(m => {
+    const div = document.createElement('div'); div.className = 'chart';
+    div.appendChild(document.createTextNode(m.layer));
+    div.appendChild(document.createElement('br'));
+    m.channels.forEach(ch => { const cv = heat(ch);
+      cv.style.marginRight = '4px'; div.appendChild(cv); });
+    md.appendChild(div);
+  });
+}
+refresh(); setInterval(refresh, 3000);
+</script>
+</body></html>
+"""
+
 
 class _Handler(BaseHTTPRequestHandler):
     server_version = "DL4JTPUUIServer/1.0"
@@ -73,15 +256,28 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
+    def _html(self, page: str) -> None:
+        body = page.encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "text/html")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
     def do_GET(self):
         path = urlparse(self.path).path
         if path in ("/", "/train", "/train/overview"):
-            body = _PAGE.encode()
-            self.send_response(200)
-            self.send_header("Content-Type", "text/html")
-            self.send_header("Content-Length", str(len(body)))
-            self.end_headers()
-            self.wfile.write(body)
+            self._html(_PAGE)
+        elif path == "/train/model":
+            self._html(_MODEL_PAGE)
+        elif path == "/train/system":
+            self._html(_SYSTEM_PAGE)
+        elif path == "/train/convolutional":
+            self._html(_CONV_PAGE)
+        elif path == "/train/model/graph":
+            self._json(self.ui.model_graph())
+        elif path == "/train/convolutional/data":
+            self._json(self.ui.conv_data())
         elif path == "/train/overview/data":
             self._json(self.ui.overview_data())
         elif path == "/train/sessions":
@@ -104,7 +300,15 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_POST(self):
         path = urlparse(self.path).path
-        if path == "/tsne/upload":
+        if path == "/train/convolutional/upload":
+            length = int(self.headers.get("Content-Length", "0"))
+            try:
+                self.ui.set_conv_data(json.loads(self.rfile.read(length)))
+            except Exception as e:
+                self._json({"status": "error", "detail": str(e)}, 400)
+                return
+            self._json({"status": "ok"})
+        elif path == "/tsne/upload":
             # TsneModule upload: JSON {"coords": [[x, y], ...], "labels": []}
             length = int(self.headers.get("Content-Length", "0"))
             try:
@@ -262,6 +466,106 @@ class UIServer:
 
     def tsne_data(self) -> dict:
         return self._tsne
+
+    # ----------------------------------------------------------- model graph
+    def attach_model(self, net) -> None:
+        """Register a model so the rendered Model page can draw its network
+        graph (FlowModule equivalent, reference FlowIterationListener)."""
+        self._model_graph = describe_model(net)
+
+    def model_graph(self) -> dict:
+        return getattr(self, "_model_graph", {"nodes": [], "edges": []})
+
+    # ------------------------------------------------- convolutional module
+    def set_conv_data(self, payload: dict) -> None:
+        """ConvolutionalListenerModule upload target: per-layer activation
+        maps as nested lists (reference ConvolutionalIterationListener)."""
+        self._conv = {"iteration": int(payload.get("iteration", 0)),
+                      "maps": payload.get("maps", [])}
+
+    def conv_data(self) -> dict:
+        return getattr(self, "_conv", {"iteration": 0, "maps": []})
+
+
+def describe_model(net) -> dict:
+    """Architecture graph for the Model page / Flow module: nodes with type
+    and parameter counts, edges in forward order. Works for both network
+    types (reference FlowIterationListener builds the same ModelInfo)."""
+    from deeplearning4j_tpu.nn.graph_network import ComputationGraph
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.utils.pytree import num_params
+
+    nodes = [{"name": "input", "type": "input", "nParams": 0}]
+    edges = []
+    if isinstance(net, MultiLayerNetwork):
+        prev = "input"
+        for i, layer in enumerate(net.conf.layers):
+            name = f"layer_{i}"
+            n = num_params(net.params_list[i]) if net.params_list else 0
+            nodes.append({"name": name, "type": type(layer).__name__,
+                          "nParams": int(n)})
+            edges.append([prev, name])
+            prev = name
+        return {"nodes": nodes, "edges": edges}
+    if isinstance(net, ComputationGraph):
+        nodes = [{"name": n, "type": "input", "nParams": 0}
+                 for n in net.conf.network_inputs]
+        order = net.conf.topological_order or net.conf.topo_sort()
+        for name in order:
+            vertex = net.conf.vertices[name]
+            layer = getattr(vertex, "layer", None)
+            vtype = (type(layer).__name__ if layer is not None
+                     else type(vertex).__name__)
+            n = (num_params(net.params_list.get(name, {}))
+                 if net.params_list else 0)
+            nodes.append({"name": name, "type": vtype, "nParams": int(n)})
+            for src in net.conf.vertex_inputs[name]:
+                edges.append([src, name])
+        return {"nodes": nodes, "edges": edges}
+    raise TypeError(f"cannot describe model of type {type(net)}")
+
+
+class ConvolutionalIterationListener:
+    """Posts conv-layer activation maps to the UI every N iterations
+    (reference ConvolutionalIterationListener.java renders activation
+    probability images into the ConvolutionalListenerModule). TPU-native:
+    activations are computed with one extra jitted forward on a held-out
+    probe batch, downsampled to ``max_channels`` maps of the FIRST probe
+    example, and stored as JSON-ready nested lists."""
+
+    def __init__(self, ui: "UIServer", probe_x, frequency: int = 10,
+                 max_channels: int = 8):
+        import numpy as np
+        self.ui = ui
+        self.probe_x = np.asarray(probe_x)
+        self.frequency = max(1, frequency)
+        self.max_channels = max_channels
+
+    def on_epoch_start(self, model) -> None:
+        pass
+
+    def on_epoch_end(self, model) -> None:
+        pass
+
+    def iteration_done(self, model, iteration: int) -> None:
+        if iteration % self.frequency != 0:
+            return
+        import numpy as np
+        from deeplearning4j_tpu.nn.conf.layers import ConvolutionLayer
+
+        acts = model.feed_forward(self.probe_x)
+        maps = []
+        for i, layer in enumerate(model.conf.layers):
+            if not isinstance(layer, ConvolutionLayer):
+                continue
+            a = np.asarray(acts[i])  # NHWC
+            if a.ndim != 4:
+                continue
+            chans = [a[0, :, :, c].tolist()
+                     for c in range(min(a.shape[-1], self.max_channels))]
+            maps.append({"layer": f"layer_{i}", "channels": chans})
+        if maps:
+            self.ui.set_conv_data({"iteration": iteration, "maps": maps})
 
 
 class RemoteUIStatsStorageRouter:

@@ -131,3 +131,39 @@ def test_distributed_evaluation_matches_single_device():
     assert e_dist.accuracy() == e_single.accuracy()
     np.testing.assert_array_equal(e_dist.confusion.matrix,
                                   e_single.confusion.matrix)
+
+
+def test_parameter_server_training_hooks():
+    """Training-hook SPI fires around every worker update (reference
+    dl4j-spark-parameterserver ParameterServerTrainingHook.java)."""
+    import threading
+
+    from deeplearning4j_tpu.parallel.param_server import (
+        ParameterServerParallelWrapper, ParameterServerTrainingHook)
+
+    class Recorder(ParameterServerTrainingHook):
+        def __init__(self):
+            self.pre = 0
+            self.post = 0
+            self._lock = threading.Lock()
+
+        def pre_update(self, dataset, model):
+            with self._lock:
+                self.pre += 1
+
+        def post_update(self, dataset, model):
+            with self._lock:
+                self.post += 1
+
+    net = _net()
+    hook = Recorder()
+    wrapper = (ParameterServerParallelWrapper.builder(net)
+               .workers(2).push_frequency(2).training_hooks(hook).build())
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(64, 4)).astype(np.float32)
+    y = np.zeros((64, 3), np.float32)
+    y[np.arange(64), rng.integers(0, 3, 64)] = 1
+    from deeplearning4j_tpu.datasets.iterators import ArrayDataSetIterator
+    wrapper.fit(ArrayDataSetIterator(x, y, batch=16), epochs=1)
+    assert hook.pre == 4  # 64/16 batches
+    assert hook.post == 4

@@ -157,3 +157,94 @@ def test_histogram_and_tsne_endpoints():
         assert t["labels"] == ["a", "b"] and len(t["coords"]) == 2
     finally:
         server.stop()
+
+
+def test_rendered_pages_and_model_graph():
+    """Model/System/Convolutional pages render (reference PlayUIServer
+    TrainModule model+system tabs, FlowModule, ConvolutionalListenerModule)."""
+    from deeplearning4j_tpu.ui.server import UIServer, describe_model
+
+    server = UIServer(port=0)
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+        for path, marker in [("/train/model", "Network graph"),
+                             ("/train/system", "System"),
+                             ("/train/convolutional", "Convolutional")]:
+            html = urllib.request.urlopen(base + path).read().decode()
+            assert marker in html
+            assert "<canvas" in html or "maps" in html
+
+        # model graph endpoint: attach an MLN, nodes/edges follow the chain
+        conf = (NeuralNetConfiguration.builder().seed(1)
+                .list()
+                .layer(DenseLayer(n_in=4, n_out=6, activation="relu"))
+                .layer(OutputLayer(n_in=6, n_out=2, loss="mcxent",
+                                   activation="softmax"))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        server.attach_model(net)
+        g = json.loads(urllib.request.urlopen(
+            base + "/train/model/graph").read())
+        names = [n["name"] for n in g["nodes"]]
+        assert names == ["input", "layer_0", "layer_1"]
+        assert ["input", "layer_0"] in g["edges"]
+        assert g["nodes"][1]["nParams"] == 4 * 6 + 6
+
+        # CG graphs include vertices and multi-input edges
+        from deeplearning4j_tpu.nn.graph_network import ComputationGraph
+        gconf = (NeuralNetConfiguration.builder().seed(1)
+                 .graph_builder()
+                 .add_inputs("in")
+                 .add_layer("d", DenseLayer(n_in=4, n_out=6,
+                                            activation="relu"), "in")
+                 .add_layer("out", OutputLayer(n_in=6, n_out=2, loss="mcxent",
+                                               activation="softmax"), "d")
+                 .set_outputs("out")
+                 .build())
+        cg = ComputationGraph(gconf).init()
+        gd = describe_model(cg)
+        assert {"in", "d", "out"} <= {n["name"] for n in gd["nodes"]}
+        assert ["in", "d"] in gd["edges"]
+    finally:
+        server.stop()
+
+
+def test_convolutional_listener_posts_activations():
+    from deeplearning4j_tpu.nn.conf.inputs import InputType
+    from deeplearning4j_tpu.nn.conf.layers import (
+        ConvolutionLayer, SubsamplingLayer)
+    from deeplearning4j_tpu.ui.server import (
+        ConvolutionalIterationListener, UIServer)
+
+    server = UIServer(port=0)
+    try:
+        conf = (NeuralNetConfiguration.builder().seed(2).learning_rate(0.05)
+                .list()
+                .layer(ConvolutionLayer(n_out=3, kernel_size=(3, 3),
+                                        stride=(1, 1), activation="relu"))
+                .layer(SubsamplingLayer(pooling_type="max",
+                                        kernel_size=(2, 2), stride=(2, 2)))
+                .layer(DenseLayer(n_out=8, activation="relu"))
+                .layer(OutputLayer(n_out=2, loss="mcxent",
+                                   activation="softmax"))
+                .set_input_type(InputType.convolutional(8, 8, 1))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        rng = np.random.default_rng(0)
+        probe = rng.normal(size=(2, 8, 8, 1)).astype(np.float32)
+        net.set_listeners(ConvolutionalIterationListener(server, probe,
+                                                         frequency=1))
+        x = rng.normal(size=(8, 8, 8, 1)).astype(np.float32)
+        y = np.zeros((8, 2), np.float32)
+        y[:, 0] = 1
+        net.fit(x, y)
+
+        data = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{server.port}/train/convolutional/data")
+            .read())
+        assert data["maps"], "listener posted no maps"
+        assert data["maps"][0]["layer"] == "layer_0"
+        ch = np.asarray(data["maps"][0]["channels"])
+        assert ch.shape[0] == 3 and ch.ndim == 3  # 3 channels of 2-D maps
+    finally:
+        server.stop()
