@@ -57,3 +57,20 @@ def test_stopwords_and_windows():
     assert ws[0] == ["<s>", "a", "b"]
     assert ws[1] == ["a", "b", "c"]
     assert ws[2] == ["b", "c", "</s>"]
+
+
+def test_japanese_lattice_splits_particles():
+    """Lattice-Viterbi segmentation splits closed-class morphemes out of
+    script runs (kuromoji-architecture; reference deeplearning4j-nlp-japanese)
+    — pure script-run splitting cannot produce these boundaries."""
+    tf = JapaneseTokenizerFactory()
+    toks = tf.create("私は東京へ行きます").get_tokens()
+    for particle in ("は", "へ"):
+        assert particle in toks, toks
+    assert "東京" in toks
+    # particle boundaries INSIDE a single hiragana run
+    toks = tf.create("機械学習について学ぶことがたのしい").get_tokens()
+    assert "について" in toks and "こと" in toks and "が" in toks, toks
+    # unknown words stay whole (no over-splitting)
+    assert tf.create("たのしい").get_tokens() == ["たのしい"]
+    assert tf.create("テスト").get_tokens() == ["テスト"]
