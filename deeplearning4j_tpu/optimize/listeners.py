@@ -155,6 +155,62 @@ class ParamAndGradientIterationListener(IterationListener):
                 f.write(json.dumps(row) + "\n")
 
 
+class ProfilerListener(IterationListener):
+    """Capture an XLA/XPlane profiler trace over a window of iterations
+    (SURVEY.md §5 tracing: the TPU-native analog of the reference's
+    SparkTrainingStats timeline + PerformanceListener is a jax.profiler
+    trace — kernel-level timing viewable in TensorBoard/Perfetto/xprof).
+
+    Starts tracing when ``start_iteration`` completes and stops
+    ``num_iterations`` later, writing to ``log_dir``. One-shot by default;
+    set ``repeat_every`` to re-arm periodically (each window goes to a
+    fresh subdirectory)."""
+
+    def __init__(self, log_dir: str, start_iteration: int = 10,
+                 num_iterations: int = 5,
+                 repeat_every: Optional[int] = None):
+        self.log_dir = log_dir
+        self.start_iteration = start_iteration
+        self.num_iterations = max(1, num_iterations)
+        self.repeat_every = repeat_every
+        self.windows: list = []  # directories of completed traces
+        self._active_since: Optional[int] = None
+
+    def _start(self, iteration: int) -> None:
+        import os
+
+        import jax
+        sub = (os.path.join(self.log_dir, f"iter_{iteration}")
+               if self.repeat_every else self.log_dir)
+        os.makedirs(sub, exist_ok=True)
+        jax.profiler.start_trace(sub)
+        self._active_since = iteration
+        self._dir = sub
+
+    def _stop(self) -> None:
+        import jax
+        jax.profiler.stop_trace()
+        self.windows.append(self._dir)
+        self._active_since = None
+        if self.repeat_every:
+            self.start_iteration += self.repeat_every
+
+    def iteration_done(self, model, iteration: int) -> None:
+        if self._active_since is None:
+            if iteration >= self.start_iteration and \
+                    (not self.windows or self.repeat_every):
+                self._start(iteration)
+        elif iteration - self._active_since >= self.num_iterations:
+            # read the score first so the traced window includes the real
+            # device work (lazy score would otherwise sync outside the trace)
+            _ = model.score_value
+            self._stop()
+
+    def on_epoch_end(self, model) -> None:
+        if self._active_since is not None:
+            self._stop()
+
+
 class CheckpointListener(IterationListener):
     """Periodic checkpointing for deterministic restart (SURVEY.md §5:
     reference ModelSerializer zips include updater state so training resumes

@@ -124,3 +124,35 @@ def test_early_stopping_parallel_trainer():
     result = trainer.fit()
     assert result.total_epochs == 3
     assert result.best_model is not None
+
+
+def test_profiler_listener_captures_trace(tmp_path):
+    """ProfilerListener writes an XPlane trace over its iteration window
+    (SURVEY §5 tracing parity: jax.profiler is the TPU-native timeline)."""
+    import glob
+
+    import numpy as np
+
+    from deeplearning4j_tpu.nn.conf.builders import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.optimize.listeners import ProfilerListener
+
+    conf = (NeuralNetConfiguration.builder()
+            .seed(1).learning_rate(0.1)
+            .list()
+            .layer(DenseLayer(n_in=4, n_out=8, activation="tanh"))
+            .layer(OutputLayer(n_in=8, n_out=2, loss="mse",
+                               activation="identity"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    listener = ProfilerListener(str(tmp_path), start_iteration=2,
+                                num_iterations=3)
+    net.set_listeners(listener)
+    x = np.random.default_rng(0).normal(size=(8, 4)).astype(np.float32)
+    y = np.zeros((8, 2), np.float32)
+    for _ in range(8):
+        net.fit(x, y)
+    assert listener.windows, "no trace window completed"
+    files = glob.glob(str(tmp_path) + "/**/*.xplane.pb", recursive=True)
+    assert files, "no xplane trace written"
