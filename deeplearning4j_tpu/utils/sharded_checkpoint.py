@@ -1,0 +1,121 @@
+"""Sharded (orbax) checkpointing for mesh-distributed training state.
+
+The zip container (`utils/model_serializer`, reference
+util/ModelSerializer.java) gathers everything to one host — fine for
+single-chip models, wrong for mesh-sharded ones: a TP/FSDP-sharded param
+tree would be all-gathered through the host on every save. This module is
+the TPU-native alternative (SURVEY.md §5 checkpoint/resume: "orbax-style
+checkpoint of {config-json, params, opt-state, normalizer}"): each host
+writes only its addressable shards via orbax/TensorStore, restore places
+shards directly onto the target sharding, and the model config travels
+alongside as JSON so a checkpoint is self-describing. Works multi-host
+(every process calls save/restore collectively) and on the single-process
+virtual mesh the test suite uses.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Optional
+
+import jax
+
+_PARAMS = "params"
+_UPDATER = "updater"
+_STATES = "states"
+_CONFIG_FILE = "config.json"
+_META_FILE = "meta.json"
+
+
+def _checkpointer():
+    import orbax.checkpoint as ocp
+
+    return ocp.PyTreeCheckpointer()
+
+
+def save_sharded(directory: str, net, *, step: Optional[int] = None) -> str:
+    """Write a sharded checkpoint of the network's full training state.
+
+    ``directory`` must be empty/absent; each leaf keeps its current
+    ``jax.sharding`` layout on disk, so no host gather happens for
+    distributed params. Returns the directory.
+    """
+    directory = os.path.abspath(directory)
+    ckpt = _checkpointer()
+    tree = {_PARAMS: net.params_list, _STATES: net.state_list,
+            _UPDATER: net.updater_state}
+    ckpt.save(os.path.join(directory, "state"), tree)
+    # config + bookkeeping are tiny host-side JSON (process 0 writes)
+    if jax.process_index() == 0:
+        with open(os.path.join(directory, _CONFIG_FILE), "w") as f:
+            f.write(net.conf.to_json())
+        with open(os.path.join(directory, _META_FILE), "w") as f:
+            json.dump({"iteration": int(getattr(net, "iteration", 0)),
+                       "epoch": int(getattr(net, "epoch", 0)),
+                       "step": step,
+                       "network_type": type(net).__name__}, f)
+    return directory
+
+
+def restore_sharded(directory: str, net=None, *, shardings=None):
+    """Restore a sharded checkpoint.
+
+    ``net``: a constructed (possibly uninitialized) network to restore into;
+    None rebuilds one from the stored config JSON. ``shardings``: optional
+    pytree (or prefix) of `jax.sharding.Sharding` matching the params tree —
+    leaves restore DIRECTLY onto those device placements (no host
+    round-trip); None restores to the default device layout.
+    """
+    import orbax.checkpoint as ocp
+
+    directory = os.path.abspath(directory)
+    if net is None:
+        with open(os.path.join(directory, _CONFIG_FILE)) as f:
+            net = _net_from_config(f.read(), directory)
+    if net.params_list is None:
+        net.init()
+
+    template = {_PARAMS: net.params_list, _STATES: net.state_list,
+                _UPDATER: net.updater_state}
+    if shardings is not None:
+        restore_args = {
+            _PARAMS: jax.tree_util.tree_map(
+                lambda leaf, sh: ocp.ArrayRestoreArgs(sharding=sh),
+                net.params_list, shardings),
+            _STATES: jax.tree_util.tree_map(
+                lambda leaf: ocp.RestoreArgs(), net.state_list),
+            _UPDATER: jax.tree_util.tree_map(
+                lambda leaf: ocp.RestoreArgs(), net.updater_state),
+        }
+        tree = _checkpointer().restore(os.path.join(directory, "state"),
+                                       item=template,
+                                       restore_args=restore_args)
+    else:
+        tree = _checkpointer().restore(os.path.join(directory, "state"),
+                                       item=template)
+    net.params_list = tree[_PARAMS]
+    net.state_list = tree[_STATES]
+    net.updater_state = tree[_UPDATER]
+    meta_path = os.path.join(directory, _META_FILE)
+    if os.path.exists(meta_path):
+        with open(meta_path) as f:
+            meta = json.load(f)
+        net.iteration = int(meta.get("iteration", 0))
+        net.epoch = int(meta.get("epoch", 0))
+    return net
+
+
+def _net_from_config(config_json: str, directory: str):
+    with open(os.path.join(directory, _META_FILE)) as f:
+        net_type = json.load(f).get("network_type", "MultiLayerNetwork")
+    if net_type == "ComputationGraph":
+        from deeplearning4j_tpu.nn.conf.graphconf import (
+            ComputationGraphConfiguration)
+        from deeplearning4j_tpu.nn.graph_network import ComputationGraph
+
+        return ComputationGraph(
+            ComputationGraphConfiguration.from_json(config_json))
+    from deeplearning4j_tpu.nn.conf.multilayer import MultiLayerConfiguration
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    return MultiLayerNetwork(MultiLayerConfiguration.from_json(config_json))

@@ -1,0 +1,84 @@
+"""Sharded orbax checkpointing on the virtual 8-device mesh (SURVEY §5:
+"orbax-style checkpoint of {config-json, params, opt-state, normalizer}" —
+the TPU-native alternative to the single-host zip container)."""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn.conf.builders import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+
+def _trained_net():
+    conf = (NeuralNetConfiguration.builder()
+            .seed(5).learning_rate(0.05).updater("adam")
+            .list()
+            .layer(DenseLayer(n_in=4, n_out=16, activation="tanh"))
+            .layer(OutputLayer(n_in=16, n_out=3, loss="mcxent",
+                               activation="softmax"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(16, 4)).astype(np.float32)
+    y = np.zeros((16, 3), np.float32)
+    y[np.arange(16), rng.integers(0, 3, 16)] = 1
+    net.fit(x, y)
+    net.fit(x, y)
+    return net, x, y
+
+
+def test_save_restore_roundtrip_and_resume(tmp_path):
+    import jax
+
+    from deeplearning4j_tpu.utils.sharded_checkpoint import (
+        restore_sharded, save_sharded)
+
+    net, x, y = _trained_net()
+    out_before = np.asarray(net.output(x))
+    save_sharded(str(tmp_path / "ckpt"), net, step=2)
+
+    restored = restore_sharded(str(tmp_path / "ckpt"))  # rebuilt from config
+    assert restored.iteration == net.iteration
+    np.testing.assert_allclose(np.asarray(restored.output(x)), out_before,
+                               rtol=1e-6, atol=1e-7)
+    # updater state restored exactly -> identical continued trajectory
+    net.fit(x, y)
+    restored.fit(x, y)
+    for a, b in zip(jax.tree_util.tree_leaves(net.params_list),
+                    jax.tree_util.tree_leaves(restored.params_list)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-6, atol=1e-7)
+
+
+def test_restore_onto_mesh_sharding(tmp_path):
+    """Restore places leaves DIRECTLY onto a mesh sharding — the multi-host
+    path where no single host materializes the full tree."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from deeplearning4j_tpu.parallel.mesh import build_mesh
+    from deeplearning4j_tpu.utils.sharded_checkpoint import (
+        restore_sharded, save_sharded)
+
+    net, x, _ = _trained_net()
+    save_sharded(str(tmp_path / "ckpt"), net)
+
+    mesh = build_mesh({"model": 8})
+    # shard every 2-D param's output dim over 'model'; replicate the rest
+    shardings = [
+        {name: NamedSharding(mesh,
+                             P(None, "model") if p.ndim == 2
+                             and p.shape[1] % 8 == 0 else P())
+         for name, p in layer_params.items()}
+        for layer_params in net.params_list]
+    restored = restore_sharded(str(tmp_path / "ckpt"),
+                               MultiLayerNetwork(net.conf),
+                               shardings=shardings)
+    w0 = restored.params_list[0]["W"]  # (4, 16) sharded over 8 devices
+    assert len(w0.sharding.device_set) == 8
+    np.testing.assert_allclose(np.asarray(w0),
+                               np.asarray(net.params_list[0]["W"]),
+                               rtol=0, atol=0)
+    np.testing.assert_allclose(np.asarray(restored.output(x)),
+                               np.asarray(net.output(x)),
+                               rtol=1e-6, atol=1e-6)
