@@ -117,3 +117,49 @@ def test_tensor_parallel_sharding_applies():
     net._jit_cache.clear()
     out = np.asarray(net.output(x))
     np.testing.assert_allclose(ref, out, atol=1e-6)
+
+
+def test_local_sgd_multi_io_graph():
+    """Multi-input/multi-output CG local-SGD (closes the round-2 wrapper
+    NotImplementedError gate; reference ParallelWrapper handles MultiDataSet
+    fit, ParallelWrapper.java:117): runs with averaging_frequency>1, params
+    stay finite, and the model still learns."""
+    from deeplearning4j_tpu.nn.conf.vertices import MergeVertex
+    from deeplearning4j_tpu.nn.graph_network import (
+        ComputationGraph, MultiDataSet)
+
+    conf = (NeuralNetConfiguration.builder()
+            .seed(4).learning_rate(0.1).updater("sgd")
+            .graph_builder()
+            .add_inputs("a", "b")
+            .add_layer("da", DenseLayer(n_in=3, n_out=6, activation="tanh"),
+                       "a")
+            .add_layer("db", DenseLayer(n_in=2, n_out=6, activation="tanh"),
+                       "b")
+            .add_vertex("m", MergeVertex(), "da", "db")
+            .add_layer("out", OutputLayer(n_in=12, n_out=2, loss="mcxent",
+                                          activation="softmax"), "m")
+            .set_outputs("out")
+            .build())
+    net = ComputationGraph(conf).init()
+    rng = np.random.default_rng(0)
+    batches = []
+    for _ in range(8):
+        xa = rng.normal(size=(16, 3)).astype(np.float32)
+        xb = rng.normal(size=(16, 2)).astype(np.float32)
+        labels = (xa[:, 0] + xb[:, 0] > 0).astype(int)
+        y = np.zeros((16, 2), np.float32)
+        y[np.arange(16), labels] = 1
+        batches.append(MultiDataSet([xa, xb], [y]))
+    mds = MultiDataSet([np.concatenate([b.features[0] for b in batches])[:32],
+                        np.concatenate([b.features[1] for b in batches])[:32]],
+                       [np.concatenate([b.labels[0] for b in batches])[:32]])
+    s0 = net.score(mds)
+    pw = (ParallelWrapper.builder(net)
+          .workers(8).prefetch_buffer(0).averaging_frequency(2)
+          .build())
+    for _ in range(6):
+        pw.fit(ListDataSetIterator(batches))
+    s1 = net.score(mds)
+    assert np.isfinite(s1)
+    assert s1 < s0, (s0, s1)
