@@ -25,6 +25,11 @@ from jax.experimental.pallas import tpu as pltpu
 Array = jax.Array
 _NEG = -1e30
 
+# Flash-attention tile sizes: MXU/VMEM-friendly defaults, overridable for
+# on-chip sweeps (DL4J_FLASH_BLK_Q / DL4J_FLASH_BLK_K).
+_BLK_Q = int(os.environ.get("DL4J_FLASH_BLK_Q", "128"))
+_BLK_K = int(os.environ.get("DL4J_FLASH_BLK_K", "128"))
+
 
 def use_pallas() -> bool:
     """Backend seam (reference helper loading seam).
@@ -87,9 +92,12 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, blk_k: int, causal: bool,
 
 
 def _flash_forward(q: Array, k: Array, v: Array, causal: bool,
-                   blk_q: int = 128, blk_k: int = 128,
+                   blk_q: int = None, blk_k: int = None,
                    interpret: bool = False) -> Array:
-    """q,k,v: (B, T, H, D) -> (B, T, H, D)."""
+    """q,k,v: (B, T, H, D) -> (B, T, H, D). None block sizes -> env-tunable
+    module defaults (_BLK_Q/_BLK_K)."""
+    blk_q = blk_q or _BLK_Q
+    blk_k = blk_k or _BLK_K
     B, Tq, H, D = q.shape
     Tk = k.shape[1]
     blk_q = min(blk_q, Tq)
@@ -127,7 +135,9 @@ def _attention_xla(q, k, v, causal):
     return attention_reference(q, k, v, causal).astype(q.dtype)
 
 
-def _tileable(tq: int, tk: int, blk_q: int = 128, blk_k: int = 128) -> bool:
+def _tileable(tq: int, tk: int, blk_q: int = None, blk_k: int = None) -> bool:
+    blk_q = blk_q or _BLK_Q
+    blk_k = blk_k or _BLK_K
     return tq % min(blk_q, tq) == 0 and tk % min(blk_k, tk) == 0
 
 
@@ -161,7 +171,7 @@ def flash_attention(q: Array, k: Array, v: Array, causal: bool = False,
     return _attention_xla(q, k, v, causal)
 
 
-def _attention_bwd_chunked(q, k, v, g, causal, blk_q: int = 128):
+def _attention_bwd_chunked(q, k, v, g, causal, blk_q: int = None):
     """Chunked attention backward: lax.scan over query blocks, recomputing the
     (blk_q, Tk) score tile per step. dK/dV accumulate in f32 in the carry.
 
@@ -169,7 +179,9 @@ def _attention_bwd_chunked(q, k, v, g, causal, blk_q: int = 128):
     dV = Pᵀ dO, dP = dO Vᵀ, dS = P ∘ (dP − rowsum(P ∘ dP)), dQ = dS·K·scale,
     dK = dSᵀ·Q·scale. Query rows padded up to a block multiple carry dO = 0,
     which makes their dS exactly 0, so padding contributes nothing.
+    None blk_q -> env-tunable module default (_BLK_Q).
     """
+    blk_q = blk_q or _BLK_Q
     B, Tq, H, D = q.shape
     Tk = k.shape[1]
     scale = 1.0 / (D ** 0.5)
