@@ -1,0 +1,62 @@
+"""AlexNet (Krizhevsky 2012) as a MultiLayerNetwork configuration.
+
+The reference era's standard ImageNet CNN besides VGG/GoogLeNet (its model
+zoo ships AlexNet built from the same conf primitives this framework
+provides: Convolution/LRN/MaxPooling/Dense/Dropout — reference
+nn/conf/layers/* and nn/layers/normalization/LocalResponseNormalization.java
+for the LRN stages). NHWC layout for XLA:TPU; the two-GPU grouping of the
+original is folded into plain convolutions, as every modern reimplementation
+does.
+"""
+from __future__ import annotations
+
+from deeplearning4j_tpu.nn.conf.builders import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import (
+    ConvolutionLayer, DenseLayer, DropoutLayer, LocalResponseNormalization,
+    OutputLayer, SubsamplingLayer,
+)
+from deeplearning4j_tpu.nn.conf.multilayer import MultiLayerConfiguration
+
+
+def alexnet(n_classes: int = 1000, image_size: int = 224, channels: int = 3,
+            seed: int = 12345, learning_rate: float = 0.01,
+            dropout: float = 0.5) -> MultiLayerConfiguration:
+    lb = (NeuralNetConfiguration.builder()
+          .seed(seed)
+          .learning_rate(learning_rate)
+          .updater("nesterovs").momentum(0.9)
+          .weight_init("relu")
+          .list()
+          .layer(ConvolutionLayer(n_out=96, kernel_size=(11, 11),
+                                  stride=(4, 4), convolution_mode="same",
+                                  activation="relu"))
+          .layer(LocalResponseNormalization(n=5, alpha=1e-4, beta=0.75, k=2))
+          .layer(SubsamplingLayer(pooling_type="max", kernel_size=(3, 3),
+                                  stride=(2, 2)))
+          .layer(ConvolutionLayer(n_out=256, kernel_size=(5, 5),
+                                  stride=(1, 1), convolution_mode="same",
+                                  activation="relu"))
+          .layer(LocalResponseNormalization(n=5, alpha=1e-4, beta=0.75, k=2))
+          .layer(SubsamplingLayer(pooling_type="max", kernel_size=(3, 3),
+                                  stride=(2, 2)))
+          .layer(ConvolutionLayer(n_out=384, kernel_size=(3, 3),
+                                  stride=(1, 1), convolution_mode="same",
+                                  activation="relu"))
+          .layer(ConvolutionLayer(n_out=384, kernel_size=(3, 3),
+                                  stride=(1, 1), convolution_mode="same",
+                                  activation="relu"))
+          .layer(ConvolutionLayer(n_out=256, kernel_size=(3, 3),
+                                  stride=(1, 1), convolution_mode="same",
+                                  activation="relu"))
+          .layer(SubsamplingLayer(pooling_type="max", kernel_size=(3, 3),
+                                  stride=(2, 2)))
+          .layer(DenseLayer(n_out=4096, activation="relu"))
+          .layer(DropoutLayer(dropout=dropout))
+          .layer(DenseLayer(n_out=4096, activation="relu"))
+          .layer(DropoutLayer(dropout=dropout))
+          .layer(OutputLayer(n_out=n_classes, loss="mcxent",
+                             activation="softmax", weight_init="xavier")))
+    lb.set_input_type(InputType.convolutional(image_size, image_size,
+                                              channels))
+    return lb.build()

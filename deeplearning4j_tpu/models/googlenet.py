@@ -1,0 +1,108 @@
+"""GoogLeNet / Inception-v1 (Szegedy 2014) as a ComputationGraph.
+
+The canonical multi-branch ComputationGraph model of the reference era:
+each inception module is four parallel towers (1x1 / 1x1->3x3 / 1x1->5x5 /
+maxpool->1x1) concatenated on the channel axis — exactly what MergeVertex
+exists for (reference nn/graph/vertex/impl/MergeVertex.java). Auxiliary
+classifier heads are omitted (inference-era practice); NHWC layout for
+XLA:TPU. The MXU sees each tower as an independent conv, and XLA fuses the
+channel concat into the consumers.
+"""
+from __future__ import annotations
+
+from deeplearning4j_tpu.nn.conf.builders import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.graphconf import ComputationGraphConfiguration
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import (
+    ConvolutionLayer, DropoutLayer, GlobalPoolingLayer,
+    LocalResponseNormalization, OutputLayer, SubsamplingLayer,
+)
+from deeplearning4j_tpu.nn.conf.vertices import MergeVertex
+
+# (1x1, 3x3reduce, 3x3, 5x5reduce, 5x5, poolproj) per module, GoogLeNet table 1
+_INCEPTION = {
+    "3a": (64, 96, 128, 16, 32, 32),
+    "3b": (128, 128, 192, 32, 96, 64),
+    "4a": (192, 96, 208, 16, 48, 64),
+    "4b": (160, 112, 224, 24, 64, 64),
+    "4c": (128, 128, 256, 24, 64, 64),
+    "4d": (112, 144, 288, 32, 64, 64),
+    "4e": (256, 160, 320, 32, 128, 128),
+    "5a": (256, 160, 320, 32, 128, 128),
+    "5b": (384, 192, 384, 48, 128, 128),
+}
+
+
+def _conv(gb, name, n_out, kernel, stride, input_name):
+    gb.add_layer(name, ConvolutionLayer(n_out=n_out, kernel_size=kernel,
+                                        stride=stride,
+                                        convolution_mode="same",
+                                        activation="relu"), input_name)
+    return name
+
+
+def _inception(gb, name: str, in_name: str, cfg) -> str:
+    c1, r3, c3, r5, c5, pp = cfg
+    b1 = _conv(gb, f"{name}_1x1", c1, (1, 1), (1, 1), in_name)
+    t3 = _conv(gb, f"{name}_3x3r", r3, (1, 1), (1, 1), in_name)
+    b3 = _conv(gb, f"{name}_3x3", c3, (3, 3), (1, 1), t3)
+    t5 = _conv(gb, f"{name}_5x5r", r5, (1, 1), (1, 1), in_name)
+    b5 = _conv(gb, f"{name}_5x5", c5, (5, 5), (1, 1), t5)
+    gb.add_layer(f"{name}_pool",
+                 SubsamplingLayer(pooling_type="max", kernel_size=(3, 3),
+                                  stride=(1, 1), convolution_mode="same"),
+                 in_name)
+    bp = _conv(gb, f"{name}_poolproj", pp, (1, 1), (1, 1), f"{name}_pool")
+    gb.add_vertex(f"{name}_concat", MergeVertex(), b1, b3, b5, bp)
+    return f"{name}_concat"
+
+
+def googlenet(n_classes: int = 1000, image_size: int = 224, channels: int = 3,
+              seed: int = 12345, learning_rate: float = 0.01,
+              dropout: float = 0.4) -> ComputationGraphConfiguration:
+    gb = (NeuralNetConfiguration.builder()
+          .seed(seed)
+          .learning_rate(learning_rate)
+          .updater("nesterovs").momentum(0.9)
+          .weight_init("relu")
+          .graph_builder()
+          .add_inputs("input"))
+    _conv(gb, "stem_conv", 64, (7, 7), (2, 2), "input")
+    gb.add_layer("stem_pool",
+                 SubsamplingLayer(pooling_type="max", kernel_size=(3, 3),
+                                  stride=(2, 2), convolution_mode="same"),
+                 "stem_conv")
+    gb.add_layer("stem_lrn", LocalResponseNormalization(n=5), "stem_pool")
+    _conv(gb, "stem_conv2r", 64, (1, 1), (1, 1), "stem_lrn")
+    _conv(gb, "stem_conv2", 192, (3, 3), (1, 1), "stem_conv2r")
+    gb.add_layer("stem_lrn2", LocalResponseNormalization(n=5), "stem_conv2")
+    gb.add_layer("pool2",
+                 SubsamplingLayer(pooling_type="max", kernel_size=(3, 3),
+                                  stride=(2, 2), convolution_mode="same"),
+                 "stem_lrn2")
+    cur = "pool2"
+    for mod in ("3a", "3b"):
+        cur = _inception(gb, mod, cur, _INCEPTION[mod])
+    gb.add_layer("pool3",
+                 SubsamplingLayer(pooling_type="max", kernel_size=(3, 3),
+                                  stride=(2, 2), convolution_mode="same"),
+                 cur)
+    cur = "pool3"
+    for mod in ("4a", "4b", "4c", "4d", "4e"):
+        cur = _inception(gb, mod, cur, _INCEPTION[mod])
+    gb.add_layer("pool4",
+                 SubsamplingLayer(pooling_type="max", kernel_size=(3, 3),
+                                  stride=(2, 2), convolution_mode="same"),
+                 cur)
+    cur = "pool4"
+    for mod in ("5a", "5b"):
+        cur = _inception(gb, mod, cur, _INCEPTION[mod])
+    gb.add_layer("avgpool", GlobalPoolingLayer(pooling_type="avg"), cur)
+    gb.add_layer("drop", DropoutLayer(dropout=dropout), "avgpool")
+    gb.add_layer("fc", OutputLayer(n_out=n_classes, loss="mcxent",
+                                   activation="softmax", weight_init="xavier"),
+                 "drop")
+    gb.set_outputs("fc")
+    gb.set_input_types(InputType.convolutional(image_size, image_size,
+                                               channels))
+    return gb.build()
