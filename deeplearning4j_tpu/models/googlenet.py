@@ -59,7 +59,9 @@ def _inception(gb, name: str, in_name: str, cfg) -> str:
 
 def googlenet(n_classes: int = 1000, image_size: int = 224, channels: int = 3,
               seed: int = 12345, learning_rate: float = 0.01,
-              dropout: float = 0.4) -> ComputationGraphConfiguration:
+              dropout: float = 0.6) -> ComputationGraphConfiguration:
+    # dropout is the RETAIN probability (DropoutLayer convention); the
+    # paper's "dropout (40%)" drops 40% -> retain 0.6
     gb = (NeuralNetConfiguration.builder()
           .seed(seed)
           .learning_rate(learning_rate)
