@@ -365,9 +365,10 @@ class ComputationGraph(LazyScore):
         return num_params(self.params_list)
 
     # ------------------------------------------------------------------ inference
-    def _jit(self, name, fn):
+    def _jit(self, name, fn, donate=None):
         if name not in self._jit_cache:
-            self._jit_cache[name] = jax.jit(fn)
+            self._jit_cache[name] = (jax.jit(fn, donate_argnums=donate)
+                                     if donate else jax.jit(fn))
         return self._jit_cache[name]
 
     def output(self, *inputs) -> list:
@@ -487,8 +488,11 @@ class ComputationGraph(LazyScore):
               for i in range(n_in)]
         ys = [jnp.asarray(np.stack([b[1][i] for b in batches]))
               for i in range(n_out)]
+        # donated params/states/updater: in-place XLA update (see
+        # MultiLayerNetwork._dispatch_multistep)
         multi = self._jit("multistep",
-                          make_graph_multistep_train_step(self.conf))
+                          make_graph_multistep_train_step(self.conf),
+                          donate=(0, 1, 2))
         (self.params_list, self.state_list, self.updater_state, losses) = multi(
             self.params_list, self.state_list, self.updater_state, xs, ys,
             self._next_rng(), jnp.int32(self.iteration))

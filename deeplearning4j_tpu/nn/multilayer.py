@@ -283,9 +283,10 @@ class MultiLayerNetwork(LazyScore):
         return num_params(self.params_list)
 
     # ------------------------------------------------------------------ inference
-    def _jit(self, name, fn):
+    def _jit(self, name, fn, donate=None):
         if name not in self._jit_cache:
-            self._jit_cache[name] = jax.jit(fn)
+            self._jit_cache[name] = (jax.jit(fn, donate_argnums=donate)
+                                     if donate else jax.jit(fn))
         return self._jit_cache[name]
 
     def output(self, x, train: bool = False) -> Array:
@@ -434,7 +435,13 @@ class MultiLayerNetwork(LazyScore):
             xs = xs.astype(self.stage_dtype)
         xs = jnp.asarray(xs)
         ys = jnp.asarray(np.stack([b[1] for b in batches]))
-        multi = self._jit("multistep", make_multistep_train_step(self.conf))
+        # params/states/updater buffers are DONATED: XLA updates them in
+        # place (no 2x param HBM during the step). The previous arrays are
+        # consumed — anyone holding stale references gets a loud
+        # "deleted buffer" error, never silent corruption; clone() deep-
+        # copies for this reason. (Donation is a no-op on CPU.)
+        multi = self._jit("multistep", make_multistep_train_step(self.conf),
+                          donate=(0, 1, 2))
         (self.params_list, self.state_list, self.updater_state, losses) = multi(
             self.params_list, self.state_list, self.updater_state, xs, ys,
             self._next_rng(), jnp.int32(self.iteration))
@@ -592,9 +599,13 @@ class MultiLayerNetwork(LazyScore):
         import copy
 
         net = MultiLayerNetwork(copy.deepcopy(self.conf))
-        net.params_list = jax.tree_util.tree_map(lambda a: a, self.params_list)
-        net.state_list = jax.tree_util.tree_map(lambda a: a, self.state_list)
-        net.updater_state = jax.tree_util.tree_map(lambda a: a, self.updater_state)
+        # REAL buffer copies, not aliases: the fused fit path donates param
+        # buffers to XLA, so a clone sharing arrays with the original would
+        # see its arrays deleted when either of them trains
+        cp = lambda a: jnp.array(a)
+        net.params_list = jax.tree_util.tree_map(cp, self.params_list)
+        net.state_list = jax.tree_util.tree_map(cp, self.state_list)
+        net.updater_state = jax.tree_util.tree_map(cp, self.updater_state)
         net.iteration = self.iteration
         net._rng = self._rng
         return net
