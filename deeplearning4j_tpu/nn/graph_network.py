@@ -406,21 +406,59 @@ class ComputationGraph(LazyScore):
         (reference fit:670/747)."""
         from deeplearning4j_tpu.datasets.dataset import DataSet
 
-        if isinstance(data, MultiDataSet):
-            self._fit_batch(data.features, data.labels,
-                            data.features_masks, data.labels_masks)
-            return
-        if isinstance(data, DataSet):
-            self._fit_batch([data.features], [data.labels],
-                            [data.features_mask] if data.features_mask is not None else None,
-                            [data.labels_mask] if data.labels_mask is not None else None)
+        if isinstance(data, (MultiDataSet, DataSet)):
+            xs, ys, fm, lm = _coerce_graph_batch(data)
+            if epochs > 1 and fm is None and lm is None \
+                    and self._repeat_multistep_ok():
+                self._fit_repeated(xs, ys, epochs)
+            else:
+                for _ in range(epochs):
+                    self._fit_batch(xs, ys, fm, lm)
             return
         if labels is not None:
-            xs = data if isinstance(data, (list, tuple)) else [data]
-            ys = labels if isinstance(labels, (list, tuple)) else [labels]
-            self._fit_batch(list(xs), list(ys))
+            xs = list(data if isinstance(data, (list, tuple)) else [data])
+            ys = list(labels if isinstance(labels, (list, tuple)) else [labels])
+            if epochs > 1 and self._repeat_multistep_ok():
+                self._fit_repeated(xs, ys, epochs)
+            else:
+                for _ in range(epochs):
+                    self._fit_batch(xs, ys)
             return
         self.fit_iterator(data, epochs=epochs)
+
+    def _repeat_multistep_ok(self) -> bool:
+        return (self.dispatch_ksteps > 1 and self._uses_sgd()
+                and self.conf.global_conf.iterations <= 1
+                and not self._tbptt_active())
+
+    def _fit_repeated(self, xs, ys, epochs: int) -> None:
+        """Repeated steps on one device-resident multi-IO batch, K per
+        dispatch (see MultiLayerNetwork._fit_repeated)."""
+        def stage(a):
+            a = jnp.asarray(a)
+            return (a.astype(self.stage_dtype)
+                    if self.stage_dtype is not None else a)
+
+        xd = [stage(a) for a in xs]
+        yd = [jnp.asarray(a) for a in ys]
+        multi = self._jit("multistep",
+                          make_graph_multistep_train_step(self.conf),
+                          donate=(0, 1, 2))
+        remaining = epochs
+        while remaining > 0:
+            k = min(self.dispatch_ksteps, remaining)
+            xk = [jnp.broadcast_to(a[None], (k,) + a.shape) for a in xd]
+            yk = [jnp.broadcast_to(a[None], (k,) + a.shape) for a in yd]
+            (self.params_list, self.state_list, self.updater_state,
+             losses) = multi(self.params_list, self.state_list,
+                             self.updater_state, xk, yk, self._next_rng(),
+                             jnp.int32(self.iteration))
+            for i in range(k):
+                self.iteration += 1
+                self.score_value = (lambda ls=losses, j=i: ls[j])
+                for listener in self.listeners:
+                    listener.iteration_done(self, self.iteration)
+            remaining -= k
 
     #: train steps fused per host dispatch in fit_iterator (see
     #: MultiLayerNetwork.dispatch_ksteps); 1 disables the K-step path

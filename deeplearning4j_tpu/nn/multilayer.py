@@ -342,19 +342,60 @@ class MultiLayerNetwork(LazyScore):
         return sub
 
     def fit(self, x, y=None, *, epochs: int = 1, fmask=None, lmask=None) -> None:
-        """Fit on arrays, a DataSet, or a DataSetIterator (reference fit:978)."""
+        """Fit on arrays, a DataSet, or a DataSetIterator (reference fit:978).
+
+        Array/DataSet fits with ``epochs > 1`` take the K-step fused path
+        when eligible: the batch is staged on device ONCE and broadcast
+        across the scan axis, so repeated epochs cost one host transfer and
+        ``ceil(epochs/K)`` dispatches instead of ``epochs`` round-trips."""
         from deeplearning4j_tpu.datasets.dataset import DataSet
 
         if y is None and isinstance(x, DataSet):
-            for _ in range(epochs):
-                self._fit_batch(x.features, x.labels, x.features_mask,
-                                x.labels_mask)
+            self.fit(x.features, x.labels, epochs=epochs,
+                     fmask=x.features_mask, lmask=x.labels_mask)
             return
         if y is None and hasattr(x, "__iter__") and not isinstance(x, (jnp.ndarray, np.ndarray)):
             self.fit_iterator(x, epochs=epochs)
             return
+        if (epochs > 1 and fmask is None and lmask is None
+                and self._repeat_multistep_ok()):
+            self._fit_repeated(x, y, epochs)
+            return
         for _ in range(epochs):
             self._fit_batch(x, y, fmask, lmask)
+
+    def _repeat_multistep_ok(self) -> bool:
+        return (self.dispatch_ksteps > 1
+                and self._uses_sgd()
+                and self.conf.global_conf.iterations <= 1
+                and not (self.conf.backprop_type == "TruncatedBPTT"
+                         and any(isinstance(l, LSTM)
+                                 for l in self.conf.layers)))
+
+    def _fit_repeated(self, x, y, epochs: int) -> None:
+        """``epochs`` repeated steps on one device-resident batch, K per
+        dispatch via the scanned train step (broadcast along the scan axis —
+        XLA reads the same HBM buffer each step, no K-fold staging)."""
+        xd, yd = jnp.asarray(x), jnp.asarray(y)
+        if self.stage_dtype is not None:
+            xd = xd.astype(self.stage_dtype)
+        multi = self._jit("multistep", make_multistep_train_step(self.conf),
+                          donate=(0, 1, 2))
+        remaining = epochs
+        while remaining > 0:
+            k = min(self.dispatch_ksteps, remaining)
+            xs = jnp.broadcast_to(xd[None], (k,) + xd.shape)
+            ys = jnp.broadcast_to(yd[None], (k,) + yd.shape)
+            (self.params_list, self.state_list, self.updater_state,
+             losses) = multi(self.params_list, self.state_list,
+                             self.updater_state, xs, ys, self._next_rng(),
+                             jnp.int32(self.iteration))
+            for i in range(k):
+                self.iteration += 1
+                self.score_value = (lambda ls=losses, j=i: ls[j])
+                for listener in self.listeners:
+                    listener.iteration_done(self, self.iteration)
+            remaining -= k
 
     #: train steps fused per host dispatch in fit_iterator (lax.scan); 1
     #: disables the K-step path. Benched sweet spot for relay-attached TPUs.

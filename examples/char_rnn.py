@@ -36,10 +36,11 @@ def main():
     x = np.eye(V, dtype=np.float32)[np.stack([ids[s:s + T] for s in starts])]
     y = np.eye(V, dtype=np.float32)[np.stack([ids[s + 1:s + T + 1]
                                               for s in starts])]
-    for step in range(args.steps):
-        net.fit(x, y)
-        if step % 10 == 0:
-            print(f"step {step}: loss {net.score_value:.4f}")
+    # fused fit: K steps per XLA dispatch, batch staged on device once;
+    # the listener's periodic score read is the only host sync
+    from deeplearning4j_tpu.optimize.listeners import ScoreIterationListener
+    net.set_listeners(ScoreIterationListener(10))
+    net.fit(x, y, epochs=args.steps)
 
     # streaming generation via rnn_time_step (reference rnnTimeStep)
     net.rnn_clear_previous_state()

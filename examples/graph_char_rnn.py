@@ -49,10 +49,11 @@ def main():
     x = np.eye(V, dtype=np.float32)[np.stack([ids[s:s + T] for s in starts])]
     y = np.eye(V, dtype=np.float32)[np.stack([ids[s + 1:s + T + 1]
                                               for s in starts])]
-    for step in range(args.steps):
-        net.fit([x], [y])
-        if step % 10 == 0:
-            print(f"step {step}: loss {net.score_value:.4f}")
+    # TBPTT configs run the exact per-chunk path; a Standard-backprop graph
+    # would take the fused K-step dispatch here (see examples/char_rnn.py)
+    from deeplearning4j_tpu.optimize.listeners import ScoreIterationListener
+    net.set_listeners(ScoreIterationListener(10))
+    net.fit([x], [y], epochs=args.steps)
 
     # streaming generation carries LSTM-vertex state across calls
     net.rnn_clear_previous_state()

@@ -355,3 +355,74 @@ def test_lazy_score_defers_sync():
     assert isinstance(s, float)
     assert isinstance(net._score_raw, float)  # cached after first read
     assert net.score_value == s
+
+
+def test_fit_epochs_fused_equals_sequential():
+    """fit(x, y, epochs=N) fuses K repeated steps per dispatch (batch staged
+    once, broadcast along the scan axis) — must equal N sequential fits."""
+    import jax
+
+    rng = np.random.default_rng(2)
+    x = rng.normal(size=(24, 5)).astype(np.float32)
+    y = np.zeros((24, 3), np.float32)
+    y[np.arange(24), rng.integers(0, 3, 24)] = 1
+
+    def build():
+        conf = (NeuralNetConfiguration.builder()
+                .seed(9).learning_rate(0.05).updater("adam")
+                .list()
+                .layer(DenseLayer(n_in=5, n_out=8, activation="tanh"))
+                .layer(OutputLayer(n_in=8, n_out=3, loss="mcxent",
+                                   activation="softmax"))
+                .build())
+        return MultiLayerNetwork(conf).init()
+
+    net_a = build()
+    net_a.fit(x, y, epochs=7)  # K=8 default -> one fused dispatch of 7
+
+    net_b = build()
+    net_b.dispatch_ksteps = 1  # forces the sequential per-batch path
+    net_b.fit(x, y, epochs=7)
+
+    assert net_a.iteration == net_b.iteration == 7
+    for a, b in zip(jax.tree_util.tree_leaves(net_a.params_list),
+                    jax.tree_util.tree_leaves(net_b.params_list)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_graph_fit_epochs_fused_equals_sequential():
+    import jax
+
+    from deeplearning4j_tpu.nn.graph_network import ComputationGraph
+
+    rng = np.random.default_rng(3)
+    x = rng.normal(size=(16, 4)).astype(np.float32)
+    y = np.zeros((16, 2), np.float32)
+    y[np.arange(16), rng.integers(0, 2, 16)] = 1
+
+    def build():
+        conf = (NeuralNetConfiguration.builder()
+                .seed(9).learning_rate(0.05).updater("sgd")
+                .graph_builder()
+                .add_inputs("in")
+                .add_layer("d", DenseLayer(n_in=4, n_out=6,
+                                           activation="tanh"), "in")
+                .add_layer("out", OutputLayer(n_in=6, n_out=2, loss="mcxent",
+                                              activation="softmax"), "d")
+                .set_outputs("out")
+                .build())
+        return ComputationGraph(conf).init()
+
+    net_a = build()
+    net_a.fit([x], [y], epochs=11)  # 8 + 3 fused dispatches
+
+    net_b = build()
+    net_b.dispatch_ksteps = 1
+    net_b.fit([x], [y], epochs=11)
+
+    assert net_a.iteration == net_b.iteration == 11
+    for a, b in zip(jax.tree_util.tree_leaves(net_a.params_list),
+                    jax.tree_util.tree_leaves(net_b.params_list)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
