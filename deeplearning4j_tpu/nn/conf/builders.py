@@ -64,6 +64,11 @@ class GlobalConf:
     mini_batch: bool = True
     use_regularization: bool = False
     max_num_line_search_iterations: int = 5
+    #: rematerialize per-layer activations in backward (jax.checkpoint):
+    #: trades recompute FLOPs for activation HBM — the TPU-native memory
+    #: lever for deep/long-sequence models (no reference equivalent; the
+    #: JVM runtime keeps all activations)
+    gradient_checkpointing: bool = False
 
 
 _LAYER_INHERIT_FIELDS = (

@@ -87,6 +87,7 @@ def graph_forward(conf: ComputationGraphConfiguration, params: dict, states: dic
     order = conf.topological_order or conf.topo_sort()
     rngs = (jax.random.split(rng, len(order)) if rng is not None
             else [None] * len(order))
+    remat = train and conf.global_conf.gradient_checkpointing
     for i, name in enumerate(order):
         vertex = conf.vertices[name]
         srcs = conf.vertex_inputs[name]
@@ -95,8 +96,15 @@ def graph_forward(conf: ComputationGraphConfiguration, params: dict, states: dic
         if (collect_loss_inputs and name in conf.network_outputs
                 and isinstance(vertex, LayerVertex) and vertex.layer.has_loss()):
             loss_inputs[name] = vins[0]
-        y, ns = vertex.apply(params.get(name, {}), states.get(name, {}), vins,
-                             train=train, rng=rngs[i], mask=mask)
+        if remat and isinstance(vertex, LayerVertex):
+            # jax.checkpoint per layer vertex: backward recomputes this
+            # vertex's forward instead of holding its activations
+            def f(p, vi, _v=vertex, _s=states.get(name, {}), _r=rngs[i]):
+                return _v.apply(p, _s, vi, train=True, rng=_r, mask=mask)
+            y, ns = jax.checkpoint(f)(params.get(name, {}), vins)
+        else:
+            y, ns = vertex.apply(params.get(name, {}), states.get(name, {}),
+                                 vins, train=train, rng=rngs[i], mask=mask)
         acts[name] = y
         new_states[name] = ns
         mask_of[name] = mask

@@ -93,11 +93,17 @@ def forward_fn(conf: MultiLayerConfiguration, params_list, state_list, x, *,
 def loss_fn(conf: MultiLayerConfiguration, params_list, state_list, x, y, rng,
             fmask=None, lmask=None):
     """Training loss: forward to the last (loss) layer + regularization.
-    Returns (loss, new_state_list)."""
+    Returns (loss, new_state_list).
+
+    With ``gradient_checkpointing`` set, each layer application is wrapped in
+    ``jax.checkpoint``: backward recomputes the layer's forward instead of
+    holding its activations in HBM — peak activation memory drops from
+    O(depth) to O(1) layers at ~1.3x FLOPs."""
     layers = conf.layers
     last = layers[-1]
     if not last.has_loss():
         raise ValueError("Last layer has no loss function; cannot compute supervised loss")
+    remat = conf.global_conf.gradient_checkpointing
     h = x
     new_states = []
     rngs = (jax.random.split(rng, len(layers))
@@ -106,8 +112,13 @@ def loss_fn(conf: MultiLayerConfiguration, params_list, state_list, x, y, rng,
         pp = conf.preprocessor(i)
         if pp is not None:
             h = pp.pre_process(h, fmask)
-        h, ns = layer.apply(params_list[i], state_list[i], h,
-                            train=True, rng=rngs[i], mask=fmask)
+        if remat:
+            def f(p, hh, _layer=layer, _s=state_list[i], _r=rngs[i]):
+                return _layer.apply(p, _s, hh, train=True, rng=_r, mask=fmask)
+            h, ns = jax.checkpoint(f)(params_list[i], h)
+        else:
+            h, ns = layer.apply(params_list[i], state_list[i], h,
+                                train=True, rng=rngs[i], mask=fmask)
         new_states.append(ns)
     pp = conf.preprocessor(len(layers) - 1)
     if pp is not None:

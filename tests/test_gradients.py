@@ -289,3 +289,72 @@ class TestGradientCheckLRN:
                     input_type=InputType.convolutional(5, 5, 2))
         assert check_gradients(net, rand((3, 5, 5, 2)), onehot(3, 2),
                                subset=60, verbose=True)
+
+
+class TestGradientCheckpointing:
+    """jax.checkpoint remat (gradient_checkpointing conf flag) must be
+    gradient-invisible: identical loss and gradients, only memory/FLOPs
+    change."""
+
+    def test_mln_remat_gradients_identical(self):
+        import jax
+        import jax.numpy as jnp
+
+        from deeplearning4j_tpu.nn.multilayer import loss_fn
+
+        def build(remat):
+            conf = (NeuralNetConfiguration.builder()
+                    .seed(7).learning_rate(0.05)
+                    .gradient_checkpointing(remat)
+                    .list()
+                    .layer(DenseLayer(n_in=4, n_out=8, activation="tanh"))
+                    .layer(DenseLayer(n_in=8, n_out=8, activation="relu"))
+                    .layer(OutputLayer(n_in=8, n_out=3, loss="mcxent",
+                                       activation="softmax"))
+                    .build())
+            return MultiLayerNetwork(conf).init()
+
+        x = jnp.asarray(rand((6, 4)))
+        y = jnp.asarray(onehot(6, 3))
+        nets = [build(False), build(True)]
+        outs = []
+        for net in nets:
+            g = jax.grad(lambda p, n=net: loss_fn(n.conf, p, n.state_list,
+                                                  x, y, None)[0])(
+                net.params_list)
+            outs.append(g)
+        for a, b in zip(jax.tree_util.tree_leaves(outs[0]),
+                        jax.tree_util.tree_leaves(outs[1])):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-6, atol=1e-7)
+
+    def test_graph_remat_training_matches(self):
+        import jax
+
+        from deeplearning4j_tpu.nn.graph_network import ComputationGraph
+
+        def build(remat):
+            conf = (NeuralNetConfiguration.builder()
+                    .seed(7).learning_rate(0.05).updater("sgd")
+                    .gradient_checkpointing(remat)
+                    .graph_builder()
+                    .add_inputs("in")
+                    .add_layer("d1", DenseLayer(n_in=4, n_out=8,
+                                                activation="tanh"), "in")
+                    .add_layer("out", OutputLayer(n_in=8, n_out=3,
+                                                  loss="mcxent",
+                                                  activation="softmax"), "d1")
+                    .set_outputs("out")
+                    .build())
+            return ComputationGraph(conf).init()
+
+        x = rand((6, 4), seed=5)
+        y = onehot(6, 3, seed=6)
+        nets = [build(False), build(True)]
+        for net in nets:
+            for _ in range(3):
+                net.fit([x], [y])
+        for a, b in zip(jax.tree_util.tree_leaves(nets[0].params_list),
+                        jax.tree_util.tree_leaves(nets[1].params_list)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-6, atol=1e-7)
