@@ -160,6 +160,22 @@ class ParallelWrapper:
             out_shardings=(repl, repl, repl, repl),
         )
 
+    def _stage(self, arr, spec: P):
+        """Host batch -> device array laid out for the jit's in_shardings.
+
+        Single-process: a plain transfer (the jit places it). Multi-process
+        (jax.distributed cluster): every process holds the same global batch
+        from its iterator, and each contributes only its addressable shards
+        via make_array_from_callback — the cross-host equivalent of the
+        reference's Spark executors each taking their partition of the RDD
+        (ParameterAveragingTrainingMaster.executeTraining:344)."""
+        arr = np.asarray(arr)
+        if jax.process_count() == 1:
+            return jnp.asarray(arr)
+        sharding = NamedSharding(self.mesh, spec)
+        return jax.make_array_from_callback(arr.shape, sharding,
+                                            lambda idx: arr[idx])
+
     def _fit_sync(self, iterator, epochs: int) -> None:
         net = self.model
         if self._sync_step is None:
@@ -203,10 +219,11 @@ class ParallelWrapper:
 
         def dispatch_one(x, y):
             if is_graph:
-                x = [jnp.asarray(a) for a in x]
-                y = [jnp.asarray(a) for a in y]
+                x = [self._stage(a, P("data")) for a in x]
+                y = [self._stage(a, P("data")) for a in y]
             else:
-                x, y = jnp.asarray(x), jnp.asarray(y)
+                x = self._stage(x, P("data"))
+                y = self._stage(y, P("data"))
             (net.params_list, net.state_list, net.updater_state, loss) = \
                 self._sync_step(net.params_list, net.state_list,
                                 net.updater_state, x, y, net._next_rng(),
@@ -220,14 +237,19 @@ class ParallelWrapper:
             if len(batches) == 1:
                 dispatch_one(*batches[0])
                 return
+            stack_spec = P(None, "data")
             if is_graph:
-                xs = [jnp.asarray(np.stack([b[0][i] for b in batches]))
+                xs = [self._stage(np.stack([b[0][i] for b in batches]),
+                                  stack_spec)
                       for i in range(len(batches[0][0]))]
-                ys = [jnp.asarray(np.stack([b[1][i] for b in batches]))
+                ys = [self._stage(np.stack([b[1][i] for b in batches]),
+                                  stack_spec)
                       for i in range(len(batches[0][1]))]
             else:
-                xs = jnp.asarray(np.stack([b[0] for b in batches]))
-                ys = jnp.asarray(np.stack([b[1] for b in batches]))
+                xs = self._stage(np.stack([b[0] for b in batches]),
+                                 stack_spec)
+                ys = self._stage(np.stack([b[1] for b in batches]),
+                                 stack_spec)
             (net.params_list, net.state_list, net.updater_state, losses) = \
                 self._sync_multi(net.params_list, net.state_list,
                                  net.updater_state, xs, ys, net._next_rng(),

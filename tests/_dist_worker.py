@@ -60,16 +60,31 @@ def main() -> None:
     gy = jax.make_array_from_process_local_data(
         bsh, y[pid * half:(pid + 1) * half])
 
-    step = jax.jit(make_train_step(conf),
-                   in_shardings=(repl, repl, repl, bsh, bsh, repl, repl),
-                   out_shardings=(repl, repl, repl, repl))
-    params, _, _, loss = step(net.params_list, net.state_list,
-                              net.updater_state, gx, gy,
-                              jax.random.PRNGKey(0), jnp.int32(0))
+    mode = sys.argv[3] if len(sys.argv) > 3 else "step"
+    if mode == "step":
+        step = jax.jit(make_train_step(conf),
+                       in_shardings=(repl, repl, repl, bsh, bsh, repl, repl),
+                       out_shardings=(repl, repl, repl, repl))
+        params, _, _, loss = step(net.params_list, net.state_list,
+                                  net.updater_state, gx, gy,
+                                  jax.random.PRNGKey(0), jnp.int32(0))
+        loss_val = float(loss)
+    else:  # "wrapper": the production ParallelWrapper sync-DP fit over the
+        #            2-process mesh (multi-host batch staging via
+        #            make_array_from_callback inside _stage)
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+        from deeplearning4j_tpu.datasets.iterators import ListDataSetIterator
+        from deeplearning4j_tpu.parallel.wrapper import ParallelWrapper
+
+        batches = [DataSet(x.copy(), y.copy()) for _ in range(4)]
+        pw = ParallelWrapper(net, prefetch=0, mesh=mesh)
+        pw.fit(ListDataSetIterator(batches))
+        params = net.params_list
+        loss_val = net.score_value
 
     flat = np.concatenate([np.ravel(np.asarray(leaf)) for leaf in
                            jax.tree_util.tree_leaves(params)])
-    print(json.dumps({"pid": pid, "loss": float(loss),
+    print(json.dumps({"pid": pid, "loss": loss_val,
                       "psum": float(flat.sum()),
                       "head": [float(v) for v in flat[:5]]}), flush=True)
     jax.distributed.shutdown()

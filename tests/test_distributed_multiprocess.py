@@ -25,7 +25,7 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def test_two_process_sync_dp_matches_single_process():
+def _run_workers(mode: str):
     port = _free_port()
     env = os.environ.copy()
     env["JAX_PLATFORMS"] = "cpu"
@@ -33,10 +33,14 @@ def test_two_process_sync_dp_matches_single_process():
     env.pop("XLA_FLAGS", None)  # one CPU device per process
     repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
-    procs = [subprocess.Popen(
-        [sys.executable, _WORKER, str(i), str(port)], env=env,
+    return [subprocess.Popen(
+        [sys.executable, _WORKER, str(i), str(port), mode], env=env,
         stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
         for i in (0, 1)]
+
+
+def test_two_process_sync_dp_matches_single_process():
+    procs = _run_workers("step")
     outs = []
     for p in procs:
         stdout, stderr = p.communicate(timeout=180)
@@ -83,4 +87,51 @@ def test_two_process_sync_dp_matches_single_process():
     np.testing.assert_allclose(outs[0]["head"], flat[:5],
                                rtol=1e-5, atol=1e-6)
     np.testing.assert_allclose(outs[0]["loss"], float(loss),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_two_process_parallel_wrapper_fit_matches_single_process():
+    """The PRODUCTION ParallelWrapper.fit over a 2-process jax.distributed
+    mesh == single-process fit on the same batches (multi-host batch staging
+    via make_array_from_callback; reference analog: the same Spark job giving
+    the same model regardless of executor count)."""
+    procs = _run_workers("wrapper")
+    outs = []
+    for p in procs:
+        stdout, stderr = p.communicate(timeout=240)
+        assert p.returncode == 0, f"worker failed:\n{stderr[-2000:]}"
+        outs.append(json.loads(stdout.strip().splitlines()[-1]))
+
+    assert outs[0]["psum"] == outs[1]["psum"]
+    assert outs[0]["head"] == outs[1]["head"]
+
+    # single-process oracle: same net, same 4 batches, plain fit_iterator
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.datasets.iterators import ListDataSetIterator
+    from deeplearning4j_tpu.nn.conf.builders import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    conf = (NeuralNetConfiguration.builder()
+            .seed(9).learning_rate(0.1).updater("sgd")
+            .list()
+            .layer(DenseLayer(n_in=4, n_out=8, activation="tanh"))
+            .layer(OutputLayer(n_in=8, n_out=3, loss="mcxent",
+                               activation="softmax"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    rng = np.random.default_rng(0)
+    B = 8
+    x = rng.normal(size=(B, 4)).astype(np.float32)
+    y = np.zeros((B, 3), np.float32)
+    y[np.arange(B), rng.integers(0, 3, B)] = 1
+    net.fit_iterator(ListDataSetIterator(
+        [DataSet(x.copy(), y.copy()) for _ in range(4)]))
+
+    import jax
+    flat = np.concatenate([np.ravel(np.asarray(leaf)) for leaf in
+                           jax.tree_util.tree_leaves(net.params_list)])
+    np.testing.assert_allclose(outs[0]["psum"], float(flat.sum()),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(outs[0]["head"], flat[:5],
                                rtol=1e-5, atol=1e-6)
