@@ -23,7 +23,7 @@ from deeplearning4j_tpu.nn.conf.graphconf import ComputationGraphConfiguration
 from deeplearning4j_tpu.nn.conf.vertices import LayerVertex
 from deeplearning4j_tpu.nn.multilayer import LazyScore, _updater_spec
 from deeplearning4j_tpu.nn.updaters import (
-    effective_lr, normalize_gradients, updater_init, updater_step,
+    effective_lr, normalize_gradients, updater_init, updater_step_with_param,
 )
 from deeplearning4j_tpu.utils.pytree import flatten_params, num_params, unflatten_params
 
@@ -161,8 +161,9 @@ def _apply_graph_updates(conf, params, grads, upd_state, iteration):
         p_new, u_new = {}, {}
         for pname, grad in g_v.items():
             this_lr = lr_bias if pname in ("b", "vb", "beta") else lr
-            step, ustate = updater_step(spec, grad, upd_state[name][pname],
-                                        this_lr, iteration)
+            step, ustate = updater_step_with_param(
+                spec, grad, params[name][pname], upd_state[name][pname],
+                this_lr, iteration)
             p_new[pname] = params[name][pname] - step
             u_new[pname] = ustate
         new_params[name] = p_new

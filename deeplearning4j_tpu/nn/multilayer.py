@@ -29,7 +29,8 @@ from deeplearning4j_tpu.nn.conf.multilayer import MultiLayerConfiguration
 from deeplearning4j_tpu.nn.conf.layers.base import PretrainLayer
 from deeplearning4j_tpu.nn.conf.layers.recurrent import LSTM
 from deeplearning4j_tpu.nn.updaters import (
-    UpdaterSpec, effective_lr, normalize_gradients, updater_init, updater_step,
+    UpdaterSpec, effective_lr, normalize_gradients, updater_init,
+    updater_step, updater_step_with_param,
 )
 from deeplearning4j_tpu.utils.pytree import flatten_params, num_params, unflatten_params
 
@@ -161,8 +162,9 @@ def make_train_step(conf: MultiLayerConfiguration):
             u_new = {}
             for name, grad in g_i.items():
                 this_lr = lr_bias if name in ("b", "vb", "beta") else lr
-                step, ustate = updater_step(spec, grad, upd_state[i][name],
-                                            this_lr, iteration)
+                step, ustate = updater_step_with_param(
+                    spec, grad, params_list[i][name], upd_state[i][name],
+                    this_lr, iteration)
                 p_new[name] = params_list[i][name] - step
                 u_new[name] = ustate
             new_params.append(p_new)
@@ -737,7 +739,9 @@ def make_tbptt_step(conf: MultiLayerConfiguration):
                               g.lr_policy_steps, g.lr_schedule, g.max_num_iterations)
             p_new, u_new = {}, {}
             for name, grad in g_i.items():
-                step, ustate = updater_step(spec, grad, upd_state[i][name], lr, iteration)
+                step, ustate = updater_step_with_param(
+                    spec, grad, params_list[i][name], upd_state[i][name], lr,
+                    iteration)
                 p_new[name] = params_list[i][name] - step
                 u_new[name] = ustate
             new_params.append(p_new)
@@ -779,7 +783,9 @@ def make_pretrain_step(conf: MultiLayerConfiguration, layer_idx: int):
                           g.lr_policy_steps, g.lr_schedule, g.max_num_iterations)
         p_new, u_new = {}, {}
         for name, grad in grads.items():
-            step, ustate = updater_step(spec, grad, layer_upd_state[name], lr, iteration)
+            step, ustate = updater_step_with_param(
+                spec, grad, params_list[layer_idx][name],
+                layer_upd_state[name], lr, iteration)
             p_new[name] = params_list[layer_idx][name] - step
             u_new[name] = ustate
         return p_new, u_new, loss

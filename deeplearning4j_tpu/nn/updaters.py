@@ -94,6 +94,10 @@ def updater_init(spec: UpdaterSpec, param: Array) -> dict:
         return {"msg": z(), "msdx": z()}
     if n == "adamax":
         return {"m": z(), "u": z()}
+    if n == "lars":
+        return {"v": z()}
+    if n == "lamb":
+        return {"m": z(), "v": z()}
     raise ValueError(f"Unknown updater '{spec.name}'")
 
 
@@ -140,7 +144,51 @@ def updater_step(spec: UpdaterSpec, grad: Array, state: dict, lr: Array,
         dx = grad * jnp.sqrt(state["msdx"] + eps) / jnp.sqrt(msg + eps)
         msdx = rho * state["msdx"] + (1 - rho) * dx * dx
         return dx, {"msg": msg, "msdx": msdx}
+    if n in ("lars", "lamb"):
+        # trust-ratio updaters need the parameter value; callers route them
+        # through updater_step_with_param
+        raise ValueError(f"'{n}' needs the param value: call "
+                         "updater_step_with_param")
     raise ValueError(f"Unknown updater '{spec.name}'")
+
+
+def _safe_norm(x: Array) -> Array:
+    return jnp.sqrt(jnp.sum(x.astype(jnp.float32) ** 2) + 1e-12)
+
+
+def _needs_param(name: str) -> bool:
+    return name.lower() in ("lars", "lamb")
+
+
+def updater_step_with_param(spec: UpdaterSpec, grad: Array, param: Array,
+                            state: dict, lr: Array,
+                            iteration) -> tuple[Array, dict]:
+    """Like updater_step, but for updaters whose math needs the parameter
+    value itself (LARS/LAMB layerwise trust ratios). Falls through to
+    updater_step for everything else."""
+    n = spec.name.lower()
+    eps = spec.epsilon
+    if n == "lars":
+        mu = scheduled_value(spec.momentum, spec.momentum_schedule, iteration)
+        w_norm = _safe_norm(param)
+        g_norm = _safe_norm(grad)
+        trust = jnp.where(g_norm > 0, w_norm / g_norm, 1.0)
+        trust = jnp.where(w_norm > 0, trust, 1.0)
+        v = mu * state["v"] + lr * trust * grad
+        return v, {"v": v}
+    if n == "lamb":
+        b1, b2 = spec.adam_mean_decay, spec.adam_var_decay
+        t = jnp.asarray(iteration, jnp.float32) + 1.0
+        m = b1 * state["m"] + (1 - b1) * grad
+        v = b2 * state["v"] + (1 - b2) * grad * grad
+        m_hat = m / (1 - jnp.power(b1, t))
+        v_hat = v / (1 - jnp.power(b2, t))
+        update = m_hat / (jnp.sqrt(v_hat) + eps)
+        w_norm = _safe_norm(param)
+        u_norm = _safe_norm(update)
+        trust = jnp.where((w_norm > 0) & (u_norm > 0), w_norm / u_norm, 1.0)
+        return lr * trust * update, {"m": m, "v": v}
+    return updater_step(spec, grad, state, lr, iteration)
 
 
 # ------------------------------------------------------------- gradient normalization

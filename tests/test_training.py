@@ -140,7 +140,8 @@ def test_updaters_all_run():
     x = np.random.default_rng(0).normal(size=(16, 4)).astype(np.float32)
     y = np.zeros((16, 2), np.float32)
     y[:, 0] = 1
-    for upd in ["sgd", "nesterovs", "adam", "adagrad", "rmsprop", "adadelta", "adamax"]:
+    for upd in ["sgd", "nesterovs", "adam", "adagrad", "rmsprop", "adadelta",
+                "adamax", "lars", "lamb"]:
         conf = (NeuralNetConfiguration.builder()
                 .seed(1).learning_rate(0.01).updater(upd)
                 .list()
@@ -426,3 +427,28 @@ def test_graph_fit_epochs_fused_equals_sequential():
                     jax.tree_util.tree_leaves(net_b.params_list)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=1e-5, atol=1e-6)
+
+
+def test_lars_lamb_trust_ratio_scales_update():
+    """LARS/LAMB layerwise trust ratio: a parameter with 10x the norm gets a
+    proportionally larger raw update under the same gradient (the property
+    that makes large-batch scaling work; You et al. 2017/2019)."""
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.nn.updaters import (
+        UpdaterSpec, updater_init, updater_step_with_param)
+
+    for name in ("lars", "lamb"):
+        spec = UpdaterSpec(name=name)
+        g = jnp.ones((4,)) * 0.5
+        small = jnp.ones((4,)) * 0.1
+        big = jnp.ones((4,)) * 1.0
+        s_small = updater_init(spec, small)
+        s_big = updater_init(spec, big)
+        step_small, _ = updater_step_with_param(spec, g, small, s_small,
+                                                jnp.float32(0.1), 0)
+        step_big, _ = updater_step_with_param(spec, g, big, s_big,
+                                              jnp.float32(0.1), 0)
+        ratio = float(jnp.linalg.norm(step_big)
+                      / jnp.linalg.norm(step_small))
+        assert 9.0 < ratio < 11.0, (name, ratio)
