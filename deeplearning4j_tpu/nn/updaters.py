@@ -49,6 +49,19 @@ def effective_lr(base_lr: float, policy: Optional[str], iteration,
         for k in sorted((schedule or {}).keys(), key=int):
             lr = jnp.where(it >= int(k), jnp.float32((schedule or {})[k]), lr)
         return lr
+    # TPU-era schedules beyond the reference's policy set
+    if p == "cosine":
+        # half-cosine from base_lr to ~0 over max_iterations
+        frac = jnp.clip(it / max(max_iterations, 1), 0.0, 1.0)
+        return base_lr * 0.5 * (1.0 + jnp.cos(jnp.pi * frac))
+    if p == "warmup_cosine":
+        # linear warmup over `steps` iterations, then cosine to max_iterations
+        warm = jnp.maximum(jnp.asarray(steps, jnp.float32), 1.0)
+        warm_lr = base_lr * it / warm
+        frac = jnp.clip((it - warm) / jnp.maximum(max_iterations - warm, 1.0),
+                        0.0, 1.0)
+        cos_lr = base_lr * 0.5 * (1.0 + jnp.cos(jnp.pi * frac))
+        return jnp.where(it < warm, warm_lr, cos_lr)
     raise ValueError(f"Unknown lr policy '{policy}'")
 
 

@@ -452,3 +452,26 @@ def test_lars_lamb_trust_ratio_scales_update():
         ratio = float(jnp.linalg.norm(step_big)
                       / jnp.linalg.norm(step_small))
         assert 9.0 < ratio < 11.0, (name, ratio)
+
+
+def test_cosine_and_warmup_schedules():
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.nn.updaters import effective_lr
+
+    # cosine: base at 0, ~half at midpoint, ~0 at the end
+    lr0 = float(effective_lr(0.4, "cosine", 0, max_iterations=100))
+    lr50 = float(effective_lr(0.4, "cosine", 50, max_iterations=100))
+    lr100 = float(effective_lr(0.4, "cosine", 100, max_iterations=100))
+    assert abs(lr0 - 0.4) < 1e-6 and abs(lr50 - 0.2) < 1e-6 and lr100 < 1e-6
+
+    # warmup_cosine: linear ramp over `steps`, then cosine down
+    w10 = float(effective_lr(0.4, "warmup_cosine", 5, steps=10,
+                             max_iterations=100))
+    w_peak = float(effective_lr(0.4, "warmup_cosine", 10, steps=10,
+                                max_iterations=100))
+    w_end = float(effective_lr(0.4, "warmup_cosine", 100, steps=10,
+                               max_iterations=100))
+    assert abs(w10 - 0.2) < 1e-6
+    assert abs(w_peak - 0.4) < 1e-6
+    assert w_end < 1e-6
