@@ -1,0 +1,84 @@
+"""Tensor-parallel training + sharded checkpointing on a device mesh.
+
+The TPU-native capabilities the JVM reference never had: Megatron-style
+output-dim param sharding over a 'model' mesh axis (XLA GSPMD inserts the
+collectives), and an orbax checkpoint whose leaves keep their sharding on
+disk — no host gather — restored directly onto the mesh.
+
+Run (CPU virtual mesh):
+  PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+  XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+  python examples/tensor_parallel_checkpoint.py
+"""
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from deeplearning4j_tpu.nn.conf.builders import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork, make_train_step
+from deeplearning4j_tpu.parallel.mesh import build_mesh, shard_params_for_tp
+from deeplearning4j_tpu.utils.sharded_checkpoint import (
+    restore_sharded, save_sharded)
+
+
+def main():
+    n = len(jax.devices())
+    mesh = build_mesh({"data": max(n // 2, 1), "model": 2 if n >= 2 else 1})
+    print(f"mesh: {dict(mesh.shape)} over {n} devices")
+
+    conf = (NeuralNetConfiguration.builder()
+            .seed(7).learning_rate(0.1).updater("lamb")
+            .list()
+            .layer(DenseLayer(n_in=16, n_out=64, activation="relu"))
+            .layer(DenseLayer(n_in=64, n_out=64, activation="relu"))
+            .layer(OutputLayer(n_in=64, n_out=4, loss="mcxent",
+                               activation="softmax"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+
+    # Megatron-style TP: 2-D weights sharded on the output dim over 'model'
+    params = shard_params_for_tp(net.params_list, conf, mesh)
+    bsh = NamedSharding(mesh, P("data"))
+    # computation follows the input shardings: params carry TP layouts,
+    # the batch is DP-sharded, GSPMD inserts the collectives
+    step = jax.jit(make_train_step(conf))
+
+    rng = np.random.default_rng(0)
+    x = jax.device_put(
+        jnp.asarray(rng.normal(size=(32, 16)).astype(np.float32)), bsh)
+    labels = rng.integers(0, 4, 32)
+    y = jax.device_put(jnp.asarray(np.eye(4, dtype=np.float32)[labels]), bsh)
+    states, upd = net.state_list, net.updater_state
+    key = jax.random.PRNGKey(0)
+    for i in range(20):
+        params, states, upd, loss = step(params, states, upd, x, y, key,
+                                         jnp.int32(i))
+        if i % 5 == 0:
+            print(f"step {i}: loss {float(loss):.4f} | W1 sharding "
+                  f"{params[1]['W'].sharding.spec}")
+
+    # sharded checkpoint: each leaf written in its mesh layout
+    net.params_list, net.state_list, net.updater_state = params, states, upd
+    ckpt = os.path.join(tempfile.mkdtemp(), "tp_ckpt")
+    save_sharded(ckpt, net, step=20)
+
+    # restore DIRECTLY onto the same TP sharding
+    shardings = jax.tree_util.tree_map(lambda a: a.sharding, params)
+    restored = restore_sharded(ckpt, MultiLayerNetwork(conf),
+                               shardings=shardings)
+    w = restored.params_list[1]["W"]
+    print(f"restored W1: sharding {w.sharding.spec}, "
+          f"{len(w.sharding.device_set)} devices, "
+          f"max|diff|={float(jnp.max(jnp.abs(w - params[1]['W']))):.2e}")
+
+
+if __name__ == "__main__":
+    main()
