@@ -19,12 +19,12 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import NamedSharding, PartitionSpec as P
 
 from deeplearning4j_tpu.nn.conf.builders import NeuralNetConfiguration
 from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
 from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork, make_train_step
-from deeplearning4j_tpu.parallel.mesh import build_mesh, shard_params_for_tp
+from deeplearning4j_tpu.parallel.mesh import (
+    batch_sharding, build_mesh, shard_params_for_tp)
 from deeplearning4j_tpu.utils.sharded_checkpoint import (
     restore_sharded, save_sharded)
 
@@ -46,20 +46,23 @@ def main():
 
     # Megatron-style TP: 2-D weights sharded on the output dim over 'model'
     params = shard_params_for_tp(net.params_list, conf, mesh)
-    bsh = NamedSharding(mesh, P("data"))
+    bsh = batch_sharding(mesh)
     # computation follows the input shardings: params carry TP layouts,
-    # the batch is DP-sharded, GSPMD inserts the collectives
-    step = jax.jit(make_train_step(conf))
+    # the batch is DP-sharded, GSPMD inserts the collectives. Donated
+    # training state -> in-place updates, no 2x HBM (same as the fit path).
+    step = jax.jit(make_train_step(conf), donate_argnums=(0, 1, 2))
 
     rng = np.random.default_rng(0)
+    B = 8 * mesh.shape["data"]  # divisible by the data axis at any scale
     x = jax.device_put(
-        jnp.asarray(rng.normal(size=(32, 16)).astype(np.float32)), bsh)
-    labels = rng.integers(0, 4, 32)
+        jnp.asarray(rng.normal(size=(B, 16)).astype(np.float32)), bsh)
+    labels = rng.integers(0, 4, B)
     y = jax.device_put(jnp.asarray(np.eye(4, dtype=np.float32)[labels]), bsh)
     states, upd = net.state_list, net.updater_state
     key = jax.random.PRNGKey(0)
     for i in range(20):
-        params, states, upd, loss = step(params, states, upd, x, y, key,
+        params, states, upd, loss = step(params, states, upd, x, y,
+                                         jax.random.fold_in(key, i),
                                          jnp.int32(i))
         if i % 5 == 0:
             print(f"step {i}: loss {float(loss):.4f} | W1 sharding "
