@@ -10,7 +10,7 @@ update (segment mean) both stay on device.
 """
 from __future__ import annotations
 
-from typing import NamedTuple, Optional
+from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp

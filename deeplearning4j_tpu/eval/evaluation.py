@@ -9,7 +9,6 @@ the model forward producing the guesses is the jitted TPU path.
 """
 from __future__ import annotations
 
-from collections import defaultdict
 from typing import Optional
 
 import numpy as np

@@ -5,7 +5,6 @@ is streaming and O(steps) memory, same design here.
 """
 from __future__ import annotations
 
-from typing import Optional
 
 import numpy as np
 

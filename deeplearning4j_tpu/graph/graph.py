@@ -7,7 +7,7 @@ adjacency-list file formats).
 from __future__ import annotations
 
 import dataclasses
-from typing import Generic, List, Optional, Sequence, TypeVar
+from typing import Generic, List, Optional, TypeVar
 
 V = TypeVar("V")
 

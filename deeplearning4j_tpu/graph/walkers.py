@@ -6,7 +6,7 @@ EXCEPTION_ON_DISCONNECTED semantics.
 """
 from __future__ import annotations
 
-from typing import Iterator, List, Optional
+from typing import Iterator, List
 
 import numpy as np
 

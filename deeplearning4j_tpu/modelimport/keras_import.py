@@ -18,14 +18,13 @@ original dim_ordering.
 from __future__ import annotations
 
 import json
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Optional
 
 import jax.numpy as jnp
 import numpy as np
 
 from deeplearning4j_tpu.modelimport.hdf5 import H5File
 from deeplearning4j_tpu.nn.conf.builders import NeuralNetConfiguration
-from deeplearning4j_tpu.nn.conf.graphconf import GraphBuilder
 from deeplearning4j_tpu.nn.conf.inputs import InputType
 from deeplearning4j_tpu.nn.conf.layers import (
     ActivationLayer, BatchNormalization, ConvolutionLayer, DenseLayer,

@@ -11,8 +11,7 @@ from deeplearning4j_tpu.nn.conf.builders import NeuralNetConfiguration
 from deeplearning4j_tpu.nn.conf.graphconf import ComputationGraphConfiguration
 from deeplearning4j_tpu.nn.conf.inputs import InputType
 from deeplearning4j_tpu.nn.conf.layers import (
-    ActivationLayer, BatchNormalization, ConvolutionLayer, DenseLayer,
-    GlobalPoolingLayer, OutputLayer, SubsamplingLayer,
+    ActivationLayer, BatchNormalization, ConvolutionLayer, GlobalPoolingLayer, OutputLayer, SubsamplingLayer,
 )
 from deeplearning4j_tpu.nn.conf.vertices import ElementWiseVertex
 

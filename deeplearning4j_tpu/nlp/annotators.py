@@ -12,7 +12,7 @@ from __future__ import annotations
 
 import dataclasses
 import re
-from typing import Callable, List, Optional, Sequence
+from typing import List, Optional, Sequence
 
 
 @dataclasses.dataclass

@@ -6,7 +6,6 @@ tf-idf row vectors (dense numpy here; rows feed DataSet pipelines).
 """
 from __future__ import annotations
 
-import math
 from typing import Iterable, List, Optional
 
 import numpy as np

@@ -15,7 +15,6 @@ import jax.numpy as jnp
 import numpy as np
 
 from deeplearning4j_tpu.nlp.sequencevectors import SequenceVectors
-from deeplearning4j_tpu.nlp.vocab import VocabConstructor
 
 
 class Glove(SequenceVectors):

@@ -13,7 +13,7 @@ are facades over this engine, as in the reference.
 """
 from __future__ import annotations
 
-from typing import Callable, Iterable, List, Optional, Sequence
+from typing import Iterable, List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp

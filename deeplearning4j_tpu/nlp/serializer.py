@@ -6,8 +6,6 @@ word2vec binary format (header "n d\\n", then word + space + d float32 LE).
 """
 from __future__ import annotations
 
-import struct
-from typing import Optional
 
 import jax.numpy as jnp
 import numpy as np

@@ -19,7 +19,7 @@ per layer the same way), so a serialized MultiLayerConfiguration is self-contain
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Optional
+from typing import Optional
 
 from deeplearning4j_tpu.nn.conf.inputs import InputType
 from deeplearning4j_tpu.nn.conf.serde import register_config

@@ -8,7 +8,6 @@ through vertices (n_in inference + auto preprocessor insertion), validation.
 from __future__ import annotations
 
 import dataclasses
-from typing import Optional
 
 from deeplearning4j_tpu.nn.conf.builders import GlobalConf, bake_layer_defaults
 from deeplearning4j_tpu.nn.conf.inputs import InputType

@@ -12,7 +12,6 @@ Layout: [batch, time, features] like the recurrent layers.
 from __future__ import annotations
 
 import dataclasses
-from typing import Optional
 
 import jax
 import jax.numpy as jnp

@@ -13,7 +13,7 @@ Layout: NHWC activations, HWIO kernels (XLA:TPU preferred). ConvolutionMode pari
 from __future__ import annotations
 
 import dataclasses
-from typing import Optional, Sequence
+from typing import Sequence
 
 import jax
 import jax.numpy as jnp

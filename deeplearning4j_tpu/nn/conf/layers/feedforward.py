@@ -9,7 +9,6 @@ Forward math is a jnp matmul (MXU) + fused activation; backprop is autodiff.
 from __future__ import annotations
 
 import dataclasses
-from typing import Optional
 
 import jax
 import jax.numpy as jnp

@@ -8,7 +8,7 @@ Each is a pure function over its input activations; LayerVertex wraps a Layer co
 from __future__ import annotations
 
 import dataclasses
-from typing import Optional, Sequence
+from typing import Optional
 
 import jax
 import jax.numpy as jnp

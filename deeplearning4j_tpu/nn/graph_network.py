@@ -11,9 +11,8 @@ function, as in MultiLayerNetwork.
 from __future__ import annotations
 
 import dataclasses
-import functools
 import math
-from typing import Iterable, Optional, Sequence
+from typing import Optional
 
 import jax
 import jax.numpy as jnp

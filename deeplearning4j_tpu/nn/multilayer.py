@@ -19,7 +19,7 @@ from __future__ import annotations
 import functools
 import math
 import time
-from typing import Any, Iterable, Optional, Sequence
+from typing import Iterable, Optional
 
 import jax
 import jax.numpy as jnp

@@ -15,7 +15,7 @@ StochasticGradientDescent.stepFunction (NegativeGradientStepFunction).
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Optional
+from typing import Optional
 
 import jax
 import jax.numpy as jnp

@@ -20,7 +20,6 @@ from typing import List, Optional
 import jax
 import numpy as np
 
-from deeplearning4j_tpu.utils.pytree import tree_average
 
 
 class ParameterServer:

@@ -17,7 +17,6 @@ Both are exact: outputs match single-device softmax attention to fp tolerance.
 from __future__ import annotations
 
 import functools
-from typing import Optional
 
 import jax
 import jax.numpy as jnp

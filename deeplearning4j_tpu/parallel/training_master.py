@@ -19,7 +19,7 @@ from __future__ import annotations
 import functools
 import json
 import time
-from typing import Iterable, List, Optional
+from typing import List, Optional
 
 import jax
 import jax.numpy as jnp

@@ -10,7 +10,7 @@ drifting on flush ordering / fallback semantics.
 """
 from __future__ import annotations
 
-from typing import Callable, Iterable, Iterator, Optional, Tuple
+from typing import Callable, Iterable, Iterator, Tuple
 
 import jax
 

@@ -13,7 +13,6 @@ from __future__ import annotations
 import io
 import json
 import zipfile
-from typing import Optional
 
 import jax
 import jax.numpy as jnp
