@@ -147,3 +147,69 @@ def test_vae_composite_distribution_roundtrip():
     assert isinstance(rd.components[1][1],
                       ExponentialReconstructionDistribution)
     assert rd.input_size(5) == 3 * 2 + 2
+
+
+def test_serde_fuzz_random_configs_roundtrip():
+    """Property test: randomly assembled configurations round-trip through
+    JSON with identical serialized form AND identical network outputs
+    (config JSON is the checkpoint schema — it must be total over the layer
+    space, not just the layouts other tests happen to use)."""
+    import numpy as np
+
+    from deeplearning4j_tpu.nn.conf.layers import (
+        AutoEncoder, BatchNormalization, DenseLayer, DropoutLayer,
+        GravesLSTM, OutputLayer, RnnOutputLayer,
+    )
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    rng = np.random.default_rng(2026)
+    updaters = ["sgd", "adam", "rmsprop", "nesterovs", "lamb"]
+    acts = ["tanh", "relu", "sigmoid", "identity"]
+    for trial in range(8):
+        width_in = int(rng.integers(2, 6))
+        recurrent = bool(rng.integers(0, 2))
+        b = (NeuralNetConfiguration.builder()
+             .seed(int(rng.integers(0, 10000)))
+             .learning_rate(float(rng.uniform(0.001, 0.2)))
+             .updater(str(rng.choice(updaters)))
+             .list())
+        cur = width_in
+        for _ in range(int(rng.integers(1, 4))):
+            kind = int(rng.integers(0, 4)) if not recurrent else 4
+            n_out = int(rng.integers(3, 9))
+            if kind == 0:
+                b.layer(DenseLayer(n_in=cur, n_out=n_out,
+                                   activation=str(rng.choice(acts)),
+                                   l1=float(rng.choice([0.0, 0.01])),
+                                   l2=float(rng.choice([0.0, 0.02]))))
+            elif kind == 1:
+                b.layer(BatchNormalization(n_in=cur))
+                n_out = cur
+            elif kind == 2:
+                b.layer(DropoutLayer(dropout=0.8))
+                n_out = cur
+            elif kind == 3:
+                b.layer(AutoEncoder(n_in=cur, n_out=n_out,
+                                    activation="sigmoid"))
+            else:
+                b.layer(GravesLSTM(n_in=cur, n_out=n_out, activation="tanh"))
+            cur = n_out
+        if recurrent:
+            b.layer(RnnOutputLayer(n_in=cur, n_out=3, loss="mcxent",
+                                   activation="softmax"))
+        else:
+            b.layer(OutputLayer(n_in=cur, n_out=3, loss="mcxent",
+                                activation="softmax"))
+        conf = b.build()
+        js = conf.to_json()
+        conf2 = type(conf).from_json(js)
+        assert conf2.to_json() == js, f"trial {trial}: serialized form drifted"
+
+        net1 = MultiLayerNetwork(conf).init()
+        net2 = MultiLayerNetwork(conf2).init()
+        shape = (4, 5, width_in) if recurrent else (4, width_in)
+        x = rng.normal(size=shape).astype(np.float32)
+        np.testing.assert_allclose(np.asarray(net1.output(x)),
+                                   np.asarray(net2.output(x)),
+                                   rtol=1e-6, atol=1e-7,
+                                   err_msg=f"trial {trial}")
