@@ -1,0 +1,37 @@
+"""Worker for the crash-resume fault-injection test: trains with a
+per-iteration CheckpointListener, then dies hard (os._exit — no cleanup, no
+atexit, the moral equivalent of a preempted TPU host) at iteration 5."""
+import os
+import sys
+
+import numpy as np
+
+
+def main() -> None:
+    ckpt_dir = sys.argv[1]
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from tests.test_checkpoint_finetune import _data, _net
+
+    from deeplearning4j_tpu.optimize.listeners import (
+        CheckpointListener, IterationListener)
+
+    net = _net()
+
+    class CrashAt(IterationListener):
+        def iteration_done(self, model, iteration):
+            if iteration == 5:
+                print("CRASHING at iteration 5", flush=True)
+                os._exit(17)
+
+    # listener order matters: checkpoint BEFORE the crash hook
+    net.set_listeners(CheckpointListener(ckpt_dir, every_n_iterations=1),
+                      CrashAt())
+    x, y = _data()
+    for _ in range(10):
+        net.fit(x, y)
+    print("never reached", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -156,3 +156,43 @@ def test_vgg_style_keras_import_finetune(tmp_path):
             first = net.score_value
     assert net.score_value < first
     assert np.asarray(net.output(x[:2])).shape == (2, nc)
+
+
+def test_crash_resume_matches_uninterrupted_run(tmp_path):
+    """Fault injection (SURVEY §5): a training process that dies hard
+    (os._exit mid-fit, simulating host preemption) resumes from the
+    CheckpointListener's latest.zip and reproduces the uninterrupted
+    trajectory exactly — the reference's deterministic-restart contract
+    (ModelSerializer zips include updater state)."""
+    import os
+    import subprocess
+    import sys
+
+    worker = os.path.join(os.path.dirname(__file__), "_crash_worker.py")
+    ckpt_dir = str(tmp_path / "ckpts")
+    env = os.environ.copy()
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run([sys.executable, worker, ckpt_dir], env=env,
+                          capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 17, proc.stderr[-1500:]  # died as planned
+    assert "CRASHING at iteration 5" in proc.stdout
+
+    latest = CheckpointListener.last_checkpoint(ckpt_dir)
+    assert latest is not None
+    resumed = restore_multi_layer_network(latest, load_updater=True)
+    assert resumed.iteration == 5
+
+    x, y = _data()
+    for _ in range(5):
+        resumed.fit(x, y)
+
+    # oracle: uninterrupted 10 steps in this process
+    oracle = _net()
+    for _ in range(10):
+        oracle.fit(x, y)
+    np.testing.assert_allclose(np.asarray(resumed.params()),
+                               np.asarray(oracle.params()),
+                               rtol=1e-6, atol=1e-7)
