@@ -176,3 +176,64 @@ def test_rbm_cd_runs():
     grads = jax.grad(lambda p: layer.pretrain_loss(p, x, rng=KEY))(params)
     assert np.isfinite(float(loss))
     assert all(np.isfinite(np.asarray(g)).all() for g in grads.values())
+
+
+class TestExceptionMessages:
+    """Config-error tests (reference deeplearning4j-core exceptions suite):
+    typos must fail fast with actionable messages listing the known names."""
+
+    def test_unknown_activation_lists_known(self):
+        from deeplearning4j_tpu.ops.activations import get_activation
+        with pytest.raises(ValueError, match="relu"):
+            get_activation("rellu")
+
+    def test_unknown_loss_lists_known(self):
+        from deeplearning4j_tpu.ops.losses import get_loss
+        with pytest.raises(ValueError, match="mcxent"):
+            get_loss("mcxnet")
+
+    def test_unknown_updater(self):
+        import jax.numpy as jnp
+        from deeplearning4j_tpu.nn.updaters import UpdaterSpec, updater_init
+        with pytest.raises(ValueError, match="Unknown updater"):
+            updater_init(UpdaterSpec(name="adamw_typo"), jnp.zeros((2,)))
+
+    def test_unknown_lr_policy(self):
+        from deeplearning4j_tpu.nn.updaters import effective_lr
+        with pytest.raises(ValueError, match="Unknown lr policy"):
+            effective_lr(0.1, "cosine_typo", 0)
+
+    def test_unknown_reconstruction_distribution(self):
+        from deeplearning4j_tpu.nn.conf.layers.variational import (
+            resolve_reconstruction_distribution)
+        with pytest.raises(ValueError, match="gaussian"):
+            resolve_reconstruction_distribution("gausian")
+
+    def test_output_layer_required_for_supervised_loss(self):
+        from deeplearning4j_tpu.nn.conf.builders import NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.conf.layers import DenseLayer
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        import numpy as np
+        conf = (NeuralNetConfiguration.builder().seed(1)
+                .list()
+                .layer(DenseLayer(n_in=3, n_out=2, activation="tanh"))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        with pytest.raises(ValueError, match="no loss"):
+            net.fit(np.zeros((2, 3), np.float32), np.zeros((2, 2), np.float32))
+
+    def test_uninitialized_network_message(self):
+        from deeplearning4j_tpu.nn.conf.builders import NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        import numpy as np
+        conf = (NeuralNetConfiguration.builder().seed(1)
+                .list()
+                .layer(DenseLayer(n_in=3, n_out=2, activation="tanh"))
+                .layer(OutputLayer(n_in=2, n_out=2, loss="mse",
+                                   activation="identity"))
+                .build())
+        net = MultiLayerNetwork(conf)  # init() not called
+        with pytest.raises(RuntimeError, match="init"):
+            net.fit(np.zeros((2, 3), np.float32),
+                    np.zeros((2, 2), np.float32))
