@@ -440,7 +440,10 @@ def _bench_fns():
 
 def _child_main(args) -> None:
     """Run one benchmark in-process and print its JSON record."""
-    if not args.f32:
+    if args.bf16_act:
+        from deeplearning4j_tpu.common import full_bf16_policy
+        full_bf16_policy()
+    elif not args.f32:
         from deeplearning4j_tpu.common import bf16_matmul_policy
         bf16_matmul_policy()
 
@@ -452,7 +455,8 @@ def _child_main(args) -> None:
     vs = round(r["samples_per_sec"] / base, 3) if base else None
     import jax
     r["backend"] = jax.default_backend()
-    r["dtype"] = "f32" if args.f32 else "bf16"
+    r["dtype"] = ("bf16_act" if args.bf16_act else
+                  "f32" if args.f32 else "bf16")
     print(json.dumps({
         "metric": _METRICS[args.model],
         "value": round(r["samples_per_sec"], 2),
@@ -482,8 +486,12 @@ def main() -> None:
     ap.add_argument("--iters", type=int, default=None)
     ap.add_argument("--ksteps", type=int, default=None,
                     help="train steps fused per host dispatch")
-    ap.add_argument("--f32", action="store_true",
+    dt = ap.add_mutually_exclusive_group()
+    dt.add_argument("--f32", action="store_true",
                     help="float32 compute (default is bfloat16 matmul/conv)")
+    dt.add_argument("--bf16-act", action="store_true",
+                    help="full_bf16_policy: bfloat16 activations too (halves "
+                         "activation HBM traffic; norm stats/losses stay f32)")
     ap.add_argument("--child", action="store_true", help=argparse.SUPPRESS)
     # worst case must finish inside the harness's own command timeout
     # (round-1 artifacts show it kills at ~600s): 2 x 240s + 5s backoff < 500s

@@ -36,6 +36,31 @@ def set_policy(param_dtype=None, compute_dtype=None, output_dtype=None) -> Dtype
     return _POLICY
 
 
+def at_least_f32(dtype) -> jnp.dtype:
+    """The dtype to run precision-critical reductions (norm statistics, loss
+    entry points) in: float32 when activations flow as bf16/f16, otherwise the
+    incoming dtype unchanged (the float64 gradient-check path must not be
+    downcast)."""
+    return dtype if jnp.finfo(dtype).bits >= 32 else jnp.dtype(jnp.float32)
+
+
 def bf16_matmul_policy() -> DtypePolicy:
     """bfloat16 compute on the MXU, float32 params/outputs."""
     return set_policy(compute_dtype=jnp.bfloat16)
+
+
+def full_bf16_policy() -> DtypePolicy:
+    """bfloat16 compute AND activations; float32 params, optimizer state and
+    norm statistics.
+
+    Halves activation HBM traffic vs :func:`bf16_matmul_policy` (each layer
+    otherwise materializes its output back to float32). Precision-critical
+    reductions stay float32 regardless of this policy: batch-norm/layer-norm
+    statistics and every registered loss upcast internally (custom callable
+    losses are wrapped the same way by ``ops.losses.get_loss``), and gradients
+    follow the float32 param dtype, so updater semantics are unchanged.
+    VariationalAutoencoder's encoder/decoder matmuls use raw float32 params
+    and stay float32 under any policy; AutoEncoder/RBM route through the
+    shared dense kernel and follow the policy like every other layer.
+    """
+    return set_policy(compute_dtype=jnp.bfloat16, output_dtype=jnp.bfloat16)

@@ -16,7 +16,7 @@ import dataclasses
 import jax
 import jax.numpy as jnp
 
-from deeplearning4j_tpu.common import get_policy
+from deeplearning4j_tpu.common import at_least_f32, get_policy
 from deeplearning4j_tpu.nn.conf.inputs import InputType
 from deeplearning4j_tpu.nn.conf.layers.base import FeedForwardLayer
 from deeplearning4j_tpu.nn.conf.serde import register_config
@@ -80,7 +80,7 @@ class SelfAttentionLayer(FeedForwardLayer):
         o = o.reshape(B, T, self.n_out)
         out = jnp.matmul(o.astype(pol.compute_dtype),
                          params["Wo"].astype(pol.compute_dtype))
-        out = out.astype(pol.output_dtype) + params["b"]
+        out = out.astype(pol.output_dtype) + params["b"].astype(pol.output_dtype)
         return self.act_fn()(out), state
 
 
@@ -132,9 +132,12 @@ class TransformerBlock(FeedForwardLayer):
 
     @staticmethod
     def _ln(x, g, b, eps=1e-5):
-        mu = jnp.mean(x, axis=-1, keepdims=True)
-        var = jnp.var(x, axis=-1, keepdims=True)
-        return (x - mu) * jax.lax.rsqrt(var + eps) * g + b
+        # statistics in at least float32 even when activations flow as bf16
+        xf = x.astype(at_least_f32(x.dtype))
+        mu = jnp.mean(xf, axis=-1, keepdims=True)
+        var = jnp.var(xf, axis=-1, keepdims=True)
+        xhat = ((xf - mu) * jax.lax.rsqrt(var + eps)).astype(x.dtype)
+        return xhat * g.astype(x.dtype) + b.astype(x.dtype)
 
     def apply(self, params, state, x, *, train=False, rng=None, mask=None):
         from deeplearning4j_tpu.ops.pallas_kernels import (
@@ -161,12 +164,12 @@ class TransformerBlock(FeedForwardLayer):
         o = o.reshape(B, T, F)
         att = jnp.matmul(o.astype(pol.compute_dtype),
                          params["Wo"].astype(pol.compute_dtype))
-        x = x + att.astype(pol.output_dtype) + params["bo"]
+        x = x + att.astype(pol.output_dtype) + params["bo"].astype(pol.output_dtype)
         h = self._ln(x, params["ln2_g"], params["ln2_b"])
         h = jnp.matmul(h.astype(pol.compute_dtype),
                        params["W1"].astype(pol.compute_dtype))
-        h = jax.nn.gelu(h.astype(pol.output_dtype) + params["b1"])
+        h = jax.nn.gelu(h.astype(pol.output_dtype) + params["b1"].astype(pol.output_dtype))
         h = self.apply_dropout(h, rng, train)
         h = jnp.matmul(h.astype(pol.compute_dtype),
                        params["W2"].astype(pol.compute_dtype))
-        return x + h.astype(pol.output_dtype) + params["b2"], state
+        return x + h.astype(pol.output_dtype) + params["b2"].astype(pol.output_dtype), state

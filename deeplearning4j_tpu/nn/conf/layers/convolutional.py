@@ -86,7 +86,7 @@ class ConvolutionLayer(Layer):
             dimension_numbers=("NHWC", "HWIO", "NHWC"),
         ).astype(pol.output_dtype)
         if self.has_bias:
-            out = out + params["b"]
+            out = out + params["b"].astype(out.dtype)
         return self.act_fn()(out), state
 
     def output_type(self, itype: InputType) -> InputType:

@@ -140,7 +140,8 @@ class EmbeddingLayer(FeedForwardLayer):
             idx = x.astype(jnp.int32)
             if idx.ndim > 1 and idx.shape[-1] == 1:
                 idx = idx[..., 0]
-        emb = params["W"][idx] + params["b"]
+        pol = get_policy()
+        emb = (params["W"][idx] + params["b"]).astype(pol.output_dtype)
         return self.act_fn()(emb), state
 
 

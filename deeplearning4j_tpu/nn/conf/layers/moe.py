@@ -18,6 +18,7 @@ import dataclasses
 import jax
 import jax.numpy as jnp
 
+from deeplearning4j_tpu.common import get_policy
 from deeplearning4j_tpu.nn.conf.inputs import InputType
 from deeplearning4j_tpu.nn.conf.layers.base import FeedForwardLayer
 from deeplearning4j_tpu.nn.conf.serde import register_config
@@ -71,23 +72,33 @@ class MoELayer(FeedForwardLayer):
 
     def expert_ffn(self, params, buf):
         """Apply every expert to its token buffer: buf [E, C, F] -> [E, C, F]."""
-        h = jnp.einsum("ecf,efh->ech", buf, params["W1"]) + params["b1"][:, None]
+        pol = get_policy()
+        h = (jnp.einsum("ecf,efh->ech", buf.astype(pol.compute_dtype),
+                        params["W1"].astype(pol.compute_dtype))
+             .astype(pol.output_dtype) + params["b1"][:, None].astype(pol.output_dtype))
         h = jax.nn.relu(h)
-        return (jnp.einsum("ech,ehf->ecf", h, params["W2"])
-                + params["b2"][:, None])
+        return (jnp.einsum("ech,ehf->ecf", h.astype(pol.compute_dtype),
+                           params["W2"].astype(pol.compute_dtype))
+                .astype(pol.output_dtype)
+                + params["b2"][:, None].astype(pol.output_dtype))
 
     def apply(self, params, state, x, *, train=False, rng=None, mask=None):
         shape = x.shape
         F = shape[-1]
         x2d = x.reshape(-1, F)
+        pol = get_policy()
         eidx, gate, _ = self.route(params, x2d, train=train, rng=rng)
         # dense evaluation: every expert on every token, select by routing
-        h = jnp.einsum("sf,efh->esh", x2d, params["W1"]) + params["b1"][:, None]
+        h = (jnp.einsum("sf,efh->esh", x2d.astype(pol.compute_dtype),
+                        params["W1"].astype(pol.compute_dtype))
+             .astype(pol.output_dtype) + params["b1"][:, None].astype(pol.output_dtype))
         h = jax.nn.relu(h)
-        y_all = (jnp.einsum("esh,ehf->esf", h, params["W2"])
-                 + params["b2"][:, None])                    # [E, S, F]
-        sel = jax.nn.one_hot(eidx, self.n_experts, dtype=x2d.dtype)  # [S, E]
-        y = jnp.einsum("se,esf->sf", sel, y_all) * gate[:, None]
+        y_all = (jnp.einsum("esh,ehf->esf", h.astype(pol.compute_dtype),
+                            params["W2"].astype(pol.compute_dtype))
+                 .astype(pol.output_dtype)
+                 + params["b2"][:, None].astype(pol.output_dtype))  # [E, S, F]
+        sel = jax.nn.one_hot(eidx, self.n_experts, dtype=y_all.dtype)  # [S, E]
+        y = jnp.einsum("se,esf->sf", sel, y_all) * gate[:, None].astype(y_all.dtype)
         return self.act_fn()(y.reshape(shape)), state
 
     def load_balance_loss(self, params, x2d) -> jax.Array:

@@ -17,6 +17,7 @@ import dataclasses
 import jax
 import jax.numpy as jnp
 
+from deeplearning4j_tpu.common import at_least_f32
 from deeplearning4j_tpu.nn.conf.inputs import InputType
 from deeplearning4j_tpu.nn.conf.layers.base import Layer
 from deeplearning4j_tpu.nn.conf.serde import register_config
@@ -55,9 +56,15 @@ class BatchNormalization(Layer):
 
     def apply(self, params, state, x, *, train=False, rng=None, mask=None):
         axes = tuple(range(x.ndim - 1))
+        # statistics in at least float32: under the full-bf16 activation
+        # policy x arrives as bfloat16, and mean/var of many small values is
+        # exactly where bf16's 8-bit mantissa loses training accuracy (the
+        # float64 gradient-check path flows through undowncast)
+        stat_dtype = at_least_f32(x.dtype)
         if train:
-            mean = jnp.mean(x, axis=axes)
-            var = jnp.var(x, axis=axes)
+            xf = x.astype(stat_dtype)
+            mean = jnp.mean(xf, axis=axes)
+            var = jnp.var(xf, axis=axes)
             new_state = {
                 "mean": self.decay * state["mean"] + (1 - self.decay) * mean,
                 "var": self.decay * state["var"] + (1 - self.decay) * var,
@@ -66,11 +73,11 @@ class BatchNormalization(Layer):
             mean, var = state["mean"], state["var"]
             new_state = state
         inv = jax.lax.rsqrt(var + self.eps)
-        xhat = (x - mean) * inv
+        xhat = ((x.astype(stat_dtype) - mean) * inv).astype(x.dtype)
         if self.lock_gamma_beta:
-            out = self.gamma * xhat + self.beta
+            out = jnp.asarray(self.gamma, x.dtype) * xhat + jnp.asarray(self.beta, x.dtype)
         else:
-            out = params["gamma"] * xhat + params["beta"]
+            out = params["gamma"].astype(x.dtype) * xhat + params["beta"].astype(x.dtype)
         return self.act_fn()(out), new_state
 
 

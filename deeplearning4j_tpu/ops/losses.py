@@ -163,10 +163,30 @@ LOSSES: dict[str, Callable] = {
 }
 
 
+def _f32_entry(fn: Callable) -> Callable:
+    """Losses compute in at least float32. Under the full-bf16 activation
+    policy the network hands the output layer bfloat16 pre-activations;
+    log/exp/div in the loss are where reduced precision actually hurts (and
+    the upcast is one elementwise op on (B, C) logits — free next to the
+    savings upstream). Never downcasts: the float64 gradient-check path
+    (nn/gradientcheck.py) flows through unchanged."""
+    from deeplearning4j_tpu.common import at_least_f32
+
+    def _upcast(a: Array) -> Array:
+        if jnp.issubdtype(a.dtype, jnp.floating):
+            return a.astype(at_least_f32(a.dtype))
+        return a
+
+    def wrapped(labels, preout, activation, mask=None):
+        return fn(_upcast(jnp.asarray(labels)),
+                  _upcast(jnp.asarray(preout)), activation, mask)
+    return wrapped
+
+
 def get_loss(name) -> Callable:
     if callable(name):
-        return name
+        return _f32_entry(name)
     key = str(name).lower()
     if key not in LOSSES:
         raise ValueError(f"Unknown loss '{name}'. Known: {sorted(LOSSES)}")
-    return LOSSES[key]
+    return _f32_entry(LOSSES[key])
