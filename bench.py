@@ -19,7 +19,9 @@ TPU-first measurement methodology:
    197e12 = TPU v5e).
 
 Usage: python bench.py [--model lenet|resnet50|char_rnn|transformer|word2vec]
-                       [--batch N] [--iters N] [--ksteps K] [--f32]
+                       [--batch N] [--iters N] [--ksteps K]
+                       [--f32 | --bf16-act]   (default: bf16 matmul, f32
+                       activations; --bf16-act keeps activations bf16 too)
 """
 from __future__ import annotations
 

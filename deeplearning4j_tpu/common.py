@@ -7,6 +7,7 @@ semantics) while matmul/conv compute may run in ``compute_dtype`` (bfloat16 on t
 """
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 
 import jax.numpy as jnp
@@ -24,6 +25,27 @@ _POLICY = DtypePolicy()
 
 def get_policy() -> DtypePolicy:
     return _POLICY
+
+
+def policy_key() -> tuple:
+    """Hashable identity of the active policy. Networks key their compiled-
+    program caches on this: the policy is read at trace time, so a cached
+    program silently pins whatever policy was active at first call unless the
+    cache key includes it."""
+    return (jnp.dtype(_POLICY.param_dtype).name,
+            jnp.dtype(_POLICY.compute_dtype).name,
+            jnp.dtype(_POLICY.output_dtype).name)
+
+
+def effective_policy_key(conf_dtype: str | None) -> tuple:
+    """The cache key under which a traced program's dtypes are decided.
+
+    A config-declared dtype (GlobalConf.dtype, applied via wrap_with_policy)
+    pins the program regardless of the ambient global policy — so such
+    programs must NOT be invalidated or re-keyed when the global policy
+    changes. Every compiled-program cache in the framework keys on this one
+    helper so the rule can't diverge between sites."""
+    return (conf_dtype,) if conf_dtype else (None,) + policy_key()
 
 
 def set_policy(param_dtype=None, compute_dtype=None, output_dtype=None) -> DtypePolicy:
@@ -47,6 +69,49 @@ def at_least_f32(dtype) -> jnp.dtype:
 def bf16_matmul_policy() -> DtypePolicy:
     """bfloat16 compute on the MXU, float32 params/outputs."""
     return set_policy(compute_dtype=jnp.bfloat16)
+
+
+_NAMED_POLICIES = {
+    "float32": DtypePolicy(),
+    "bfloat16": DtypePolicy(compute_dtype=jnp.bfloat16),
+    "bfloat16_full": DtypePolicy(compute_dtype=jnp.bfloat16,
+                                 output_dtype=jnp.bfloat16),
+}
+
+
+def resolve_policy(name: str) -> DtypePolicy:
+    """Named policy for the config DSL's ``dtype`` field."""
+    key = str(name).lower()
+    if key not in _NAMED_POLICIES:
+        raise ValueError(f"Unknown dtype policy '{name}'. "
+                         f"Known: {sorted(_NAMED_POLICIES)}")
+    return _NAMED_POLICIES[key]
+
+
+@contextlib.contextmanager
+def override_policy(name: str):
+    """Temporarily install a named policy. Wrapped around function BODIES that
+    jit traces (wrap_with_policy): tracing runs the body under the override,
+    baking the dtype into the compiled program; execution never re-enters the
+    Python body, so the global policy is untouched at run time."""
+    global _POLICY
+    saved = _POLICY
+    _POLICY = resolve_policy(name)
+    try:
+        yield
+    finally:
+        _POLICY = saved
+
+
+def wrap_with_policy(fn, name: str | None):
+    """Make ``fn`` trace under the named policy (no-op when name is None)."""
+    if not name:
+        return fn
+
+    def wrapped(*args, **kwargs):
+        with override_policy(name):
+            return fn(*args, **kwargs)
+    return wrapped
 
 
 def full_bf16_policy() -> DtypePolicy:

@@ -69,6 +69,19 @@ class GlobalConf:
     #: lever for deep/long-sequence models (no reference equivalent; the
     #: JVM runtime keeps all activations)
     gradient_checkpointing: bool = False
+    #: per-network dtype policy, serialized with the config (the reference's
+    #: one global Nd4j data type, made declarative): None -> whatever global
+    #: policy is active (common.set_policy); "float32"; "bfloat16" (bf16
+    #: matmul/conv, f32 activations); "bfloat16_full" (bf16 activations too,
+    #: f32 params/norm-stats/losses — common.full_bf16_policy semantics)
+    dtype: Optional[str] = None
+
+
+def validate_global_conf(g: GlobalConf) -> None:
+    """Fail fast on config-string typos at build time, not first trace."""
+    if g.dtype is not None:
+        from deeplearning4j_tpu import common
+        common.resolve_policy(g.dtype)  # raises ValueError with known names
 
 
 _LAYER_INHERIT_FIELDS = (
@@ -194,6 +207,7 @@ class ListBuilder:
     def build(self):
         from deeplearning4j_tpu.nn.conf.multilayer import MultiLayerConfiguration
 
+        validate_global_conf(self._g)
         for layer in self._layers:
             bake_layer_defaults(layer, self._g)
 

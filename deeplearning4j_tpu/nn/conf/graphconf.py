@@ -132,6 +132,8 @@ class GraphBuilder:
         return self
 
     def build(self) -> ComputationGraphConfiguration:
+        from deeplearning4j_tpu.nn.conf.builders import validate_global_conf
+        validate_global_conf(self._g)
         conf = ComputationGraphConfiguration(
             global_conf=self._g,
             vertices=self._vertices,

@@ -96,10 +96,13 @@ class LocalResponseNormalization(Layer):
         return ()
 
     def apply(self, params, state, x, *, train=False, rng=None, mask=None):
-        # x is NHWC; sum x^2 over a window of n adjacent channels
+        # x is NHWC; sum x^2 over a window of n adjacent channels. Like the
+        # other norms, the square/sum/power statistics run in at least f32
+        # under the bf16 activation policy.
         half = self.n // 2
-        sq = x * x
+        xf = x.astype(at_least_f32(x.dtype))
+        sq = xf * xf
         padded = jnp.pad(sq, ((0, 0),) * (x.ndim - 1) + ((half, half),))
         windowed = sum(padded[..., i:i + x.shape[-1]] for i in range(self.n))
         denom = (self.k + self.alpha * windowed) ** self.beta
-        return x / denom, state
+        return (xf / denom).astype(x.dtype), state

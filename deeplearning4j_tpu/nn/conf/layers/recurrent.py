@@ -51,14 +51,16 @@ def _lstm_scan(params: dict, x: Array, act, gate_act, h0: Array, c0: Array,
         z = xw_t + jnp.matmul(h.astype(pol.compute_dtype), rw)
         zi, zf, zg, zo = jnp.split(z.astype(pol.output_dtype), 4, axis=-1)
         if peephole:
-            zi = zi + c * params["pI"]
-            zf = zf + c * params["pF"]
+            # cast peephole params to the gate dtype: a silent bf16*f32
+            # promotion here would flip the scan carry dtype mid-trace
+            zi = zi + c * params["pI"].astype(zi.dtype)
+            zf = zf + c * params["pF"].astype(zf.dtype)
         i = gate_act(zi)
         f = gate_act(zf)
         g = act(zg)
         c_new = f * c + i * g
         if peephole:
-            zo = zo + c_new * params["pO"]
+            zo = zo + c_new * params["pO"].astype(zo.dtype)
         o = gate_act(zo)
         h_new = o * act(c_new)
         if m_t is not None:

@@ -18,6 +18,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from deeplearning4j_tpu import common
 from deeplearning4j_tpu.nn.conf.graphconf import ComputationGraphConfiguration
 from deeplearning4j_tpu.nn.conf.vertices import LayerVertex
 from deeplearning4j_tpu.nn.multilayer import LazyScore, _updater_spec
@@ -180,7 +181,8 @@ def make_graph_train_step(conf: ComputationGraphConfiguration):
                                                    upd_state, iteration)
         return new_params, new_states, new_upd, loss
 
-    return train_step
+    # a config-declared dtype policy is baked in at trace time (GlobalConf.dtype)
+    return common.wrap_with_policy(train_step, conf.global_conf.dtype)
 
 
 def _is_streaming_lstm(vertex) -> bool:
@@ -284,7 +286,7 @@ def make_graph_tbptt_step(conf: ComputationGraphConfiguration):
                                                    upd_state, iteration)
         return new_params, new_states, new_upd, new_rnn, loss
 
-    return tbptt_step
+    return common.wrap_with_policy(tbptt_step, conf.global_conf.dtype)
 
 
 def make_graph_multistep_train_step(conf: ComputationGraphConfiguration):
@@ -373,12 +375,6 @@ class ComputationGraph(LazyScore):
         return num_params(self.params_list)
 
     # ------------------------------------------------------------------ inference
-    def _jit(self, name, fn, donate=None):
-        if name not in self._jit_cache:
-            self._jit_cache[name] = (jax.jit(fn, donate_argnums=donate)
-                                     if donate else jax.jit(fn))
-        return self._jit_cache[name]
-
     def output(self, *inputs) -> list:
         """Forward pass returning all network outputs (reference output:1520)."""
         xs = [jnp.asarray(x) for x in inputs]

@@ -25,6 +25,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from deeplearning4j_tpu import common
 from deeplearning4j_tpu.nn.conf.multilayer import MultiLayerConfiguration
 from deeplearning4j_tpu.nn.conf.layers.base import PretrainLayer
 from deeplearning4j_tpu.nn.conf.layers.recurrent import LSTM
@@ -171,7 +172,8 @@ def make_train_step(conf: MultiLayerConfiguration):
             new_upd.append(u_new)
         return new_params, new_states, new_upd, loss
 
-    return train_step
+    # a config-declared dtype policy is baked in at trace time (GlobalConf.dtype)
+    return common.wrap_with_policy(train_step, g.dtype)
 
 
 def make_multistep_train_step(conf: MultiLayerConfiguration):
@@ -233,6 +235,23 @@ class LazyScore:
     @score_value.setter
     def score_value(self, value) -> None:
         self._score_raw = value
+
+    def _jit(self, name, fn, donate=None):
+        """Per-network compiled-program cache, keyed on the program name AND
+        the active dtype policy: the policy is read at trace time, so a
+        name-only key would silently pin the policy active at first call.
+        A config-declared ``dtype`` (GlobalConf.dtype) overrides the global
+        policy for this network's programs."""
+        if not hasattr(self, "_jit_cache"):
+            self._jit_cache = {}
+        conf_dtype = getattr(getattr(getattr(self, "conf", None),
+                                     "global_conf", None), "dtype", None)
+        fn = common.wrap_with_policy(fn, conf_dtype)
+        key = (name,) + common.effective_policy_key(conf_dtype)
+        if key not in self._jit_cache:
+            self._jit_cache[key] = (jax.jit(fn, donate_argnums=donate)
+                                    if donate else jax.jit(fn))
+        return self._jit_cache[key]
 
 
 class MultiLayerNetwork(LazyScore):
@@ -296,12 +315,6 @@ class MultiLayerNetwork(LazyScore):
         return num_params(self.params_list)
 
     # ------------------------------------------------------------------ inference
-    def _jit(self, name, fn, donate=None):
-        if name not in self._jit_cache:
-            self._jit_cache[name] = (jax.jit(fn, donate_argnums=donate)
-                                     if donate else jax.jit(fn))
-        return self._jit_cache[name]
-
     def output(self, x, train: bool = False) -> Array:
         """Forward pass returning final activations (reference output:2061)."""
         x = jnp.asarray(x)
@@ -748,7 +761,7 @@ def make_tbptt_step(conf: MultiLayerConfiguration):
             new_upd.append(u_new)
         return new_params, state_list, new_upd, new_rnn, loss
 
-    return tbptt_step
+    return common.wrap_with_policy(tbptt_step, g.dtype)
 
 
 def make_pretrain_step(conf: MultiLayerConfiguration, layer_idx: int):
@@ -790,4 +803,4 @@ def make_pretrain_step(conf: MultiLayerConfiguration, layer_idx: int):
             u_new[name] = ustate
         return p_new, u_new, loss
 
-    return pretrain_step
+    return common.wrap_with_policy(pretrain_step, g.dtype)

@@ -35,12 +35,16 @@ def _moe_local(router_params, expert_params, x, *, layer, axis_name: str,
     x2d = x.reshape(S, F)
 
     eidx, gate, _ = layer.route(router_params, x2d)
-    sel = jax.nn.one_hot(eidx, E, dtype=x2d.dtype)              # [S, E]
+    # routing/position arithmetic is exact int32/float32 bookkeeping: under
+    # the full-bf16 activation policy x2d.dtype can only count to 256 before
+    # cumsum slots collide and tokens silently overwrite each other
+    sel = jax.nn.one_hot(eidx, E, dtype=jnp.float32)            # [S, E]
     # position of each token within its expert's capacity buffer
     pos = (jnp.cumsum(sel, axis=0) - 1.0) * sel                 # [S, E]
     in_cap = sel * (pos < capacity)
-    pos_oh = jax.nn.one_hot(pos.astype(jnp.int32), capacity,
-                            dtype=x2d.dtype) * in_cap[..., None]  # [S, E, C]
+    pos_oh = (jax.nn.one_hot(pos.astype(jnp.int32), capacity,
+                             dtype=jnp.float32)
+              * in_cap[..., None]).astype(x2d.dtype)            # [S, E, C]
     # pack: [E, C, F] buffers of this shard's tokens per destination expert
     buf = jnp.einsum("sec,sf->ecf", pos_oh, x2d)
     # exchange: every device gets its experts' buffers from every shard.
@@ -56,8 +60,9 @@ def _moe_local(router_params, expert_params, x, *, layer, axis_name: str,
                          tiled=False)                           # [N=E grouping back]
     out = out.reshape(E, capacity, F)
     # combine: gather each token's result from its (expert, slot) and gate it
-    y = jnp.einsum("sec,ecf->sf", pos_oh, out) * gate[:, None]
-    return y.reshape(Bl, T, F)
+    # (gate cast so the f32 router bookkeeping can't promote the activations)
+    y = jnp.einsum("sec,ecf->sf", pos_oh, out) * gate[:, None].astype(out.dtype)
+    return y.astype(x2d.dtype).reshape(Bl, T, F)
 
 
 class ExpertParallelMoE:

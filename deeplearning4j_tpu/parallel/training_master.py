@@ -27,6 +27,8 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from jax import shard_map
 
+from deeplearning4j_tpu import common
+
 from deeplearning4j_tpu.parallel.mesh import data_parallel_mesh
 
 
@@ -158,7 +160,11 @@ class ParameterAveragingTrainingMaster(TrainingMaster):
         from deeplearning4j_tpu.nn.graph_network import ComputationGraph, make_graph_train_step
         from deeplearning4j_tpu.nn.multilayer import make_train_step
 
-        key = id(model.conf)
+        # keyed on the effective dtype policy: a conf-declared dtype pins the
+        # program (make_*_train_step wraps it), so only unpinned programs are
+        # re-keyed when the global policy changes
+        key = (id(model.conf),) + common.effective_policy_key(
+            getattr(model.conf.global_conf, "dtype", None))
         if key in self._local_fns:
             return self._local_fns[key]
         mesh = self.mesh
@@ -294,7 +300,10 @@ class DistributedMultiLayer:
     def __init__(self, model, training_master: TrainingMaster):
         self.model = model
         self.master = training_master
-        self._eval_fwd = None  # jitted sharded forward, built on first use
+        # jitted sharded forward, built on first use and rebuilt on dtype-
+        # policy change (the policy is read at trace time)
+        self._eval_fwd = None
+        self._eval_fwd_policy = None
 
     def fit(self, data, epochs: int = 1):
         for _ in range(epochs):
@@ -314,17 +323,19 @@ class DistributedMultiLayer:
 
         n = mesh.shape["data"]
         net = self.model
-        if self._eval_fwd is None:  # jit caches by fn identity: build once
+        conf_dtype = getattr(net.conf.global_conf, "dtype", None)
+        eff = common.effective_policy_key(conf_dtype)
+        if self._eval_fwd is None or self._eval_fwd_policy != eff:
+            self._eval_fwd_policy = eff
             repl = NamedSharding(mesh, P())
             batch_sh = NamedSharding(mesh, P("data"))
             if isinstance(net, MultiLayerNetwork):
-                self._eval_fwd = jax.jit(
-                    lambda p, s, x: net._output_pure(p, s, x, train=False)[0],
-                    in_shardings=(repl, repl, batch_sh))
+                fwd_py = lambda p, s, x: net._output_pure(p, s, x, train=False)[0]
             else:
-                self._eval_fwd = jax.jit(
-                    lambda p, s, x: net._output_pure(p, s, [x])[0][0],
-                    in_shardings=(repl, repl, batch_sh))
+                fwd_py = lambda p, s, x: net._output_pure(p, s, [x])[0][0]
+            # a conf-declared dtype pins this program like LazyScore._jit does
+            self._eval_fwd = jax.jit(common.wrap_with_policy(fwd_py, conf_dtype),
+                                     in_shardings=(repl, repl, batch_sh))
         fwd = self._eval_fwd
         params, states = net.params_list, net.state_list
         e = Evaluation()

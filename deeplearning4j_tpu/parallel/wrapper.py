@@ -97,6 +97,18 @@ class ParallelWrapper:
         self._local_step = None
         self._avg_fn = None
         self._local = None  # stacked per-replica (params, states, upd) for local-SGD
+        # dtype policy the cached jitted programs were traced under; they are
+        # rebuilt when it changes (the policy is read at trace time)
+        self._traced_policy = None
+
+    def _drop_stale_programs(self) -> None:
+        from deeplearning4j_tpu import common
+        eff = common.effective_policy_key(
+            getattr(self.model.conf.global_conf, "dtype", None))
+        if self._traced_policy != eff:
+            self._traced_policy = eff
+            self._sync_step = self._sync_multi = None
+            self._local_step = self._avg_fn = None
 
     @staticmethod
     def builder(model) -> ParallelWrapperBuilder:
@@ -178,6 +190,7 @@ class ParallelWrapper:
 
     def _fit_sync(self, iterator, epochs: int) -> None:
         net = self.model
+        self._drop_stale_programs()
         if self._sync_step is None:
             self._sync_step = self._make_sync_step()
             self._sync_multi = self._make_sync_multistep()
@@ -321,6 +334,7 @@ class ParallelWrapper:
     def _fit_local_sgd(self, iterator, epochs: int) -> None:
         net = self.model
         D = self.n_workers
+        self._drop_stale_programs()
         if self._local_step is None:
             self._local_step, self._avg_fn = self._make_local_sgd_fns()
         stack = functools.partial(

@@ -74,6 +74,70 @@ def test_full_bf16_batchnorm_state_stays_f32():
     assert float(jnp.abs(bn_state["mean"]).sum()) > 0
 
 
+def test_conf_declared_dtype_overrides_global_policy():
+    """GlobalConf.dtype pins the network's programs to a named policy
+    regardless of the ambient global policy, and serializes with the config
+    (the declarative equivalent of the reference's one global Nd4j dtype)."""
+    from deeplearning4j_tpu.nn.conf.builders import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.conf.multilayer import MultiLayerConfiguration
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    conf = (NeuralNetConfiguration.builder().seed(3).dtype("bfloat16_full")
+            .list()
+            .layer(DenseLayer(n_in=6, n_out=8, activation="relu"))
+            .layer(OutputLayer(n_in=8, n_out=4, activation="softmax",
+                               loss="mcxent"))
+            .build())
+    # survives JSON round-trip
+    conf2 = MultiLayerConfiguration.from_json(conf.to_json())
+    assert conf2.global_conf.dtype == "bfloat16_full"
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(8, 6)).astype(np.float32)
+    y = np.zeros((8, 4), np.float32)
+    y[np.arange(8), rng.integers(0, 4, 8)] = 1
+
+    net = MultiLayerNetwork(conf2).init()
+    # ambient policy is f32; the conf-declared policy must win
+    assert net.output(x).dtype == jnp.bfloat16
+    l0 = net.score(x, y)
+    for _ in range(5):
+        net.fit(x, y)
+    assert net.score(x, y) < l0
+    assert all(p.dtype == jnp.float32 for p in jax.tree.leaves(net.params_list))
+
+    # typos fail fast at build time, not at first trace
+    with pytest.raises(ValueError, match="Unknown dtype policy"):
+        (NeuralNetConfiguration.builder().dtype("bf16").list()
+         .layer(OutputLayer(n_in=2, n_out=2, loss="mse",
+                            activation="identity")).build())
+
+
+def test_peephole_lstm_trains_under_full_bf16():
+    """GravesLSTM's peephole terms must not promote the scan carry dtype
+    (bf16 carry + f32 peephole params would crash lax.scan at trace time)."""
+    from deeplearning4j_tpu.nn.conf.builders import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf.layers import GravesLSTM, RnnOutputLayer
+
+    C.full_bf16_policy()
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    conf = (NeuralNetConfiguration.builder().seed(5).list()
+            .layer(GravesLSTM(n_in=6, n_out=8, activation="tanh"))
+            .layer(RnnOutputLayer(n_in=8, n_out=6, loss="mcxent",
+                                  activation="softmax"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, 6, (4, 10))
+    x = np.eye(6, dtype=np.float32)[ids]
+    l0 = net.score(x, x)
+    for _ in range(4):
+        net.fit(x, x)
+    assert net.score(x, x) < l0
+    assert net.output(x).dtype == jnp.bfloat16
+
+
 def test_full_bf16_forward_close_to_f32():
     """Same params, same input: bf16-activation forward stays within bf16
     tolerance of the f32 forward (the two programs compute the same math)."""
@@ -89,8 +153,9 @@ def test_full_bf16_forward_close_to_f32():
                        max_len=16)).init()
     ref = np.asarray(net.output(x), np.float32)
 
+    # switching the policy must retrace automatically (jit cache is keyed on
+    # the active policy, not just the program name)
     C.full_bf16_policy()
-    net._jit_cache = {}  # policy is read at trace time; drop stale programs
     got = np.asarray(net.output(x), np.float32)
     assert np.allclose(ref, got, atol=0.05, rtol=0.05), (
         np.abs(ref - got).max())

@@ -291,6 +291,59 @@ class TestGradientCheckLRN:
                                subset=60, verbose=True)
 
 
+def _labels_for(loss: str, n: int, c: int, seed: int = 11) -> np.ndarray:
+    """Valid-label generator per loss family (reference
+    LossFunctionGradientCheck.java builds exactly such a table: each
+    ILossFunction gets labels from its domain)."""
+    rng = np.random.default_rng(seed)
+    if loss in ("mcxent", "negativeloglikelihood", "kl_divergence"):
+        p = rng.uniform(0.1, 1.0, size=(n, c))
+        return (p / p.sum(axis=1, keepdims=True)).astype(np.float32)
+    if loss in ("xent", "reconstruction_crossentropy"):
+        return (rng.uniform(size=(n, c)) > 0.5).astype(np.float32)
+    if loss in ("hinge", "squared_hinge"):
+        return (2 * (rng.uniform(size=(n, c)) > 0.5) - 1).astype(np.float32)
+    if loss == "poisson":
+        return rng.integers(0, 4, size=(n, c)).astype(np.float32)
+    if loss in ("mape",):
+        return rng.uniform(0.5, 2.0, size=(n, c)).astype(np.float32)
+    if loss in ("msle",):
+        return rng.uniform(0.0, 2.0, size=(n, c)).astype(np.float32)
+    return rng.normal(size=(n, c)).astype(np.float32)
+
+
+class TestLossFunctionGradientCheck:
+    """Every loss x compatible output activation, numeric vs analytic
+    (reference gradientcheck/LossFunctionGradientCheck.java — the full
+    ILossFunction battery)."""
+
+    CASES = [
+        ("mse", "identity"), ("mse", "tanh"),
+        ("l2", "identity"),
+        ("mae", "identity"),
+        ("l1", "identity"),
+        ("mape", "sigmoid"),
+        ("msle", "softplus"),
+        ("mcxent", "softmax"),
+        ("negativeloglikelihood", "softmax"),
+        ("xent", "sigmoid"),
+        ("reconstruction_crossentropy", "sigmoid"),
+        ("hinge", "identity"),
+        ("squared_hinge", "identity"),
+        ("kl_divergence", "softmax"),
+        ("poisson", "softplus"),
+        ("cosine_proximity", "identity"),
+    ]
+
+    @pytest.mark.parametrize("loss,act", CASES,
+                             ids=[f"{l}-{a}" for l, a in CASES])
+    def test_loss_gradients(self, loss, act):
+        net = build([DenseLayer(n_in=4, n_out=6, activation="tanh"),
+                     OutputLayer(n_in=6, n_out=3, loss=loss, activation=act)])
+        y = _labels_for(loss, 5, 3)
+        assert check_gradients(net, rand((5, 4)), y, verbose=True)
+
+
 class TestGradientCheckpointing:
     """jax.checkpoint remat (gradient_checkpointing conf flag) must be
     gradient-invisible: identical loss and gradients, only memory/FLOPs
