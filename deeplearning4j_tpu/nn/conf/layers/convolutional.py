@@ -93,9 +93,11 @@ class ConvolutionLayer(Layer):
         kh, kw = _pair(self.kernel_size)
         sh, sw = _pair(self.stride)
         ph, pw = _pair(self.padding)
+        dh, dw = _pair(self.dilation)
         mode = self.convolution_mode.lower()
-        h = _out_dim(itype.height, kh, sh, ph, mode)
-        w = _out_dim(itype.width, kw, sw, pw, mode)
+        # effective kernel size under dilation, matching XLA's rhs_dilation
+        h = _out_dim(itype.height, (kh - 1) * dh + 1, sh, ph, mode)
+        w = _out_dim(itype.width, (kw - 1) * dw + 1, sw, pw, mode)
         return InputType.convolutional(h, w, self.n_out)
 
 

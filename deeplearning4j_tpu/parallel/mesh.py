@@ -43,6 +43,41 @@ def build_mesh(axes: dict[str, int], devices: Optional[Sequence] = None) -> Mesh
     return Mesh(arr, tuple(axes.keys()))
 
 
+def build_hybrid_mesh(ici_axes: dict[str, int],
+                      dcn_axes: dict[str, int]) -> Mesh:
+    """Multi-slice mesh: per-axis size = ici_size * dcn_size, with device
+    placement chosen so the ``dcn_axes`` multiplier spans slices (DCN) and the
+    ``ici_axes`` factor stays inside a slice (ICI).
+
+    This encodes the scaling rule the reference never needed (its Spark tree-
+    aggregate treated all links alike, SURVEY.md §2.4): collective-heavy axes
+    (tensor/sequence parallel) must ride ICI, so give them dcn multiplier 1;
+    bandwidth-light axes (data parallel gradient all-reduce, expert all_to_all
+    at low frequency) may span slices. Keys of ``dcn_axes`` must be a subset
+    of ``ici_axes`` (missing keys mean multiplier 1).
+
+    On a single slice/process (including the CPU test mesh) this degrades to
+    a plain mesh with the same axis names and product sizes, so code written
+    against it runs unchanged from one chip to multi-slice.
+    """
+    dcn = {k: int(dcn_axes.get(k, 1)) for k in ici_axes}
+    unknown = set(dcn_axes) - set(ici_axes)
+    if unknown:
+        raise ValueError(f"dcn_axes {sorted(unknown)} not present in ici_axes "
+                         f"{sorted(ici_axes)}")
+    n_slices = len({getattr(d, "slice_index", 0) for d in jax.devices()})
+    if n_slices > 1 and any(v > 1 for v in dcn.values()):
+        # real multi-slice topology: misconfigurations must raise loudly —
+        # a silent fallback here could lay a collective-heavy axis across
+        # DCN, the exact failure this helper exists to prevent
+        from jax.experimental import mesh_utils
+        devs = mesh_utils.create_hybrid_device_mesh(
+            mesh_shape=tuple(ici_axes.values()),
+            dcn_mesh_shape=tuple(dcn.values()))
+        return Mesh(devs, tuple(ici_axes.keys()))
+    return build_mesh({k: ici_axes[k] * dcn[k] for k in ici_axes})
+
+
 def data_parallel_mesh(n: Optional[int] = None,
                        devices: Optional[Sequence] = None) -> Mesh:
     devices = list(devices if devices is not None else jax.devices())

@@ -88,6 +88,45 @@ class TestGradientCheckCNN:
                      OutputLayer(n_in=6, n_out=3, loss="mcxent", activation="softmax")])
         assert check_gradients(net, rand((8, 4)), onehot(8, 3), subset=40)
 
+    @pytest.mark.parametrize("mode", ["same", "truncate"])
+    def test_convolution_modes(self, mode):
+        """ConvolutionMode parity (reference CNNGradientCheckTest runs the
+        battery per mode; nn/conf/ConvolutionMode.java)."""
+        net = build([ConvolutionLayer(n_out=3, kernel_size=(3, 3),
+                                      stride=(2, 2), convolution_mode=mode,
+                                      activation="tanh"),
+                     DenseLayer(n_out=6, activation="relu"),
+                     OutputLayer(n_out=2, loss="mcxent",
+                                 activation="softmax")],
+                    input_type=InputType.convolutional(7, 7, 2))
+        assert check_gradients(net, rand((3, 7, 7, 2)), onehot(3, 2),
+                               subset=60, verbose=True)
+
+    @pytest.mark.parametrize("pooling", ["max", "avg", "pnorm"])
+    def test_pooling_types(self, pooling):
+        """All reference PoolingTypes backprop correctly through
+        lax.reduce_window (reference SubsamplingLayer pooling battery)."""
+        net = build([ConvolutionLayer(n_out=2, kernel_size=(2, 2),
+                                      stride=(1, 1), activation="tanh"),
+                     SubsamplingLayer(pooling_type=pooling, kernel_size=(2, 2),
+                                      stride=(2, 2), pnorm=2),
+                     OutputLayer(n_out=2, loss="mcxent",
+                                 activation="softmax")],
+                    input_type=InputType.convolutional(5, 5, 1))
+        assert check_gradients(net, rand((3, 5, 5, 1)), onehot(3, 2),
+                               subset=60, verbose=True)
+
+    def test_dilated_convolution(self):
+        net = build([ConvolutionLayer(n_out=3, kernel_size=(2, 2),
+                                      stride=(1, 1), dilation=(2, 2),
+                                      activation="tanh"),
+                     DenseLayer(n_out=6, activation="relu"),
+                     OutputLayer(n_out=2, loss="mcxent",
+                                 activation="softmax")],
+                    input_type=InputType.convolutional(7, 7, 1))
+        assert check_gradients(net, rand((2, 7, 7, 1)), onehot(2, 2),
+                               subset=60)
+
 
 class TestGradientCheckRNN:
     def test_lstm_rnn_output(self):

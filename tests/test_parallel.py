@@ -163,3 +163,25 @@ def test_local_sgd_multi_io_graph():
     s1 = net.score(mds)
     assert np.isfinite(s1)
     assert s1 < s0, (s0, s1)
+
+
+def test_hybrid_mesh_single_slice_fallback():
+    """build_hybrid_mesh degrades to a plain product mesh on one slice (the
+    CPU test environment) with identical axis names, and a DP-over-dcn x
+    TP-over-ici sharded step still executes."""
+    from deeplearning4j_tpu.parallel.mesh import build_hybrid_mesh
+
+    mesh = build_hybrid_mesh({"data": 2, "model": 2}, {"data": 2})
+    assert mesh.axis_names == ("data", "model")
+    assert mesh.shape["data"] == 4 and mesh.shape["model"] == 2
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    x = jnp.arange(8 * 4, dtype=jnp.float32).reshape(8, 4)
+    w = jnp.ones((4, 4), jnp.float32)
+    xs = jax.device_put(x, NamedSharding(mesh, P("data")))
+    ws = jax.device_put(w, NamedSharding(mesh, P(None, "model")))
+    y = jax.jit(jnp.matmul)(xs, ws)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(x) @ np.asarray(w))
+
+    with pytest.raises(ValueError, match="not present"):
+        build_hybrid_mesh({"data": 2}, {"expert": 2})
