@@ -29,6 +29,27 @@ _BLK_Q = int(os.environ.get("DL4J_FLASH_BLK_Q", "128"))
 _BLK_K = int(os.environ.get("DL4J_FLASH_BLK_K", "128"))
 
 
+def _causal_mask(s, q0, k0):
+    """Mask score tile ``s`` [blk_q, blk_k] to q_pos >= k_pos, where the tile
+    starts at absolute positions (q0, k0). ONE shared convention for the
+    forward and both backward kernels — they must never disagree."""
+    blk_q, blk_k = s.shape
+    q_pos = q0 + jax.lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 0)
+    k_pos = k0 + jax.lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 1)
+    return jnp.where(q_pos >= k_pos, s, _NEG)
+
+
+def _flatten_heads(a):
+    """(B, T, H, D) -> (B*H, T, D) kernel layout."""
+    B, T, H, D = a.shape
+    return a.transpose(0, 2, 1, 3).reshape(B * H, T, D)
+
+
+def _unflatten_heads(a, B, H):
+    BH, T, D = a.shape
+    return a.reshape(B, H, T, D).transpose(0, 2, 1, 3)
+
+
 def use_pallas() -> bool:
     """Backend seam (reference helper loading seam).
 
@@ -51,11 +72,14 @@ def use_pallas() -> bool:
 
 
 # ---------------------------------------------------------------- flash attention
-def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, blk_k: int, causal: bool,
-                      blk_q: int, seq_k: int, scale: float):
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, blk_k: int,
+                      causal: bool, blk_q: int, seq_k: int, scale: float):
     """One (batch*head, q-block) program: stream K/V blocks, online softmax.
 
-    q_ref: (blk_q, D); k_ref/v_ref: (seq_k, D); o_ref: (blk_q, D).
+    q_ref: (blk_q, D); k_ref/v_ref: (seq_k, D); o_ref: (blk_q, D);
+    lse_ref: (blk_q,) log-sum-exp of the scaled scores per query row —
+    saved so the backward can recompute P = exp(S - lse) without a second
+    online-softmax pass.
     """
     qi = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32) * scale      # block is (1, blk_q, D)
@@ -71,11 +95,7 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, blk_k: int, causal: bool,
         v_blk = v_ref[0, pl.ds(j * blk_k, blk_k), :].astype(jnp.float32)
         s = q @ k_blk.T                                   # (blk_q, blk_k)
         if causal:
-            q_pos = qi * blk_q + jax.lax.broadcasted_iota(
-                jnp.int32, (blk_q, blk_k), 0)
-            k_pos = j * blk_k + jax.lax.broadcasted_iota(
-                jnp.int32, (blk_q, blk_k), 1)
-            s = jnp.where(q_pos >= k_pos, s, _NEG)
+            s = _causal_mask(s, qi * blk_q, j * blk_k)
         m_blk = jnp.max(s, axis=1)
         m_new = jnp.maximum(m, m_blk)
         p = jnp.exp(s - m_new[:, None])
@@ -86,14 +106,16 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, blk_k: int, causal: bool,
         return m_new, l_new, acc_new
 
     m, l, acc = jax.lax.fori_loop(0, n_k, body, (m, l, acc))
-    o_ref[0] = (acc / jnp.maximum(l, 1e-20)[:, None]).astype(o_ref.dtype)
+    l_safe = jnp.maximum(l, 1e-20)
+    o_ref[0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
+    lse_ref[0] = m + jnp.log(l_safe)
 
 
 def _flash_forward(q: Array, k: Array, v: Array, causal: bool,
                    blk_q: int = None, blk_k: int = None,
-                   interpret: bool = False) -> Array:
-    """q,k,v: (B, T, H, D) -> (B, T, H, D). None block sizes -> env-tunable
-    module defaults (_BLK_Q/_BLK_K)."""
+                   interpret: bool = False):
+    """q,k,v: (B, T, H, D) -> (out (B, T, H, D), lse (B*H, Tq) f32). None
+    block sizes -> env-tunable module defaults (_BLK_Q/_BLK_K)."""
     blk_q = blk_q or _BLK_Q
     blk_k = blk_k or _BLK_K
     B, Tq, H, D = q.shape
@@ -104,14 +126,11 @@ def _flash_forward(q: Array, k: Array, v: Array, causal: bool,
         raise ValueError(f"sequence lengths ({Tq},{Tk}) must be divisible by "
                          f"block sizes ({blk_q},{blk_k})")
     scale = 1.0 / (D ** 0.5)
-    # (B, T, H, D) -> (B*H, T, D)
-    qr = q.transpose(0, 2, 1, 3).reshape(B * H, Tq, D)
-    kr = k.transpose(0, 2, 1, 3).reshape(B * H, Tk, D)
-    vr = v.transpose(0, 2, 1, 3).reshape(B * H, Tk, D)
+    qr, kr, vr = _flatten_heads(q), _flatten_heads(k), _flatten_heads(v)
 
     kernel = functools.partial(_flash_fwd_kernel, blk_k=blk_k, causal=causal,
                                blk_q=blk_q, seq_k=Tk, scale=scale)
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=(B * H, Tq // blk_q),
         in_specs=[
@@ -119,11 +138,17 @@ def _flash_forward(q: Array, k: Array, v: Array, causal: bool,
             pl.BlockSpec((1, Tk, D), lambda bh, i: (bh, 0, 0)),
             pl.BlockSpec((1, Tk, D), lambda bh, i: (bh, 0, 0)),
         ],
-        out_specs=pl.BlockSpec((1, blk_q, D), lambda bh, i: (bh, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((B * H, Tq, D), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, blk_q, D), lambda bh, i: (bh, i, 0)),
+            pl.BlockSpec((1, blk_q), lambda bh, i: (bh, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * H, Tq, D), q.dtype),
+            jax.ShapeDtypeStruct((B * H, Tq), jnp.float32),
+        ],
         interpret=interpret,
     )(qr, kr, vr)
-    return out.reshape(B, H, Tq, D).transpose(0, 2, 1, 3)
+    return _unflatten_heads(out, B, H), lse
 
 
 def _attention_xla(q, k, v, causal):
@@ -162,11 +187,146 @@ def flash_attention(q: Array, k: Array, v: Array, causal: bool = False,
                     interpret: bool = False) -> Array:
     """Tiled attention: pallas forward on TPU (shapes that don't tile fall
     back to the identical XLA math rather than erroring), XLA elsewhere.
-    Backward recomputes scores per query chunk (flash-attention practice:
-    trade FLOPs for HBM; peak extra memory O(blk_q·Tk), never O(Tq·Tk))."""
+    Backward is tiled pallas too (dQ + dK/dV kernels recomputing P from the
+    saved logsumexp — flash-attention practice: trade FLOPs for HBM; peak
+    extra memory O(blk·T), never O(Tq·Tk)); set DL4J_FLASH_PALLAS_BWD=0 to
+    use the XLA chunked-scan backward instead."""
     if (use_pallas() or interpret) and _tileable(q.shape[1], k.shape[1]):
-        return _flash_forward(q, k, v, causal, interpret=interpret)
+        return _flash_forward(q, k, v, causal, interpret=interpret)[0]
     return _attention_xla(q, k, v, causal)
+
+
+# -------------------------------------------------- pallas backward kernels
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                         dq_ref, *, blk_k: int, causal: bool, blk_q: int,
+                         seq_k: int, scale: float):
+    """dQ program per (batch*head, q-block): stream K/V blocks.
+
+    dS = P ∘ (dP − delta) with P = exp(S − lse), dP = dO·Vᵀ,
+    delta = rowsum(dO ∘ O); dQ = dS·K·scale.
+    """
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32)              # (blk_q, D)
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0].astype(jnp.float32)          # (blk_q,)
+    delta = delta_ref[0].astype(jnp.float32)      # (blk_q,)
+    dq = jnp.zeros_like(q)
+    n_k = seq_k // blk_k
+
+    def body(j, dq):
+        k_blk = k_ref[0, pl.ds(j * blk_k, blk_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(j * blk_k, blk_k), :].astype(jnp.float32)
+        s = (q @ k_blk.T) * scale
+        if causal:
+            s = _causal_mask(s, qi * blk_q, j * blk_k)
+        p = jnp.exp(s - lse[:, None])
+        dp = do @ v_blk.T
+        ds = p * (dp - delta[:, None]) * scale
+        return dq + ds @ k_blk
+
+    dq = jax.lax.fori_loop(0, n_k, body, dq)
+    dq_ref[0] = dq.astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                          dk_ref, dv_ref, *, blk_q: int, causal: bool,
+                          blk_k: int, seq_q: int, scale: float):
+    """dK/dV program per (batch*head, k-block): stream Q/dO blocks.
+
+    dV = Pᵀ·dO accumulated over q-blocks; dK = dSᵀ·Q·scale.
+    """
+    ki = pl.program_id(1)
+    k_blk = k_ref[0].astype(jnp.float32)          # (blk_k, D)
+    v_blk = v_ref[0].astype(jnp.float32)
+    dk = jnp.zeros_like(k_blk)
+    dv = jnp.zeros_like(v_blk)
+    n_q = seq_q // blk_q
+
+    def body(i, carry):
+        dk, dv = carry
+        q_blk = q_ref[0, pl.ds(i * blk_q, blk_q), :].astype(jnp.float32)
+        do_blk = do_ref[0, pl.ds(i * blk_q, blk_q), :].astype(jnp.float32)
+        lse_blk = lse_ref[0, pl.ds(i * blk_q, blk_q)].astype(jnp.float32)
+        delta_blk = delta_ref[0, pl.ds(i * blk_q, blk_q)].astype(jnp.float32)
+        s = (q_blk @ k_blk.T) * scale             # (blk_q, blk_k)
+        if causal:
+            s = _causal_mask(s, i * blk_q, ki * blk_k)
+        p = jnp.exp(s - lse_blk[:, None])
+        dv = dv + p.T @ do_blk
+        dp = do_blk @ v_blk.T
+        ds = p * (dp - delta_blk[:, None]) * scale
+        dk = dk + ds.T @ q_blk
+        return dk, dv
+
+    dk, dv = jax.lax.fori_loop(0, n_q, body, (dk, dv))
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _flash_backward(q, k, v, out, lse, g, causal, blk_q: int = None,
+                    blk_k: int = None, interpret: bool = False):
+    """Tiled pallas backward from the saved forward logsumexp."""
+    blk_q = blk_q or _BLK_Q
+    blk_k = blk_k or _BLK_K
+    B, Tq, H, D = q.shape
+    Tk = k.shape[1]
+    blk_q = min(blk_q, Tq)
+    blk_k = min(blk_k, Tk)
+    if Tq % blk_q or Tk % blk_k:
+        raise ValueError(f"sequence lengths ({Tq},{Tk}) must be divisible by "
+                         f"block sizes ({blk_q},{blk_k})")
+    scale = 1.0 / (D ** 0.5)
+    qr, kr, vr = _flatten_heads(q), _flatten_heads(k), _flatten_heads(v)
+    gr, outr = _flatten_heads(g), _flatten_heads(out)
+    # delta = rowsum(dO ∘ O): one cheap fused elementwise+reduce in XLA
+    delta = jnp.sum(gr.astype(jnp.float32) * outr.astype(jnp.float32), axis=-1)
+
+    dq_kernel = functools.partial(_flash_bwd_dq_kernel, blk_k=blk_k,
+                                  causal=causal, blk_q=blk_q, seq_k=Tk,
+                                  scale=scale)
+    dq = pl.pallas_call(
+        dq_kernel,
+        grid=(B * H, Tq // blk_q),
+        in_specs=[
+            pl.BlockSpec((1, blk_q, D), lambda bh, i: (bh, i, 0)),
+            pl.BlockSpec((1, Tk, D), lambda bh, i: (bh, 0, 0)),
+            pl.BlockSpec((1, Tk, D), lambda bh, i: (bh, 0, 0)),
+            pl.BlockSpec((1, blk_q, D), lambda bh, i: (bh, i, 0)),
+            pl.BlockSpec((1, blk_q), lambda bh, i: (bh, i)),
+            pl.BlockSpec((1, blk_q), lambda bh, i: (bh, i)),
+        ],
+        out_specs=pl.BlockSpec((1, blk_q, D), lambda bh, i: (bh, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((B * H, Tq, D), q.dtype),
+        interpret=interpret,
+    )(qr, kr, vr, gr, lse, delta)
+
+    dkv_kernel = functools.partial(_flash_bwd_dkv_kernel, blk_q=blk_q,
+                                   causal=causal, blk_k=blk_k, seq_q=Tq,
+                                   scale=scale)
+    dk, dv = pl.pallas_call(
+        dkv_kernel,
+        grid=(B * H, Tk // blk_k),
+        in_specs=[
+            pl.BlockSpec((1, Tq, D), lambda bh, j: (bh, 0, 0)),
+            pl.BlockSpec((1, blk_k, D), lambda bh, j: (bh, j, 0)),
+            pl.BlockSpec((1, blk_k, D), lambda bh, j: (bh, j, 0)),
+            pl.BlockSpec((1, Tq, D), lambda bh, j: (bh, 0, 0)),
+            pl.BlockSpec((1, Tq), lambda bh, j: (bh, 0)),
+            pl.BlockSpec((1, Tq), lambda bh, j: (bh, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, blk_k, D), lambda bh, j: (bh, j, 0)),
+            pl.BlockSpec((1, blk_k, D), lambda bh, j: (bh, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * H, Tk, D), k.dtype),
+            jax.ShapeDtypeStruct((B * H, Tk, D), v.dtype),
+        ],
+        interpret=interpret,
+    )(qr, kr, vr, gr, lse, delta)
+
+    return (_unflatten_heads(dq, B, H), _unflatten_heads(dk, B, H),
+            _unflatten_heads(dv, B, H))
 
 
 def _attention_bwd_chunked(q, k, v, g, causal, blk_q: int = None):
@@ -221,12 +381,23 @@ def _attention_bwd_chunked(q, k, v, g, causal, blk_q: int = None):
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
+def _pallas_bwd_enabled() -> bool:
+    return os.environ.get("DL4J_FLASH_PALLAS_BWD", "1") != "0"
+
+
 def _flash_fwd_rule(q, k, v, causal, interpret):
-    return flash_attention(q, k, v, causal, interpret), (q, k, v)
+    if (use_pallas() or interpret) and _tileable(q.shape[1], k.shape[1]) \
+            and _pallas_bwd_enabled():
+        out, lse = _flash_forward(q, k, v, causal, interpret=interpret)
+        return out, (q, k, v, out, lse)
+    return flash_attention(q, k, v, causal, interpret), (q, k, v, None, None)
 
 
 def _flash_bwd_rule(causal, interpret, res, g):
-    q, k, v = res
+    q, k, v, out, lse = res
+    if lse is not None:
+        return _flash_backward(q, k, v, out, lse, g, causal,
+                               interpret=interpret)
     return _attention_bwd_chunked(q, k, v, g, causal)
 
 

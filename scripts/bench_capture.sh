@@ -1,0 +1,36 @@
+#!/bin/bash
+# Capture the full benchmark grid on the real chip in one relay-healthy window.
+# Appends one JSON line per run to scripts/bench_log.jsonl (never overwrites).
+# Usage: scripts/bench_capture.sh [quick|full]
+set -u
+cd "$(dirname "$0")/.."
+LOG=scripts/bench_log.jsonl
+MODE=${1:-full}
+
+run() {
+    echo "--- bench $* $(date -u +%H:%M:%S)" >&2
+    out=$(timeout 560 python bench.py "$@" --attempts 1 --attempt-timeout 480 2>/dev/null | tail -1)
+    [ -n "$out" ] || out=null   # keep bench_log.jsonl valid per-line JSON
+    echo "{\"args\": \"$*\", \"ts\": \"$(date -u +%FT%TZ)\", \"rec\": $out}" >> "$LOG"
+    echo "$out" | head -c 300 >&2; echo >&2
+}
+
+# headline configs, default dtype (bf16 matmul)
+run --model resnet50
+run --model resnet50 --bf16-act
+run --model transformer
+run --model transformer --bf16-act
+if [ "$MODE" = full ]; then
+    run --model lenet
+    run --model lenet --bf16-act
+    run --model char_rnn
+    run --model char_rnn --bf16-act
+    run --model word2vec
+    run --model attention
+    run --model fit_resnet50
+    run --model fit_lenet
+    # batch sweep for the flagship at the winning dtype
+    run --model resnet50 --bf16-act --batch 64
+    run --model resnet50 --bf16-act --batch 256
+fi
+echo "done -> $LOG" >&2
