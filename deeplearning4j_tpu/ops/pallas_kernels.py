@@ -72,15 +72,20 @@ def use_pallas() -> bool:
 
 
 # ---------------------------------------------------------------- flash attention
-def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, blk_k: int,
-                      causal: bool, blk_q: int, seq_k: int, scale: float):
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, *rest, blk_k: int, causal: bool,
+                      blk_q: int, seq_k: int, scale: float, has_mask: bool):
     """One (batch*head, q-block) program: stream K/V blocks, online softmax.
 
     q_ref: (blk_q, D); k_ref/v_ref: (seq_k, D); o_ref: (blk_q, D);
     lse_ref: (blk_q,) log-sum-exp of the scaled scores per query row —
     saved so the backward can recompute P = exp(S - lse) without a second
-    online-softmax pass.
+    online-softmax pass. With has_mask, a (seq_k,) {0,1} key-padding mask
+    precedes the outputs: masked keys get -inf logits.
     """
+    if has_mask:
+        km_ref, o_ref, lse_ref = rest
+    else:
+        o_ref, lse_ref = rest
     qi = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32) * scale      # block is (1, blk_q, D)
     d = q.shape[-1]
@@ -94,6 +99,9 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, blk_k: int,
         k_blk = k_ref[0, pl.ds(j * blk_k, blk_k), :].astype(jnp.float32)
         v_blk = v_ref[0, pl.ds(j * blk_k, blk_k), :].astype(jnp.float32)
         s = q @ k_blk.T                                   # (blk_q, blk_k)
+        if has_mask:
+            km_blk = km_ref[0, pl.ds(j * blk_k, blk_k)].astype(jnp.float32)
+            s = jnp.where(km_blk[None, :] > 0, s, _NEG)
         if causal:
             s = _causal_mask(s, qi * blk_q, j * blk_k)
         m_blk = jnp.max(s, axis=1)
@@ -111,11 +119,19 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, blk_k: int,
     lse_ref[0] = m + jnp.log(l_safe)
 
 
+def _bh_mask(key_mask: Array, H: int) -> Array:
+    """[B, Tk] {0,1} key mask -> (B*H, Tk) f32 kernel operand."""
+    B, Tk = key_mask.shape
+    return jnp.broadcast_to(key_mask.astype(jnp.float32)[:, None, :],
+                            (B, H, Tk)).reshape(B * H, Tk)
+
+
 def _flash_forward(q: Array, k: Array, v: Array, causal: bool,
                    blk_q: int = None, blk_k: int = None,
-                   interpret: bool = False):
+                   interpret: bool = False, key_mask: Array = None):
     """q,k,v: (B, T, H, D) -> (out (B, T, H, D), lse (B*H, Tq) f32). None
-    block sizes -> env-tunable module defaults (_BLK_Q/_BLK_K)."""
+    block sizes -> env-tunable module defaults (_BLK_Q/_BLK_K). key_mask:
+    optional [B, Tk] {0,1} key-padding mask."""
     blk_q = blk_q or _BLK_Q
     blk_k = blk_k or _BLK_K
     B, Tq, H, D = q.shape
@@ -127,17 +143,24 @@ def _flash_forward(q: Array, k: Array, v: Array, causal: bool,
                          f"block sizes ({blk_q},{blk_k})")
     scale = 1.0 / (D ** 0.5)
     qr, kr, vr = _flatten_heads(q), _flatten_heads(k), _flatten_heads(v)
+    has_mask = key_mask is not None
 
     kernel = functools.partial(_flash_fwd_kernel, blk_k=blk_k, causal=causal,
-                               blk_q=blk_q, seq_k=Tk, scale=scale)
+                               blk_q=blk_q, seq_k=Tk, scale=scale,
+                               has_mask=has_mask)
+    in_specs = [
+        pl.BlockSpec((1, blk_q, D), lambda bh, i: (bh, i, 0)),
+        pl.BlockSpec((1, Tk, D), lambda bh, i: (bh, 0, 0)),
+        pl.BlockSpec((1, Tk, D), lambda bh, i: (bh, 0, 0)),
+    ]
+    operands = [qr, kr, vr]
+    if has_mask:
+        in_specs.append(pl.BlockSpec((1, Tk), lambda bh, i: (bh, 0)))
+        operands.append(_bh_mask(key_mask, H))
     out, lse = pl.pallas_call(
         kernel,
         grid=(B * H, Tq // blk_q),
-        in_specs=[
-            pl.BlockSpec((1, blk_q, D), lambda bh, i: (bh, i, 0)),
-            pl.BlockSpec((1, Tk, D), lambda bh, i: (bh, 0, 0)),
-            pl.BlockSpec((1, Tk, D), lambda bh, i: (bh, 0, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, blk_q, D), lambda bh, i: (bh, i, 0)),
             pl.BlockSpec((1, blk_q), lambda bh, i: (bh, i)),
@@ -147,7 +170,7 @@ def _flash_forward(q: Array, k: Array, v: Array, causal: bool,
             jax.ShapeDtypeStruct((B * H, Tq), jnp.float32),
         ],
         interpret=interpret,
-    )(qr, kr, vr)
+    )(*operands)
     return _unflatten_heads(out, B, H), lse
 
 
@@ -158,17 +181,21 @@ def _attention_xla(q, k, v, causal):
     return attention_reference(q, k, v, causal).astype(q.dtype)
 
 
+def _pallas_ok(q, k, interpret: bool) -> bool:
+    """ONE dispatch predicate for every flash/masked entry point AND its
+    custom_vjp fwd rule — they must agree, or a forward under jax.grad would
+    silently take a different code path than the plain forward."""
+    return (use_pallas() or interpret) and _tileable(q.shape[1], k.shape[1])
+
+
 def _tileable(tq: int, tk: int, blk_q: int = None, blk_k: int = None) -> bool:
     blk_q = blk_q or _BLK_Q
     blk_k = blk_k or _BLK_K
     return tq % min(blk_q, tq) == 0 and tk % min(blk_k, tk) == 0
 
 
-def masked_attention(q: Array, k: Array, v: Array, key_mask: Array,
-                     causal: bool = False) -> Array:
-    """Attention with a {0,1} key/padding mask [B, Tk]: masked keys get -inf
-    logits (NOT zeroed k/v — zeroing still leaves them e^0 softmax mass).
-    Shapes as flash_attention: (B, T, H, D)."""
+def _masked_attention_xla(q: Array, k: Array, v: Array, key_mask: Array,
+                          causal: bool) -> Array:
     d = q.shape[-1]
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(jnp.float32(d))
     s = jnp.where(key_mask[:, None, None, :] > 0, s, _NEG)
@@ -182,6 +209,50 @@ def masked_attention(q: Array, k: Array, v: Array, key_mask: Array,
     return jnp.einsum("bhqk,bkhd->bqhd", p, v).astype(q.dtype)
 
 
+def masked_attention(q: Array, k: Array, v: Array, key_mask: Array,
+                     causal: bool = False, interpret: bool = False) -> Array:
+    """Attention with a {0,1} key/padding mask [B, Tk]: masked keys get -inf
+    logits (NOT zeroed k/v — zeroing still leaves them e^0 softmax mass).
+    Shapes as flash_attention: (B, T, H, D). On TPU this rides the same
+    tiled Pallas kernels as flash_attention (O(blk·T) memory); elsewhere or
+    on non-tileable shapes it runs the identical XLA math."""
+    return _masked_attention_vjp(q, k, v, key_mask.astype(jnp.float32),
+                                 causal, interpret)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def _masked_attention_vjp(q, k, v, key_mask, causal, interpret):
+    if _pallas_ok(q, k, interpret):
+        return _flash_forward(q, k, v, causal, interpret=interpret,
+                              key_mask=key_mask)[0]
+    return _masked_attention_xla(q, k, v, key_mask, causal)
+
+
+def _masked_fwd_rule(q, k, v, key_mask, causal, interpret):
+    if _pallas_ok(q, k, interpret) and _pallas_bwd_enabled():
+        out, lse = _flash_forward(q, k, v, causal, interpret=interpret,
+                                  key_mask=key_mask)
+        return out, (q, k, v, key_mask, out, lse)
+    return (_masked_attention_vjp(q, k, v, key_mask, causal, interpret),
+            (q, k, v, key_mask, None, None))
+
+
+def _masked_bwd_rule(causal, interpret, res, g):
+    q, k, v, km, out, lse = res
+    if lse is not None:
+        dq, dk, dv = _flash_backward(q, k, v, out, lse, g, causal,
+                                     interpret=interpret, key_mask=km)
+    else:
+        _, vjp = jax.vjp(
+            lambda a, b, c: _masked_attention_xla(a, b, c, km, causal),
+            q, k, v)
+        dq, dk, dv = vjp(g)
+    return dq, dk, dv, jnp.zeros_like(km)
+
+
+_masked_attention_vjp.defvjp(_masked_fwd_rule, _masked_bwd_rule)
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
 def flash_attention(q: Array, k: Array, v: Array, causal: bool = False,
                     interpret: bool = False) -> Array:
@@ -191,20 +262,26 @@ def flash_attention(q: Array, k: Array, v: Array, causal: bool = False,
     saved logsumexp — flash-attention practice: trade FLOPs for HBM; peak
     extra memory O(blk·T), never O(Tq·Tk)); set DL4J_FLASH_PALLAS_BWD=0 to
     use the XLA chunked-scan backward instead."""
-    if (use_pallas() or interpret) and _tileable(q.shape[1], k.shape[1]):
+    if _pallas_ok(q, k, interpret):
         return _flash_forward(q, k, v, causal, interpret=interpret)[0]
     return _attention_xla(q, k, v, causal)
 
 
 # -------------------------------------------------- pallas backward kernels
 def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                         dq_ref, *, blk_k: int, causal: bool, blk_q: int,
-                         seq_k: int, scale: float):
+                         *rest, blk_k: int, causal: bool, blk_q: int,
+                         seq_k: int, scale: float, has_mask: bool = False):
     """dQ program per (batch*head, q-block): stream K/V blocks.
 
     dS = P ∘ (dP − delta) with P = exp(S − lse), dP = dO·Vᵀ,
-    delta = rowsum(dO ∘ O); dQ = dS·K·scale.
+    delta = rowsum(dO ∘ O); dQ = dS·K·scale. Masked entries clamp to P = 0
+    rather than exp(S − lse): for a fully key-masked row lse is ~_NEG and
+    the exponent would overflow.
     """
+    if has_mask:
+        km_ref, dq_ref = rest
+    else:
+        (dq_ref,) = rest
     qi = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32)              # (blk_q, D)
     do = do_ref[0].astype(jnp.float32)
@@ -217,9 +294,12 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         k_blk = k_ref[0, pl.ds(j * blk_k, blk_k), :].astype(jnp.float32)
         v_blk = v_ref[0, pl.ds(j * blk_k, blk_k), :].astype(jnp.float32)
         s = (q @ k_blk.T) * scale
+        if has_mask:
+            km_blk = km_ref[0, pl.ds(j * blk_k, blk_k)].astype(jnp.float32)
+            s = jnp.where(km_blk[None, :] > 0, s, _NEG)
         if causal:
             s = _causal_mask(s, qi * blk_q, j * blk_k)
-        p = jnp.exp(s - lse[:, None])
+        p = jnp.where(s <= _NEG, 0.0, jnp.exp(s - lse[:, None]))
         dp = do @ v_blk.T
         ds = p * (dp - delta[:, None]) * scale
         return dq + ds @ k_blk
@@ -229,15 +309,21 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                          dk_ref, dv_ref, *, blk_q: int, causal: bool,
-                          blk_k: int, seq_q: int, scale: float):
+                          *rest, blk_q: int, causal: bool,
+                          blk_k: int, seq_q: int, scale: float,
+                          has_mask: bool = False):
     """dK/dV program per (batch*head, k-block): stream Q/dO blocks.
 
     dV = Pᵀ·dO accumulated over q-blocks; dK = dSᵀ·Q·scale.
     """
+    if has_mask:
+        km_ref, dk_ref, dv_ref = rest
+    else:
+        dk_ref, dv_ref = rest
     ki = pl.program_id(1)
     k_blk = k_ref[0].astype(jnp.float32)          # (blk_k, D)
     v_blk = v_ref[0].astype(jnp.float32)
+    km_blk = km_ref[0].astype(jnp.float32) if has_mask else None  # (blk_k,)
     dk = jnp.zeros_like(k_blk)
     dv = jnp.zeros_like(v_blk)
     n_q = seq_q // blk_q
@@ -249,9 +335,11 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         lse_blk = lse_ref[0, pl.ds(i * blk_q, blk_q)].astype(jnp.float32)
         delta_blk = delta_ref[0, pl.ds(i * blk_q, blk_q)].astype(jnp.float32)
         s = (q_blk @ k_blk.T) * scale             # (blk_q, blk_k)
+        if has_mask:
+            s = jnp.where(km_blk[None, :] > 0, s, _NEG)
         if causal:
             s = _causal_mask(s, i * blk_q, ki * blk_k)
-        p = jnp.exp(s - lse_blk[:, None])
+        p = jnp.where(s <= _NEG, 0.0, jnp.exp(s - lse_blk[:, None]))
         dv = dv + p.T @ do_blk
         dp = do_blk @ v_blk.T
         ds = p * (dp - delta_blk[:, None]) * scale
@@ -264,8 +352,10 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _flash_backward(q, k, v, out, lse, g, causal, blk_q: int = None,
-                    blk_k: int = None, interpret: bool = False):
-    """Tiled pallas backward from the saved forward logsumexp."""
+                    blk_k: int = None, interpret: bool = False,
+                    key_mask: Array = None):
+    """Tiled pallas backward from the saved forward logsumexp. key_mask:
+    optional [B, Tk] {0,1} key-padding mask, same semantics as forward."""
     blk_q = blk_q or _BLK_Q
     blk_k = blk_k or _BLK_K
     B, Tq, H, D = q.shape
@@ -280,40 +370,52 @@ def _flash_backward(q, k, v, out, lse, g, causal, blk_q: int = None,
     gr, outr = _flatten_heads(g), _flatten_heads(out)
     # delta = rowsum(dO ∘ O): one cheap fused elementwise+reduce in XLA
     delta = jnp.sum(gr.astype(jnp.float32) * outr.astype(jnp.float32), axis=-1)
+    has_mask = key_mask is not None
+    km = _bh_mask(key_mask, H) if has_mask else None
 
     dq_kernel = functools.partial(_flash_bwd_dq_kernel, blk_k=blk_k,
                                   causal=causal, blk_q=blk_q, seq_k=Tk,
-                                  scale=scale)
+                                  scale=scale, has_mask=has_mask)
+    dq_specs = [
+        pl.BlockSpec((1, blk_q, D), lambda bh, i: (bh, i, 0)),
+        pl.BlockSpec((1, Tk, D), lambda bh, i: (bh, 0, 0)),
+        pl.BlockSpec((1, Tk, D), lambda bh, i: (bh, 0, 0)),
+        pl.BlockSpec((1, blk_q, D), lambda bh, i: (bh, i, 0)),
+        pl.BlockSpec((1, blk_q), lambda bh, i: (bh, i)),
+        pl.BlockSpec((1, blk_q), lambda bh, i: (bh, i)),
+    ]
+    dq_operands = [qr, kr, vr, gr, lse, delta]
+    if has_mask:
+        dq_specs.append(pl.BlockSpec((1, Tk), lambda bh, i: (bh, 0)))
+        dq_operands.append(km)
     dq = pl.pallas_call(
         dq_kernel,
         grid=(B * H, Tq // blk_q),
-        in_specs=[
-            pl.BlockSpec((1, blk_q, D), lambda bh, i: (bh, i, 0)),
-            pl.BlockSpec((1, Tk, D), lambda bh, i: (bh, 0, 0)),
-            pl.BlockSpec((1, Tk, D), lambda bh, i: (bh, 0, 0)),
-            pl.BlockSpec((1, blk_q, D), lambda bh, i: (bh, i, 0)),
-            pl.BlockSpec((1, blk_q), lambda bh, i: (bh, i)),
-            pl.BlockSpec((1, blk_q), lambda bh, i: (bh, i)),
-        ],
+        in_specs=dq_specs,
         out_specs=pl.BlockSpec((1, blk_q, D), lambda bh, i: (bh, i, 0)),
         out_shape=jax.ShapeDtypeStruct((B * H, Tq, D), q.dtype),
         interpret=interpret,
-    )(qr, kr, vr, gr, lse, delta)
+    )(*dq_operands)
 
     dkv_kernel = functools.partial(_flash_bwd_dkv_kernel, blk_q=blk_q,
                                    causal=causal, blk_k=blk_k, seq_q=Tq,
-                                   scale=scale)
+                                   scale=scale, has_mask=has_mask)
+    dkv_specs = [
+        pl.BlockSpec((1, Tq, D), lambda bh, j: (bh, 0, 0)),
+        pl.BlockSpec((1, blk_k, D), lambda bh, j: (bh, j, 0)),
+        pl.BlockSpec((1, blk_k, D), lambda bh, j: (bh, j, 0)),
+        pl.BlockSpec((1, Tq, D), lambda bh, j: (bh, 0, 0)),
+        pl.BlockSpec((1, Tq), lambda bh, j: (bh, 0)),
+        pl.BlockSpec((1, Tq), lambda bh, j: (bh, 0)),
+    ]
+    dkv_operands = [qr, kr, vr, gr, lse, delta]
+    if has_mask:
+        dkv_specs.append(pl.BlockSpec((1, blk_k), lambda bh, j: (bh, j)))
+        dkv_operands.append(km)
     dk, dv = pl.pallas_call(
         dkv_kernel,
         grid=(B * H, Tk // blk_k),
-        in_specs=[
-            pl.BlockSpec((1, Tq, D), lambda bh, j: (bh, 0, 0)),
-            pl.BlockSpec((1, blk_k, D), lambda bh, j: (bh, j, 0)),
-            pl.BlockSpec((1, blk_k, D), lambda bh, j: (bh, j, 0)),
-            pl.BlockSpec((1, Tq, D), lambda bh, j: (bh, 0, 0)),
-            pl.BlockSpec((1, Tq), lambda bh, j: (bh, 0)),
-            pl.BlockSpec((1, Tq), lambda bh, j: (bh, 0)),
-        ],
+        in_specs=dkv_specs,
         out_specs=[
             pl.BlockSpec((1, blk_k, D), lambda bh, j: (bh, j, 0)),
             pl.BlockSpec((1, blk_k, D), lambda bh, j: (bh, j, 0)),
@@ -323,7 +425,7 @@ def _flash_backward(q, k, v, out, lse, g, causal, blk_q: int = None,
             jax.ShapeDtypeStruct((B * H, Tk, D), v.dtype),
         ],
         interpret=interpret,
-    )(qr, kr, vr, gr, lse, delta)
+    )(*dkv_operands)
 
     return (_unflatten_heads(dq, B, H), _unflatten_heads(dk, B, H),
             _unflatten_heads(dv, B, H))
@@ -386,8 +488,7 @@ def _pallas_bwd_enabled() -> bool:
 
 
 def _flash_fwd_rule(q, k, v, causal, interpret):
-    if (use_pallas() or interpret) and _tileable(q.shape[1], k.shape[1]) \
-            and _pallas_bwd_enabled():
+    if _pallas_ok(q, k, interpret) and _pallas_bwd_enabled():
         out, lse = _flash_forward(q, k, v, causal, interpret=interpret)
         return out, (q, k, v, out, lse)
     return flash_attention(q, k, v, causal, interpret), (q, k, v, None, None)
