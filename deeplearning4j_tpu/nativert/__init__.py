@@ -111,6 +111,18 @@ def _declare(lib: ctypes.CDLL) -> None:
     lib.dl4j_stats_abort.argtypes = [ctypes.c_void_p]
     lib.dl4j_runtime_version.restype = ctypes.c_int
 
+    lib.dl4j_vocab_count_file.restype = ctypes.c_void_p
+    lib.dl4j_vocab_count_file.argtypes = [ctypes.c_char_p, ctypes.c_int,
+                                          ctypes.c_int]
+    lib.dl4j_vocab_num_words.restype = c_i64
+    lib.dl4j_vocab_num_words.argtypes = [ctypes.c_void_p]
+    lib.dl4j_vocab_total_tokens.restype = c_i64
+    lib.dl4j_vocab_total_tokens.argtypes = [ctypes.c_void_p]
+    lib.dl4j_vocab_entry.restype = c_i64
+    lib.dl4j_vocab_entry.argtypes = [ctypes.c_void_p, c_i64, ctypes.c_char_p,
+                                     c_i64]
+    lib.dl4j_vocab_close.argtypes = [ctypes.c_void_p]
+
 
 def get_runtime() -> Optional[ctypes.CDLL]:
     """Load (building if needed) the native runtime; None when unavailable.
@@ -130,7 +142,7 @@ def get_runtime() -> Optional[ctypes.CDLL]:
         try:
             lib = ctypes.CDLL(str(_LIB_PATH))
             _declare(lib)
-            if lib.dl4j_runtime_version() != 2:
+            if lib.dl4j_runtime_version() != 3:
                 return None
             _lib = lib
         except OSError:
@@ -327,3 +339,45 @@ def encode_stats_native(session_id: str, worker_id: str, timestamp: int,
     finally:
         if h:
             lib.dl4j_stats_abort(h)
+
+
+# ---------------------------------------------------------------------------
+# Vocabulary counting (parallel token counts, reference VocabConstructor.java)
+# ---------------------------------------------------------------------------
+
+def count_tokens_file(path: str, common_preprocess: bool = False,
+                      nthreads: int = 0) -> Optional[List[tuple]]:
+    """Count whitespace tokens in an ASCII text file with worker threads.
+
+    Returns [(word, count), ...] ordered by count desc then word asc, or
+    None when the native runtime is unavailable, the file can't be read, or
+    it contains non-ASCII bytes (the Python tokenizer pipeline has unicode
+    semantics this fast path intentionally does not replicate).
+    ``common_preprocess`` applies the CommonPreprocessor rules (strip
+    punctuation/digits, lowercase) inline during the scan.
+    """
+    lib = get_runtime()
+    if lib is None:
+        return None
+    h = lib.dl4j_vocab_count_file(path.encode(), 1 if common_preprocess else 0,
+                                  int(nthreads))
+    if not h:
+        return None
+    try:
+        n = lib.dl4j_vocab_num_words(h)
+        cap = 65536
+        buf = ctypes.create_string_buffer(cap)
+        out = []
+        for i in range(int(n)):
+            cnt = lib.dl4j_vocab_entry(h, i, buf, cap)
+            if cnt < 0:
+                return None
+            word = buf.value.decode("ascii")
+            if len(word) >= cap - 1:
+                # possible truncation (undetectable through the C ABI):
+                # decline and let the Python pipeline keep the full token
+                return None
+            out.append((word, int(cnt)))
+        return out
+    finally:
+        lib.dl4j_vocab_close(h)

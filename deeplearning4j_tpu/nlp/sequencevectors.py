@@ -89,6 +89,25 @@ class SequenceVectors:
             negative=self.negative)
         self.lookup.reset_weights()
 
+    def build_vocab_from_file(self, path: str, tokenizer_factory=None) -> None:
+        """Vocabulary straight from a corpus file: the count phase runs in the
+        native C++ runtime with worker threads when the tokenizer allows it
+        (VocabConstructor.build_from_file), mirroring the reference's parallel
+        vocab construction (VocabConstructor.java:33). Defaults to this
+        vectorizer's configured tokenizer (Word2Vec.tokenizer_factory) so the
+        vocab is built with the same tokenization training will use."""
+        if tokenizer_factory is None:
+            tokenizer_factory = getattr(self, "tokenizer_factory", None)
+        constructor = VocabConstructor(
+            min_word_frequency=self.min_word_frequency,
+            build_huffman_tree=True, special=list(self.special_tokens))
+        cache = constructor.build_from_file(path, tokenizer_factory)
+        self.vocab = cache
+        self.lookup = InMemoryLookupTable(
+            cache, self.vector_length, seed=self.seed, use_hs=self.use_hs,
+            negative=self.negative)
+        self.lookup.reset_weights()
+
     # ------------------------------------------------------------------ training
     def fit(self, sequences: Iterable[Sequence[str]],
             labels: Optional[List[Sequence[str]]] = None) -> None:

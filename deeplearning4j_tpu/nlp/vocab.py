@@ -7,6 +7,7 @@ wordstore/inmemory/AbstractCache.java (word<->index maps, counts).
 from __future__ import annotations
 
 import heapq
+import itertools
 from typing import Dict, Iterable, List, Optional, Sequence
 
 MAX_CODE_LENGTH = 40  # classic word2vec bound (reference Huffman.java MAX_CODE_LENGTH)
@@ -146,3 +147,46 @@ class VocabConstructor:
         if self.build_huffman_tree:
             build_huffman(cache)
         return cache
+
+    def build_from_file(self, path: str, tokenizer_factory=None) -> VocabCache:
+        """Build the vocabulary straight from a text file.
+
+        For the default whitespace tokenizer (optionally with
+        CommonPreprocessor) over ASCII corpora, counting runs in the native
+        C++ runtime with worker threads — the analog of the reference's
+        parallel VocabConstructor count phase (VocabConstructor.java:33).
+        Any other tokenizer, a missing native runtime, or non-ASCII content
+        falls back to the Python pipeline with identical results.
+        """
+        from deeplearning4j_tpu.nlp.tokenization import (
+            CommonPreprocessor, DefaultTokenizerFactory)
+
+        pre = getattr(tokenizer_factory, "_pre", None)
+        native_ok = tokenizer_factory is None or (
+            type(tokenizer_factory) is DefaultTokenizerFactory
+            and (pre is None or type(pre) is CommonPreprocessor))
+        if native_ok:
+            from deeplearning4j_tpu import nativert
+            counts = nativert.count_tokens_file(
+                str(path), common_preprocess=pre is not None)
+            if counts is not None:
+                cache = VocabCache()
+                for word, count in counts:
+                    if word:
+                        cache.add_token(word, float(count))
+                # specials are guaranteed present (same as the callers of
+                # build_joint_vocabulary, which append one occurrence each)
+                for sp in self.special:
+                    cache.add_token(sp)
+                cache.finish(self.min_word_frequency, self.special)
+                if self.build_huffman_tree:
+                    build_huffman(cache)
+                return cache
+
+        if tokenizer_factory is None:
+            tokenizer_factory = DefaultTokenizerFactory()
+        with open(path, "r", encoding="utf-8") as f:
+            seqs = (tokenizer_factory.create(line).get_tokens()
+                    for line in f if line.strip())
+            return self.build_joint_vocabulary(
+                itertools.chain(seqs, ([sp] for sp in self.special)))

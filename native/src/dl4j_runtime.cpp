@@ -18,6 +18,9 @@
 // C ABI only (consumed via ctypes from Python).
 
 #include <algorithm>
+#include <cctype>
+#include <fstream>
+#include <unordered_map>
 #include <atomic>
 #include <condition_variable>
 #include <cstdint>
@@ -478,6 +481,145 @@ int64_t dl4j_stats_finish(void* h, uint8_t* out, int64_t cap) {
 
 void dl4j_stats_abort(void* h) { delete static_cast<StatsBuilder*>(h); }
 
-int dl4j_runtime_version(void) { return 2; }
+int dl4j_runtime_version(void) { return 3; }
+
+}  // extern "C"
+
+// ----------------------------------------------------------- vocab counter
+// Parallel vocabulary build (the reference's VocabConstructor.java:33 counts
+// tokens with worker threads before the Huffman pass). Whitespace tokens;
+// mode 1 additionally applies CommonPreprocessor semantics: strip the
+// punctuation/digit set [\d.:,"'()\[\]|/?!;] and ASCII-lowercase. ASCII-only
+// by contract — any byte >= 0x80 makes the counter return null and the
+// caller falls back to the Python pipeline (whose str.lower() has unicode
+// semantics this pass does not replicate).
+namespace {
+
+struct VocabCount {
+  std::vector<std::pair<std::string, int64_t>> entries;  // sorted count desc
+  int64_t total = 0;
+};
+
+// Python str.split() whitespace for the ASCII range: \t\n\v\f\r, space,
+// and the \x1c-\x1f separators (C isspace excludes the latter).
+inline bool vc_is_space(unsigned char c) {
+  return c == ' ' || (c >= 0x09 && c <= 0x0d) || (c >= 0x1c && c <= 0x1f);
+}
+
+inline bool vc_strip_char(unsigned char c) {
+  switch (c) {
+    case '.': case ':': case ',': case '"': case '\'': case '(': case ')':
+    case '[': case ']': case '|': case '/': case '?': case '!': case ';':
+      return true;
+    default:
+      return c >= '0' && c <= '9';
+  }
+}
+
+bool vc_count_range(const char* data, size_t begin, size_t end, bool common,
+                    std::unordered_map<std::string, int64_t>* counts,
+                    int64_t* total) {
+  std::string tok;
+  for (size_t i = begin; i <= end; i++) {
+    unsigned char c = (i < end) ? (unsigned char)data[i] : ' ';
+    if (c >= 0x80) return false;  // non-ASCII: caller must fall back
+    if (vc_is_space(c)) {
+      if (!tok.empty()) {
+        (*counts)[tok]++;
+        (*total)++;
+        tok.clear();
+      }
+      continue;
+    }
+    if (common) {
+      if (vc_strip_char(c)) continue;
+      if (c >= 'A' && c <= 'Z') c = (unsigned char)(c - 'A' + 'a');
+    }
+    tok.push_back((char)c);
+  }
+  return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Returns a handle, or null on IO error / non-ASCII content (caller falls
+// back to the Python tokenizer pipeline). nthreads <= 0 -> hardware default.
+void* dl4j_vocab_count_file(const char* path, int common_preprocess,
+                            int nthreads) {
+  std::ifstream f(path, std::ios::binary | std::ios::ate);
+  if (!f) return nullptr;
+  std::streamsize sz = f.tellg();
+  f.seekg(0);
+  std::string data((size_t)sz, '\0');
+  if (sz && !f.read(&data[0], sz)) return nullptr;
+
+  unsigned hw = std::thread::hardware_concurrency();
+  int nt = nthreads > 0 ? nthreads : (hw ? (int)hw : 1);
+  if ((int64_t)sz < (int64_t)1 << 20) nt = 1;  // small file: skip thread cost
+  // chunk boundaries snapped forward to whitespace so no token spans chunks
+  std::vector<size_t> bounds{0};
+  for (int t = 1; t < nt; t++) {
+    size_t b = (size_t)sz * (size_t)t / (size_t)nt;
+    while (b < (size_t)sz && !vc_is_space((unsigned char)data[b])) b++;
+    bounds.push_back(b);
+  }
+  bounds.push_back((size_t)sz);
+
+  int real_nt = (int)bounds.size() - 1;
+  std::vector<std::unordered_map<std::string, int64_t>> maps(real_nt);
+  std::vector<int64_t> totals(real_nt, 0);
+  std::vector<char> ok(real_nt, 1);
+  std::vector<std::thread> threads;
+  for (int t = 0; t < real_nt; t++) {
+    threads.emplace_back([&, t]() {
+      ok[t] = vc_count_range(data.data(), bounds[t], bounds[t + 1],
+                             common_preprocess != 0, &maps[t], &totals[t]);
+    });
+  }
+  for (auto& th : threads) th.join();
+  for (int t = 0; t < real_nt; t++)
+    if (!ok[t]) return nullptr;
+
+  auto* vc = new VocabCount();
+  std::unordered_map<std::string, int64_t> merged;
+  for (int t = 0; t < real_nt; t++) {
+    for (auto& kv : maps[t]) merged[kv.first] += kv.second;
+    vc->total += totals[t];
+  }
+  vc->entries.assign(merged.begin(), merged.end());
+  // deterministic order: count desc, then word asc
+  std::sort(vc->entries.begin(), vc->entries.end(),
+            [](const auto& a, const auto& b) {
+              if (a.second != b.second) return a.second > b.second;
+              return a.first < b.first;
+            });
+  return vc;
+}
+
+int64_t dl4j_vocab_num_words(void* h) {
+  return (int64_t)static_cast<VocabCount*>(h)->entries.size();
+}
+
+int64_t dl4j_vocab_total_tokens(void* h) {
+  return static_cast<VocabCount*>(h)->total;
+}
+
+// Writes word idx into out (NUL-terminated, truncated to cap) and returns its
+// count; -1 for out-of-range idx.
+int64_t dl4j_vocab_entry(void* h, int64_t idx, char* out, int64_t cap) {
+  auto* vc = static_cast<VocabCount*>(h);
+  if (idx < 0 || (size_t)idx >= vc->entries.size()) return -1;
+  const auto& e = vc->entries[(size_t)idx];
+  if (out && cap > 0) {
+    int64_t n = std::min<int64_t>((int64_t)e.first.size(), cap - 1);
+    std::memcpy(out, e.first.data(), (size_t)n);
+    out[n] = '\0';
+  }
+  return e.second;
+}
+
+void dl4j_vocab_close(void* h) { delete static_cast<VocabCount*>(h); }
 
 }  // extern "C"

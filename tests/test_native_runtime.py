@@ -161,3 +161,103 @@ def test_loader_use_after_close_raises():
         ld.next()
     with pytest.raises(ValueError):
         ld.reset()
+
+
+def _python_counts(path, common):
+    from collections import Counter
+    from deeplearning4j_tpu.nlp.tokenization import CommonPreprocessor
+    pre = CommonPreprocessor() if common else None
+    c = Counter()
+    with open(path) as f:
+        for line in f:
+            for tok in line.split():
+                if pre is not None:
+                    tok = pre.pre_process(tok)
+                if tok:
+                    c[tok] += 1
+    return dict(c)
+
+
+@pytest.mark.parametrize("common", [False, True])
+def test_vocab_counter_matches_python(tmp_path, common):
+    """Native parallel token counts == the Python tokenizer pipeline
+    (reference VocabConstructor.java parallel count phase)."""
+    p = tmp_path / "corpus.txt"
+    text = ("The quick brown fox, jumps over the lazy dog!\n"
+            "the quick RED fox; and the dog sleeps.\n" * 50)
+    p.write_text(text)
+    got = nativert.count_tokens_file(str(p), common_preprocess=common,
+                                     nthreads=3)
+    assert got is not None
+    assert dict(got) == _python_counts(str(p), common)
+    # deterministic ordering: count desc, then word asc
+    counts = [c for _, c in got]
+    assert counts == sorted(counts, reverse=True)
+    for (w1, c1), (w2, c2) in zip(got, got[1:]):
+        if c1 == c2:
+            assert w1 < w2
+
+
+def test_vocab_counter_separator_chars_match_python(tmp_path):
+    """\x1c-\x1f are whitespace for str.split(); the native scan must agree."""
+    p = tmp_path / "corpus.txt"
+    p.write_bytes(b"a\x1cb a\x1db c\x1fd\n")
+    got = nativert.count_tokens_file(str(p))
+    assert got is not None
+    assert dict(got) == _python_counts(str(p), False)
+
+
+def test_vocab_counter_rejects_non_ascii(tmp_path):
+    p = tmp_path / "corpus.txt"
+    p.write_bytes("caf\xc3\xa9 au lait".encode("latin-1"))
+    assert nativert.count_tokens_file(str(p)) is None
+
+
+def test_vocab_constructor_native_equals_python(tmp_path):
+    """VocabConstructor.build_from_file: native fast path == forced-Python
+    fallback, including Huffman codes."""
+    from deeplearning4j_tpu.nlp.tokenization import (
+        CommonPreprocessor, DefaultTokenizerFactory)
+    from deeplearning4j_tpu.nlp.vocab import VocabConstructor
+
+    p = tmp_path / "corpus.txt"
+    p.write_text("one two two three three three four four four four\n" * 20)
+    tf = DefaultTokenizerFactory()
+    tf.set_token_pre_processor(CommonPreprocessor())
+    vc = VocabConstructor(min_word_frequency=1)
+    native = vc.build_from_file(str(p), tf)
+
+    class _NotDefault(DefaultTokenizerFactory):
+        pass  # subclass => native path declines, Python pipeline runs
+
+    tf2 = _NotDefault()
+    tf2.set_token_pre_processor(CommonPreprocessor())
+    python = vc.build_from_file(str(p), tf2)
+
+    assert native.words() == python.words()
+    for w in native.words():
+        nw, pw = native.word_for(w), python.word_for(w)
+        assert nw.count == pw.count
+        assert nw.code == pw.code and nw.points == pw.points
+
+
+def test_vocab_from_file_specials_always_present(tmp_path):
+    """Specials absent from the corpus still enter the vocab, matching
+    build_vocab's caller-side injection, on BOTH the native and Python
+    paths."""
+    from deeplearning4j_tpu.nlp.tokenization import DefaultTokenizerFactory
+    from deeplearning4j_tpu.nlp.vocab import VocabConstructor
+
+    p = tmp_path / "corpus.txt"
+    p.write_text("alpha beta beta gamma\n" * 5)
+    vc = VocabConstructor(min_word_frequency=1, special=("<UNK>",))
+    native = vc.build_from_file(str(p))
+
+    class _NotDefault(DefaultTokenizerFactory):
+        pass
+
+    python = vc.build_from_file(str(p), _NotDefault())
+    assert "<UNK>" in native and "<UNK>" in python
+    assert native.words() == python.words()
+    for w in native.words():
+        assert native.word_for(w).count == python.word_for(w).count

@@ -144,3 +144,18 @@ def test_label_aware_iterator_labels():
     it = LabelAwareListSentenceIterator(["a b", "c d"])
     docs = list(it)
     assert docs[0].labels == ["DOC_0"] and docs[1].labels == ["DOC_1"]
+
+
+def test_word2vec_vocab_from_file_trains(tmp_path):
+    """build_vocab_from_file (native count phase) then fit: embeddings train
+    and similar words exist in the vocab."""
+    from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+
+    corpus = tmp_path / "corpus.txt"
+    corpus.write_text("the cat sat on the mat\nthe dog sat on the rug\n" * 30)
+    w2v = Word2Vec(vector_length=16, min_word_frequency=1, seed=7)
+    w2v.build_vocab_from_file(str(corpus))
+    assert "cat" in w2v.vocab and "rug" in w2v.vocab
+    sents = [l.split() for l in corpus.read_text().splitlines() if l]
+    w2v.fit(sents)
+    assert w2v.get_word_vector("cat").shape == (16,)
