@@ -2,5 +2,6 @@ from deeplearning4j_tpu.models.alexnet import alexnet
 from deeplearning4j_tpu.models.char_rnn import char_rnn_lstm
 from deeplearning4j_tpu.models.googlenet import googlenet
 from deeplearning4j_tpu.models.lenet import lenet_mnist
-from deeplearning4j_tpu.models.resnet import resnet50
+from deeplearning4j_tpu.models.resnet import resnet18, resnet50
 from deeplearning4j_tpu.models.vgg import vgg16
+from deeplearning4j_tpu.models.transformer import transformer_lm

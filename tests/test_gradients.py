@@ -116,6 +116,32 @@ class TestGradientCheckCNN:
         assert check_gradients(net, rand((3, 5, 5, 1)), onehot(3, 2),
                                subset=60, verbose=True)
 
+    @pytest.mark.parametrize("pooling", ["avg", "max", "sum"])
+    def test_global_pooling(self, pooling):
+        from deeplearning4j_tpu.nn.conf.layers import GlobalPoolingLayer
+        net = build([ConvolutionLayer(n_out=3, kernel_size=(2, 2),
+                                      stride=(1, 1), activation="tanh"),
+                     GlobalPoolingLayer(pooling_type=pooling),
+                     OutputLayer(n_out=2, loss="mcxent",
+                                 activation="softmax")],
+                    input_type=InputType.convolutional(5, 5, 2))
+        assert check_gradients(net, rand((3, 5, 5, 2)), onehot(3, 2),
+                               subset=60)
+
+    def test_upsampling_zeropadding(self):
+        from deeplearning4j_tpu.nn.conf.layers import (
+            Upsampling2D, ZeroPaddingLayer)
+        net = build([ZeroPaddingLayer(padding=(1, 1)),
+                     ConvolutionLayer(n_out=2, kernel_size=(3, 3),
+                                      stride=(1, 1), activation="tanh"),
+                     Upsampling2D(size=(2, 2)),
+                     DenseLayer(n_out=6, activation="relu"),
+                     OutputLayer(n_out=2, loss="mcxent",
+                                 activation="softmax")],
+                    input_type=InputType.convolutional(4, 4, 1))
+        assert check_gradients(net, rand((2, 4, 4, 1)), onehot(2, 2),
+                               subset=60)
+
     def test_dilated_convolution(self):
         net = build([ConvolutionLayer(n_out=3, kernel_size=(2, 2),
                                       stride=(1, 1), dilation=(2, 2),
