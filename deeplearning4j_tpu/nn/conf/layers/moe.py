@@ -30,6 +30,14 @@ class MoELayer(FeedForwardLayer):
     n_experts: int = 4
     expert_hidden: int = 0          # 0 -> 4 * width
     router_noise: float = 0.0       # jitter stddev at train time
+    #: Switch-transformer auxiliary load-balance loss weight, added to the
+    #: training objective (without it top-1 routing collapses onto one
+    #: expert). The term rides the layer-state pytree as "aux_loss" and is
+    #: summed by loss_fn/graph_loss.
+    aux_loss_weight: float = 0.01
+
+    def init_state(self, itype: InputType) -> dict:
+        return {"aux_loss": jnp.zeros((), jnp.float32)}
 
     def set_n_in(self, itype: InputType) -> None:
         if not self.n_in:
@@ -87,7 +95,12 @@ class MoELayer(FeedForwardLayer):
         F = shape[-1]
         x2d = x.reshape(-1, F)
         pol = get_policy()
-        eidx, gate, _ = self.route(params, x2d, train=train, rng=rng)
+        eidx, gate, probs = self.route(params, x2d, train=train, rng=rng)
+        # load-balance term from THIS routing decision (same rng/noise the
+        # tokens were actually dispatched with)
+        lb = self._balance_term(eidx, probs)
+        new_state = {"aux_loss": (lb if train
+                                  else jnp.zeros((), jnp.float32)).astype(jnp.float32)}
         # dense evaluation: every expert on every token, select by routing
         h = (jnp.einsum("sf,efh->esh", x2d.astype(pol.compute_dtype),
                         params["W1"].astype(pol.compute_dtype))
@@ -99,12 +112,16 @@ class MoELayer(FeedForwardLayer):
                  + params["b2"][:, None].astype(pol.output_dtype))  # [E, S, F]
         sel = jax.nn.one_hot(eidx, self.n_experts, dtype=y_all.dtype)  # [S, E]
         y = jnp.einsum("se,esf->sf", sel, y_all) * gate[:, None].astype(y_all.dtype)
-        return self.act_fn()(y.reshape(shape)), state
+        return self.act_fn()(y.reshape(shape)), new_state
+
+    def _balance_term(self, eidx, probs) -> jax.Array:
+        """Switch-transformer balance term E * sum_e f_e * P_e from a routing
+        decision — the ONE formula both training (apply) and
+        load_balance_loss optimize."""
+        frac = jnp.mean(jax.nn.one_hot(eidx, self.n_experts), axis=0)
+        return self.n_experts * jnp.sum(frac * jnp.mean(probs, axis=0))
 
     def load_balance_loss(self, params, x2d) -> jax.Array:
         """Switch-transformer auxiliary loss: E * sum_e f_e * P_e."""
         eidx, _, probs = self.route(params, x2d)
-        E = self.n_experts
-        frac = jnp.mean(jax.nn.one_hot(eidx, E), axis=0)
-        prob = jnp.mean(probs, axis=0)
-        return E * jnp.sum(frac * prob)
+        return self._balance_term(eidx, probs)

@@ -124,6 +124,13 @@ def graph_loss(conf, params, states, inputs, labels, rng, fmasks=None, lmasks=No
         h = loss_inputs[out_name]
         lmask = lmasks[i] if lmasks else None
         total = total + vertex.layer.compute_loss(params[out_name], h, labels[i], lmask)
+    # layer-declared auxiliary objectives (MoE load-balance etc.), published
+    # through the vertex state pytree as "aux_loss"
+    for name, ns in new_states.items():
+        if isinstance(ns, dict) and "aux_loss" in ns:
+            vertex = conf.vertices[name]
+            w = getattr(getattr(vertex, "layer", None), "aux_loss_weight", 1.0)
+            total = total + w * ns["aux_loss"]
     return total + _graph_regularization(conf, params), new_states
 
 
@@ -394,8 +401,21 @@ class ComputationGraph(LazyScore):
         return float(fn(self.params_list, self.state_list, xs, ys))
 
     def _score_pure(self, params, states, xs, ys):
-        loss, _ = graph_loss(self.conf, params, states, xs, ys, None)
-        return loss
+        # evaluation loss: eval-mode forward (no dropout, running BN stats,
+        # no MoE aux term) + data losses + regularization — mirrors
+        # MultiLayerNetwork.score and the reference's score():1704 semantics
+        conf = self.conf
+        _, _, loss_inputs = graph_forward(conf, params, states, xs,
+                                          train=False, rng=None,
+                                          collect_loss_inputs=True)
+        total = jnp.float32(0.0)
+        for i, out_name in enumerate(conf.network_outputs):
+            vertex = conf.vertices[out_name]
+            if not (isinstance(vertex, LayerVertex) and vertex.layer.has_loss()):
+                raise ValueError(f"Output vertex '{out_name}' has no loss function")
+            total = total + vertex.layer.compute_loss(
+                params[out_name], loss_inputs[out_name], ys[i], None)
+        return total + _graph_regularization(conf, params)
 
     # ------------------------------------------------------------------ training
     def _next_rng(self):

@@ -128,7 +128,19 @@ def loss_fn(conf: MultiLayerConfiguration, params_list, state_list, x, y, rng,
     h = last.apply_dropout(h, rngs[-1], True)
     loss = last.compute_loss(params_list[-1], h, y, lmask)
     new_states.append(state_list[-1])
+    loss = loss + _aux_losses(layers, new_states)
     return loss + _regularization(conf, params_list), new_states
+
+
+def _aux_losses(layers, new_states):
+    """Sum layer-declared auxiliary objectives (a layer publishes one by
+    returning an "aux_loss" scalar in its state — e.g. MoELayer's Switch
+    load-balance term, weighted by its ``aux_loss_weight``)."""
+    total = jnp.float32(0.0)
+    for layer, ns in zip(layers, new_states):
+        if isinstance(ns, dict) and "aux_loss" in ns:
+            total = total + getattr(layer, "aux_loss_weight", 1.0) * ns["aux_loss"]
+    return total
 
 
 def make_train_step(conf: MultiLayerConfiguration):
@@ -717,6 +729,7 @@ def make_tbptt_step(conf: MultiLayerConfiguration):
         def lf(p):
             h = x
             new_rnn = []
+            chunk_states = []
             rngs = jax.random.split(rng, len(conf.layers)) if rng is not None else None
             for i, layer in enumerate(conf.layers[:-1]):
                 pp = conf.preprocessor(i)
@@ -725,14 +738,19 @@ def make_tbptt_step(conf: MultiLayerConfiguration):
                 if isinstance(layer, LSTM) and not type(layer).__name__.startswith("GravesBidirectional"):
                     h, rs = layer.apply_streaming(p[i], rnn_states[i], h, mask=fmask)
                     new_rnn.append(jax.tree_util.tree_map(jax.lax.stop_gradient, rs))
+                    chunk_states.append(state_list[i])
                 else:
-                    h, _ = layer.apply(p[i], state_list[i], h, train=True,
-                                       rng=rngs[i], mask=fmask)
+                    h, ns = layer.apply(p[i], state_list[i], h, train=True,
+                                        rng=rngs[i], mask=fmask)
                     new_rnn.append(rnn_states[i])
+                    chunk_states.append(ns)
             last = conf.layers[-1]
             h = last.apply_dropout(h, rngs[-1], True)
             loss = last.compute_loss(p[-1], h, y, lmask)
             new_rnn.append(rnn_states[-1])
+            # layer-declared aux objectives (MoE load balance) apply per
+            # TBPTT chunk exactly as in the standard loss_fn
+            loss = loss + _aux_losses(conf.layers, chunk_states)
             return loss + _regularization(conf, p), new_rnn
 
         (loss, new_rnn), grads = jax.value_and_grad(lf, has_aux=True)(params_list)

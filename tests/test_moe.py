@@ -98,3 +98,51 @@ def test_expert_parallel_applies_activation():
     expect, _ = lyr.apply(params, {}, x)
     np.testing.assert_allclose(np.asarray(got), np.asarray(expect),
                                rtol=2e-4, atol=2e-5)
+
+
+def test_aux_load_balance_loss_enters_training_objective():
+    """The Switch load-balance term must be part of the training loss (top-1
+    routing collapses without it) and push gradient into the router weights."""
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.nn.conf.builders import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf.layers import OutputLayer
+    from deeplearning4j_tpu.nn.conf.layers.moe import MoELayer
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork, loss_fn
+
+    def build(aux_w):
+        conf = (NeuralNetConfiguration.builder().seed(11).list()
+                .layer(MoELayer(n_in=6, n_out=6, n_experts=4,
+                                expert_hidden=8, activation="relu",
+                                aux_loss_weight=aux_w))
+                .layer(OutputLayer(n_in=6, n_out=3, loss="mcxent",
+                                   activation="softmax"))
+                .build())
+        return MultiLayerNetwork(conf).init(seed=11), conf
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(32, 6)).astype(np.float32))
+    y = np.zeros((32, 3), np.float32)
+    y[np.arange(32), rng.integers(0, 3, 32)] = 1
+    y = jnp.asarray(y)
+
+    net0, conf0 = build(0.0)
+    net1, conf1 = build(0.5)
+    key = jax.random.PRNGKey(1)
+    l0, _ = loss_fn(conf0, net0.params_list, net0.state_list, x, y, key)
+    l1, _ = loss_fn(conf1, net1.params_list, net1.state_list, x, y, key)
+    # identical params/routing; the only difference is the weighted aux term
+    assert float(l1) > float(l0)
+
+    g1 = jax.grad(lambda p: loss_fn(conf1, p, net1.state_list, x, y, key)[0])(
+        net1.params_list)
+    g0 = jax.grad(lambda p: loss_fn(conf0, p, net0.state_list, x, y, key)[0])(
+        net0.params_list)
+    diff = float(jnp.abs(g1[0]["Wg"] - g0[0]["Wg"]).max())
+    assert diff > 0, "aux loss contributes no router gradient"
+
+    # inference keeps the published aux term at zero
+    out, ns = conf1.layers[0].apply(net1.params_list[0], net1.state_list[0],
+                                    x, train=False)
+    assert float(ns["aux_loss"]) == 0.0
