@@ -179,15 +179,13 @@ def bench_char_rnn(batch: int, iters: int, ksteps: int, warmup: int = 2,
     return r
 
 
-def bench_transformer(batch: int, iters: int, ksteps: int, warmup: int = 2,
-                      vocab: int = 256, seq: int = 256) -> dict:
-    """Decoder-only transformer LM over the flash-attention kernel."""
+def _bench_lm(conf, batch: int, iters: int, ksteps: int, warmup: int,
+              vocab: int, seq: int) -> dict:
+    """Shared LM measurement recipe: one-hot [B, T, V] next-token batches
+    through the K-step multistep path (used by the transformer and MoE
+    benches so the staging/sync methodology cannot diverge)."""
     import jax.numpy as jnp
 
-    from deeplearning4j_tpu.models.transformer import transformer_lm
-
-    conf = transformer_lm(vocab_size=vocab, width=256, n_layers=4, n_heads=4,
-                          max_len=seq)
     rng = np.random.default_rng(0)
     ids = rng.integers(0, vocab, (batch, seq))
     x = jnp.asarray(np.eye(vocab, dtype=np.float32)[ids])
@@ -195,6 +193,27 @@ def bench_transformer(batch: int, iters: int, ksteps: int, warmup: int = 2,
                            iters, warmup)
     r["tokens_per_sec"] = r["samples_per_sec"] * seq
     return r
+
+
+def bench_transformer(batch: int, iters: int, ksteps: int, warmup: int = 2,
+                      vocab: int = 256, seq: int = 256) -> dict:
+    """Decoder-only transformer LM over the flash-attention kernel."""
+    from deeplearning4j_tpu.models.transformer import transformer_lm
+
+    conf = transformer_lm(vocab_size=vocab, width=256, n_layers=4, n_heads=4,
+                          max_len=seq)
+    return _bench_lm(conf, batch, iters, ksteps, warmup, vocab, seq)
+
+
+def bench_moe(batch: int, iters: int, ksteps: int, warmup: int = 2,
+              vocab: int = 256, seq: int = 256) -> dict:
+    """Switch-style MoE LM (residual attention + top-1 expert FFN blocks,
+    load-balance aux loss included in the trained objective)."""
+    from deeplearning4j_tpu.models.transformer import moe_transformer_lm
+
+    conf = moe_transformer_lm(vocab_size=vocab, width=256, n_layers=4,
+                              n_heads=4, n_experts=8, max_len=seq)
+    return _bench_lm(conf, batch, iters, ksteps, warmup, vocab, seq)
 
 
 def bench_word2vec(batch: int, iters: int, ksteps: int, warmup: int = 2,
@@ -416,6 +435,7 @@ _METRICS = {
     "fit_resnet50": "resnet50_fit_api_samples_per_sec",
     "char_rnn": "char_rnn_samples_per_sec",
     "transformer": "transformer_lm_samples_per_sec",
+    "moe": "moe_transformer_samples_per_sec",
     "resnet50": "resnet50_samples_per_sec_per_chip",
     "word2vec": "word2vec_pairs_per_sec",
     "attention": "flash_attention_tokens_per_sec",
@@ -428,6 +448,7 @@ _DEFAULTS = {  # model -> (batch, iters, ksteps)
     "fit_resnet50": (64, 4, 8),
     "char_rnn": (32, 5, 8),
     "transformer": (16, 5, 8),
+    "moe": (8, 5, 4),
     "word2vec": (1024, 10, 32),
     "attention": (4, 5, 4),
 }
@@ -437,6 +458,7 @@ def _bench_fns():
     return {"lenet": bench_lenet, "resnet50": bench_resnet50,
             "fit_lenet": bench_fit_lenet, "fit_resnet50": bench_fit_resnet50,
             "char_rnn": bench_char_rnn, "transformer": bench_transformer,
+            "moe": bench_moe,
             "word2vec": bench_word2vec, "attention": bench_attention}
 
 

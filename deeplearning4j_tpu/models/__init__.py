@@ -4,4 +4,4 @@ from deeplearning4j_tpu.models.googlenet import googlenet
 from deeplearning4j_tpu.models.lenet import lenet_mnist
 from deeplearning4j_tpu.models.resnet import resnet18, resnet50
 from deeplearning4j_tpu.models.vgg import vgg16
-from deeplearning4j_tpu.models.transformer import transformer_lm
+from deeplearning4j_tpu.models.transformer import moe_transformer_lm, transformer_lm

@@ -39,3 +39,32 @@ def transformer_lm(vocab_size: int, width: int = 256, n_layers: int = 4,
                             activation="softmax"))
     lb.set_input_type(InputType.recurrent(vocab_size, max_len))
     return lb.build()
+
+
+def moe_transformer_lm(vocab_size: int, width: int = 256, n_layers: int = 4,
+                       n_heads: int = 4, n_experts: int = 8,
+                       expert_hidden: int = 0, max_len: int = 512,
+                       seed: int = 12345,
+                       learning_rate: float = 3e-4) -> MultiLayerConfiguration:
+    """Sparse-FFN causal LM: Switch-transformer blocks (pre-LN residual
+    attention + pre-LN residual top-1 MoE FFN). The MoE sublayers publish
+    their load-balance auxiliary loss into the training objective and
+    expert-parallelize over a mesh axis (parallel/moe.ExpertParallelMoE)."""
+    from deeplearning4j_tpu.nn.conf.layers.moe import MoETransformerBlock
+
+    lb = (NeuralNetConfiguration.builder()
+          .seed(seed)
+          .learning_rate(learning_rate)
+          .updater("adam")
+          .weight_init("xavier")
+          .list())
+    lb.layer(EmbeddingLayer(n_in=vocab_size, n_out=width))
+    for _ in range(n_layers):
+        lb.layer(MoETransformerBlock(n_in=width, n_out=width,
+                                     n_heads=n_heads, n_experts=n_experts,
+                                     expert_hidden=expert_hidden, causal=True,
+                                     activation="identity"))
+    lb.layer(RnnOutputLayer(n_in=width, n_out=vocab_size, loss="mcxent",
+                            activation="softmax"))
+    lb.set_input_type(InputType.recurrent(vocab_size, max_len))
+    return lb.build()

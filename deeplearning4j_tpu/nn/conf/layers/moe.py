@@ -90,18 +90,18 @@ class MoELayer(FeedForwardLayer):
                 .astype(pol.output_dtype)
                 + params["b2"][:, None].astype(pol.output_dtype))
 
-    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
-        shape = x.shape
-        F = shape[-1]
-        x2d = x.reshape(-1, F)
+    def moe_ffn_2d(self, params, x2d, *, train=False, rng=None):
+        """Core top-1 expert FFN on flattened tokens: (y2d, aux_term).
+
+        ONE implementation shared by MoELayer.apply and MoETransformerBlock's
+        residual sublayer (dense evaluation: every expert on every token,
+        select by routing — exact, and XLA-friendly on a single chip; the
+        sparse dispatch lives in parallel/moe.ExpertParallelMoE)."""
         pol = get_policy()
         eidx, gate, probs = self.route(params, x2d, train=train, rng=rng)
         # load-balance term from THIS routing decision (same rng/noise the
         # tokens were actually dispatched with)
-        lb = self._balance_term(eidx, probs)
-        new_state = {"aux_loss": (lb if train
-                                  else jnp.zeros((), jnp.float32)).astype(jnp.float32)}
-        # dense evaluation: every expert on every token, select by routing
+        aux = self._balance_term(eidx, probs)
         h = (jnp.einsum("sf,efh->esh", x2d.astype(pol.compute_dtype),
                         params["W1"].astype(pol.compute_dtype))
              .astype(pol.output_dtype) + params["b1"][:, None].astype(pol.output_dtype))
@@ -112,6 +112,16 @@ class MoELayer(FeedForwardLayer):
                  + params["b2"][:, None].astype(pol.output_dtype))  # [E, S, F]
         sel = jax.nn.one_hot(eidx, self.n_experts, dtype=y_all.dtype)  # [S, E]
         y = jnp.einsum("se,esf->sf", sel, y_all) * gate[:, None].astype(y_all.dtype)
+        return y, aux
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        shape = x.shape
+        x2d = x.reshape(-1, shape[-1])
+        y, aux = self.moe_ffn_2d(params, x2d, train=train, rng=rng)
+        # aux keeps its natural dtype (f32 in training, f64 under the
+        # gradient checker — a forced f32 cast would truncate the f64 path
+        # and make numeric-vs-analytic gradients disagree)
+        new_state = {"aux_loss": aux if train else jnp.zeros_like(aux)}
         return self.act_fn()(y.reshape(shape)), new_state
 
     def _balance_term(self, eidx, probs) -> jax.Array:
@@ -125,3 +135,70 @@ class MoELayer(FeedForwardLayer):
         """Switch-transformer auxiliary loss: E * sum_e f_e * P_e."""
         eidx, _, probs = self.route(params, x2d)
         return self._balance_term(eidx, probs)
+
+
+@register_config("MoETransformerBlock")
+@dataclasses.dataclass
+class MoETransformerBlock(MoELayer):
+    """Switch-transformer block: pre-LN residual attention, then a pre-LN
+    residual top-1 MoE FFN (Fedus et al.; the dense-FFN analog is
+    TransformerBlock). Publishes the load-balance term like MoELayer.
+
+    Params: ln1/ln2 scale+bias, fused Wqkv + Wo/bo attention projections,
+    and MoELayer's router/expert tensors.
+    """
+
+    n_heads: int = 4
+    causal: bool = True
+
+    def init_params(self, key, itype: InputType) -> dict:
+        F = self.n_out
+        if F % self.n_heads:
+            raise ValueError(f"width {F} not divisible by heads {self.n_heads}")
+        k_attn, k_moe = jax.random.split(key)
+        ka, kb = jax.random.split(k_attn)
+        params = MoELayer.init_params(self, k_moe, itype)
+        params.update({
+            "ln1_g": jnp.ones((F,), jnp.float32),
+            "ln1_b": jnp.zeros((F,), jnp.float32),
+            "Wqkv": self._init_w(ka, (F, 3 * F)),
+            "Wo": self._init_w(kb, (F, F)),
+            "bo": jnp.zeros((F,), jnp.float32),
+            "ln2_g": jnp.ones((F,), jnp.float32),
+            "ln2_b": jnp.zeros((F,), jnp.float32),
+        })
+        return params
+
+    def regularizable_params(self):
+        return ("Wqkv", "Wo", "W1", "W2")
+
+    def output_type(self, itype: InputType) -> InputType:
+        return InputType.recurrent(self.n_out, itype.timesteps)
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        from deeplearning4j_tpu.nn.conf.layers.attention import TransformerBlock
+        from deeplearning4j_tpu.ops.pallas_kernels import (
+            flash_attention, masked_attention)
+
+        pol = get_policy()
+        B, T, F = x.shape
+        H = self.n_heads
+        D = F // H
+        h = TransformerBlock._ln(x, params["ln1_g"], params["ln1_b"])
+        qkv = jnp.matmul(h.astype(pol.compute_dtype),
+                         params["Wqkv"].astype(pol.compute_dtype))
+        q, k, v = jnp.split(qkv.astype(pol.output_dtype), 3, axis=-1)
+        q, k, v = (a.reshape(B, T, H, D) for a in (q, k, v))
+        if mask is not None:
+            o = masked_attention(q, k, v, mask, self.causal)
+        else:
+            o = flash_attention(q, k, v, self.causal)
+        att = jnp.matmul(o.reshape(B, T, F).astype(pol.compute_dtype),
+                         params["Wo"].astype(pol.compute_dtype))
+        x = x + att.astype(pol.output_dtype) + params["bo"].astype(pol.output_dtype)
+
+        h = TransformerBlock._ln(x, params["ln2_g"], params["ln2_b"])
+        y2d, aux = self.moe_ffn_2d(params, h.reshape(-1, F), train=train,
+                                   rng=rng)
+        new_state = {"aux_loss": aux if train else jnp.zeros_like(aux)}
+        return x + y2d.reshape(B, T, F), new_state

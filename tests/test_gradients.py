@@ -217,6 +217,20 @@ class TestGradientCheckAttentionMoE:
         y[..., 1] = 1
         check_gradients(net, x, y)
 
+    def test_moe_transformer_block(self):
+        from deeplearning4j_tpu.nn.conf.layers.moe import MoETransformerBlock
+        net = build([MoETransformerBlock(n_in=6, n_out=6, n_heads=2,
+                                         n_experts=3, expert_hidden=8,
+                                         causal=True, activation="identity")
+                     ,
+                     RnnOutputLayer(n_in=6, n_out=3, loss="mcxent",
+                                    activation="softmax")],
+                    input_type=InputType.recurrent(6, 4))
+        x = rand((2, 4, 6), seed=14)
+        y = np.zeros((2, 4, 3), np.float32)
+        y[..., 2] = 1
+        assert check_gradients(net, x, y, subset=60)
+
     def test_moe_layer(self):
         from deeplearning4j_tpu.nn.conf.layers.moe import MoELayer
         net = build([MoELayer(n_in=6, n_out=6, n_experts=3, expert_hidden=8,

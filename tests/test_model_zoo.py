@@ -57,3 +57,21 @@ def test_googlenet_param_count_matches_literature():
     net = ComputationGraph(googlenet(n_classes=1000, image_size=224)).init()
     n = net.num_params()
     assert 5.5e6 < n < 7.5e6, n  # Inception-v1 main branch ~6M
+
+
+def test_moe_transformer_lm_trains():
+    import numpy as np
+
+    from deeplearning4j_tpu.models.transformer import moe_transformer_lm
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    conf = moe_transformer_lm(vocab_size=20, width=32, n_layers=2, n_heads=2,
+                              n_experts=4, max_len=12, learning_rate=0.01)
+    net = MultiLayerNetwork(conf).init()
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, 20, (4, 12))
+    x = np.eye(20, dtype=np.float32)[ids]
+    l0 = net.score(x, x)
+    for _ in range(12):
+        net.fit(x, x)
+    assert net.score(x, x) < l0
