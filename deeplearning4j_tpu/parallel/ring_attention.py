@@ -121,11 +121,15 @@ def ring_attention(q: Array, k: Array, v: Array, mesh: Mesh,
 def _ulysses_local(q, k, v, *, axis_name: str, causal: bool):
     """All-to-all: (T/N, H) -> (T, H/N), full attention, swap back
     (DeepSpeed-Ulysses sequence parallelism)."""
+    from deeplearning4j_tpu.ops.pallas_kernels import flash_attention
+
     # (B, T/N, H, D) -> (B, T, H/N, D)
     qg = lax.all_to_all(q, axis_name, split_axis=2, concat_axis=1, tiled=True)
     kg = lax.all_to_all(k, axis_name, split_axis=2, concat_axis=1, tiled=True)
     vg = lax.all_to_all(v, axis_name, split_axis=2, concat_axis=1, tiled=True)
-    og = attention_reference(qg, kg, vg, causal=causal)
+    # full-sequence attention on 1/N of the heads: the tiled flash kernel
+    # keeps memory O(blk*T) on TPU (identical XLA math elsewhere)
+    og = flash_attention(qg, kg, vg, causal)
     return lax.all_to_all(og, axis_name, split_axis=1, concat_axis=2, tiled=True)
 
 
