@@ -384,6 +384,7 @@ class ComputationGraph(LazyScore):
     # ------------------------------------------------------------------ inference
     def output(self, *inputs) -> list:
         """Forward pass returning all network outputs (reference output:1520)."""
+        self._require_init()
         xs = [jnp.asarray(x) for x in inputs]
         fn = self._jit("output", self._output_pure)
         outs, _ = fn(self.params_list, self.state_list, xs)
@@ -395,6 +396,7 @@ class ComputationGraph(LazyScore):
         return [acts[o] for o in self.conf.network_outputs], ns
 
     def score(self, mds: MultiDataSet) -> float:
+        self._require_init()
         xs = [jnp.asarray(f) for f in mds.features]
         ys = [jnp.asarray(l) for l in mds.labels]
         fn = self._jit("score", self._score_pure)
@@ -419,9 +421,9 @@ class ComputationGraph(LazyScore):
 
     # ------------------------------------------------------------------ training
     def _next_rng(self):
+        self._require_init()
         if self._rng is None:
-            raise RuntimeError("Network not initialized — call net.init() before "
-                               "fit/output (reference ComputationGraph.init:266)")
+            raise RuntimeError(self.NOT_INITIALIZED_MSG)
         self._rng, sub = jax.random.split(self._rng)
         return sub
 
@@ -652,6 +654,7 @@ class ComputationGraph(LazyScore):
         """Streaming inference carrying LSTM-vertex hidden state across calls
         (reference ComputationGraph.rnnTimeStep:1788). Each input: [B,T,F]
         (T may be 1). Returns the list of network outputs."""
+        self._require_init()
         xs = [jnp.asarray(x) for x in inputs]
         if self._rnn_state is None:
             self._rnn_state = _init_graph_rnn_states(self.conf, xs[0].shape[0],
@@ -670,6 +673,7 @@ class ComputationGraph(LazyScore):
         self._rnn_state = None
 
     def gradient_and_score(self, xs, ys):
+        self._require_init()
         xs = [jnp.asarray(x) for x in xs]
         ys = [jnp.asarray(y) for y in ys]
 

@@ -248,6 +248,18 @@ class LazyScore:
     def score_value(self, value) -> None:
         self._score_raw = value
 
+    #: one copy of the user-facing message (raised from several entry points
+    #: on both network types)
+    NOT_INITIALIZED_MSG = (
+        "Network not initialized — call net.init() before fit/output "
+        "(reference MultiLayerNetwork.init:386 / ComputationGraph.init:266)")
+
+    def _require_init(self) -> None:
+        """Raise the reference's actionable not-initialized error instead of a
+        NoneType crash (both network types share this via LazyScore)."""
+        if getattr(self, "params_list", None) is None:
+            raise RuntimeError(self.NOT_INITIALIZED_MSG)
+
     def _jit(self, name, fn, donate=None):
         """Per-network compiled-program cache, keyed on the program name AND
         the active dtype policy: the policy is read at trace time, so a
@@ -328,10 +340,14 @@ class MultiLayerNetwork(LazyScore):
 
     # ------------------------------------------------------------------ inference
     def output(self, x, train: bool = False) -> Array:
-        """Forward pass returning final activations (reference output:2061)."""
+        """Forward pass returning final activations (reference output:2061).
+        ``train=True`` runs training-mode layer behavior (batch statistics);
+        dropout needs an rng and is not applied on this inference path."""
+        self._require_init()
         x = jnp.asarray(x)
 
-        fn = self._jit("output", functools.partial(self._output_pure, train=False))
+        fn = self._jit(f"output_train{train}",
+                       functools.partial(self._output_pure, train=train))
         out, _ = fn(self.params_list, self.state_list, x)
         return out
 
@@ -342,6 +358,7 @@ class MultiLayerNetwork(LazyScore):
 
     def feed_forward(self, x, train: bool = False) -> list:
         """Per-layer activations (reference feedForward:657)."""
+        self._require_init()
         out, _, acts = forward_fn(self.conf, self.params_list, self.state_list,
                                   jnp.asarray(x), train=train, rng=None, collect=True)
         return acts
@@ -351,6 +368,7 @@ class MultiLayerNetwork(LazyScore):
 
     def score(self, x=None, y=None, dataset=None) -> float:
         """Loss (incl. regularization) on a dataset, no dropout (reference score:1704)."""
+        self._require_init()
         if dataset is not None:
             x, y = dataset.features, dataset.labels
         x, y = jnp.asarray(x), jnp.asarray(y)
@@ -373,9 +391,9 @@ class MultiLayerNetwork(LazyScore):
 
     # ------------------------------------------------------------------ training
     def _next_rng(self):
+        self._require_init()
         if self._rng is None:
-            raise RuntimeError("Network not initialized — call net.init() before "
-                               "fit/output (reference MultiLayerNetwork.init:386)")
+            raise RuntimeError(self.NOT_INITIALIZED_MSG)
         self._rng, sub = jax.random.split(self._rng)
         return sub
 
@@ -650,6 +668,7 @@ class MultiLayerNetwork(LazyScore):
     def rnn_time_step(self, x) -> Array:
         """Streaming inference carrying hidden state across calls (reference
         rnnTimeStep:2196). x: [B,T,F] (T may be 1)."""
+        self._require_init()
         x = jnp.asarray(x)
         if self._rnn_state is None:
             self._rnn_state = _init_rnn_states(self.conf, x.shape[0], x.dtype)
@@ -665,6 +684,7 @@ class MultiLayerNetwork(LazyScore):
     def gradient_and_score(self, x, y, fmask=None, lmask=None):
         """(grads pytree, score) without updating params (reference
         computeGradientAndScore:1807). Deterministic: no dropout rng."""
+        self._require_init()
         x, y = jnp.asarray(x), jnp.asarray(y)
 
         def lf(p):

@@ -118,7 +118,8 @@ def ring_attention(q: Array, k: Array, v: Array, mesh: Mesh,
     return fn(q, k, v)
 
 
-def _ulysses_local(q, k, v, *, axis_name: str, causal: bool):
+def _ulysses_local(q, k, v, *, axis_name: str, causal: bool,
+                   interpret: bool = False):
     """All-to-all: (T/N, H) -> (T, H/N), full attention, swap back
     (DeepSpeed-Ulysses sequence parallelism)."""
     from deeplearning4j_tpu.ops.pallas_kernels import flash_attention
@@ -128,22 +129,29 @@ def _ulysses_local(q, k, v, *, axis_name: str, causal: bool):
     kg = lax.all_to_all(k, axis_name, split_axis=2, concat_axis=1, tiled=True)
     vg = lax.all_to_all(v, axis_name, split_axis=2, concat_axis=1, tiled=True)
     # full-sequence attention on 1/N of the heads: the tiled flash kernel
-    # keeps memory O(blk*T) on TPU (identical XLA math elsewhere)
-    og = flash_attention(qg, kg, vg, causal)
+    # keeps memory O(blk*T) on TPU (identical XLA math elsewhere; interpret
+    # lets tests exercise the pallas-under-shard_map path on CPU)
+    og = flash_attention(qg, kg, vg, causal, interpret)
     return lax.all_to_all(og, axis_name, split_axis=1, concat_axis=2, tiled=True)
 
 
 def ulysses_attention(q: Array, k: Array, v: Array, mesh: Mesh,
-                      axis_name: str = "sp", causal: bool = False) -> Array:
+                      axis_name: str = "sp", causal: bool = False,
+                      interpret: bool = False) -> Array:
     """Sequence-parallel attention via head-sharding all-to-all. Requires the
     head count to be divisible by the axis size."""
     n = mesh.shape[axis_name]
     if q.shape[2] % n != 0:
         raise ValueError(f"num heads {q.shape[2]} not divisible by axis size {n}")
     spec = P(None, axis_name)
+    # check_vma=False: pallas_call's out_shape carries no varying-mesh-axes
+    # annotation, so the flash kernel inside the body can't satisfy the vma
+    # checker; correctness is pinned by the =reference tests instead
     fn = shard_map(
-        functools.partial(_ulysses_local, axis_name=axis_name, causal=causal),
-        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+        functools.partial(_ulysses_local, axis_name=axis_name, causal=causal,
+                          interpret=interpret),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False)
     sh = NamedSharding(mesh, spec)
     q, k, v = (jax.device_put(x, sh) for x in (q, k, v))
     return fn(q, k, v)

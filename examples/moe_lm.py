@@ -65,13 +65,22 @@ def main():
     # routing balance after training, measured from the block's REAL router
     # input: the Switch balance term E*sum(f_e*P_e) is exactly 1.0 at perfect
     # balance and E when everything routes to one expert
+    import contextlib
+
     import jax
     import jax.numpy as jnp
 
-    h0, _ = conf.layers[0].apply(net.params_list[0], net.state_list[0],
-                                 jnp.asarray(x))
-    _, ns = conf.layers[1].apply(net.params_list[1], net.state_list[1], h0,
-                                 train=True, rng=jax.random.PRNGKey(0))
+    from deeplearning4j_tpu import common
+
+    # probe under the SAME dtype policy training used (conf-declared policies
+    # are applied inside the network's compiled programs, not globally)
+    ctx = (common.override_policy(conf.global_conf.dtype)
+           if conf.global_conf.dtype else contextlib.nullcontext())
+    with ctx:
+        h0, _ = conf.layers[0].apply(net.params_list[0], net.state_list[0],
+                                     jnp.asarray(x))
+        _, ns = conf.layers[1].apply(net.params_list[1], net.state_list[1], h0,
+                                     train=True, rng=jax.random.PRNGKey(0))
     print(f"block-1 load-balance term: {float(ns['aux_loss']):.3f} "
           f"(1.0 = perfectly balanced, {args.experts} = collapsed)")
 

@@ -1,0 +1,36 @@
+
+
+def test_uninitialized_network_clear_errors():
+    """output/score before init() raise the actionable not-initialized error
+    on both network types, never a NoneType crash."""
+    import numpy as np
+    import pytest
+
+    from deeplearning4j_tpu.nn.conf.builders import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.graph_network import (
+        ComputationGraph, MultiDataSet)
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    conf = (NeuralNetConfiguration.builder().list()
+            .layer(DenseLayer(n_in=4, n_out=4, activation="relu"))
+            .layer(OutputLayer(n_in=4, n_out=2, loss="mse",
+                               activation="identity")).build())
+    net = MultiLayerNetwork(conf)
+    x = np.zeros((2, 4), np.float32)
+    y = np.zeros((2, 2), np.float32)
+    with pytest.raises(RuntimeError, match="not initialized"):
+        net.output(x)
+    with pytest.raises(RuntimeError, match="not initialized"):
+        net.score(x, y)
+
+    g = (NeuralNetConfiguration.builder().graph_builder()
+         .add_inputs("in")
+         .add_layer("out", OutputLayer(n_in=4, n_out=2, loss="mse",
+                                       activation="identity"), "in")
+         .set_outputs("out").build())
+    cg = ComputationGraph(g)
+    with pytest.raises(RuntimeError, match="not initialized"):
+        cg.output(x)
+    with pytest.raises(RuntimeError, match="not initialized"):
+        cg.score(MultiDataSet([x], [y]))

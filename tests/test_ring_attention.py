@@ -71,3 +71,25 @@ def test_ring_attention_long_sequence_sharded_memory():
     got = ring_attention(q, k, v, _mesh(), causal=True)
     np.testing.assert_allclose(np.asarray(got), np.asarray(expect),
                                rtol=2e-4, atol=2e-5)
+
+
+def test_ulysses_pallas_interpret_matches_reference():
+    """The pallas flash kernel running UNDER shard_map (interpret mode on the
+    CPU mesh) must equal the reference math — without this, the TPU ulysses
+    path would ship exercised only through the XLA fallback."""
+    import numpy as np
+
+    from deeplearning4j_tpu.parallel.mesh import build_mesh
+    from deeplearning4j_tpu.parallel.ring_attention import (
+        attention_reference, ulysses_attention)
+
+    n = 4
+    mesh = build_mesh({"sp": n})
+    rng = np.random.default_rng(0)
+    # T multiple of blk after gather; H divisible by axis
+    q, k, v = (jnp.asarray(rng.normal(size=(2, 16 * n, n, 8))
+                           .astype(np.float32)) for _ in range(3))
+    got = ulysses_attention(q, k, v, mesh, causal=True, interpret=True)
+    want = attention_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-4, atol=2e-5)
