@@ -25,6 +25,8 @@ if [ "$MODE" = full ]; then
     run --model lenet --bf16-act
     run --model char_rnn
     run --model char_rnn --bf16-act
+    run --model moe
+    run --model moe --bf16-act
     run --model word2vec
     run --model attention
     run --model fit_resnet50
