@@ -1,0 +1,33 @@
+"""Examples must stay runnable (the reference ships runnable examples as its
+de-facto integration suite). Two fast ones run end-to-end via subprocess;
+the heavier CNN/parallel examples are covered by their underlying API tests.
+"""
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+_ROOT = Path(__file__).resolve().parent.parent
+
+
+def _run_example(name: str, *args: str) -> str:
+    env = dict(os.environ)
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    env["JAX_PLATFORMS"] = "cpu"
+    out = subprocess.run(
+        [sys.executable, str(_ROOT / "examples" / name), *args],
+        capture_output=True, text=True, timeout=420, env=env, cwd=str(_ROOT))
+    assert out.returncode == 0, out.stderr[-800:]
+    return out.stdout
+
+
+def test_word2vec_example():
+    stdout = _run_example("word2vec.py")
+    assert "nearest to" in stdout
+
+
+def test_moe_lm_example():
+    stdout = _run_example("moe_lm.py", "--steps", "4")
+    assert "load-balance term" in stdout
