@@ -52,12 +52,29 @@ class Prediction:
 
 
 class Evaluation:
-    def __init__(self, n_classes: Optional[int] = None, labels: Optional[list] = None):
-        self.labels = labels
+    """Classification accumulator.
+
+    ``labels`` attaches class-label names used in ``stats()`` and the rendered
+    confusion matrix (reference eval/Evaluation.java labeled constructors);
+    ``top_n > 1`` additionally tracks top-N accuracy — a guess counts if the
+    true class is among the N highest-probability outputs (reference
+    Evaluation(List<String> labels, int topN) and stats() top-N block).
+    """
+
+    def __init__(self, n_classes: Optional[int] = None, labels: Optional[list] = None,
+                 top_n: int = 1):
+        self.labels = list(labels) if labels else None
         self.n_classes = n_classes or (len(labels) if labels else None)
+        self.top_n = max(1, int(top_n))
+        self.top_n_correct = 0
         self.confusion: Optional[ConfusionMatrix] = None
         self.num_examples = 0
         self._predictions: list = []
+
+    def label_name(self, cls: int) -> str:
+        if self.labels and 0 <= cls < len(self.labels):
+            return str(self.labels[cls])
+        return str(cls)
 
     def _ensure(self, n: int):
         if self.confusion is None:
@@ -95,6 +112,12 @@ class Evaluation:
         self._ensure(labels.shape[-1])
         actual = labels.argmax(-1)
         guess = predictions.argmax(-1)
+        if self.top_n > 1 and len(actual):
+            n = min(self.top_n, predictions.shape[-1])
+            topk = np.argpartition(predictions, -n, axis=-1)[:, -n:]
+            self.top_n_correct += int((topk == actual[:, None]).any(-1).sum())
+        else:
+            self.top_n_correct += int((actual == guess).sum())
         for i, (a, g) in enumerate(zip(actual, guess)):
             self.confusion.add(int(a), int(g))
             if record_meta_data is not None:
@@ -153,18 +176,35 @@ class Evaluation:
         p, r = self.precision(cls), self.recall(cls)
         return 2 * p * r / (p + r) if (p + r) else 0.0
 
+    def top_n_accuracy(self) -> float:
+        """Fraction of examples whose true class was in the top-N guesses
+        (reference Evaluation.topNAccuracy())."""
+        if self.num_examples == 0:
+            return 0.0
+        return self.top_n_correct / self.num_examples
+
     def stats(self) -> str:
-        """Human-readable summary (reference Evaluation.stats():352)."""
+        """Human-readable summary with class-label names when provided
+        (reference Evaluation.stats():352)."""
         lines = ["==========================Scores========================================",
                  f" Examples:  {self.num_examples}",
-                 f" Accuracy:  {self.accuracy():.4f}",
-                 f" Precision: {self.precision():.4f}",
-                 f" Recall:    {self.recall():.4f}",
-                 f" F1 Score:  {self.f1():.4f}",
-                 "========================================================================"]
+                 f" Accuracy:  {self.accuracy():.4f}"]
+        if self.top_n > 1:
+            lines.append(f" Top-{self.top_n} Accuracy: {self.top_n_accuracy():.4f}")
+        lines += [f" Precision: {self.precision():.4f}",
+                  f" Recall:    {self.recall():.4f}",
+                  f" F1 Score:  {self.f1():.4f}",
+                  "========================================================================"]
         if self.confusion is not None and self.n_classes <= 20:
-            lines.append("Confusion matrix:")
-            lines.append(str(self.confusion))
+            names = [self.label_name(c) for c in range(self.n_classes)]
+            w = max(len(n) for n in names)
+            lines.append("Confusion matrix (rows = actual, cols = predicted):")
+            cols = " ".join(f"{n:>{max(w, 5)}}" for n in names)
+            lines.append(f"{'':>{w}} {cols}")
+            for a in range(self.n_classes):
+                row = " ".join(f"{self.confusion.get_count(a, p):>{max(w, 5)}}"
+                               for p in range(self.n_classes))
+                lines.append(f"{names[a]:>{w}} {row}")
         return "\n".join(lines)
 
     def merge(self, other: "Evaluation") -> "Evaluation":
@@ -175,6 +215,10 @@ class Evaluation:
         if self.confusion is None:
             self.n_classes = other.n_classes
             self.confusion = ConfusionMatrix(other.n_classes)
+        if self.labels is None:
+            self.labels = other.labels
         self.confusion.matrix += other.confusion.matrix
         self.num_examples += other.num_examples
+        self.top_n_correct += other.top_n_correct
+        self._predictions.extend(other._predictions)
         return self

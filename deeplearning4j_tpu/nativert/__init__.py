@@ -145,7 +145,10 @@ def get_runtime() -> Optional[ctypes.CDLL]:
             if lib.dl4j_runtime_version() != 3:
                 return None
             _lib = lib
-        except OSError:
+        except (OSError, AttributeError):
+            # AttributeError: a stale older-version .so whose rebuild failed
+            # is missing current-version symbols — fall back to pure Python
+            # rather than raising out of native_available()
             _lib = None
         return _lib
 

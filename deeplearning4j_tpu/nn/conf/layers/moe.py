@@ -14,6 +14,7 @@ Params: "Wg" [F, E] router; experts batched on the leading axis —
 from __future__ import annotations
 
 import dataclasses
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -150,6 +151,11 @@ class MoETransformerBlock(MoELayer):
 
     n_heads: int = 4
     causal: bool = True
+    #: residual-stream blocks take no output nonlinearity by default; an
+    #: explicit non-identity default here keeps bake_layer_defaults from
+    #: filling None with the global activation (sigmoid) and squashing the
+    #: residual stream. A user-set activation is still honored in apply().
+    activation: Optional[str] = "identity"
 
     def init_params(self, key, itype: InputType) -> dict:
         F = self.n_out
@@ -201,4 +207,6 @@ class MoETransformerBlock(MoELayer):
         y2d, aux = self.moe_ffn_2d(params, h.reshape(-1, F), train=train,
                                    rng=rng)
         new_state = {"aux_loss": aux if train else jnp.zeros_like(aux)}
-        return x + y2d.reshape(B, T, F), new_state
+        # honor a user-configured activation on the block output (default is
+        # identity — the standard residual-stream semantics)
+        return self.act_fn()(x + y2d.reshape(B, T, F)), new_state

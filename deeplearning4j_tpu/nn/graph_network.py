@@ -124,14 +124,23 @@ def graph_loss(conf, params, states, inputs, labels, rng, fmasks=None, lmasks=No
         h = loss_inputs[out_name]
         lmask = lmasks[i] if lmasks else None
         total = total + vertex.layer.compute_loss(params[out_name], h, labels[i], lmask)
-    # layer-declared auxiliary objectives (MoE load-balance etc.), published
-    # through the vertex state pytree as "aux_loss"
+    total = total + _aux_losses(conf, new_states)
+    return total + _graph_regularization(conf, params), new_states
+
+
+def _aux_losses(conf, new_states):
+    """Layer-declared auxiliary objectives (MoE load-balance etc.), published
+    through the vertex state pytree as "aux_loss". Shared by the standard and
+    TBPTT train objectives so a MoE vertex keeps its balance term under
+    truncated BPTT too (reference computeGradientAndScore:952 adds every
+    layer's contribution regardless of backprop type)."""
+    total = jnp.float32(0.0)
     for name, ns in new_states.items():
         if isinstance(ns, dict) and "aux_loss" in ns:
             vertex = conf.vertices[name]
             w = getattr(getattr(vertex, "layer", None), "aux_loss_weight", 1.0)
             total = total + w * ns["aux_loss"]
-    return total + _graph_regularization(conf, params), new_states
+    return total
 
 
 def _coerce_graph_batch(ds):
@@ -285,6 +294,7 @@ def make_graph_tbptt_step(conf: ComputationGraphConfiguration):
                 lmask = lmasks[i] if lmasks else None
                 total = total + vertex.layer.compute_loss(
                     p[out_name], loss_inputs[out_name], labels[i], lmask)
+            total = total + _aux_losses(conf, new_states)
             return total + _graph_regularization(conf, p), (new_states, new_rnn)
 
         (loss, (new_states, new_rnn)), grads = jax.value_and_grad(
@@ -460,12 +470,9 @@ class ComputationGraph(LazyScore):
     def _fit_repeated(self, xs, ys, epochs: int) -> None:
         """Repeated steps on one device-resident multi-IO batch, K per
         dispatch (see MultiLayerNetwork._fit_repeated)."""
-        def stage(a):
-            a = jnp.asarray(a)
-            return (a.astype(self.stage_dtype)
-                    if self.stage_dtype is not None else a)
+        from deeplearning4j_tpu.nn.multilayer import _stage_host
 
-        xd = [stage(a) for a in xs]
+        xd = [jnp.asarray(_stage_host(a, self.stage_dtype)) for a in xs]
         yd = [jnp.asarray(a) for a in ys]
         multi = self._jit("multistep",
                           make_graph_multistep_train_step(self.conf),
@@ -543,12 +550,10 @@ class ComputationGraph(LazyScore):
             return
         n_in, n_out = len(batches[0][0]), len(batches[0][1])
 
-        def stage(stack):
-            if self.stage_dtype is not None:
-                stack = stack.astype(self.stage_dtype)
-            return jnp.asarray(stack)
+        from deeplearning4j_tpu.nn.multilayer import _stage_host
 
-        xs = [stage(np.stack([b[0][i] for b in batches]))
+        xs = [jnp.asarray(_stage_host(np.stack([b[0][i] for b in batches]),
+                                      self.stage_dtype))
               for i in range(n_in)]
         ys = [jnp.asarray(np.stack([b[1][i] for b in batches]))
               for i in range(n_out)]
@@ -607,18 +612,57 @@ class ComputationGraph(LazyScore):
                 listener.iteration_done(self, self.iteration)
 
     # ------------------------------------------------------------------ evaluation
-    def evaluate(self, iterator):
+    def evaluate(self, iterator, labels_list=None, top_n: int = 1):
+        """Evaluate the network's outputs against a (Multi)DataSet iterator
+        (reference ComputationGraph.evaluate:2230,2253).
+
+        Label masks are threaded per output stream — masked timesteps do not
+        count — and every network output is scored against its matching label
+        array into one accumulated Evaluation (single-output graphs behave
+        exactly as before). ``labels_list``/``top_n`` attach class-label names
+        and top-N accuracy, as in MultiLayerNetwork.evaluate.
+        """
         from deeplearning4j_tpu.eval.evaluation import Evaluation
 
-        ev = Evaluation()
+        ev = Evaluation(labels=labels_list, top_n=top_n)
         if hasattr(iterator, "reset"):
             iterator.reset()
         for ds in iterator:
-            feats = ds.features if isinstance(ds, MultiDataSet) else [ds.features]
-            labels = ds.labels if isinstance(ds, MultiDataSet) else [ds.labels]
-            outs = self.output(*feats)
-            ev.eval(np.asarray(labels[0]), np.asarray(outs[0]))
+            feats, labels, fmasks, lmasks = _coerce_graph_batch(ds)
+            outs = self._output_for_eval(feats, fmasks)
+            n_cls = np.asarray(labels[0]).shape[-1]
+            for i, out in enumerate(outs):
+                if i >= len(labels):
+                    break
+                if np.asarray(labels[i]).shape[-1] != n_cls:
+                    # one Evaluation holds one confusion matrix; streams with
+                    # a different class count need their own pass (evaluate a
+                    # single-output view or use eval/ directly)
+                    continue
+                lm = (np.asarray(lmasks[i])
+                      if lmasks and i < len(lmasks) and lmasks[i] is not None
+                      else None)
+                ev.eval(np.asarray(labels[i]), np.asarray(out), mask=lm)
         return ev
+
+    def _output_for_eval(self, feats, fmasks):
+        """Eval-mode forward that honors feature masks (evaluate's path;
+        output() stays the mask-free public inference entry)."""
+        self._require_init()
+        xs = [jnp.asarray(f) for f in feats]
+        if fmasks is None:
+            fn = self._jit("output", self._output_pure)
+            outs, _ = fn(self.params_list, self.state_list, xs)
+            return outs
+        ms = [jnp.asarray(m) if m is not None else None for m in fmasks]
+        fn = self._jit("output_masked", self._output_masked_pure)
+        outs, _ = fn(self.params_list, self.state_list, xs, ms)
+        return outs
+
+    def _output_masked_pure(self, params, states, xs, masks):
+        acts, ns, _ = graph_forward(self.conf, params, states, xs, train=False,
+                                    rng=None, masks=masks)
+        return [acts[o] for o in self.conf.network_outputs], ns
 
     # ------------------------------------------------------------------ TBPTT
     def _fit_tbptt(self, xs, ys, fmasks=None, lmasks=None) -> None:

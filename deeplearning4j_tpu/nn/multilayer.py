@@ -218,6 +218,18 @@ def make_multistep_train_step(conf: MultiLayerConfiguration):
     return multi_step
 
 
+def _stage_host(x, dtype):
+    """Cast features to the staging dtype ON THE HOST, before the device
+    transfer, so ``stage_dtype`` halves host->device wire bytes on every fit
+    path (its documented contract). Device-resident jax Arrays are cast on
+    device instead — pulling them back to host would defeat the point."""
+    if dtype is None:
+        return x
+    if isinstance(x, jax.Array):
+        return x.astype(dtype)
+    return np.asarray(x).astype(dtype, copy=False)
+
+
 class LazyScore:
     """`score_value` that syncs device->host only when actually read.
 
@@ -271,8 +283,14 @@ class LazyScore:
         conf_dtype = getattr(getattr(getattr(self, "conf", None),
                                      "global_conf", None), "dtype", None)
         fn = common.wrap_with_policy(fn, conf_dtype)
-        key = (name,) + common.effective_policy_key(conf_dtype)
+        pol = common.effective_policy_key(conf_dtype)
+        key = (name,) + pol
         if key not in self._jit_cache:
+            # evict programs traced under a different policy — repeatedly
+            # switching the global dtype policy must not grow the cache
+            # without bound (each entry pins a compiled XLA program)
+            for stale in [k for k in self._jit_cache if k[1:] != pol]:
+                del self._jit_cache[stale]
             self._jit_cache[key] = (jax.jit(fn, donate_argnums=donate)
                                     if donate else jax.jit(fn))
         return self._jit_cache[key]
@@ -432,9 +450,8 @@ class MultiLayerNetwork(LazyScore):
         """``epochs`` repeated steps on one device-resident batch, K per
         dispatch via the scanned train step (broadcast along the scan axis —
         XLA reads the same HBM buffer each step, no K-fold staging)."""
-        xd, yd = jnp.asarray(x), jnp.asarray(y)
-        if self.stage_dtype is not None:
-            xd = xd.astype(self.stage_dtype)
+        xd = jnp.asarray(_stage_host(x, self.stage_dtype))
+        yd = jnp.asarray(y)
         multi = self._jit("multistep", make_multistep_train_step(self.conf),
                           donate=(0, 1, 2))
         remaining = epochs
@@ -527,10 +544,8 @@ class MultiLayerNetwork(LazyScore):
         if len(batches) == 1:
             self._fit_batch(batches[0][0], batches[0][1])
             return
-        xs = np.stack([b[0] for b in batches])
-        if self.stage_dtype is not None:
-            xs = xs.astype(self.stage_dtype)
-        xs = jnp.asarray(xs)
+        xs = jnp.asarray(_stage_host(np.stack([b[0] for b in batches]),
+                                     self.stage_dtype))
         ys = jnp.asarray(np.stack([b[1] for b in batches]))
         # params/states/updater buffers are DONATED: XLA updates them in
         # place (no 2x param HBM during the step). The previous arrays are
@@ -628,10 +643,16 @@ class MultiLayerNetwork(LazyScore):
                 self.score_value = loss  # synced lazily (LazyScore)
 
     # ------------------------------------------------------------------ evaluation
-    def evaluate(self, iterator_or_x, y=None):
+    def evaluate(self, iterator_or_x, y=None, labels_list=None, top_n: int = 1):
+        """Evaluate classification accuracy over an iterator or an (x, y) pair.
+
+        ``labels_list`` attaches class-label names to the returned Evaluation's
+        stats; ``top_n`` tracks top-N accuracy alongside top-1 (reference
+        MultiLayerNetwork.evaluate(DataSetIterator, List<String>, int)).
+        """
         from deeplearning4j_tpu.eval.evaluation import Evaluation
 
-        ev = Evaluation()
+        ev = Evaluation(labels=labels_list, top_n=top_n)
         if y is not None:
             ev.eval(np.asarray(y), np.asarray(self.output(iterator_or_x)))
             return ev

@@ -167,6 +167,11 @@ class ParameterAveragingTrainingMaster(TrainingMaster):
             getattr(model.conf.global_conf, "dtype", None))
         if key in self._local_fns:
             return self._local_fns[key]
+        # switching the global dtype policy must not grow the cache without
+        # bound: drop programs traced under a policy that no longer applies
+        for stale in [k for k in self._local_fns if k[0] == key[0]
+                      and k[1:] != key[1:]]:
+            del self._local_fns[stale]
         mesh = self.mesh
         if isinstance(model, ComputationGraph):
             graph_base = make_graph_train_step(model.conf)

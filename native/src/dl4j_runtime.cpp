@@ -523,6 +523,10 @@ bool vc_count_range(const char* data, size_t begin, size_t end, bool common,
   for (size_t i = begin; i <= end; i++) {
     unsigned char c = (i < end) ? (unsigned char)data[i] : ' ';
     if (c >= 0x80) return false;  // non-ASCII: caller must fall back
+    // non-printable control bytes outside the whitespace set (NUL etc.)
+    // would be silently truncated by the C-string readout; decline so the
+    // Python fallback keeps the token intact
+    if (c < 0x20 && !vc_is_space(c)) return false;
     if (vc_is_space(c)) {
       if (!tok.empty()) {
         (*counts)[tok]++;

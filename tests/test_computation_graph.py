@@ -320,3 +320,69 @@ def test_graph_char_rnn_streaming_generation():
         np.testing.assert_allclose(np.asarray(out).sum(axis=-1), 1.0,
                                    atol=1e-5)
     assert len(generated) == 8
+
+
+def test_graph_evaluate_threads_label_masks():
+    """CG.evaluate must honor labels_mask — masked timesteps don't count
+    (reference ComputationGraph.evaluate:2230; parity with
+    MultiLayerNetwork.evaluate's mask threading)."""
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.datasets.iterators import ExistingDataSetIterator
+    from deeplearning4j_tpu.eval.evaluation import Evaluation
+    from deeplearning4j_tpu.nn.conf.layers import GravesLSTM, RnnOutputLayer
+
+    rng = np.random.default_rng(0)
+    B, T, C = 4, 6, 3
+    x = rng.normal(size=(B, T, C)).astype(np.float32)
+    y = np.eye(C, dtype=np.float32)[rng.integers(0, C, (B, T))]
+    lmask = np.ones((B, T), np.float32)
+    lmask[:, T // 2:] = 0  # second half of every sequence is padding
+    conf = (NeuralNetConfiguration.builder()
+            .seed(3).learning_rate(0.05)
+            .graph_builder()
+            .add_inputs("in")
+            .add_layer("lstm", GravesLSTM(n_in=C, n_out=8, activation="tanh"),
+                       "in")
+            .add_layer("out", RnnOutputLayer(n_in=8, n_out=C, loss="mcxent",
+                                             activation="softmax"), "lstm")
+            .set_outputs("out")
+            .build())
+    net = ComputationGraph(conf).init()
+    it = ExistingDataSetIterator([DataSet(x, y, labels_mask=lmask)])
+    ev = net.evaluate(it)
+    # reference accumulation: identical forward, mask applied by hand
+    expect = Evaluation()
+    expect.eval(y, np.asarray(net.output(x)[0]), mask=lmask)
+    assert ev.num_examples == expect.num_examples == B * (T // 2)
+    assert ev.accuracy() == expect.accuracy()
+    # and differs from the mask-blind count
+    assert ev.num_examples != B * T
+
+
+def test_graph_evaluate_multi_output_and_top_n():
+    """Every network output is scored against its label stream; top_n and
+    labels_list ride through (reference ComputationGraph.evaluate:2253)."""
+    from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=(10, 4)).astype(np.float32)
+    y1 = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 10)]
+    y2 = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 10)]
+    conf = (NeuralNetConfiguration.builder()
+            .seed(4).learning_rate(0.1)
+            .graph_builder()
+            .add_inputs("in")
+            .add_layer("shared", DenseLayer(n_in=4, n_out=8,
+                                            activation="tanh"), "in")
+            .add_layer("out1", OutputLayer(n_in=8, n_out=3, loss="mcxent",
+                                           activation="softmax"), "shared")
+            .add_layer("out2", OutputLayer(n_in=8, n_out=3, loss="mcxent",
+                                           activation="softmax"), "shared")
+            .set_outputs("out1", "out2")
+            .build())
+    net = ComputationGraph(conf).init()
+    mds = MultiDataSet([x], [y1, y2])
+    ev = net.evaluate(iter([mds]), labels_list=["a", "b", "c"], top_n=2)
+    assert ev.num_examples == 20  # both output streams accumulated
+    assert ev.top_n_accuracy() >= ev.accuracy()
+    assert "Top-2 Accuracy" in ev.stats() and "a" in ev.stats()

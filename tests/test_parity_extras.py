@@ -156,3 +156,37 @@ def test_profiler_listener_captures_trace(tmp_path):
     assert listener.windows, "no trace window completed"
     files = glob.glob(str(tmp_path) + "/**/*.xplane.pb", recursive=True)
     assert files, "no xplane trace written"
+
+
+def test_evaluation_top_n_accuracy():
+    """Top-N accuracy counts a guess when the true class is among the N
+    highest-probability outputs (reference Evaluation(topN) / topNAccuracy)."""
+    e = Evaluation(top_n=2)
+    labels = np.eye(3, dtype=np.float32)[[0, 1, 2, 0]]
+    preds = np.array([[0.6, 0.3, 0.1],   # top-1 hit
+                      [0.5, 0.4, 0.1],   # top-1 miss, top-2 hit (cls 1)
+                      [0.5, 0.4, 0.1],   # top-2 miss (cls 2 is last)
+                      [0.1, 0.5, 0.4]],  # both miss... top-2 of row = {1,2}, actual 0 -> miss
+                     np.float32)
+    e.eval(labels, preds)
+    assert e.accuracy() == 0.25
+    assert e.top_n_accuracy() == 0.5
+    assert f"Top-2 Accuracy" in e.stats()
+
+
+def test_evaluation_label_names_in_stats():
+    """Class-label names render in stats()/confusion output (reference
+    eval/Evaluation.java labeled constructors)."""
+    e = Evaluation(labels=["cat", "dog", "fish"])
+    labels = np.eye(3, dtype=np.float32)[[0, 1, 2, 2]]
+    preds = np.eye(3, dtype=np.float32)[[0, 1, 1, 2]]
+    e.eval(labels, preds)
+    s = e.stats()
+    assert "cat" in s and "dog" in s and "fish" in s
+    assert e.label_name(1) == "dog"
+    # merge preserves names and top-n counters
+    e2 = Evaluation()
+    e2.eval(labels, preds)
+    e2.merge(e)
+    assert e2.labels == ["cat", "dog", "fish"]
+    assert e2.num_examples == 8

@@ -475,3 +475,36 @@ def test_cosine_and_warmup_schedules():
     assert abs(w10 - 0.2) < 1e-6
     assert abs(w_peak - 0.4) < 1e-6
     assert w_end < 1e-6
+
+
+def test_stage_dtype_casts_on_host_before_transfer():
+    """stage_dtype's contract is halved host->device wire bytes: the cast
+    must happen on the HOST numpy array before jnp.asarray on every fit
+    path (round-3 weak item: _fit_repeated shipped f32 then cast)."""
+    import jax
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.nn.multilayer import _stage_host
+
+    x = np.random.default_rng(0).normal(size=(4, 3)).astype(np.float32)
+    staged = _stage_host(x, jnp.bfloat16)
+    assert isinstance(staged, np.ndarray) and not isinstance(staged, jax.Array)
+    assert staged.dtype == jnp.bfloat16  # cast happened host-side, pre-wire
+    assert _stage_host(x, None) is x
+    # device-resident arrays stay on device (no host round-trip)
+    xd = jnp.asarray(x)
+    assert isinstance(_stage_host(xd, jnp.bfloat16), jax.Array)
+
+    # the fused-epochs path still trains correctly with staging enabled
+    conf = (NeuralNetConfiguration.builder()
+            .seed(0).learning_rate(0.1)
+            .list()
+            .layer(DenseLayer(n_in=4, n_out=8, activation="tanh"))
+            .layer(OutputLayer(n_in=8, n_out=3, loss="mcxent",
+                               activation="softmax"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    net.stage_dtype = jnp.bfloat16
+    xs = np.random.default_rng(1).normal(size=(16, 4)).astype(np.float32)
+    ys = np.eye(3, dtype=np.float32)[np.random.default_rng(2).integers(0, 3, 16)]
+    net.fit(xs, ys, epochs=4)
+    assert np.isfinite(net.score_value)
