@@ -74,43 +74,92 @@ def check_pretrain_gradients(net, layer_idx: int, x, *, eps: float = 1e-6,
             def score(p_layer):
                 return layer.pretrain_loss(p_layer, h, rng=key)
 
-            analytic = jax.grad(score)(params64[layer_idx])
-            flat_analytic = np.asarray(flatten_params(analytic), np.float64)
-            flat_params = np.asarray(flatten_params(params64[layer_idx]),
-                                     np.float64)
-            n = len(flat_params)
-            if subset is not None and subset < n:
-                indices = np.random.default_rng(seed).choice(n, subset,
-                                                             replace=False)
-            else:
-                indices = np.arange(n)
-            score_jit = jax.jit(lambda flat: score(
-                unflatten_params(params64[layer_idx], flat)))
-            fails = 0
-            max_err = 0.0
-            for i in indices:
-                plus = flat_params.copy()
-                plus[i] += eps
-                minus = flat_params.copy()
-                minus[i] -= eps
-                numeric = (float(score_jit(jnp.asarray(plus)))
-                           - float(score_jit(jnp.asarray(minus)))) / (2 * eps)
-                a = flat_analytic[i]
-                denom = max(abs(numeric), abs(a))
-                rel = abs(numeric - a) / denom if denom > 0 else 0.0
-                if rel > max_rel_error and abs(numeric - a) > min_abs_error:
-                    fails += 1
-                    if verbose:
-                        print(f"param {i}: analytic={a:.8g} "
-                              f"numeric={numeric:.8g} rel={rel:.3g}")
-                max_err = max(max_err,
-                              rel if abs(numeric - a) > min_abs_error else 0.0)
-            if verbose:
-                print(f"pretrain gradient check: {len(indices)} params, "
-                      f"max rel err {max_err:.3g}, {fails} failures")
-            return fails == 0
+            return _fd_check_subtree(score, params64[layer_idx], eps=eps,
+                                     max_rel_error=max_rel_error,
+                                     min_abs_error=min_abs_error,
+                                     subset=subset, seed=seed, verbose=verbose,
+                                     tag="pretrain")
     finally:
         common._POLICY = saved_policy
+
+
+def check_graph_pretrain_gradients(net, vertex_name: str, xs, *,
+                                   eps: float = 1e-6,
+                                   max_rel_error: float = 1e-3,
+                                   min_abs_error: float = 1e-8,
+                                   subset: Optional[int] = None, seed: int = 0,
+                                   rng_seed: int = 5,
+                                   verbose: bool = False) -> bool:
+    """ComputationGraph twin of check_pretrain_gradients (reference
+    GradientCheckUtil.checkGradientsPretrainLayer:305 applied to graph
+    vertices): evaluate the vertex's ancestors in f64 eval mode, then
+    finite-difference its pretrain objective wrt that vertex's params."""
+    from deeplearning4j_tpu import common
+    from deeplearning4j_tpu.nn.graph_network import eval_forward_to_vertex
+
+    saved_policy = common.get_policy()
+    common.set_policy(jnp.float64, jnp.float64, jnp.float64)
+    try:
+        with jax.enable_x64(True):
+            conf = net.conf
+            layer = conf.vertices[vertex_name].layer
+            params64 = jax.tree_util.tree_map(
+                lambda a: jnp.asarray(np.asarray(a), jnp.float64),
+                net.params_list)
+            inputs64 = [jnp.asarray(np.asarray(x), jnp.float64) for x in xs]
+            h = eval_forward_to_vertex(conf, params64, net.state_list,
+                                       inputs64, vertex_name)
+            key = jax.random.PRNGKey(rng_seed)
+
+            def score(p_vertex):
+                return layer.pretrain_loss(p_vertex, h, rng=key)
+
+            return _fd_check_subtree(score, params64[vertex_name], eps=eps,
+                                     max_rel_error=max_rel_error,
+                                     min_abs_error=min_abs_error,
+                                     subset=subset, seed=seed, verbose=verbose,
+                                     tag=f"graph pretrain[{vertex_name}]")
+    finally:
+        common._POLICY = saved_policy
+
+
+def _fd_check_subtree(score, params_subtree, *, eps, max_rel_error,
+                      min_abs_error, subset, seed, verbose, tag) -> bool:
+    """Central finite-difference vs autodiff over one params subtree (the
+    shared core of the MLN and CG pretrain checkers)."""
+    analytic = jax.grad(score)(params_subtree)
+    flat_analytic = np.asarray(flatten_params(analytic), np.float64)
+    flat_params = np.asarray(flatten_params(params_subtree), np.float64)
+    n = len(flat_params)
+    if subset is not None and subset < n:
+        indices = np.random.default_rng(seed).choice(n, subset, replace=False)
+    else:
+        indices = np.arange(n)
+    score_jit = jax.jit(lambda flat: score(
+        unflatten_params(params_subtree, flat)))
+    fails = 0
+    max_err = 0.0
+    for i in indices:
+        plus = flat_params.copy()
+        plus[i] += eps
+        minus = flat_params.copy()
+        minus[i] -= eps
+        numeric = (float(score_jit(jnp.asarray(plus)))
+                   - float(score_jit(jnp.asarray(minus)))) / (2 * eps)
+        a = flat_analytic[i]
+        denom = max(abs(numeric), abs(a))
+        rel = abs(numeric - a) / denom if denom > 0 else 0.0
+        if rel > max_rel_error and abs(numeric - a) > min_abs_error:
+            fails += 1
+            if verbose:
+                print(f"param {i}: analytic={a:.8g} "
+                      f"numeric={numeric:.8g} rel={rel:.3g}")
+        max_err = max(max_err,
+                      rel if abs(numeric - a) > min_abs_error else 0.0)
+    if verbose:
+        print(f"{tag} gradient check: {len(indices)} params, "
+              f"max rel err {max_err:.3g}, {fails} failures")
+    return fails == 0
 
 
 def _check_gradients_x64(net, x, y, *, eps, max_rel_error, min_abs_error, subset,

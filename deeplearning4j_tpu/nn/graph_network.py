@@ -331,6 +331,71 @@ def make_graph_multistep_train_step(conf: ComputationGraphConfiguration):
     return multi_step
 
 
+def _ancestor_set(conf, target: str) -> set:
+    """All vertices the target transitively depends on (inputs included)."""
+    anc: set = set()
+    stack = list(conf.vertex_inputs.get(target, []))
+    while stack:
+        n = stack.pop()
+        if n in anc:
+            continue
+        anc.add(n)
+        stack.extend(conf.vertex_inputs.get(n, []))
+    return anc
+
+
+def eval_forward_to_vertex(conf, params, states, inputs, name: str):
+    """Eval-mode forward of ``name``'s ancestors only; returns the vertex's
+    (first) input activation. ONE walk shared by the pretrain train step and
+    the graph pretrain gradient checker so both always see the same forward."""
+    anc = _ancestor_set(conf, name)
+    order = [n for n in (conf.topological_order or conf.topo_sort())
+             if n in anc]
+    acts = dict(zip(conf.network_inputs, inputs))
+    for n in order:
+        if n in acts:
+            continue
+        vins = [acts[s] for s in conf.vertex_inputs[n]]
+        y, _ = conf.vertices[n].apply(params.get(n, {}), states.get(n, {}),
+                                      vins, train=False, rng=None)
+        acts[n] = y
+    return acts[conf.vertex_inputs[name][0]]
+
+
+def make_graph_pretrain_step(conf: ComputationGraphConfiguration, name: str):
+    """Unsupervised pretrain step for one graph vertex (reference
+    ComputationGraph.pretrainLayer:540): evaluate the vertex's ancestors in
+    eval mode, stop the gradient at the vertex input, and minimize the
+    vertex layer's pretrain objective — only that vertex's params move."""
+    g = conf.global_conf
+    layer = conf.vertices[name].layer
+
+    def pretrain_step(params, states, vertex_upd_state, inputs, rng, iteration):
+        h = jax.lax.stop_gradient(
+            eval_forward_to_vertex(conf, params, states, inputs, name))
+
+        def lf(p):
+            return layer.pretrain_loss(p, h, rng=rng)
+
+        loss, grads = jax.value_and_grad(lf)(params[name])
+        grads = normalize_gradients(grads, layer.gradient_normalization,
+                                    layer.gradient_normalization_threshold or 1.0)
+        spec = _updater_spec(layer)
+        lr = effective_lr(layer.learning_rate, g.lr_policy, iteration,
+                          g.lr_policy_decay_rate, g.lr_policy_power,
+                          g.lr_policy_steps, g.lr_schedule, g.max_num_iterations)
+        p_new, u_new = {}, {}
+        for pname, grad in grads.items():
+            step, ustate = updater_step_with_param(
+                spec, grad, params[name][pname], vertex_upd_state[pname],
+                lr, iteration)
+            p_new[pname] = params[name][pname] - step
+            u_new[pname] = ustate
+        return p_new, u_new, loss
+
+    return common.wrap_with_policy(pretrain_step, g.dtype)
+
+
 class ComputationGraph(LazyScore):
     """Stateful shell (reference nn/graph/ComputationGraph.java)."""
 
@@ -516,6 +581,10 @@ class ComputationGraph(LazyScore):
                     listener.on_epoch_start(self)
             if hasattr(iterator, "reset"):
                 iterator.reset()
+            if self.conf.pretrain:
+                self.pretrain(iterator)
+                if hasattr(iterator, "reset"):
+                    iterator.reset()
             if multistep_ok:
                 self._fit_epoch_multistep(iterator, k)
             else:
@@ -610,6 +679,48 @@ class ComputationGraph(LazyScore):
             self.iteration += 1
             for listener in self.listeners:
                 listener.iteration_done(self, self.iteration)
+
+    # ------------------------------------------------------------------ pretrain
+    def pretrain(self, iterator) -> None:
+        """Greedy layerwise unsupervised pretraining over every pretrainable
+        vertex in topological order (reference ComputationGraph.pretrain:509):
+        earlier vertices are frozen features for later ones."""
+        from deeplearning4j_tpu.nn.conf.layers.base import PretrainLayer
+
+        for name in self.conf.topological_order or self.conf.topo_sort():
+            vertex = self.conf.vertices[name]
+            if (isinstance(vertex, LayerVertex)
+                    and isinstance(vertex.layer, PretrainLayer)):
+                self.pretrain_layer(name, iterator)
+
+    def pretrain_layer(self, name: str, iterator) -> None:
+        """Pretrain ONE vertex layer unsupervised (reference
+        ComputationGraph.pretrainLayer:540). Ancestor vertices run in eval
+        mode to produce its input; only the named vertex's params update."""
+        from deeplearning4j_tpu.nn.conf.layers.base import PretrainLayer
+
+        self._require_init()
+        if name not in self.conf.vertices:
+            raise ValueError(
+                f"Unknown vertex '{name}' — graph vertices: "
+                f"{sorted(self.conf.vertices)}")
+        vertex = self.conf.vertices[name]
+        if not (isinstance(vertex, LayerVertex)
+                and isinstance(vertex.layer, PretrainLayer)):
+            raise ValueError(
+                f"Vertex '{name}' is not pretrainable — layerwise pretraining "
+                "needs an unsupervised layer (VAE, RBM, AutoEncoder)")
+        step = self._jit(f"pretrain:{name}",
+                         make_graph_pretrain_step(self.conf, name))
+        if hasattr(iterator, "reset"):
+            iterator.reset()
+        for ds in iterator:
+            xs, _, _, _ = _coerce_graph_batch(ds)
+            xs = [jnp.asarray(x) for x in xs]
+            (self.params_list[name], self.updater_state[name], loss) = step(
+                self.params_list, self.state_list, self.updater_state[name],
+                xs, self._next_rng(), jnp.int32(self.iteration))
+            self.score_value = loss  # synced lazily (LazyScore)
 
     # ------------------------------------------------------------------ evaluation
     def evaluate(self, iterator, labels_list=None, top_n: int = 1):

@@ -632,7 +632,8 @@ class MultiLayerNetwork(LazyScore):
         for idx, layer in enumerate(self.conf.layers):
             if not isinstance(layer, PretrainLayer):
                 continue
-            step = jax.jit(make_pretrain_step(self.conf, idx))
+            step = self._jit(f"pretrain:{idx}",
+                             make_pretrain_step(self.conf, idx))
             if hasattr(iterator, "reset"):
                 iterator.reset()
             for ds in iterator:

@@ -3,6 +3,7 @@
 Reference analog: deeplearning4j-core TestComputationGraphNetwork +
 GradientCheckTestsComputationGraph.
 """
+import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
@@ -386,3 +387,110 @@ def test_graph_evaluate_multi_output_and_top_n():
     assert ev.num_examples == 20  # both output streams accumulated
     assert ev.top_n_accuracy() >= ev.accuracy()
     assert "Top-2 Accuracy" in ev.stats() and "a" in ev.stats()
+
+
+def test_graph_pretrain_layer_and_pretrain():
+    """CG layerwise pretraining parity (reference ComputationGraph
+    pretrain:509 / pretrainLayer:540): only the target vertex's params move,
+    its unsupervised loss decreases, and pretrain() walks every pretrainable
+    vertex in topological order."""
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.datasets.iterators import ExistingDataSetIterator
+    from deeplearning4j_tpu.nn.conf.layers import (
+        AutoEncoder, DenseLayer, OutputLayer, VariationalAutoencoder,
+    )
+
+    rng = np.random.default_rng(7)
+    x = rng.normal(size=(16, 6)).astype(np.float32)
+    conf = (NeuralNetConfiguration.builder()
+            .seed(11).learning_rate(0.05)
+            .graph_builder()
+            .add_inputs("in")
+            .add_layer("ae", AutoEncoder(n_in=6, n_out=5,
+                                         activation="sigmoid"), "in")
+            .add_layer("vae", VariationalAutoencoder(
+                n_in=5, n_out=4, encoder_layer_sizes=(8,),
+                decoder_layer_sizes=(8,)), "ae")
+            .add_layer("out", OutputLayer(n_in=4, n_out=3, loss="mcxent",
+                                          activation="softmax"), "vae")
+            .set_outputs("out")
+            .build())
+    net = ComputationGraph(conf).init()
+    it = ExistingDataSetIterator(
+        [DataSet(x, np.eye(3, dtype=np.float32)[rng.integers(0, 3, 16)])])
+
+    p_before = {n: jax.tree_util.tree_map(np.asarray, p)
+                for n, p in net.params_list.items()}
+    # pretrain the VAE vertex alone: ae + out params must not move
+    losses = []
+    for _ in range(15):
+        net.pretrain_layer("vae", it)
+        losses.append(net.score_value)
+    assert losses[-1] < losses[0], losses
+    for pname, val in net.params_list["ae"].items():
+        np.testing.assert_array_equal(np.asarray(val), p_before["ae"][pname])
+    for pname, val in net.params_list["out"].items():
+        np.testing.assert_array_equal(np.asarray(val), p_before["out"][pname])
+    moved = any(not np.array_equal(np.asarray(v), p_before["vae"][k])
+                for k, v in net.params_list["vae"].items())
+    assert moved
+
+    # pretrain() walks both pretrainable vertices (ae then vae)
+    net2 = ComputationGraph(conf).init()
+    p0 = {n: jax.tree_util.tree_map(np.asarray, p)
+          for n, p in net2.params_list.items()}
+    net2.pretrain(it)
+    for vertex_name in ("ae", "vae"):
+        assert any(
+            not np.array_equal(np.asarray(v), p0[vertex_name][k])
+            for k, v in net2.params_list[vertex_name].items()), vertex_name
+    for pname, val in net2.params_list["out"].items():
+        np.testing.assert_array_equal(np.asarray(val), p0["out"][pname])
+
+    # actionable errors
+    with pytest.raises(ValueError, match="not pretrainable"):
+        net.pretrain_layer("out", it)
+    with pytest.raises(ValueError, match="Unknown vertex"):
+        net.pretrain_layer("nope", it)
+
+
+def test_graph_rbm_vertex_pretrains():
+    """An RBM vertex pretrains under CG pretrain_layer: its CD surrogate
+    objective moves only its own params and free energy of the data drops
+    (CD's objective is not a true loss, so descent — not FD — is the check)."""
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.datasets.iterators import ExistingDataSetIterator
+    from deeplearning4j_tpu.nn.conf.layers import OutputLayer, RBM
+
+    rng = np.random.default_rng(8)
+    x = (rng.uniform(size=(32, 6)) > 0.5).astype(np.float32)
+    conf = (NeuralNetConfiguration.builder()
+            .seed(21).learning_rate(0.1)
+            .graph_builder()
+            .add_inputs("in")
+            .add_layer("rbm", RBM(n_in=6, n_out=8,
+                                  activation="sigmoid"), "in")
+            .add_layer("out", OutputLayer(n_in=8, n_out=2, loss="mcxent",
+                                          activation="softmax"), "rbm")
+            .set_outputs("out")
+            .build())
+    net = ComputationGraph(conf).init()
+    it = ExistingDataSetIterator(
+        [DataSet(x, np.eye(2, dtype=np.float32)[rng.integers(0, 2, 32)])])
+
+    def recon_err(params, v):
+        # CD's observable progress metric: one up-down pass reconstruction
+        def sigmoid(a):
+            return 1.0 / (1.0 + np.exp(-a))
+        h = sigmoid(v @ np.asarray(params["W"]) + np.asarray(params["b"]))
+        vr = sigmoid(h @ np.asarray(params["W"]).T + np.asarray(params["vb"]))
+        return float(np.mean((v - vr) ** 2))
+
+    err0 = recon_err(net.params_list["rbm"], x)
+    out_before = jax.tree_util.tree_map(np.asarray, net.params_list["out"])
+    for _ in range(30):
+        net.pretrain_layer("rbm", it)
+    err1 = recon_err(net.params_list["rbm"], x)
+    assert err1 < err0, (err0, err1)
+    for pname, val in net.params_list["out"].items():
+        np.testing.assert_array_equal(np.asarray(val), out_before[pname])

@@ -490,3 +490,36 @@ class TestGradientCheckpointing:
                         jax.tree_util.tree_leaves(nets[1].params_list)):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        rtol=1e-6, atol=1e-7)
+
+
+def test_graph_vertex_pretrain_gradients():
+    """CG pretrain objectives gradient-check per vertex (reference
+    GradientCheckUtil.checkGradientsPretrainLayer applied to graph vertices).
+    The RBM vertex is excluded from FD checking — its CD surrogate is not a
+    true loss (see test_rbm_cd_surrogate_matches_cd_update); its graph-
+    pretrain path is covered by the descent test in test_computation_graph."""
+    from deeplearning4j_tpu.nn.conf.builders import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf.layers import (
+        AutoEncoder, OutputLayer, VariationalAutoencoder,
+    )
+    from deeplearning4j_tpu.nn.gradientcheck import check_graph_pretrain_gradients
+    from deeplearning4j_tpu.nn.graph_network import ComputationGraph
+
+    rng = np.random.default_rng(2)
+    x = rng.normal(size=(4, 5)).astype(np.float64)
+    conf = (NeuralNetConfiguration.builder()
+            .seed(3).learning_rate(0.05)
+            .graph_builder()
+            .add_inputs("in")
+            .add_layer("vae", VariationalAutoencoder(
+                n_in=5, n_out=4, encoder_layer_sizes=(6,),
+                decoder_layer_sizes=(6,)), "in")
+            .add_layer("ae", AutoEncoder(n_in=4, n_out=4,
+                                         activation="sigmoid"), "vae")
+            .add_layer("out", OutputLayer(n_in=4, n_out=3, loss="mcxent",
+                                          activation="softmax"), "ae")
+            .set_outputs("out")
+            .build())
+    net = ComputationGraph(conf).init()
+    assert check_graph_pretrain_gradients(net, "vae", [x], subset=60)
+    assert check_graph_pretrain_gradients(net, "ae", [x], subset=60)
