@@ -230,7 +230,14 @@ def bench_word2vec(batch: int, iters: int, ksteps: int, warmup: int = 2,
 
     from deeplearning4j_tpu.nlp.learning import PairBatch, make_train_step
 
+    from deeplearning4j_tpu.nlp import learning
+
     step = make_train_step(use_hs=False, negative=negative)
+    # A/B twin: the opposite embedding-update path (dense one-hot matmul vs
+    # XLA scatter) so one record carries both on-chip numbers
+    auto_dense = learning.resolve_dense_update(vocab)
+    step_alt = make_train_step(use_hs=False, negative=negative,
+                               dense_update=not auto_dense)
     rng = np.random.default_rng(0)
     syn0 = jnp.asarray(rng.normal(size=(vocab, dim)).astype(np.float32) * 0.01)
     syn1 = jnp.zeros((1, dim), jnp.float32)  # HS table unused (negative sampling)
@@ -252,35 +259,52 @@ def bench_word2vec(batch: int, iters: int, ksteps: int, warmup: int = 2,
     )
     keys = jax.random.split(jax.random.PRNGKey(0), ksteps)
 
-    def multi(syn0, syn1, syn1neg, batches, keys):
-        def body(carry, inp):
-            s0, s1, sn = carry
-            b, k = inp
-            s0, s1, sn = step(s0, s1, sn, cum_table, b, jnp.float32(0.025), k)
-            return (s0, s1, sn), None
+    def make_multi(stepfn):
+        def multi(syn0, syn1, syn1neg, batches, keys):
+            def body(carry, inp):
+                s0, s1, sn = carry
+                b, k = inp
+                s0, s1, sn = stepfn(s0, s1, sn, cum_table, b,
+                                    jnp.float32(0.025), k)
+                return (s0, s1, sn), None
 
-        carry, _ = jax.lax.scan(body, (syn0, syn1, syn1neg), (batches, keys))
-        return carry
+            carry, _ = jax.lax.scan(body, (syn0, syn1, syn1neg),
+                                    (batches, keys))
+            return carry
 
-    jit_multi = jax.jit(multi, donate_argnums=(0, 1, 2))
+        return jax.jit(multi, donate_argnums=(0, 1, 2))
+
+    def time_path(jit_multi, s0, s1, sn):
+        for _ in range(warmup):
+            s0, s1, sn = jit_multi(s0, s1, sn, batches, keys)
+        float(s0[0, 0])  # hard sync: host read (see module docstring)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            s0, s1, sn = jit_multi(s0, s1, sn, batches, keys)
+        float(s0[0, 0])  # chain-forcing host read through donated buffers
+        return time.perf_counter() - t0
+
+    jit_multi = make_multi(step)
     # scan body counted once by cost analysis (see _xla_flops) -> x ksteps
     flops_per_dispatch = ksteps * _xla_flops(jit_multi, syn0, syn1, syn1neg,
                                              batches, keys)
-    for _ in range(warmup):
-        syn0, syn1, syn1neg = jit_multi(syn0, syn1, syn1neg, batches, keys)
-    float(syn0[0, 0])  # hard sync: host read (see module docstring)
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        syn0, syn1, syn1neg = jit_multi(syn0, syn1, syn1neg, batches, keys)
-    float(syn0[0, 0])  # chain-forcing host read through donated buffers
-    dt = time.perf_counter() - t0
+    # copies BEFORE timing: both paths donate their input buffers
+    alt0, alt1, altn = syn0.copy(), syn1.copy(), syn1neg.copy()
+    dt = time_path(jit_multi, syn0, syn1, syn1neg)
+    dt_alt = time_path(make_multi(step_alt), alt0, alt1, altn)
+    dense_dt, scatter_dt = (dt, dt_alt) if auto_dense else (dt_alt, dt)
     flops_per_sec = flops_per_dispatch * iters / dt if flops_per_dispatch else 0.0
+    pairs = batch * ksteps * iters
     return {
-        "samples_per_sec": batch * ksteps * iters / dt,
+        "samples_per_sec": pairs / dt,
         "step_time_ms": dt / (iters * ksteps) * 1000,
         "batch": batch, "iters": iters, "ksteps": ksteps,
         "tflops_per_sec": round(flops_per_sec / 1e12, 4),
         "mfu": round(flops_per_sec / PEAK_FLOPS, 6),
+        "update_path": "dense" if auto_dense else "scatter",
+        "dense_pairs_per_sec": round(pairs / dense_dt, 1),
+        "scatter_pairs_per_sec": round(pairs / scatter_dt, 1),
+        "dense_speedup": round(scatter_dt / dense_dt, 3),
     }
 
 

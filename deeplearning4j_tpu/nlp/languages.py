@@ -44,11 +44,18 @@ class StopWords:
 # edges + character-class unknown-word edges), per-edge word costs plus a
 # connection penalty. The reference vendors a 6.9k-LoC kuromoji fork whose
 # quality comes from the full IPADIC dictionary; this image ships no such
-# dictionary, so the lexicon below covers the closed-class morphemes
-# (particles, copulas, auxiliaries, frequent function words) that dominate
-# segmentation decisions, and open-class words fall to script-run unknown
-# edges — same algorithm, miniature dictionary. The TokenizerFactory seam is
-# unchanged, so a full-dictionary build can drop in without touching callers.
+# dictionary, so the embedded lexicon covers (a) closed-class morphemes —
+# particles, copulas, auxiliaries, demonstratives, frequent adverbs — and
+# (b) generated conjugation paradigms (~1000 surface forms from ~100
+# high-frequency verb/adjective stems via the standard godan/ichidan/
+# i-adjective rules below). Coverage gap vs IPADIC, stated precisely:
+# IPADIC carries ~300k open-class entries (nouns, names, rare verbs) with
+# per-pair connection costs and POS tags; here open-class words fall to
+# script-run unknown edges (whole kanji/katakana runs kept intact), no POS
+# is emitted, and compound kanji runs without a lexicon boundary are not
+# split (e.g. 毎日日本語 stays one run). Same algorithm, miniature
+# dictionary; the TokenizerFactory seam is unchanged, so a full-dictionary
+# build can drop in without touching callers.
 
 _JA_LEXICON = {
     # case/topic particles (lowest cost: always split off)
@@ -68,7 +75,81 @@ _JA_LEXICON = {
     "これ": 180, "それ": 180, "あれ": 190, "どれ": 195, "この": 175,
     "その": 175, "あの": 185, "ここ": 190, "そこ": 190, "わたし": 190,
     "私": 200, "人": 260, "日": 270, "年": 270, "月": 270, "時": 270,
+    # frequent adverbs / temporal nouns / question words
+    "とても": 220, "少し": 220, "すこし": 230, "もう": 220, "まだ": 220,
+    "また": 225, "すぐ": 225, "よく": 230, "たくさん": 225, "ちょっと": 225,
+    "いつも": 225, "時々": 235, "今日": 230, "明日": 230, "昨日": 230,
+    "今": 250, "毎日": 235, "今朝": 240, "今年": 240, "何": 240,
+    "いつ": 240, "どこ": 235, "だれ": 240, "誰": 245, "なぜ": 240,
+    "どう": 235, "こう": 250, "そう": 240,
 }
+
+# ---- conjugation paradigms -------------------------------------------------
+# IPADIC's verb/adjective coverage is mostly paradigm expansion; the same
+# expansion is generated here programmatically for a list of high-frequency
+# stems. Each surface form enters the lexicon at a flat cost so the lattice
+# prefers one conjugated-verb edge over unknown-run + auxiliary splits.
+# (Original stem lists + standard textbook conjugation rules — no dictionary
+# data is copied.)
+
+#: godan row -> (nai-stem a, masu-stem i, e-stem, o-stem, te-form suffix)
+_GODAN_ROWS = {
+    "う": ("わ", "い", "え", "お", "って"),
+    "く": ("か", "き", "け", "こ", "いて"),
+    "ぐ": ("が", "ぎ", "げ", "ご", "いで"),
+    "す": ("さ", "し", "せ", "そ", "して"),
+    "つ": ("た", "ち", "て", "と", "って"),
+    "ぬ": ("な", "に", "ね", "の", "んで"),
+    "ぶ": ("ば", "び", "べ", "ぼ", "んで"),
+    "む": ("ま", "み", "め", "も", "んで"),
+    "る": ("ら", "り", "れ", "ろ", "って"),
+}
+
+_GODAN_VERBS = """行く 書く 聞く 歩く 働く 着く 泳ぐ 急ぐ 話す 出す 貸す 返す
+待つ 持つ 立つ 死ぬ 遊ぶ 呼ぶ 飛ぶ 読む 飲む 住む 休む 頼む 買う 使う 会う
+言う 思う 歌う 習う 作る 乗る 帰る 入る 走る 知る 売る 送る 取る 終わる
+始まる 分かる かかる もらう""".split()
+
+_ICHIDAN_VERBS = """見る 食べる 寝る 起きる 出る 着る 開ける 閉める 教える
+覚える 忘れる 借りる 降りる できる 考える 伝える 見せる 入れる 続ける
+あげる くれる""".split()
+
+_I_ADJECTIVES = """高い 安い 新しい 古い 大きい 小さい 良い 悪い 早い 遅い
+長い 短い 暑い 寒い 楽しい 難しい 面白い 美しい 強い 弱い 近い 遠い 多い
+少ない 白い 黒い 赤い 青い 忙しい 嬉しい""".split()
+
+_CONJ_COST = 240  # between closed-class morphemes and bare-noun kanji runs
+
+
+def _expand_verb_paradigms(lexicon: dict) -> None:
+    def add(form: str) -> None:
+        lexicon.setdefault(form, _CONJ_COST)
+
+    for verb in _GODAN_VERBS:
+        stem, ending = verb[:-1], verb[-1]
+        a, i, e, o, te_suf = _GODAN_ROWS[ending]
+        te = stem + ("って" if verb == "行く" else te_suf)  # 行く is irregular
+        past = te[:-1] + ("だ" if te.endswith("で") else "た")
+        for f in (verb, te, past, stem + i, stem + i + "ます",
+                  stem + i + "ました", stem + i + "ません", stem + a + "ない",
+                  stem + a + "なかった", stem + e + "る", stem + e + "ば",
+                  stem + o + "う", stem + i + "たい"):
+            add(f)
+    for verb in _ICHIDAN_VERBS:
+        stem = verb[:-1]
+        for f in (verb, stem + "て", stem + "た", stem + "ない",
+                  stem + "なかった", stem + "ます", stem + "ました",
+                  stem + "ません", stem + "られる", stem + "よう",
+                  stem + "れば", stem + "たい"):
+            add(f)
+    for adj in _I_ADJECTIVES:
+        stem = adj[:-1]
+        for f in (adj, stem + "く", stem + "くて", stem + "かった",
+                  stem + "くない", stem + "くなかった", stem + "ければ"):
+            add(f)
+
+
+_expand_verb_paradigms(_JA_LEXICON)
 _JA_MAX_WORD = max(len(w) for w in _JA_LEXICON)
 _JA_EDGE_COST = 50          # connection penalty per lattice edge
 _JA_UNK_BASE = 700          # unknown-word base cost

@@ -38,7 +38,45 @@ class PairBatch(NamedTuple):
     update_dest: Array  # (B, W) int32 where input-gradients are scattered
 
 
-def make_train_step(use_hs: bool, negative: int, chunk: int = 64):
+import os
+
+
+#: vocab-size ceiling for the dense one-hot-matmul update path (auto mode).
+#: A (rows, V) one-hot times (rows, D) update is exact scatter-add math but
+#: runs on the MXU instead of XLA's serialized scatter unit — the scatter is
+#: the binding constraint of this kernel on TPU (BASELINE.md word2vec row).
+#: Above the ceiling the one-hot traffic outgrows the scatter cost.
+DENSE_UPDATE_MAX_VOCAB = int(os.environ.get("DL4J_W2V_DENSE_MAX_VOCAB",
+                                            65536))
+
+
+def resolve_dense_update(n_words: int) -> bool:
+    """THE auto heuristic for the dense one-hot-matmul update path, shared
+    with bench.py's A/B labeling: DL4J_W2V_DENSE=0/1 forces it; otherwise
+    dense iff the vocab fits the ceiling AND there is an MXU (on CPU a
+    one-hot matmul is orders of magnitude slower than scatter)."""
+    env = os.environ.get("DL4J_W2V_DENSE")
+    if env is not None:
+        return env == "1"
+    return (n_words <= DENSE_UPDATE_MAX_VOCAB
+            and jax.default_backend() not in ("cpu",))
+
+
+def _scatter_add(table, idx_flat, upd_flat, dense: bool):
+    """table[idx] += upd with identical semantics on both paths: duplicate
+    indices accumulate, out-of-range indices are dropped (one_hot yields a
+    zero row exactly where scatter mode="drop" skips). precision=HIGHEST
+    keeps the MXU pass float32-exact — without it TPU einsum rounds the
+    updates to bfloat16 and the two paths diverge numerically."""
+    if dense:
+        oh = jax.nn.one_hot(idx_flat, table.shape[0], dtype=upd_flat.dtype)
+        return table + jnp.einsum("nv,nd->vd", oh, upd_flat,
+                                  precision=jax.lax.Precision.HIGHEST)
+    return table.at[idx_flat].add(upd_flat, mode="drop")
+
+
+def make_train_step(use_hs: bool, negative: int, chunk: int = 64,
+                    dense_update: Optional[bool] = None):
     """Returns jitted step(syn0, syn1, syn1neg, cum_table, batch, lr, key).
 
     The batch is applied in sequential sub-chunks of ``chunk`` pairs via
@@ -46,11 +84,20 @@ def make_train_step(use_hs: bool, negative: int, chunk: int = 64):
     root, in nearly every pair) would otherwise receive hundreds of colliding
     scatter-adds computed from one stale snapshot and diverge; chunking bounds
     the staleness to ``chunk`` pairs while keeping a single device dispatch
-    (word2vec's update semantics are fully online, one pair at a time)."""
+    (word2vec's update semantics are fully online, one pair at a time).
+
+    ``dense_update`` routes the embedding-table updates through one-hot
+    matmuls (MXU) instead of XLA scatter; None = auto via
+    resolve_dense_update (an explicit argument always wins over the
+    DL4J_W2V_DENSE env override so A/B twins stay distinct).
+    DL4J_W2V_CHUNK=N overrides the chunk size at build time."""
+    chunk = int(os.environ.get("DL4J_W2V_CHUNK", chunk))
 
     def apply_chunk(syn0, syn1, syn1neg, cum_table, batch: PairBatch, lr, key):
         B, W = batch.ctx.shape
         d = syn0.shape[1]
+        dense = (dense_update if dense_update is not None
+                 else resolve_dense_update(syn0.shape[0]))
         ctx_vecs = syn0[batch.ctx]                        # (B, W, D)
         cmask = batch.ctx_mask[..., None]                 # (B, W, 1)
         counts = jnp.maximum(jnp.sum(batch.ctx_mask, 1, keepdims=True), 1.0)
@@ -65,8 +112,8 @@ def make_train_step(use_hs: bool, negative: int, chunk: int = 64):
                  * batch.code_mask * batch.pair_mask[:, None])  # (B, L)
             neu1e = neu1e + jnp.einsum("bl,bld->bd", g, p_vecs)
             dsyn1 = jnp.einsum("bl,bd->bld", g, h)
-            syn1 = syn1.at[batch.points.reshape(-1)].add(
-                dsyn1.reshape(-1, d), mode="drop")
+            syn1 = _scatter_add(syn1, batch.points.reshape(-1),
+                                dsyn1.reshape(-1, d), dense)
 
         if negative > 0:
             k = negative
@@ -84,14 +131,14 @@ def make_train_step(use_hs: bool, negative: int, chunk: int = 64):
                  * batch.pair_mask[:, None])              # (B, 1+k)
             neu1e = neu1e + jnp.einsum("bk,bkd->bd", g, n_vecs)
             dneg = jnp.einsum("bk,bd->bkd", g, h)
-            syn1neg = syn1neg.at[tgts.reshape(-1)].add(
-                dneg.reshape(-1, d), mode="drop")
+            syn1neg = _scatter_add(syn1neg, tgts.reshape(-1),
+                                   dneg.reshape(-1, d), dense)
 
         # scatter the accumulated input gradient to every real input token
         upd = (neu1e[:, None, :] * cmask
                * batch.pair_mask[:, None, None])          # (B, W, D)
-        syn0 = syn0.at[batch.update_dest.reshape(-1)].add(
-            upd.reshape(-1, d), mode="drop")
+        syn0 = _scatter_add(syn0, batch.update_dest.reshape(-1),
+                            upd.reshape(-1, d), dense)
         return syn0, syn1, syn1neg
 
     @functools.partial(jax.jit, donate_argnums=(0, 1, 2))

@@ -1,0 +1,190 @@
+"""Training-UI internationalization.
+
+Reference: deeplearning4j-play ui/i18n/DefaultI18N.java:1 — a singleton
+message source resolving (key, language) to UI strings, with a default
+language fallback and per-language resource tables (the reference loads
+dl4j_i18n/*.properties files; the same tables are embedded here).
+
+The server substitutes ``{{key}}`` placeholders in its page templates through
+``I18N.get_message`` — the language comes from the request's ``?lang=``
+query parameter or ``Accept-Language`` header, falling back to the instance
+default (reference I18NProvider + language cookie handling).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+DEFAULT_LANGUAGE = "en"
+
+#: key -> {language -> message}. English is complete; other languages fall
+#: back to English per key (reference DefaultI18N.getMessage fallback).
+_MESSAGES: Dict[str, Dict[str, str]] = {
+    "train.pagetitle": {
+        "en": "DL4J-TPU Training UI", "ja": "DL4J-TPU トレーニングUI",
+        "de": "DL4J-TPU Trainings-UI", "fr": "Interface d'entraînement DL4J-TPU",
+        "es": "Interfaz de entrenamiento DL4J-TPU", "zh": "DL4J-TPU 训练界面",
+        "ko": "DL4J-TPU 학습 UI", "ru": "Интерфейс обучения DL4J-TPU",
+    },
+    "train.nav.overview": {
+        "en": "Overview", "ja": "概要", "de": "Übersicht", "fr": "Aperçu",
+        "es": "Resumen", "zh": "概览", "ko": "개요", "ru": "Обзор",
+    },
+    "train.nav.model": {
+        "en": "Model", "ja": "モデル", "de": "Modell", "fr": "Modèle",
+        "es": "Modelo", "zh": "模型", "ko": "모델", "ru": "Модель",
+    },
+    "train.nav.system": {
+        "en": "System", "ja": "システム", "de": "System", "fr": "Système",
+        "es": "Sistema", "zh": "系统", "ko": "시스템", "ru": "Система",
+    },
+    "train.nav.convolutional": {
+        "en": "Convolutional", "ja": "畳み込み", "de": "Faltung",
+        "fr": "Convolution", "es": "Convolución", "zh": "卷积",
+        "ko": "합성곱", "ru": "Свёртка",
+    },
+    "train.nav.histograms": {
+        "en": "Histograms", "ja": "ヒストグラム", "de": "Histogramme",
+        "fr": "Histogrammes", "es": "Histogramas", "zh": "直方图",
+        "ko": "히스토그램", "ru": "Гистограммы",
+    },
+    "train.overview.title": {
+        "en": "Training overview", "ja": "トレーニング概要",
+        "de": "Trainingsübersicht", "fr": "Aperçu de l'entraînement",
+        "es": "Resumen del entrenamiento", "zh": "训练概览",
+        "ko": "학습 개요", "ru": "Обзор обучения",
+    },
+    "train.overview.chart.score": {
+        "en": "Model score vs iteration", "ja": "スコア対反復",
+        "de": "Modellwert pro Iteration", "fr": "Score du modèle par itération",
+        "es": "Puntuación del modelo por iteración", "zh": "模型得分与迭代",
+        "ko": "반복별 모델 점수", "ru": "Оценка модели по итерациям",
+    },
+    "train.overview.chart.ratio": {
+        "en": "Mean update:parameter ratio (log10)",
+        "ja": "平均更新:パラメータ比 (log10)",
+        "de": "Mittleres Update:Parameter-Verhältnis (log10)",
+        "fr": "Ratio moyen mise à jour:paramètre (log10)",
+        "es": "Razón media actualización:parámetro (log10)",
+        "zh": "平均更新:参数比 (log10)", "ko": "평균 업데이트:파라미터 비율 (log10)",
+        "ru": "Среднее отношение обновление:параметр (log10)",
+    },
+    "train.model.title": {
+        "en": "Model", "ja": "モデル", "de": "Modell", "fr": "Modèle",
+        "es": "Modelo", "zh": "模型", "ko": "모델", "ru": "Модель",
+    },
+    "train.model.graph": {
+        "en": "Network graph", "ja": "ネットワークグラフ",
+        "de": "Netzwerkgraph", "fr": "Graphe du réseau",
+        "es": "Grafo de la red", "zh": "网络图", "ko": "네트워크 그래프",
+        "ru": "Граф сети",
+    },
+    "train.model.layers": {
+        "en": "Layers", "ja": "レイヤー", "de": "Schichten", "fr": "Couches",
+        "es": "Capas", "zh": "层", "ko": "레이어", "ru": "Слои",
+    },
+    "train.model.histograms": {
+        "en": "Parameter histograms (latest iteration)",
+        "ja": "パラメータヒストグラム（最新の反復）",
+        "de": "Parameterhistogramme (letzte Iteration)",
+        "fr": "Histogrammes des paramètres (dernière itération)",
+        "es": "Histogramas de parámetros (última iteración)",
+        "zh": "参数直方图（最新迭代）", "ko": "파라미터 히스토그램 (최근 반복)",
+        "ru": "Гистограммы параметров (последняя итерация)",
+    },
+    "train.model.table.parameter": {
+        "en": "parameter", "ja": "パラメータ", "de": "Parameter",
+        "fr": "paramètre", "es": "parámetro", "zh": "参数", "ko": "파라미터",
+        "ru": "параметр",
+    },
+    "train.model.table.meanw": {
+        "en": "mean |w|", "ja": "平均 |w|", "de": "Mittel |w|",
+        "fr": "moyenne |w|", "es": "media |w|", "zh": "均值 |w|",
+        "ko": "평균 |w|", "ru": "среднее |w|",
+    },
+    "train.model.table.meangrad": {
+        "en": "mean |grad|", "ja": "平均 |grad|", "de": "Mittel |grad|",
+        "fr": "moyenne |grad|", "es": "media |grad|", "zh": "均值 |grad|",
+        "ko": "평균 |grad|", "ru": "среднее |grad|",
+    },
+    "train.system.title": {
+        "en": "System", "ja": "システム", "de": "System", "fr": "Système",
+        "es": "Sistema", "zh": "系统", "ko": "시스템", "ru": "Система",
+    },
+    "train.system.chart.rss": {
+        "en": "Host RSS", "ja": "ホストRSS", "de": "Host-RSS",
+        "fr": "RSS hôte", "es": "RSS del host", "zh": "主机 RSS",
+        "ko": "호스트 RSS", "ru": "RSS хоста",
+    },
+    "train.system.chart.device": {
+        "en": "Device memory", "ja": "デバイスメモリ",
+        "de": "Gerätespeicher", "fr": "Mémoire du périphérique",
+        "es": "Memoria del dispositivo", "zh": "设备内存",
+        "ko": "디바이스 메모리", "ru": "Память устройства",
+    },
+    "train.conv.title": {
+        "en": "Convolutional activations", "ja": "畳み込み活性",
+        "de": "Faltungsaktivierungen", "fr": "Activations convolutives",
+        "es": "Activaciones convolucionales", "zh": "卷积激活",
+        "ko": "합성곱 활성화", "ru": "Свёрточные активации",
+    },
+    "train.histograms.params": {
+        "en": "Parameters", "ja": "パラメータ", "de": "Parameter",
+        "fr": "Paramètres", "es": "Parámetros", "zh": "参数",
+        "ko": "파라미터", "ru": "Параметры",
+    },
+    "train.histograms.gradients": {
+        "en": "Gradients", "ja": "勾配", "de": "Gradienten",
+        "fr": "Gradients", "es": "Gradientes", "zh": "梯度", "ko": "그래디언트",
+        "ru": "Градиенты",
+    },
+    "train.histograms.updates": {
+        "en": "Updates", "ja": "更新", "de": "Updates",
+        "fr": "Mises à jour", "es": "Actualizaciones", "zh": "更新",
+        "ko": "업데이트", "ru": "Обновления",
+    },
+    "train.histograms.none": {
+        "en": "no statistics recorded yet", "ja": "統計はまだ記録されていません",
+        "de": "noch keine Statistiken aufgezeichnet",
+        "fr": "aucune statistique enregistrée",
+        "es": "aún no hay estadísticas registradas", "zh": "尚未记录统计数据",
+        "ko": "아직 기록된 통계가 없습니다", "ru": "статистика ещё не записана",
+    },
+}
+
+
+class I18N:
+    """Singleton message source (reference DefaultI18N.getInstance)."""
+
+    _instance: Optional["I18N"] = None
+
+    def __init__(self):
+        self.default_language = DEFAULT_LANGUAGE
+
+    @classmethod
+    def get_instance(cls) -> "I18N":
+        if cls._instance is None:
+            cls._instance = I18N()
+        return cls._instance
+
+    def set_default_language(self, lang: str) -> None:
+        self.default_language = lang
+
+    @staticmethod
+    def available_languages() -> List[str]:
+        langs = set()
+        for table in _MESSAGES.values():
+            langs.update(table)
+        return sorted(langs)
+
+    def get_message(self, key: str, lang: Optional[str] = None) -> str:
+        """Resolve key in ``lang`` with English fallback; unknown keys echo
+        the key (the reference returns the raw key too — a visible marker
+        beats a 500)."""
+        table = _MESSAGES.get(key)
+        if table is None:
+            return key
+        lang = (lang or self.default_language).split("-")[0].lower()
+        return table.get(lang) or table.get("en") or key
+
+    def get_messages(self, lang: str) -> Dict[str, str]:
+        return {k: self.get_message(k, lang) for k in _MESSAGES}

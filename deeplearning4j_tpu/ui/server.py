@@ -10,6 +10,7 @@ the environment has zero egress), and the remote-receiver POST route.
 from __future__ import annotations
 
 import json
+import re
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import List, Optional
@@ -27,17 +28,18 @@ table { border-collapse: collapse; background: #fff; }
 td, th { border: 1px solid #ddd; padding: 4px 10px; font-size: 13px; }
 </style>"""
 
-_NAV = """<nav><a href="/train/overview">Overview</a>
-<a href="/train/model">Model</a>
-<a href="/train/system">System</a>
-<a href="/train/convolutional">Convolutional</a></nav>"""
+_NAV = """<nav><a href="/train/overview">{{train.nav.overview}}</a>
+<a href="/train/model">{{train.nav.model}}</a>
+<a href="/train/system">{{train.nav.system}}</a>
+<a href="/train/convolutional">{{train.nav.convolutional}}</a>
+<a href="/train/histograms">{{train.nav.histograms}}</a></nav>"""
 
 _PAGE = """<!DOCTYPE html>
-<html><head><title>DL4J-TPU Training UI</title>
+<html><head><meta charset="utf-8"><title>{{train.pagetitle}}</title>
 """ + _STYLE + """</head>
 <body>
 """ + _NAV + """
-<h2>Training overview</h2>
+<h2>{{train.overview.title}}</h2>
 <div class="chart"><canvas id="score" width="900" height="260"></canvas></div>
 <div class="chart"><canvas id="ratio" width="900" height="260"></canvas></div>
 <script>
@@ -59,9 +61,9 @@ function drawSeries(canvasId, xs, ys, label, color) {
 }
 async function refresh() {
   const r = await fetch('/train/overview/data'); const d = await r.json();
-  drawSeries('score', d.iterations, d.scores, 'Model score vs iteration', '#c33');
+  drawSeries('score', d.iterations, d.scores, '{{train.overview.chart.score}}', '#c33');
   drawSeries('ratio', d.iterations, d.updateRatios,
-             'Mean update:parameter ratio (log10)', '#36c');
+             '{{train.overview.chart.ratio}}', '#36c');
 }
 refresh(); setInterval(refresh, 2000);
 </script>
@@ -72,15 +74,15 @@ refresh(); setInterval(refresh, 2000);
 # parameter tables and histograms (reference TrainModule model tab,
 # deeplearning4j-play TrainModule.java; FlowIterationListener flow chart).
 _MODEL_PAGE = """<!DOCTYPE html>
-<html><head><title>DL4J-TPU UI - Model</title>
+<html><head><meta charset="utf-8"><title>{{train.pagetitle}} - {{train.model.title}}</title>
 """ + _STYLE + """</head>
 <body>
 """ + _NAV + """
-<h2>Model</h2>
-<div class="chart"><b>Network graph</b><br>
+<h2>{{train.model.title}}</h2>
+<div class="chart"><b>{{train.model.graph}}</b><br>
 <canvas id="flow" width="900" height="220"></canvas></div>
-<div class="chart"><b>Layers</b><div id="layers"></div></div>
-<div class="chart"><b>Parameter histograms (latest iteration)</b>
+<div class="chart"><b>{{train.model.layers}}</b><div id="layers"></div></div>
+<div class="chart"><b>{{train.model.histograms}}</b>
 <div id="hists"></div></div>
 <script>
 function drawFlow(graph) {
@@ -129,8 +131,9 @@ async function refresh() {
   const g = await (await fetch('/train/model/graph')).json();
   drawFlow(g);
   const d = await (await fetch('/train/model/data')).json();
-  let html = '<table><tr><th>parameter</th><th>mean |w|</th>' +
-             '<th>mean |grad|</th></tr>';
+  let html = '<table><tr><th>{{train.model.table.parameter}}</th>' +
+             '<th>{{train.model.table.meanw}}</th>' +
+             '<th>{{train.model.table.meangrad}}</th></tr>';
   for (const [name, v] of Object.entries(d.layers || {})) {
     const gm = (d.gradients || {})[name];
     html += '<tr><td>' + name + '</td><td>' + v.meanMagnitude.toPrecision(4)
@@ -157,11 +160,11 @@ refresh(); setInterval(refresh, 3000);
 
 # Rendered system page (reference TrainModule system tab: memory charts).
 _SYSTEM_PAGE = """<!DOCTYPE html>
-<html><head><title>DL4J-TPU UI - System</title>
+<html><head><meta charset="utf-8"><title>{{train.pagetitle}} - {{train.system.title}}</title>
 """ + _STYLE + """</head>
 <body>
 """ + _NAV + """
-<h2>System</h2>
+<h2>{{train.system.title}}</h2>
 <div class="chart"><canvas id="rss" width="900" height="240"></canvas></div>
 <div class="chart"><canvas id="dev" width="900" height="240"></canvas></div>
 <script>
@@ -185,8 +188,8 @@ function drawSeries(canvasId, ys, label, color) {
 }
 async function refresh() {
   const d = await (await fetch('/train/system/data')).json();
-  drawSeries('rss', d.memRssBytes, 'Host RSS', '#c33');
-  drawSeries('dev', d.deviceMemBytes, 'Device memory', '#36c');
+  drawSeries('rss', d.memRssBytes, '{{train.system.chart.rss}}', '#c33');
+  drawSeries('dev', d.deviceMemBytes, '{{train.system.chart.device}}', '#36c');
 }
 refresh(); setInterval(refresh, 3000);
 </script>
@@ -196,11 +199,11 @@ refresh(); setInterval(refresh, 3000);
 # Convolutional module (reference ConvolutionalListenerModule +
 # ConvolutionalIterationListener: streams conv-layer activation images).
 _CONV_PAGE = """<!DOCTYPE html>
-<html><head><title>DL4J-TPU UI - Convolutional</title>
+<html><head><meta charset="utf-8"><title>{{train.pagetitle}} - {{train.nav.convolutional}}</title>
 """ + _STYLE + """</head>
 <body>
 """ + _NAV + """
-<h2>Convolutional activations</h2>
+<h2>{{train.conv.title}}</h2>
 <div id="meta"></div><div id="maps"></div>
 <script>
 function heat(arr) {
@@ -241,12 +244,37 @@ refresh(); setInterval(refresh, 3000);
 """
 
 
+_PLACEHOLDER = re.compile(r"\{\{([A-Za-z0-9_.]+)\}\}")
+
+
+def _localize(template: str, lang: Optional[str]) -> str:
+    """Substitute {{key}} placeholders through the I18N message source
+    (reference DefaultI18N.getMessage over the Play templates)."""
+    from deeplearning4j_tpu.ui.i18n import I18N
+
+    i18n = I18N.get_instance()
+    return _PLACEHOLDER.sub(lambda m: i18n.get_message(m.group(1), lang),
+                            template)
+
+
 class _Handler(BaseHTTPRequestHandler):
     server_version = "DL4JTPUUIServer/1.0"
     ui: "UIServer" = None
 
     def log_message(self, fmt, *args):  # silence request logging
         pass
+
+    def _request_lang(self) -> Optional[str]:
+        """?lang= query param, else the Accept-Language header's first tag
+        (reference I18NProvider language resolution)."""
+        q = parse_qs(urlparse(self.path).query)
+        if q.get("lang"):
+            return q["lang"][0]
+        accept = self.headers.get("Accept-Language")
+        if accept:
+            # first tag, q-value stripped: "ja;q=0.9, en;q=0.8" -> "ja"
+            return accept.split(",")[0].split(";")[0].strip()
+        return None
 
     def _json(self, obj, code=200):
         body = json.dumps(obj).encode()
@@ -266,14 +294,28 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_GET(self):
         path = urlparse(self.path).path
+        lang = self._request_lang()
         if path in ("/", "/train", "/train/overview"):
-            self._html(_PAGE)
+            self._html(_localize(_PAGE, lang))
         elif path == "/train/model":
-            self._html(_MODEL_PAGE)
+            self._html(_localize(_MODEL_PAGE, lang))
         elif path == "/train/system":
-            self._html(_SYSTEM_PAGE)
+            self._html(_localize(_SYSTEM_PAGE, lang))
         elif path == "/train/convolutional":
-            self._html(_CONV_PAGE)
+            self._html(_localize(_CONV_PAGE, lang))
+        elif path == "/train/histograms":
+            # server-side rendered histogram page built from ui-components
+            # charts (reference HistogramModule rendered view)
+            q = parse_qs(urlparse(self.path).query)
+            self._html(self.ui.histograms_page(q.get("session", [None])[0],
+                                               lang))
+        elif path == "/lang/setCurrent":
+            # reference DefaultI18N: change the server's default language
+            q = parse_qs(urlparse(self.path).query)
+            from deeplearning4j_tpu.ui.i18n import I18N
+            I18N.get_instance().set_default_language(
+                q.get("lang", ["en"])[0])
+            self._json({"status": "ok"})
         elif path == "/train/model/graph":
             self._json(self.ui.model_graph())
         elif path == "/train/convolutional/data":
@@ -458,6 +500,41 @@ class UIServer:
                 "params": fmt(r.param_stats),
                 "gradients": fmt(r.gradient_stats),
                 "updates": fmt(r.update_stats)}
+
+    def histograms_page(self, session: Optional[str], lang: Optional[str]) -> str:
+        """Server-side rendered histogram page: ChartHistogram components per
+        recorded variable, grouped params/gradients/updates (reference
+        HistogramModule's rendered view over ui-components charts)."""
+        from deeplearning4j_tpu.ui.components import (
+            ChartHistogram, ComponentText, render_page)
+        from deeplearning4j_tpu.ui.i18n import I18N
+
+        i18n = I18N.get_instance()
+        msg = lambda k: i18n.get_message(k, lang)
+        data = self.histogram_data(session)
+        comps = []
+        for section, key in (("params", "train.histograms.params"),
+                             ("gradients", "train.histograms.gradients"),
+                             ("updates", "train.histograms.updates")):
+            entries = data.get(section) or {}
+            if not entries:
+                continue
+            comps.append(ComponentText(msg(key), heading=True))
+            for name, v in sorted(entries.items()):
+                bins = v["bins"]
+                if not bins:
+                    continue
+                lo, hi = v["min"], v["max"]
+                width = (hi - lo) / len(bins) if hi > lo else 1.0
+                lowers = [lo + i * width for i in range(len(bins))]
+                uppers = [lo + (i + 1) * width for i in range(len(bins))]
+                comps.append(ChartHistogram(name, lowers, uppers, bins))
+        if not comps:
+            comps = [ComponentText(msg("train.histograms.none"))]
+        title = f"{msg('train.pagetitle')} - {msg('train.nav.histograms')}"
+        nav = _localize(_NAV, lang)
+        page = render_page(title, *comps)
+        return page.replace("<body>", "<body>" + nav, 1)
 
     def set_tsne(self, payload: dict) -> None:
         """TsneModule upload target (coords + optional labels)."""

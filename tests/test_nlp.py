@@ -159,3 +159,45 @@ def test_word2vec_vocab_from_file_trains(tmp_path):
     sents = [l.split() for l in corpus.read_text().splitlines() if l]
     w2v.fit(sents)
     assert w2v.get_word_vector("cat").shape == (16,)
+
+
+def test_dense_update_path_matches_scatter():
+    """The one-hot-matmul (MXU) embedding update must be bit-compatible with
+    the XLA scatter path: duplicates accumulate, OOB padding rows drop
+    (the TPU throughput optimization for the word2vec kernels — reference
+    SkipGram.java:168-178 batched native exec, in TPU form)."""
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.nlp.learning import (
+        BatchAccumulator, make_train_step)
+
+    V, D = 50, 16
+    rng = np.random.default_rng(0)
+    acc = BatchAccumulator(batch_size=8, window_width=3, code_length=4,
+                           n_words=V)
+    batch = None
+    for i in range(8):
+        batch = acc.add([int(rng.integers(0, V)) for _ in range(3)],
+                        int(rng.integers(0, V)),
+                        [int(rng.integers(0, V)) for _ in range(3)],
+                        [float(rng.integers(0, 2)) for _ in range(3)]) or batch
+    args0 = lambda: (jnp.asarray(rng.normal(size=(V, D)), jnp.float32),)
+    syn0 = jnp.asarray(np.random.default_rng(1).normal(size=(V, D)),
+                       jnp.float32)
+    syn1 = jnp.asarray(np.random.default_rng(2).normal(size=(V, D)),
+                       jnp.float32)
+    syn1neg = jnp.asarray(np.random.default_rng(3).normal(size=(V, D)),
+                          jnp.float32)
+    cum = jnp.cumsum(jnp.ones((V,)) / V)
+    key = jax.random.PRNGKey(7)
+
+    outs = {}
+    for dense in (False, True):
+        step = make_train_step(use_hs=True, negative=3, chunk=4,
+                               dense_update=dense)
+        outs[dense] = step(syn0.copy(), syn1.copy(), syn1neg.copy(), cum,
+                           batch, 0.025, key)
+    for a, b in zip(outs[False], outs[True]):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)

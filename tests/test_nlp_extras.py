@@ -74,3 +74,27 @@ def test_japanese_lattice_splits_particles():
     # unknown words stay whole (no over-splitting)
     assert tf.create("たのしい").get_tokens() == ["たのしい"]
     assert tf.create("テスト").get_tokens() == ["テスト"]
+
+
+def test_japanese_conjugation_paradigm_fixtures():
+    """Segmentation regression fixtures over the generated verb/adjective
+    conjugation paradigms (round-4 lexicon growth; reference
+    deeplearning4j-nlp-japanese with full IPADIC — see languages.py header
+    for exactly what the embedded lexicon does and does not cover)."""
+    tf = JapaneseTokenizerFactory()
+    fixtures = {
+        "私は東京へ行きます": ["私", "は", "東京", "へ", "行きます"],
+        "本を読んだ": ["本", "を", "読んだ"],
+        "新しいカメラを買いました": ["新しい", "カメラ", "を", "買いました"],
+        "友達と映画を見ました": ["友達", "と", "映画", "を", "見ました"],
+        "これは面白かったです": ["これ", "は", "面白かった", "です"],
+        # negative-past adjective stays one token (paradigm edge beats
+        # unknown-run + auxiliary splits)
+        "難しくなかった": ["難しくなかった"],
+        "昨日は寒かった": ["昨日", "は", "寒かった"],
+        "日本語が分かりません": ["日本語", "が", "分かりません"],
+        "もう忘れた": ["もう", "忘れた"],
+    }
+    for text, expect in fixtures.items():
+        assert tf.create(text).get_tokens() == expect, (
+            text, tf.create(text).get_tokens())

@@ -248,3 +248,91 @@ def test_convolutional_listener_posts_activations():
         assert ch.shape[0] == 3 and ch.ndim == 3  # 3 channels of 2-D maps
     finally:
         server.stop()
+
+
+def test_ui_i18n_pages_and_language_switch():
+    """UI pages localize via ?lang= / Accept-Language (reference
+    DefaultI18N.java): placeholder keys never leak, Japanese strings render,
+    and /lang/setCurrent changes the server default."""
+    import urllib.request
+
+    from deeplearning4j_tpu.ui.i18n import I18N
+    from deeplearning4j_tpu.ui.server import UIServer
+
+    ui = UIServer(port=0)
+    try:
+        base = f"http://127.0.0.1:{ui.port}"
+
+        def get(path, headers=None):
+            req = urllib.request.Request(base + path, headers=headers or {})
+            return urllib.request.urlopen(req).read().decode()
+
+        en = get("/train/overview")
+        assert "Training overview" in en and "{{" not in en
+        ja = get("/train/overview?lang=ja")
+        assert "トレーニング概要" in ja and "{{" not in ja
+        # Accept-Language header resolution (q-values stripped)
+        de = get("/train/overview", {"Accept-Language": "de;q=0.9,en;q=0.8"})
+        assert "Trainingsübersicht" in de
+        # default-language switch (reference /lang/setCurrent route)
+        get("/lang/setCurrent?lang=fr")
+        fr = get("/train/model")
+        assert "Graphe du réseau" in fr
+        # unknown language falls back to English, never the raw key
+        zz = get("/train/system?lang=zz")
+        assert "Host RSS" in zz and "{{" not in zz
+        assert "ja" in I18N.available_languages()
+    finally:
+        # the singleton default is process-global state: always restore
+        I18N.get_instance().set_default_language("en")
+        ui.stop()
+
+
+def test_ui_histograms_rendered_page():
+    """/train/histograms renders ChartHistogram SVGs server-side from the
+    latest stats report (reference HistogramModule + ui-components)."""
+    import urllib.request
+
+    import numpy as np
+
+    from deeplearning4j_tpu.ui.server import UIServer
+    from deeplearning4j_tpu.ui.stats import StatsListener
+    from deeplearning4j_tpu.ui.storage import InMemoryStatsStorage
+
+    ui = UIServer(port=0)
+    try:
+        storage = InMemoryStatsStorage()
+        ui.attach(storage)
+        from deeplearning4j_tpu import NeuralNetConfiguration, MultiLayerNetwork
+        from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+
+        conf = (NeuralNetConfiguration.builder().seed(0).learning_rate(0.1)
+                .list()
+                .layer(DenseLayer(n_in=4, n_out=8, activation="tanh"))
+                .layer(OutputLayer(n_in=8, n_out=3, loss="mcxent",
+                                   activation="softmax"))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        net.set_listeners(StatsListener(storage, session_id="histsess"))
+        rng = np.random.default_rng(0)
+        net.fit(rng.normal(size=(16, 4)).astype(np.float32),
+                np.eye(3, dtype=np.float32)[rng.integers(0, 3, 16)])
+
+        base = f"http://127.0.0.1:{ui.port}"
+        page = urllib.request.urlopen(base + "/train/histograms").read().decode()
+        assert "<svg" in page and "Parameters" in page
+        assert "{{" not in page
+        # localized variant
+        ja = urllib.request.urlopen(
+            base + "/train/histograms?lang=ja").read().decode()
+        assert "パラメータ" in ja
+        # empty storage renders the no-data message, not an error
+        ui2 = UIServer(port=0)
+        try:
+            empty = urllib.request.urlopen(
+                f"http://127.0.0.1:{ui2.port}/train/histograms").read().decode()
+            assert "no statistics recorded yet" in empty
+        finally:
+            ui2.stop()
+    finally:
+        ui.stop()
