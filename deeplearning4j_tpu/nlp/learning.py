@@ -16,6 +16,7 @@ accumulated gradient (no 1/n scaling on the backward, as in word2vec C).
 from __future__ import annotations
 
 import functools
+import os
 from typing import NamedTuple, Optional
 
 import jax
@@ -36,9 +37,6 @@ class PairBatch(NamedTuple):
     code_mask: Array  # (B, L) float32 — 1 for real code positions
     pair_mask: Array  # (B,) float32 — 1 for real (non-padding) pairs
     update_dest: Array  # (B, W) int32 where input-gradients are scattered
-
-
-import os
 
 
 #: vocab-size ceiling for the dense one-hot-matmul update path (auto mode).

@@ -61,9 +61,9 @@ function drawSeries(canvasId, xs, ys, label, color) {
 }
 async function refresh() {
   const r = await fetch('/train/overview/data'); const d = await r.json();
-  drawSeries('score', d.iterations, d.scores, '{{train.overview.chart.score}}', '#c33');
+  drawSeries('score', d.iterations, d.scores, '{{js:train.overview.chart.score}}', '#c33');
   drawSeries('ratio', d.iterations, d.updateRatios,
-             '{{train.overview.chart.ratio}}', '#36c');
+             '{{js:train.overview.chart.ratio}}', '#36c');
 }
 refresh(); setInterval(refresh, 2000);
 </script>
@@ -131,9 +131,9 @@ async function refresh() {
   const g = await (await fetch('/train/model/graph')).json();
   drawFlow(g);
   const d = await (await fetch('/train/model/data')).json();
-  let html = '<table><tr><th>{{train.model.table.parameter}}</th>' +
-             '<th>{{train.model.table.meanw}}</th>' +
-             '<th>{{train.model.table.meangrad}}</th></tr>';
+  let html = '<table><tr><th>{{js:train.model.table.parameter}}</th>' +
+             '<th>{{js:train.model.table.meanw}}</th>' +
+             '<th>{{js:train.model.table.meangrad}}</th></tr>';
   for (const [name, v] of Object.entries(d.layers || {})) {
     const gm = (d.gradients || {})[name];
     html += '<tr><td>' + name + '</td><td>' + v.meanMagnitude.toPrecision(4)
@@ -188,8 +188,8 @@ function drawSeries(canvasId, ys, label, color) {
 }
 async function refresh() {
   const d = await (await fetch('/train/system/data')).json();
-  drawSeries('rss', d.memRssBytes, '{{train.system.chart.rss}}', '#c33');
-  drawSeries('dev', d.deviceMemBytes, '{{train.system.chart.device}}', '#36c');
+  drawSeries('rss', d.memRssBytes, '{{js:train.system.chart.rss}}', '#c33');
+  drawSeries('dev', d.deviceMemBytes, '{{js:train.system.chart.device}}', '#36c');
 }
 refresh(); setInterval(refresh, 3000);
 </script>
@@ -244,17 +244,28 @@ refresh(); setInterval(refresh, 3000);
 """
 
 
-_PLACEHOLDER = re.compile(r"\{\{([A-Za-z0-9_.]+)\}\}")
+_PLACEHOLDER = re.compile(r"\{\{(js:)?([A-Za-z0-9_.]+)\}\}")
 
 
 def _localize(template: str, lang: Optional[str]) -> str:
     """Substitute {{key}} placeholders through the I18N message source
-    (reference DefaultI18N.getMessage over the Play templates)."""
+    (reference DefaultI18N.getMessage over the Play templates).
+
+    ``{{js:key}}`` escapes the message for a single-quoted JavaScript
+    string literal (translations legitimately contain apostrophes — e.g.
+    the French page title — and must not break the inline scripts)."""
     from deeplearning4j_tpu.ui.i18n import I18N
 
     i18n = I18N.get_instance()
-    return _PLACEHOLDER.sub(lambda m: i18n.get_message(m.group(1), lang),
-                            template)
+
+    def sub(m):
+        msg = i18n.get_message(m.group(2), lang)
+        if m.group(1):  # js context
+            return (json.dumps(msg)[1:-1]          # \-escapes, control chars
+                    .replace("'", "\\'").replace("</", "<\\/"))
+        return msg
+
+    return _PLACEHOLDER.sub(sub, template)
 
 
 class _Handler(BaseHTTPRequestHandler):

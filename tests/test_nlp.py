@@ -182,7 +182,6 @@ def test_dense_update_path_matches_scatter():
                         int(rng.integers(0, V)),
                         [int(rng.integers(0, V)) for _ in range(3)],
                         [float(rng.integers(0, 2)) for _ in range(3)]) or batch
-    args0 = lambda: (jnp.asarray(rng.normal(size=(V, D)), jnp.float32),)
     syn0 = jnp.asarray(np.random.default_rng(1).normal(size=(V, D)),
                        jnp.float32)
     syn1 = jnp.asarray(np.random.default_rng(2).normal(size=(V, D)),
