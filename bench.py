@@ -136,29 +136,51 @@ def _onehot_batch(rng, batch: int, n_classes: int):
     return y
 
 
-def bench_lenet(batch: int, iters: int, ksteps: int, warmup: int = 2) -> dict:
+#: LM bench geometry, shared with flagship_setup
+LM_VOCAB, LM_SEQ = 256, 256
+
+
+def flagship_setup(model: str, batch: int, ksteps: int):
+    """(conf, xs_stack, ys_stack, is_graph) for a headline config — the ONE
+    construction behind both the bench measurements and
+    scripts/profile_flagship.py, so the profiled program IS the timed one."""
     import jax.numpy as jnp
 
-    from deeplearning4j_tpu.models.lenet import lenet_mnist
-
     rng = np.random.default_rng(0)
-    x = jnp.asarray(rng.normal(size=(batch, 784)).astype(np.float32))
-    y = jnp.asarray(_onehot_batch(rng, batch, 10))
-    return _measure_multistep(lenet_mnist(), _stack(x, ksteps),
-                              _stack(y, ksteps), iters, warmup)
+    if model == "resnet50":
+        from deeplearning4j_tpu.models.resnet import resnet50
+        x = jnp.asarray(rng.normal(size=(batch, 224, 224, 3))
+                        .astype(np.float32))
+        y = jnp.asarray(_onehot_batch(rng, batch, 1000))
+        return (resnet50(n_classes=1000, image_size=224),
+                [_stack(x, ksteps)], [_stack(y, ksteps)], True)
+    if model == "lenet":
+        from deeplearning4j_tpu.models.lenet import lenet_mnist
+        x = jnp.asarray(rng.normal(size=(batch, 784)).astype(np.float32))
+        y = jnp.asarray(_onehot_batch(rng, batch, 10))
+        return lenet_mnist(), _stack(x, ksteps), _stack(y, ksteps), False
+    if model in ("transformer", "moe"):
+        from deeplearning4j_tpu.models.transformer import (
+            moe_transformer_lm, transformer_lm)
+        conf = (transformer_lm(vocab_size=LM_VOCAB, width=256, n_layers=4,
+                               n_heads=4, max_len=LM_SEQ)
+                if model == "transformer" else
+                moe_transformer_lm(vocab_size=LM_VOCAB, width=256, n_layers=4,
+                                   n_heads=4, n_experts=8, max_len=LM_SEQ))
+        ids = rng.integers(0, LM_VOCAB, (batch, LM_SEQ))
+        x = jnp.asarray(np.eye(LM_VOCAB, dtype=np.float32)[ids])
+        return conf, _stack(x, ksteps), _stack(x, ksteps), False
+    raise ValueError(f"no flagship setup for model '{model}'")
+
+
+def bench_lenet(batch: int, iters: int, ksteps: int, warmup: int = 2) -> dict:
+    conf, xs, ys, graph = flagship_setup("lenet", batch, ksteps)
+    return _measure_multistep(conf, xs, ys, iters, warmup, graph=graph)
 
 
 def bench_resnet50(batch: int, iters: int, ksteps: int, warmup: int = 2) -> dict:
-    import jax.numpy as jnp
-
-    from deeplearning4j_tpu.models.resnet import resnet50
-
-    rng = np.random.default_rng(0)
-    x = jnp.asarray(rng.normal(size=(batch, 224, 224, 3)).astype(np.float32))
-    y = jnp.asarray(_onehot_batch(rng, batch, 1000))
-    return _measure_multistep(resnet50(n_classes=1000, image_size=224),
-                              [_stack(x, ksteps)], [_stack(y, ksteps)],
-                              iters, warmup, graph=True)
+    conf, xs, ys, graph = flagship_setup("resnet50", batch, ksteps)
+    return _measure_multistep(conf, xs, ys, iters, warmup, graph=graph)
 
 
 def bench_char_rnn(batch: int, iters: int, ksteps: int, warmup: int = 2,
@@ -179,41 +201,28 @@ def bench_char_rnn(batch: int, iters: int, ksteps: int, warmup: int = 2,
     return r
 
 
-def _bench_lm(conf, batch: int, iters: int, ksteps: int, warmup: int,
-              vocab: int, seq: int) -> dict:
+def _bench_lm(model: str, batch: int, iters: int, ksteps: int,
+              warmup: int) -> dict:
     """Shared LM measurement recipe: one-hot [B, T, V] next-token batches
     through the K-step multistep path (used by the transformer and MoE
     benches so the staging/sync methodology cannot diverge)."""
-    import jax.numpy as jnp
-
-    rng = np.random.default_rng(0)
-    ids = rng.integers(0, vocab, (batch, seq))
-    x = jnp.asarray(np.eye(vocab, dtype=np.float32)[ids])
-    r = _measure_multistep(conf, _stack(x, ksteps), _stack(x, ksteps),
-                           iters, warmup)
-    r["tokens_per_sec"] = r["samples_per_sec"] * seq
+    conf, xs, ys, graph = flagship_setup(model, batch, ksteps)
+    r = _measure_multistep(conf, xs, ys, iters, warmup, graph=graph)
+    r["tokens_per_sec"] = r["samples_per_sec"] * LM_SEQ
     return r
 
 
 def bench_transformer(batch: int, iters: int, ksteps: int, warmup: int = 2,
                       vocab: int = 256, seq: int = 256) -> dict:
     """Decoder-only transformer LM over the flash-attention kernel."""
-    from deeplearning4j_tpu.models.transformer import transformer_lm
-
-    conf = transformer_lm(vocab_size=vocab, width=256, n_layers=4, n_heads=4,
-                          max_len=seq)
-    return _bench_lm(conf, batch, iters, ksteps, warmup, vocab, seq)
+    return _bench_lm("transformer", batch, iters, ksteps, warmup)
 
 
 def bench_moe(batch: int, iters: int, ksteps: int, warmup: int = 2,
               vocab: int = 256, seq: int = 256) -> dict:
     """Switch-style MoE LM (residual attention + top-1 expert FFN blocks,
     load-balance aux loss included in the trained objective)."""
-    from deeplearning4j_tpu.models.transformer import moe_transformer_lm
-
-    conf = moe_transformer_lm(vocab_size=vocab, width=256, n_layers=4,
-                              n_heads=4, n_experts=8, max_len=seq)
-    return _bench_lm(conf, batch, iters, ksteps, warmup, vocab, seq)
+    return _bench_lm("moe", batch, iters, ksteps, warmup)
 
 
 def bench_word2vec(batch: int, iters: int, ksteps: int, warmup: int = 2,
@@ -360,8 +369,7 @@ def bench_attention(batch: int, iters: int, ksteps: int, warmup: int = 2,
                 if pallas_engaged else None)
 
     t_prod = t_pallas if pallas_engaged else t_xla
-    flops_per_sec = flops_per_step / t_prod if flops_per_step else 0.0
-    return {
+    rec = {
         "samples_per_sec": batch * seq / t_prod,
         "step_time_ms": t_prod * 1000,
         "batch": batch, "iters": iters, "ksteps": ksteps,
@@ -372,9 +380,38 @@ def bench_attention(batch: int, iters: int, ksteps: int, warmup: int = 2,
                       if t_pallas is not None else None),
         "pallas_speedup": (round(t_xla / t_pallas, 3)
                            if t_pallas else None),
-        "tflops_per_sec": round(flops_per_sec / 1e12, 4),
-        "mfu": round(flops_per_sec / PEAK_FLOPS, 6),
     }
+
+    # DL4J_FLASH_SWEEP=1: time the pallas kernel across tile configs so one
+    # relay window finds the best DL4J_FLASH_BLK_Q/K for this chip (VERDICT
+    # round-3 item 2's "tile sweep" candidate). Globals are read at trace
+    # time; each time_path call builds a fresh jit program.
+    if pallas_engaged and os.environ.get("DL4J_FLASH_SWEEP") == "1":
+        sweep = {}
+        saved = pk._BLK_Q, pk._BLK_K
+        for bq, bk in ((64, 128), (128, 128), (128, 256), (256, 128),
+                       (256, 256), (128, 512)):
+            if seq % bq or seq % bk:
+                continue
+            pk._BLK_Q, pk._BLK_K = bq, bk
+            try:
+                t = time_path(
+                    lambda q, k, v: pk.flash_attention(q, k, v, True))[0]
+                sweep[f"{bq}x{bk}"] = round(t * 1000, 3)
+            except Exception as e:  # a tile config may exceed VMEM
+                sweep[f"{bq}x{bk}"] = f"error: {e}"[:100]
+            finally:
+                pk._BLK_Q, pk._BLK_K = saved
+        timed = {k: v for k, v in sweep.items() if isinstance(v, float)}
+        rec["tile_sweep_ms"] = sweep
+        if timed:
+            best = min(timed, key=timed.get)
+            rec["best_tiles"] = best
+            rec["best_tiles_ms"] = timed[best]
+    flops_per_sec = flops_per_step / t_prod if flops_per_step else 0.0
+    rec["tflops_per_sec"] = round(flops_per_sec / 1e12, 4)
+    rec["mfu"] = round(flops_per_sec / PEAK_FLOPS, 6)
+    return rec
 
 
 def bench_fit_resnet50(batch: int, iters: int, ksteps: int,

@@ -12,6 +12,7 @@ over batch; mask arrays broadcast over the output dim (reference BaseEvaluation 
 """
 from __future__ import annotations
 
+import os
 from typing import Callable, Optional
 
 import jax
@@ -77,10 +78,75 @@ def _is_sigmoid(activation) -> bool:
     return getattr(activation, "__name__", "") == "sigmoid"
 
 
+@jax.custom_vjp
+def _fused_sm_xent_per(labels: Array, preout: Array) -> Array:
+    """Per-row softmax cross entropy through the Pallas fused kernel: the
+    forward computes loss AND dlogits in ONE pass over the logits
+    (ops/pallas_kernels.softmax_cross_entropy), and the backward replays the
+    saved gradient instead of re-deriving softmax from a stored log-softmax
+    — the cuDNN softmax-loss pairing, TPU form. labels/preout: (N, C);
+    returns (N, 1) f32."""
+    from deeplearning4j_tpu.ops.pallas_kernels import softmax_cross_entropy
+
+    loss, _ = softmax_cross_entropy(preout, labels,
+                                    interpret=_xent_interpret())
+    return loss[:, None]
+
+
+def _xent_interpret() -> bool:
+    # pallas interpret mode off-TPU (tests exercise the kernel body on CPU)
+    return jax.default_backend() not in ("tpu",)
+
+
+def _fused_sm_xent_fwd(labels, preout):
+    from deeplearning4j_tpu.ops.pallas_kernels import softmax_cross_entropy
+
+    loss, grad = softmax_cross_entropy(preout, labels,
+                                       interpret=_xent_interpret())
+    return loss[:, None], grad
+
+
+def _fused_sm_xent_bwd(grad, ct):
+    # labels are data in LossMCXENT (reference semantics) — zero cotangent;
+    # dpreout = ct * (softmax - labels), saved from the forward pass
+    d = ct.astype(jnp.float32) * grad.astype(jnp.float32)
+    return jnp.zeros_like(grad), d.astype(grad.dtype)
+
+
+_fused_sm_xent_per.defvjp(_fused_sm_xent_fwd, _fused_sm_xent_bwd)
+
+
+def _fused_xent_engaged(preout: Array) -> bool:
+    """DL4J_FUSED_XENT=0 disables, =1 forces (interpret mode off-TPU); unset
+    -> engaged exactly when the other pallas kernels are (use_pallas()).
+    Read at call time like every other kill switch in the tree."""
+    env = os.environ.get("DL4J_FUSED_XENT")
+    if env == "0":
+        return False
+    if preout.dtype not in (jnp.float32, jnp.bfloat16):
+        return False  # f64 gradient checks stay on the exact autodiff path
+    if env == "1":
+        return True
+    from deeplearning4j_tpu.ops.pallas_kernels import use_pallas
+
+    return use_pallas()
+
+
 def mcxent(labels: Array, preout: Array, activation, mask=None) -> Array:
     """Multi-class cross entropy (reference LossMCXENT). Fused log-softmax when the
-    output activation is softmax (the common OutputLayer pairing)."""
+    output activation is softmax (the common OutputLayer pairing); on TPU the
+    per-row loss+gradient ride the fused Pallas kernel via custom_vjp."""
     if _is_softmax(activation):
+        if _fused_xent_engaged(preout):
+            C = preout.shape[-1]
+            # labels cast to the logits dtype BEFORE the custom_vjp call:
+            # bwd's zero labels-cotangent must match the primal aval (int
+            # one-hot labels would otherwise crash jax.grad)
+            per = _fused_sm_xent_per(
+                labels.reshape(-1, C).astype(preout.dtype),
+                preout.reshape(-1, C))
+            per = per.reshape(preout.shape[:-1] + (1,)).astype(preout.dtype)
+            return _reduce(per, mask)
         logp = jax.nn.log_softmax(preout, axis=-1)
     else:
         out = activation(preout)

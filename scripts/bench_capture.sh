@@ -28,7 +28,7 @@ if [ "$MODE" = full ]; then
     run --model moe
     run --model moe --bf16-act
     run --model word2vec
-    run --model attention
+    (export DL4J_FLASH_SWEEP=1; run --model attention)
     run --model fit_resnet50
     run --model fit_lenet
     # batch sweep for the flagship at the winning dtype

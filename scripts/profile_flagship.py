@@ -29,62 +29,31 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def build_program(model: str, batch: int, ksteps: int):
-    """The same (jitted fn, args) bench.py times for this config."""
+    """The same (jitted fn, args) bench.py times for this config — model,
+    data, and jit construction come from bench.flagship_setup and the same
+    make_*_multistep_train_step + donation, so the profiled program IS the
+    benchmarked one."""
     import jax
     import jax.numpy as jnp
 
-    from bench import _onehot_batch, _stack
+    from bench import flagship_setup
 
-    rng = np.random.default_rng(0)
-    if model == "resnet50":
-        from deeplearning4j_tpu.models.resnet import resnet50
+    conf, xs, ys, graph = flagship_setup(model, batch, ksteps)
+    if graph:
         from deeplearning4j_tpu.nn.graph_network import (
             ComputationGraph, make_graph_multistep_train_step)
-        conf = resnet50(n_classes=1000, image_size=224)
         net = ComputationGraph(conf).init()
         multi = jax.jit(make_graph_multistep_train_step(conf),
                         donate_argnums=(0, 1, 2))
-        x = jnp.asarray(rng.normal(size=(batch, 224, 224, 3)).astype(np.float32))
-        y = jnp.asarray(_onehot_batch(rng, batch, 1000))
-        args = (net.params_list, net.state_list, net.updater_state,
-                [_stack(x, ksteps)], [_stack(y, ksteps)],
-                jax.random.PRNGKey(0), jnp.int32(0))
-        return multi, args
-    if model in ("transformer", "moe"):
-        from deeplearning4j_tpu.models.transformer import (
-            moe_transformer_lm, transformer_lm)
+    else:
         from deeplearning4j_tpu.nn.multilayer import (
             MultiLayerNetwork, make_multistep_train_step)
-        vocab, seq = 256, 256
-        conf = (transformer_lm(vocab_size=vocab, width=256, n_layers=4,
-                               n_heads=4, max_len=seq) if model == "transformer"
-                else moe_transformer_lm(vocab_size=vocab, width=256,
-                                        n_layers=4, n_heads=4, n_experts=8,
-                                        max_len=seq))
         net = MultiLayerNetwork(conf).init()
         multi = jax.jit(make_multistep_train_step(conf),
                         donate_argnums=(0, 1, 2))
-        ids = rng.integers(0, vocab, (batch, seq))
-        x = jnp.asarray(np.eye(vocab, dtype=np.float32)[ids])
-        args = (net.params_list, net.state_list, net.updater_state,
-                _stack(x, ksteps), _stack(x, ksteps),
-                jax.random.PRNGKey(0), jnp.int32(0))
-        return multi, args
-    if model == "lenet":
-        from deeplearning4j_tpu.models.lenet import lenet_mnist
-        from deeplearning4j_tpu.nn.multilayer import (
-            MultiLayerNetwork, make_multistep_train_step)
-        conf = lenet_mnist()
-        net = MultiLayerNetwork(conf).init()
-        multi = jax.jit(make_multistep_train_step(conf),
-                        donate_argnums=(0, 1, 2))
-        x = jnp.asarray(rng.normal(size=(batch, 784)).astype(np.float32))
-        y = jnp.asarray(_onehot_batch(rng, batch, 10))
-        args = (net.params_list, net.state_list, net.updater_state,
-                _stack(x, ksteps), _stack(y, ksteps),
-                jax.random.PRNGKey(0), jnp.int32(0))
-        return multi, args
-    raise SystemExit(f"unknown model {model}")
+    args = (net.params_list, net.state_list, net.updater_state, xs, ys,
+            jax.random.PRNGKey(0), jnp.int32(0))
+    return multi, args
 
 
 def capture(model: str, batch: int, ksteps: int, logdir: str,
@@ -130,10 +99,12 @@ def summarize(logdir: str, top: int = 25) -> dict:
     total_ns = 0
     for plane in planes:
         lines = list(plane.lines)
-        # device planes carry container lines ("XLA Modules", "Steps") that
-        # span the same wall time as the per-op line — summing every line
-        # double-counts. Keep only the per-op line when present.
-        op_lines = [l for l in lines if "op" in (l.name or "").lower()]
+        # device planes carry container lines ("XLA Modules", "Steps",
+        # "Framework Name Scope") spanning the same wall time as the per-op
+        # line — summing every line double-counts. Keep exactly the XLA
+        # per-op line when present.
+        op_lines = [l for l in lines
+                    if (l.name or "").strip().lower() in ("xla ops", "ops")]
         for line in (op_lines or lines):
             for ev in line.events:
                 nm = ev.name

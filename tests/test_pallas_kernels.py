@@ -168,3 +168,47 @@ def test_masked_attention_pallas_matches_xla(causal):
         assert np.all(np.isfinite(np.asarray(a))), name
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=5e-4, atol=5e-5, err_msg=name)
+
+
+def test_fused_xent_loss_path_matches_xla():
+    """mcxent through the fused Pallas softmax-xent custom_vjp (forced via
+    DL4J_FUSED_XENT=1, interpret on CPU) must match the XLA autodiff path in
+    value AND gradient, including masked time-series input — this is the
+    production wiring of ops/pallas_kernels.softmax_cross_entropy."""
+    import os
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from deeplearning4j_tpu.ops import losses
+
+    rng = np.random.default_rng(0)
+    cases = [
+        (rng.normal(size=(8, 5)).astype(np.float32),
+         np.eye(5, dtype=np.float32)[rng.integers(0, 5, 8)], None),
+        # integer one-hot labels: the fused path must cast, not crash
+        (rng.normal(size=(8, 5)).astype(np.float32),
+         np.eye(5, dtype=np.int32)[rng.integers(0, 5, 8)], None),
+        (rng.normal(size=(4, 6, 3)).astype(np.float32),
+         np.eye(3, dtype=np.float32)[rng.integers(0, 3, (4, 6))],
+         (rng.uniform(size=(4, 6)) > 0.3).astype(np.float32)),
+    ]
+    act = jax.nn.softmax
+    for preout, labels, mask in cases:
+        preout, labels = jnp.asarray(preout), jnp.asarray(labels)
+        m = jnp.asarray(mask) if mask is not None else None
+
+        def run():
+            f = lambda p: losses.mcxent(labels, p, act, m)
+            return float(f(preout)), np.asarray(jax.grad(f)(preout))
+
+        try:
+            os.environ["DL4J_FUSED_XENT"] = "0"
+            v_xla, g_xla = run()
+            os.environ["DL4J_FUSED_XENT"] = "1"
+            v_fused, g_fused = run()
+        finally:
+            os.environ.pop("DL4J_FUSED_XENT", None)
+        assert abs(v_xla - v_fused) < 1e-5, (v_xla, v_fused)
+        np.testing.assert_allclose(g_fused, g_xla, rtol=1e-4, atol=1e-6)
