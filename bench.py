@@ -212,16 +212,17 @@ def _bench_lm(model: str, batch: int, iters: int, ksteps: int,
     return r
 
 
-def bench_transformer(batch: int, iters: int, ksteps: int, warmup: int = 2,
-                      vocab: int = 256, seq: int = 256) -> dict:
-    """Decoder-only transformer LM over the flash-attention kernel."""
+def bench_transformer(batch: int, iters: int, ksteps: int,
+                      warmup: int = 2) -> dict:
+    """Decoder-only transformer LM over the flash-attention kernel
+    (geometry fixed by flagship_setup: LM_VOCAB x LM_SEQ)."""
     return _bench_lm("transformer", batch, iters, ksteps, warmup)
 
 
-def bench_moe(batch: int, iters: int, ksteps: int, warmup: int = 2,
-              vocab: int = 256, seq: int = 256) -> dict:
+def bench_moe(batch: int, iters: int, ksteps: int, warmup: int = 2) -> dict:
     """Switch-style MoE LM (residual attention + top-1 expert FFN blocks,
-    load-balance aux loss included in the trained objective)."""
+    load-balance aux loss included in the trained objective; geometry fixed
+    by flagship_setup)."""
     return _bench_lm("moe", batch, iters, ksteps, warmup)
 
 

@@ -824,8 +824,76 @@ class ComputationGraph(LazyScore):
             self.conf, params, states, rnn_states, xs, train=False, rng=None)
         return [acts[o] for o in self.conf.network_outputs], new_rnn
 
+    def rnn_get_previous_state(self):
+        """Per-vertex streaming LSTM state (reference
+        ComputationGraph.rnnGetPreviousState:1827)."""
+        return self._rnn_state
+
+    def rnn_set_previous_state(self, state) -> None:
+        """Install streaming state (reference rnnSetPreviousState:1850)."""
+        self._rnn_state = (jax.tree_util.tree_map(jnp.asarray, state)
+                           if state is not None else None)
+
     def rnn_clear_previous_state(self) -> None:
         self._rnn_state = None
+
+    def clone(self) -> "ComputationGraph":
+        """Deep copy with REAL buffer copies (see MultiLayerNetwork.clone:
+        the fused fit path donates param buffers to XLA, so clones must not
+        alias arrays). Reference ComputationGraph.clone:1663."""
+        import copy
+
+        net = ComputationGraph(copy.deepcopy(self.conf))
+        cp = lambda a: jnp.array(a)
+        net.params_list = jax.tree_util.tree_map(cp, self.params_list)
+        net.state_list = jax.tree_util.tree_map(cp, self.state_list)
+        net.updater_state = jax.tree_util.tree_map(cp, self.updater_state)
+        net.iteration = self.iteration
+        net.epoch = self.epoch
+        net._rng = self._rng
+        if self._rnn_state is not None:  # mid-stream serving handoff
+            net._rnn_state = jax.tree_util.tree_map(cp, self._rnn_state)
+        return net
+
+    def score_examples(self, data, add_regularization: bool = False):
+        """Per-example loss scores, un-reduced, summed over the graph's
+        outputs (reference ComputationGraph.scoreExamples:1485/1502).
+        Feature masks route through the forward walk, label masks weight
+        each example's own loss — as in fit()."""
+        self._require_init()
+        xs, ys, fms, lms = _coerce_graph_batch(data)
+        fn = self._jit("score_examples", self._score_examples_pure)
+        per = fn(self.params_list, self.state_list,
+                 [jnp.asarray(x) for x in xs], [jnp.asarray(y) for y in ys],
+                 [jnp.asarray(m) for m in fms] if fms else None,
+                 [jnp.asarray(m) for m in lms] if lms else None)
+        if add_regularization:
+            per = per + _graph_regularization(self.conf, self.params_list)
+        return np.asarray(per)
+
+    def _score_examples_pure(self, params, states, xs, ys, fms, lms):
+        conf = self.conf
+        _, _, loss_inputs = graph_forward(conf, params, states, xs,
+                                          train=False, rng=None, masks=fms,
+                                          collect_loss_inputs=True)
+        total = None
+        for i, out_name in enumerate(conf.network_outputs):
+            vertex = conf.vertices[out_name]
+            if not (isinstance(vertex, LayerVertex) and vertex.layer.has_loss()):
+                raise ValueError(
+                    f"Output vertex '{out_name}' has no loss function")
+            layer = vertex.layer
+            lm = lms[i] if lms and i < len(lms) and lms[i] is not None else None
+
+            def one(hi, yi, mi=None, _l=layer, _n=out_name):
+                return _l.compute_loss(params[_n], hi[None], yi[None],
+                                       mi[None] if mi is not None else None)
+
+            per = (jax.vmap(one)(loss_inputs[out_name], ys[i], lm)
+                   if lm is not None
+                   else jax.vmap(one)(loss_inputs[out_name], ys[i]))
+            total = per if total is None else total + per
+        return total
 
     def gradient_and_score(self, xs, ys):
         self._require_init()

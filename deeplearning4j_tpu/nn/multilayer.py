@@ -407,6 +407,59 @@ class MultiLayerNetwork(LazyScore):
         loss = layers[-1].compute_loss(params_list[-1], h, y, None)
         return loss + _regularization(self.conf, params_list)
 
+    def score_examples(self, x, y=None, add_regularization: bool = False):
+        """Per-example loss scores, un-reduced (reference scoreExamples:1755)
+        — the anomaly-detection / example-weighting API. ``x`` may be a
+        DataSet, whose labels mask weights each example's own loss (padded
+        timesteps don't count, as in fit()). With ``add_regularization`` the
+        network's l1/l2 term is added to every example's score."""
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+
+        self._require_init()
+        lmask = None
+        if y is None and isinstance(x, DataSet):
+            lmask = (jnp.asarray(x.labels_mask)
+                     if x.labels_mask is not None else None)
+            x, y = x.features, x.labels
+        fn = self._jit("score_examples", self._score_examples_pure)
+        per = fn(self.params_list, self.state_list, jnp.asarray(x),
+                 jnp.asarray(y), lmask)
+        if add_regularization:
+            per = per + _regularization(self.conf, self.params_list)
+        return np.asarray(per)
+
+    def _score_examples_pure(self, params_list, state_list, x, y, lmask):
+        layers = self.conf.layers
+        h = x
+        for i, layer in enumerate(layers[:-1]):
+            pp = self.conf.preprocessor(i)
+            if pp is not None:
+                h = pp.pre_process(h)
+            h, _ = layer.apply(params_list[i], state_list[i], h, train=False,
+                               rng=None)
+        pp = self.conf.preprocessor(len(layers) - 1)
+        if pp is not None:
+            h = pp.pre_process(h)
+        last = layers[-1]
+
+        # per-example: the scalar loss of a single-example batch IS that
+        # example's score (keeps every loss function's own reduction rules)
+        def one(hi, yi, mi=None):
+            return last.compute_loss(params_list[-1], hi[None], yi[None],
+                                     mi[None] if mi is not None else None)
+
+        if lmask is not None:
+            return jax.vmap(one)(h, y, lmask)
+        return jax.vmap(one)(h, y)
+
+    def f1_score(self, x, y=None) -> float:
+        """F1 on a dataset or (x, y) arrays (reference f1Score:2292)."""
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+
+        if y is None and isinstance(x, DataSet):
+            x, y = x.features, x.labels
+        return self.evaluate(x, y).f1()
+
     # ------------------------------------------------------------------ training
     def _next_rng(self):
         self._require_init()
@@ -626,22 +679,38 @@ class MultiLayerNetwork(LazyScore):
 
     # ------------------------------------------------------------------ pretrain
     def pretrain(self, iterator) -> None:
-        """Greedy layerwise unsupervised pretraining (reference pretrain:152,
-        pretrainLayer:183): for each pretrain layer, feed inputs forward to it and
-        minimize its unsupervised objective."""
+        """Greedy layerwise unsupervised pretraining (reference pretrain:152):
+        for each pretrain layer, feed inputs forward to it and minimize its
+        unsupervised objective."""
         for idx, layer in enumerate(self.conf.layers):
-            if not isinstance(layer, PretrainLayer):
-                continue
-            step = self._jit(f"pretrain:{idx}",
-                             make_pretrain_step(self.conf, idx))
-            if hasattr(iterator, "reset"):
-                iterator.reset()
-            for ds in iterator:
-                x = jnp.asarray(ds.features)
-                (self.params_list[idx], self.updater_state[idx], loss) = step(
-                    self.params_list, self.state_list, self.updater_state[idx],
-                    x, self._next_rng(), jnp.int32(self.iteration))
-                self.score_value = loss  # synced lazily (LazyScore)
+            if isinstance(layer, PretrainLayer):
+                self.pretrain_layer(idx, iterator)
+
+    def pretrain_layer(self, layer_idx: int, iterator) -> None:
+        """Pretrain ONE layer unsupervised (reference pretrainLayer:183);
+        earlier layers run in eval mode to produce its input."""
+        self._require_init()
+        if not 0 <= layer_idx < len(self.conf.layers):
+            raise ValueError(
+                f"layer_idx {layer_idx} out of range for "
+                f"{len(self.conf.layers)} layers")
+        if not isinstance(self.conf.layers[layer_idx], PretrainLayer):
+            raise ValueError(
+                f"Layer {layer_idx} "
+                f"({type(self.conf.layers[layer_idx]).__name__}) is not "
+                "pretrainable — layerwise pretraining needs an unsupervised "
+                "layer (VAE, RBM, AutoEncoder)")
+        step = self._jit(f"pretrain:{layer_idx}",
+                         make_pretrain_step(self.conf, layer_idx))
+        if hasattr(iterator, "reset"):
+            iterator.reset()
+        for ds in iterator:
+            x = jnp.asarray(ds.features)
+            (self.params_list[layer_idx], self.updater_state[layer_idx],
+             loss) = step(self.params_list, self.state_list,
+                          self.updater_state[layer_idx], x,
+                          self._next_rng(), jnp.int32(self.iteration))
+            self.score_value = loss  # synced lazily (LazyScore)
 
     # ------------------------------------------------------------------ evaluation
     def evaluate(self, iterator_or_x, y=None, labels_list=None, top_n: int = 1):
@@ -686,6 +755,18 @@ class MultiLayerNetwork(LazyScore):
             roc.eval(np.asarray(ds.labels), np.asarray(self.output(ds.features)))
         return roc
 
+    def evaluate_roc_multiclass(self, iterator, threshold_steps: int = 30):
+        """One-vs-all ROC per class (reference evaluateROCMultiClass:2401)."""
+        from deeplearning4j_tpu.eval.roc import ROCMultiClass
+
+        roc = ROCMultiClass(threshold_steps)
+        if hasattr(iterator, "reset"):
+            iterator.reset()
+        for ds in iterator:
+            roc.eval(np.asarray(ds.labels),
+                     np.asarray(self.output(ds.features)))
+        return roc
+
     # ------------------------------------------------------------------ rnn API
     def rnn_time_step(self, x) -> Array:
         """Streaming inference carrying hidden state across calls (reference
@@ -698,6 +779,17 @@ class MultiLayerNetwork(LazyScore):
         out, self._rnn_state = fn(self.params_list, self.state_list,
                                   self._rnn_state, x)
         return out
+
+    def rnn_get_previous_state(self):
+        """Per-layer streaming LSTM state (reference rnnGetPreviousState:2253);
+        None until rnn_time_step has run."""
+        return self._rnn_state
+
+    def rnn_set_previous_state(self, state) -> None:
+        """Install streaming state captured by rnn_get_previous_state
+        (reference rnnSetPreviousState:2269) — serving handoff/restore."""
+        self._rnn_state = (jax.tree_util.tree_map(jnp.asarray, state)
+                           if state is not None else None)
 
     def rnn_clear_previous_state(self) -> None:
         self._rnn_state = None
@@ -728,7 +820,10 @@ class MultiLayerNetwork(LazyScore):
         net.state_list = jax.tree_util.tree_map(cp, self.state_list)
         net.updater_state = jax.tree_util.tree_map(cp, self.updater_state)
         net.iteration = self.iteration
+        net.epoch = self.epoch
         net._rng = self._rng
+        if self._rnn_state is not None:  # mid-stream serving handoff
+            net._rnn_state = jax.tree_util.tree_map(cp, self._rnn_state)
         return net
 
 

@@ -190,3 +190,121 @@ def test_evaluation_label_names_in_stats():
     e2.merge(e)
     assert e2.labels == ["cat", "dog", "fish"]
     assert e2.num_examples == 8
+
+
+def test_score_examples_and_rnn_state_api():
+    """Round-4 surface parity: scoreExamples (un-reduced, per example;
+    reference MultiLayerNetwork:1755 / ComputationGraph:1502),
+    pretrainLayer on MLN, f1Score, rnnGet/SetPreviousState, CG.clone."""
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu import NeuralNetConfiguration, MultiLayerNetwork
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.datasets.iterators import ExistingDataSetIterator
+    from deeplearning4j_tpu.nn.conf.layers import (
+        AutoEncoder, DenseLayer, GravesLSTM, OutputLayer, RnnOutputLayer,
+    )
+    from deeplearning4j_tpu.nn.graph_network import ComputationGraph
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(12, 4)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 12)]
+    conf = (NeuralNetConfiguration.builder().seed(1).learning_rate(0.1)
+            .list()
+            .layer(DenseLayer(n_in=4, n_out=8, activation="tanh"))
+            .layer(OutputLayer(n_in=8, n_out=3, loss="mcxent",
+                               activation="softmax"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    per = net.score_examples(DataSet(x, y))
+    assert per.shape == (12,)
+    # mean of per-example scores == score() minus regularization (none here)
+    assert abs(per.mean() - net.score(x, y)) < 1e-5
+    per_reg = net.score_examples(x, y, add_regularization=True)
+    assert per_reg.shape == (12,)
+    assert 0.0 <= net.f1_score(x, y) <= 1.0
+
+    # MLN pretrain_layer: trains only that layer; errors are actionable
+    conf2 = (NeuralNetConfiguration.builder().seed(2).learning_rate(0.05)
+             .list()
+             .layer(AutoEncoder(n_in=4, n_out=6, activation="sigmoid"))
+             .layer(OutputLayer(n_in=6, n_out=3, loss="mcxent",
+                                activation="softmax"))
+             .build())
+    net2 = MultiLayerNetwork(conf2).init()
+    out_before = jax.tree_util.tree_map(np.asarray, net2.params_list[1])
+    ae_before = np.asarray(net2.params_list[0]["W"])
+    net2.pretrain_layer(0, ExistingDataSetIterator([DataSet(x, y)]))
+    assert not np.array_equal(np.asarray(net2.params_list[0]["W"]), ae_before)
+    for k, v in net2.params_list[1].items():
+        np.testing.assert_array_equal(np.asarray(v), out_before[k])
+    with pytest.raises(ValueError, match="not pretrainable"):
+        net2.pretrain_layer(1, ExistingDataSetIterator([DataSet(x, y)]))
+
+    # rnn state get/set roundtrip: restored state reproduces the next step
+    rconf = (NeuralNetConfiguration.builder().seed(3).learning_rate(0.1)
+             .list()
+             .layer(GravesLSTM(n_in=3, n_out=6, activation="tanh"))
+             .layer(RnnOutputLayer(n_in=6, n_out=3, loss="mcxent",
+                                   activation="softmax"))
+             .build())
+    rnet = MultiLayerNetwork(rconf).init()
+    seq = rng.normal(size=(2, 4, 3)).astype(np.float32)
+    rnet.rnn_time_step(seq)
+    saved = jax.tree_util.tree_map(np.asarray, rnet.rnn_get_previous_state())
+    step_in = rng.normal(size=(2, 1, 3)).astype(np.float32)
+    out_a = np.asarray(rnet.rnn_time_step(step_in))
+    rnet.rnn_set_previous_state(saved)
+    out_b = np.asarray(rnet.rnn_time_step(step_in))
+    np.testing.assert_allclose(out_a, out_b, rtol=1e-6)
+
+    # CG: clone independence + score_examples
+    gconf = (NeuralNetConfiguration.builder().seed(4).learning_rate(0.1)
+             .graph_builder()
+             .add_inputs("in")
+             .add_layer("d", DenseLayer(n_in=4, n_out=8, activation="tanh"),
+                        "in")
+             .add_layer("out", OutputLayer(n_in=8, n_out=3, loss="mcxent",
+                                           activation="softmax"), "d")
+             .set_outputs("out")
+             .build())
+    g = ComputationGraph(gconf).init()
+    gper = g.score_examples(DataSet(x, y))
+    assert gper.shape == (12,)
+    from deeplearning4j_tpu.nn.graph_network import MultiDataSet
+    assert abs(gper.mean() - g.score(MultiDataSet([x], [y]))) < 1e-5
+    g2 = g.clone()
+    g.fit([x], [y])
+    assert not np.allclose(np.asarray(g.params()), np.asarray(g2.params()))
+
+
+def test_score_examples_honors_label_masks():
+    """scoreExamples with a masked time-series DataSet: padded timesteps
+    must not count (matches fit()'s mask semantics on both network types)."""
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu import NeuralNetConfiguration, MultiLayerNetwork
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.nn.conf.layers import GravesLSTM, RnnOutputLayer
+
+    rng = np.random.default_rng(0)
+    B, T, C = 4, 6, 3
+    x = rng.normal(size=(B, T, C)).astype(np.float32)
+    y = np.eye(C, dtype=np.float32)[rng.integers(0, C, (B, T))]
+    lmask = np.ones((B, T), np.float32)
+    lmask[:, T // 2:] = 0
+    conf = (NeuralNetConfiguration.builder().seed(5).learning_rate(0.1)
+            .list()
+            .layer(GravesLSTM(n_in=C, n_out=6, activation="tanh"))
+            .layer(RnnOutputLayer(n_in=6, n_out=C, loss="mcxent",
+                                  activation="softmax"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    masked = net.score_examples(DataSet(x, y, labels_mask=lmask))
+    unmasked = net.score_examples(DataSet(x, y))
+    assert masked.shape == (B,)
+    assert not np.allclose(masked, unmasked)
+    # masked per-example score == full-sequence score of the valid half
+    half = net.score_examples(DataSet(x[:, :T // 2], y[:, :T // 2]))
+    np.testing.assert_allclose(masked, half, rtol=1e-4, atol=1e-6)

@@ -508,3 +508,25 @@ def test_stage_dtype_casts_on_host_before_transfer():
     ys = np.eye(3, dtype=np.float32)[np.random.default_rng(2).integers(0, 3, 16)]
     net.fit(xs, ys, epochs=4)
     assert np.isfinite(net.score_value)
+
+
+def test_evaluate_roc_multiclass_and_labeled_top_n():
+    """MLN evaluation surface parity: evaluateROCMultiClass (reference
+    MultiLayerNetwork.java:2401) and evaluate(iterator, labels, topN):2465."""
+    it = IrisDataSetIterator(batch=30)
+    conf = (NeuralNetConfiguration.builder()
+            .seed(3).learning_rate(0.1).updater("adam")
+            .list()
+            .layer(DenseLayer(n_in=4, n_out=16, activation="relu"))
+            .layer(OutputLayer(n_in=16, n_out=3, loss="mcxent",
+                               activation="softmax"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    net.fit_iterator(it, epochs=15)
+    roc = net.evaluate_roc_multiclass(it, threshold_steps=20)
+    aucs = [roc.calculate_auc(c) for c in range(3)]
+    assert all(0.8 < a <= 1.0 for a in aucs), aucs
+    ev = net.evaluate(it, labels_list=["setosa", "versicolor", "virginica"],
+                      top_n=2)
+    assert ev.top_n_accuracy() >= ev.accuracy() > 0.85
+    assert "setosa" in ev.stats()
