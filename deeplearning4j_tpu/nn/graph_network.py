@@ -862,11 +862,12 @@ class ComputationGraph(LazyScore):
         each example's own loss — as in fit()."""
         self._require_init()
         xs, ys, fms, lms = _coerce_graph_batch(data)
+        asarray_opt = lambda m: jnp.asarray(m) if m is not None else None
         fn = self._jit("score_examples", self._score_examples_pure)
         per = fn(self.params_list, self.state_list,
                  [jnp.asarray(x) for x in xs], [jnp.asarray(y) for y in ys],
-                 [jnp.asarray(m) for m in fms] if fms else None,
-                 [jnp.asarray(m) for m in lms] if lms else None)
+                 [asarray_opt(m) for m in fms] if fms else None,
+                 [asarray_opt(m) for m in lms] if lms else None)
         if add_regularization:
             per = per + _graph_regularization(self.conf, self.params_list)
         return np.asarray(per)

@@ -393,18 +393,26 @@ class MultiLayerNetwork(LazyScore):
         fn = self._jit("score", self._score_pure)
         return float(fn(self.params_list, self.state_list, x, y))
 
-    def _score_pure(self, params_list, state_list, x, y):
+    def _eval_trunk(self, params_list, state_list, x, fmask=None):
+        """Eval-mode forward to the last layer's input with feature-mask
+        threading — the ONE trunk behind score() and score_examples() (same
+        walk as loss_fn's, without training state)."""
         layers = self.conf.layers
         h = x
         for i, layer in enumerate(layers[:-1]):
             pp = self.conf.preprocessor(i)
             if pp is not None:
-                h = pp.pre_process(h)
-            h, _ = layer.apply(params_list[i], state_list[i], h, train=False, rng=None)
+                h = pp.pre_process(h, fmask)
+            h, _ = layer.apply(params_list[i], state_list[i], h, train=False,
+                               rng=None, mask=fmask)
         pp = self.conf.preprocessor(len(layers) - 1)
         if pp is not None:
-            h = pp.pre_process(h)
-        loss = layers[-1].compute_loss(params_list[-1], h, y, None)
+            h = pp.pre_process(h, fmask)
+        return h
+
+    def _score_pure(self, params_list, state_list, x, y):
+        h = self._eval_trunk(params_list, state_list, x)
+        loss = self.conf.layers[-1].compute_loss(params_list[-1], h, y, None)
         return loss + _regularization(self.conf, params_list)
 
     def score_examples(self, x, y=None, add_regularization: bool = False):
@@ -416,31 +424,24 @@ class MultiLayerNetwork(LazyScore):
         from deeplearning4j_tpu.datasets.dataset import DataSet
 
         self._require_init()
-        lmask = None
+        fmask = lmask = None
         if y is None and isinstance(x, DataSet):
+            fmask = (jnp.asarray(x.features_mask)
+                     if x.features_mask is not None else None)
             lmask = (jnp.asarray(x.labels_mask)
                      if x.labels_mask is not None else None)
             x, y = x.features, x.labels
         fn = self._jit("score_examples", self._score_examples_pure)
         per = fn(self.params_list, self.state_list, jnp.asarray(x),
-                 jnp.asarray(y), lmask)
+                 jnp.asarray(y), fmask, lmask)
         if add_regularization:
             per = per + _regularization(self.conf, self.params_list)
         return np.asarray(per)
 
-    def _score_examples_pure(self, params_list, state_list, x, y, lmask):
-        layers = self.conf.layers
-        h = x
-        for i, layer in enumerate(layers[:-1]):
-            pp = self.conf.preprocessor(i)
-            if pp is not None:
-                h = pp.pre_process(h)
-            h, _ = layer.apply(params_list[i], state_list[i], h, train=False,
-                               rng=None)
-        pp = self.conf.preprocessor(len(layers) - 1)
-        if pp is not None:
-            h = pp.pre_process(h)
-        last = layers[-1]
+    def _score_examples_pure(self, params_list, state_list, x, y, fmask,
+                             lmask):
+        h = self._eval_trunk(params_list, state_list, x, fmask)
+        last = self.conf.layers[-1]
 
         # per-example: the scalar loss of a single-example batch IS that
         # example's score (keeps every loss function's own reduction rules)
@@ -745,27 +746,25 @@ class MultiLayerNetwork(LazyScore):
             ev.eval(np.asarray(ds.labels), np.asarray(self.output(ds.features)))
         return ev
 
-    def evaluate_roc(self, iterator, threshold_steps: int = 30):
-        from deeplearning4j_tpu.eval.roc import ROC
-
-        roc = ROC(threshold_steps)
-        if hasattr(iterator, "reset"):
-            iterator.reset()
-        for ds in iterator:
-            roc.eval(np.asarray(ds.labels), np.asarray(self.output(ds.features)))
-        return roc
-
-    def evaluate_roc_multiclass(self, iterator, threshold_steps: int = 30):
-        """One-vs-all ROC per class (reference evaluateROCMultiClass:2401)."""
-        from deeplearning4j_tpu.eval.roc import ROCMultiClass
-
-        roc = ROCMultiClass(threshold_steps)
+    def _evaluate_roc_impl(self, roc, iterator):
         if hasattr(iterator, "reset"):
             iterator.reset()
         for ds in iterator:
             roc.eval(np.asarray(ds.labels),
                      np.asarray(self.output(ds.features)))
         return roc
+
+    def evaluate_roc(self, iterator, threshold_steps: int = 30):
+        from deeplearning4j_tpu.eval.roc import ROC
+
+        return self._evaluate_roc_impl(ROC(threshold_steps), iterator)
+
+    def evaluate_roc_multiclass(self, iterator, threshold_steps: int = 30):
+        """One-vs-all ROC per class (reference evaluateROCMultiClass:2401)."""
+        from deeplearning4j_tpu.eval.roc import ROCMultiClass
+
+        return self._evaluate_roc_impl(ROCMultiClass(threshold_steps),
+                                       iterator)
 
     # ------------------------------------------------------------------ rnn API
     def rnn_time_step(self, x) -> Array:

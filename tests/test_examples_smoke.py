@@ -31,3 +31,8 @@ def test_word2vec_example():
 def test_moe_lm_example():
     stdout = _run_example("moe_lm.py", "--steps", "4")
     assert "load-balance term" in stdout
+
+
+def test_vae_anomaly_example():
+    stdout = _run_example("vae_anomaly.py", "--steps", "8")
+    assert "anomalous=" in stdout  # self-asserts anomalies score higher

@@ -308,3 +308,57 @@ def test_score_examples_honors_label_masks():
     # masked per-example score == full-sequence score of the valid half
     half = net.score_examples(DataSet(x[:, :T // 2], y[:, :T // 2]))
     np.testing.assert_allclose(masked, half, rtol=1e-4, atol=1e-6)
+
+
+def test_score_examples_per_stream_none_masks_and_feature_mask():
+    """CG score_examples with per-stream None mask entries must not crash
+    (the 'only one output masked' MultiDataSet case); MLN score_examples
+    threads features_mask through the forward like fit() does."""
+    from deeplearning4j_tpu import NeuralNetConfiguration, MultiLayerNetwork
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.nn.conf.layers import (
+        DenseLayer, GravesLSTM, OutputLayer, RnnOutputLayer,
+    )
+    from deeplearning4j_tpu.nn.graph_network import (
+        ComputationGraph, MultiDataSet)
+
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=(4, 4)).astype(np.float32)
+    y1 = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 4)]
+    y2 = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 4)]
+    conf = (NeuralNetConfiguration.builder().seed(6).learning_rate(0.1)
+            .graph_builder()
+            .add_inputs("in")
+            .add_layer("d", DenseLayer(n_in=4, n_out=8, activation="tanh"),
+                       "in")
+            .add_layer("o1", OutputLayer(n_in=8, n_out=3, loss="mcxent",
+                                         activation="softmax"), "d")
+            .add_layer("o2", OutputLayer(n_in=8, n_out=3, loss="mcxent",
+                                         activation="softmax"), "d")
+            .set_outputs("o1", "o2")
+            .build())
+    g = ComputationGraph(conf).init()
+    mask = np.ones((4,), np.float32)
+    mask[2:] = 0
+    mds = MultiDataSet([x], [y1, y2], labels_masks=[mask, None])
+    per = g.score_examples(mds)
+    assert per.shape == (4,)
+
+    # MLN: feature mask changes LSTM activations, so scores must differ
+    B, T, C = 3, 5, 2
+    xs = rng.normal(size=(B, T, C)).astype(np.float32)
+    ys = np.eye(C, dtype=np.float32)[rng.integers(0, C, (B, T))]
+    fm = np.ones((B, T), np.float32)
+    fm[:, 3:] = 0
+    rconf = (NeuralNetConfiguration.builder().seed(7).learning_rate(0.1)
+             .list()
+             .layer(GravesLSTM(n_in=C, n_out=4, activation="tanh"))
+             .layer(RnnOutputLayer(n_in=4, n_out=C, loss="mcxent",
+                                   activation="softmax"))
+             .build())
+    net = MultiLayerNetwork(rconf).init()
+    with_fm = net.score_examples(DataSet(xs, ys, features_mask=fm,
+                                         labels_mask=fm))
+    without = net.score_examples(DataSet(xs, ys))
+    assert with_fm.shape == (B,)
+    assert not np.allclose(with_fm, without)
