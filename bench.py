@@ -652,14 +652,62 @@ def main() -> None:
         "error": kind + ": " + last_err.replace("\n", " | "),
     }
     if last_was_timeout:
-        # relay outage, not a framework failure: point the reader at the
-        # last on-chip measurement recorded for this config (BASELINE.md)
+        # relay outage, not a framework failure: embed the most recent
+        # healthy on-chip record for this config (scripts/bench_log.jsonl,
+        # appended by every bench_capture.sh run) so the artifact still
+        # carries a real number, clearly marked as prior
         rec["note"] = ("transient TPU-relay outage at measurement time; "
-                       "BASELINE.md's 'Measured (round 3)' table holds the "
-                       "last on-chip numbers for this config")
+                       "last_healthy is the most recent on-chip capture of "
+                       "this config (see also BASELINE.md)")
+        prior = _last_healthy_from_log(" ".join(sys.argv[1:]))
+        if prior is not None:
+            rec["last_healthy"] = prior
     print(json.dumps(rec), flush=True)
     if not last_was_timeout:
         sys.exit(1)
+
+
+def _config_key(args_str: str) -> dict:
+    """The fields that make two bench invocations the SAME config: model,
+    dtype mode, explicit batch/ksteps. Unrecognized flags are ignored."""
+    toks = args_str.split()
+
+    def val(flag):
+        return toks[toks.index(flag) + 1] if (flag in toks
+                                              and toks.index(flag) + 1
+                                              < len(toks)) else None
+
+    return {"model": val("--model"), "batch": val("--batch"),
+            "ksteps": val("--ksteps"), "bf16_act": "--bf16-act" in toks,
+            "f32": "--f32" in toks}
+
+
+def _last_healthy_from_log(args_str: str, path: str = None):
+    """Most recent successful record of the SAME config (model + dtype mode
+    + batch) in scripts/bench_log.jsonl (one row per bench_capture.sh run)
+    — a bf16 or batch-swept row must not stand in for an fp32 default run."""
+    if path is None:
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "scripts", "bench_log.jsonl")
+    try:
+        with open(path) as f:
+            lines = f.read().splitlines()
+    except OSError:
+        return None
+    want = _config_key(args_str)
+    for line in reversed(lines):
+        try:
+            row = json.loads(line)
+        except (json.JSONDecodeError, ValueError):
+            continue
+        if not isinstance(row, dict):
+            continue
+        r = row.get("rec")
+        if (isinstance(r, dict) and r.get("value") and not r.get("error")
+                and _config_key(row.get("args", "")) == want):
+            return {"ts": row.get("ts"), "args": row.get("args"),
+                    "record": r}
+    return None
 
 
 if __name__ == "__main__":

@@ -385,13 +385,21 @@ class MultiLayerNetwork(LazyScore):
         return np.asarray(jnp.argmax(self.output(x), axis=-1))
 
     def score(self, x=None, y=None, dataset=None) -> float:
-        """Loss (incl. regularization) on a dataset, no dropout (reference score:1704)."""
+        """Loss (incl. regularization) on a dataset, no dropout; a DataSet's
+        feature/label masks are honored like fit()'s (reference score:1704
+        via setLayerMaskArrays)."""
         self._require_init()
+        fmask = lmask = None
         if dataset is not None:
             x, y = dataset.features, dataset.labels
+            fmask = (jnp.asarray(dataset.features_mask)
+                     if dataset.features_mask is not None else None)
+            lmask = (jnp.asarray(dataset.labels_mask)
+                     if dataset.labels_mask is not None else None)
         x, y = jnp.asarray(x), jnp.asarray(y)
         fn = self._jit("score", self._score_pure)
-        return float(fn(self.params_list, self.state_list, x, y))
+        return float(fn(self.params_list, self.state_list, x, y, fmask,
+                        lmask))
 
     def _eval_trunk(self, params_list, state_list, x, fmask=None):
         """Eval-mode forward to the last layer's input with feature-mask
@@ -410,9 +418,10 @@ class MultiLayerNetwork(LazyScore):
             h = pp.pre_process(h, fmask)
         return h
 
-    def _score_pure(self, params_list, state_list, x, y):
-        h = self._eval_trunk(params_list, state_list, x)
-        loss = self.conf.layers[-1].compute_loss(params_list[-1], h, y, None)
+    def _score_pure(self, params_list, state_list, x, y, fmask=None,
+                    lmask=None):
+        h = self._eval_trunk(params_list, state_list, x, fmask)
+        loss = self.conf.layers[-1].compute_loss(params_list[-1], h, y, lmask)
         return loss + _regularization(self.conf, params_list)
 
     def score_examples(self, x, y=None, add_regularization: bool = False):

@@ -39,3 +39,38 @@ def test_cost_analysis_counts_scan_body_once():
     assert costs[1] < costs[0] * 1.5, (
         "XLA cost analysis now scales scan flops with trip count; "
         "remove the `* ksteps` factors in bench.py::_xla_flops callers")
+
+
+def test_outage_record_carries_last_healthy(tmp_path):
+    """A relay-outage error record must embed the most recent healthy
+    on-chip capture of the same config from scripts/bench_log.jsonl (round-3
+    lesson: an outage at round end erased all perf evidence)."""
+    import json
+
+    import bench
+
+    log = tmp_path / "bench_log.jsonl"
+    rows = [
+        {"args": "--model resnet50", "ts": "t1",
+         "rec": {"metric": "m", "value": 100.0}},
+        {"args": "--model resnet50 --bf16-act", "ts": "t2",
+         "rec": {"metric": "m", "value": 200.0}},
+        {"args": "--model resnet50 --bf16-act --batch 256", "ts": "t3",
+         "rec": {"metric": "m", "value": 300.0}},
+        {"args": "--model resnet50", "ts": "t4",
+         "rec": {"metric": "m", "value": 0.0, "error": "down"}},
+    ]
+    log.write_text("\n".join(json.dumps(r) for r in rows))
+    # SAME config only: a bf16/batch-swept row must not stand in for the
+    # fp32 default run (and vice versa); measurement-only flags are ignored
+    got = bench._last_healthy_from_log("--model resnet50 --attempts 1",
+                                       path=str(log))
+    assert got["ts"] == "t1" and got["record"]["value"] == 100.0
+    got = bench._last_healthy_from_log("--model resnet50 --bf16-act",
+                                       path=str(log))
+    assert got["ts"] == "t2"
+    got = bench._last_healthy_from_log(
+        "--model resnet50 --bf16-act --batch 256", path=str(log))
+    assert got["ts"] == "t3"
+    assert bench._last_healthy_from_log("--model word2vec",
+                                        path=str(log)) is None

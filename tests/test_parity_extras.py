@@ -362,3 +362,8 @@ def test_score_examples_per_stream_none_masks_and_feature_mask():
     without = net.score_examples(DataSet(xs, ys))
     assert with_fm.shape == (B,)
     assert not np.allclose(with_fm, without)
+    # score(dataset=) honors the same masks: equals mean of per-example
+    s_masked = net.score(dataset=DataSet(xs, ys, features_mask=fm,
+                                         labels_mask=fm))
+    assert abs(s_masked - with_fm.mean()) < 1e-5
+    assert abs(net.score(xs, ys) - without.mean()) < 1e-5
