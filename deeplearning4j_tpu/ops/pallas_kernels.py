@@ -181,11 +181,21 @@ def _attention_xla(q, k, v, causal):
     return attention_reference(q, k, v, causal).astype(q.dtype)
 
 
+def _in_shard_map(x) -> bool:
+    """True when ``x`` is device-varying under a shard_map trace. Every
+    pallas dispatch must yield to XLA math there: the vma checker rejects
+    pallas_call out_shapes inside shard_map (check_vma default) — shard_map
+    callers that DO want the kernel wrap it with check_vma=False themselves
+    (parallel/ring_attention.py does)."""
+    return bool(getattr(jax.typeof(x), "vma", None))
+
+
 def _pallas_ok(q, k, interpret: bool) -> bool:
     """ONE dispatch predicate for every flash/masked entry point AND its
     custom_vjp fwd rule — they must agree, or a forward under jax.grad would
     silently take a different code path than the plain forward."""
-    return (use_pallas() or interpret) and _tileable(q.shape[1], k.shape[1])
+    return ((use_pallas() or interpret) and _tileable(q.shape[1], k.shape[1])
+            and not _in_shard_map(q))
 
 
 def _tileable(tq: int, tk: int, blk_q: int = None, blk_k: int = None) -> bool:
@@ -521,9 +531,16 @@ def _sm_xent_kernel(logits_ref, labels_ref, loss_ref, grad_ref):
 def softmax_cross_entropy(logits: Array, labels: Array, blk: int = 256,
                           interpret: bool = False):
     """Fused per-row loss + dlogits. Returns (loss (N,), grad (N, C)).
-    Pallas on TPU; identical XLA math elsewhere."""
+    Pallas on TPU; identical XLA math elsewhere.
+
+    Under a shard_map trace (non-empty vma on the operands — e.g.
+    ParallelWrapper's local-SGD per-replica step) the pallas_call is skipped
+    in favor of the XLA math: the vma checker rejects the kernel's
+    out_shape and the interpret lowering its internal while_loop carry, and
+    XLA fuses this row-wise chain well anyway."""
     N, C = logits.shape
-    if (use_pallas() or interpret) and N % min(blk, N) == 0:
+    if (use_pallas() or interpret) and N % min(blk, N) == 0 \
+            and not _in_shard_map(logits):
         blk = min(blk, N)
         loss, grad = pl.pallas_call(
             _sm_xent_kernel,

@@ -212,3 +212,74 @@ def test_fused_xent_loss_path_matches_xla():
             os.environ.pop("DL4J_FUSED_XENT", None)
         assert abs(v_xla - v_fused) < 1e-5, (v_xla, v_fused)
         np.testing.assert_allclose(g_fused, g_xla, rtol=1e-4, atol=1e-6)
+
+
+def test_fused_xent_falls_back_under_shard_map():
+    """Inside a shard_map trace the fused kernel must yield to the XLA math
+    (the vma checker rejects the pallas_call there — this crashed
+    ParallelWrapper local-SGD until round 4). Forced engagement + an
+    explicit shard_map reproduce the original failure path."""
+    import os
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import PartitionSpec as P
+    from jax import shard_map
+
+    from deeplearning4j_tpu.ops import losses
+    from deeplearning4j_tpu.parallel.mesh import build_mesh
+
+    mesh = build_mesh({"data": 8})
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(16, 5)).astype(np.float32))
+    y = jnp.asarray(np.eye(5, dtype=np.float32)[rng.integers(0, 5, 16)])
+
+    def local_loss(xx, yy):
+        return losses.mcxent(yy, xx, jax.nn.softmax)[None]
+
+    try:
+        os.environ["DL4J_FUSED_XENT"] = "1"
+        per_shard = jax.jit(shard_map(
+            local_loss, mesh=mesh, in_specs=(P("data"), P("data")),
+            out_specs=P("data")))(x, y)
+        os.environ["DL4J_FUSED_XENT"] = "0"
+        expect = jax.jit(shard_map(
+            local_loss, mesh=mesh, in_specs=(P("data"), P("data")),
+            out_specs=P("data")))(x, y)
+    finally:
+        os.environ.pop("DL4J_FUSED_XENT", None)
+    np.testing.assert_allclose(np.asarray(per_shard), np.asarray(expect),
+                               rtol=1e-5)
+
+
+def test_flash_attention_falls_back_under_checked_shard_map():
+    """flash_attention inside a check_vma=True shard_map must fall back to
+    the XLA math (same crash class as the xent kernel); inside ulysses'
+    check_vma=False shard_map the pallas kernel still engages (covered by
+    test_ulysses_pallas_interpret_matches_reference)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from deeplearning4j_tpu.ops import pallas_kernels as pk
+    from deeplearning4j_tpu.parallel.mesh import build_mesh
+
+    mesh = build_mesh({"data": 4})
+    rng = np.random.default_rng(0)
+    q, k, v = (jnp.asarray(rng.normal(size=(4, 16, 2, 8)).astype(np.float32))
+               for _ in range(3))
+
+    def local(qq, kk, vv):
+        # interpret=True would normally force the pallas path; the vma guard
+        # must override it here
+        return pk.flash_attention(qq, kk, vv, True, interpret=True)
+
+    got = jax.jit(shard_map(local, mesh=mesh,
+                            in_specs=(P("data"), P("data"), P("data")),
+                            out_specs=P("data")))(q, k, v)
+    want = pk._attention_xla(q, k, v, True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-4, atol=2e-5)
