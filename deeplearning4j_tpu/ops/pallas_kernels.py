@@ -10,6 +10,17 @@ Kernels:
 * flash_attention — tiled online-softmax attention (forward), custom VJP with
   a recompute backward (standard flash-attention practice: trade FLOPs for HBM).
 * softmax_cross_entropy — fused row-softmax + NLL loss per row.
+
+Sharding interactions (validated on the virtual CPU mesh):
+* inside a vma-checked shard_map trace every kernel yields to the XLA math
+  (_in_shard_map) — the checker rejects pallas_call there; shard_map callers
+  that want the kernel set check_vma=False (parallel/ring_attention.py).
+* under plain GSPMD sharded jit (ParallelWrapper sync DP) the pallas custom
+  call is not batch-partitioned: XLA gathers operands and replicates the
+  output. Multi-chip attention should ride ring/ulysses_attention (sequence
+  parallelism) instead; if DP-sharded attention throughput looks off on
+  hardware, A/B with DL4J_TPU_DISABLE_PALLAS=1 — the XLA einsum path
+  partitions cleanly along the batch axis.
 """
 from __future__ import annotations
 
