@@ -283,3 +283,50 @@ def test_flash_attention_falls_back_under_checked_shard_map():
     want = pk._attention_xla(q, k, v, True)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=2e-4, atol=2e-5)
+
+
+def test_fused_xent_integrations_bf16_and_lbfgs():
+    """Force-engaged fused xent must train under the bfloat16_full policy
+    and through the LBFGS solver path (integration seams where the
+    custom_vjp meets dtype policies and jitted while_loop optimizers)."""
+    import os
+
+    import numpy as np
+
+    from deeplearning4j_tpu import NeuralNetConfiguration, MultiLayerNetwork
+    from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(32, 6)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 32)]
+    try:
+        os.environ["DL4J_FUSED_XENT"] = "1"
+        conf = (NeuralNetConfiguration.builder().seed(0).learning_rate(0.1)
+                .dtype("bfloat16_full")
+                .list()
+                .layer(DenseLayer(n_in=6, n_out=16, activation="tanh"))
+                .layer(OutputLayer(n_in=16, n_out=3, loss="mcxent",
+                                   activation="softmax"))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        net.fit(x, y)
+        s0 = net.score_value
+        for _ in range(20):
+            net.fit(x, y)
+        assert net.score_value < s0
+
+        conf2 = (NeuralNetConfiguration.builder().seed(1).learning_rate(0.5)
+                 .optimization_algo("lbfgs")
+                 .list()
+                 .layer(DenseLayer(n_in=6, n_out=16, activation="tanh"))
+                 .layer(OutputLayer(n_in=16, n_out=3, loss="mcxent",
+                                    activation="softmax"))
+                 .build())
+        net2 = MultiLayerNetwork(conf2).init()
+        net2.fit(x, y)
+        s0 = net2.score_value
+        for _ in range(5):
+            net2.fit(x, y)
+        assert net2.score_value <= s0
+    finally:
+        os.environ.pop("DL4J_FUSED_XENT", None)
