@@ -1,0 +1,71 @@
+"""Long-context attention via sequence parallelism: ring + Ulysses.
+
+The framework's long-context story (SURVEY.md §5): a sequence too long for
+one chip's HBM is sharded along its length over a mesh axis, and attention
+runs as a collective —
+
+* ring_attention: K/V blocks rotate around the ring (lax.ppermute) while
+  each device holds its query shard; memory per device is O(T/N).
+* ulysses_attention: all_to_all swaps sequence sharding for HEAD sharding,
+  runs the tiled flash kernel on full-length sequences for 1/N of the
+  heads, and swaps back — two collectives total.
+
+Both are exact (same math as single-device attention) and differentiable.
+This demo runs on an 8-virtual-device CPU mesh; on TPU hardware the same
+code runs over ICI with the pallas flash kernel inside ulysses.
+
+Run: XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+     python examples/long_context_sp.py
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.parallel.mesh import build_mesh
+from deeplearning4j_tpu.parallel.ring_attention import (
+    attention_reference, ring_attention, ulysses_attention,
+)
+
+
+def main():
+    n = len(jax.devices())
+    mesh = build_mesh({"sp": n})
+    B, T, H, D = 2, 128 * n, n, 16  # sequence length scales with the mesh
+    rng = np.random.default_rng(0)
+    q, k, v = (jnp.asarray(rng.normal(size=(B, T, H, D)).astype(np.float32))
+               for _ in range(3))
+    print(f"mesh: {n} devices on axis 'sp'; sequence length {T} "
+          f"({T // n} per device)")
+
+    want = attention_reference(q, k, v, causal=True)
+    for name, fn in (("ring", ring_attention), ("ulysses", ulysses_attention)):
+        t0 = time.time()
+        got = fn(q, k, v, mesh, causal=True)
+        err = float(jnp.max(jnp.abs(got - want)))
+        print(f"{name:8s} attention: max err vs single-device = {err:.2e} "
+              f"({time.time() - t0:.2f}s incl. compile)")
+        assert err < 1e-3
+
+    # differentiable: gradients flow through the collectives
+    def loss(q):
+        return jnp.sum(ring_attention(q, k, v, mesh, causal=True) ** 2)
+
+    g = jax.grad(loss)(q)
+    def ref_loss(q):
+        return jnp.sum(attention_reference(q, k, v, causal=True) ** 2)
+    g_ref = jax.grad(ref_loss)(q)
+    gerr = float(jnp.max(jnp.abs(g - g_ref)))
+    print(f"ring backward: max grad err = {gerr:.2e}")
+    assert gerr < 1e-2
+    print("sequence parallelism OK: exact attention at O(T/N) memory/device")
+
+
+if __name__ == "__main__":
+    main()

@@ -12,10 +12,11 @@ import pytest
 _ROOT = Path(__file__).resolve().parent.parent
 
 
-def _run_example(name: str, *args: str) -> str:
+def _run_example(name: str, *args: str, extra_env: dict = None) -> str:
     env = dict(os.environ)
     env["PALLAS_AXON_POOL_IPS"] = ""
     env["JAX_PLATFORMS"] = "cpu"
+    env.update(extra_env or {})
     out = subprocess.run(
         [sys.executable, str(_ROOT / "examples" / name), *args],
         capture_output=True, text=True, timeout=420, env=env, cwd=str(_ROOT))
@@ -36,3 +37,12 @@ def test_moe_lm_example():
 def test_vae_anomaly_example():
     stdout = _run_example("vae_anomaly.py", "--steps", "8")
     assert "anomalous=" in stdout  # self-asserts anomalies score higher
+
+
+def test_long_context_sp_example():
+    # the 8-device mesh is the point: ppermute/all_to_all must actually run
+    stdout = _run_example(
+        "long_context_sp.py",
+        extra_env={"XLA_FLAGS": "--xla_force_host_platform_device_count=8"})
+    assert "mesh: 8 devices" in stdout
+    assert "sequence parallelism OK" in stdout
