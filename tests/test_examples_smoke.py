@@ -41,8 +41,14 @@ def test_vae_anomaly_example():
 
 def test_long_context_sp_example():
     # the 8-device mesh is the point: ppermute/all_to_all must actually run
+    import re
+
+    flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+                   os.environ.get("XLA_FLAGS", ""))
     stdout = _run_example(
         "long_context_sp.py",
-        extra_env={"XLA_FLAGS": "--xla_force_host_platform_device_count=8"})
+        extra_env={"XLA_FLAGS":
+                   (flags + " --xla_force_host_platform_device_count=8")
+                   .strip()})
     assert "mesh: 8 devices" in stdout
     assert "sequence parallelism OK" in stdout
