@@ -656,12 +656,17 @@ def main() -> None:
         # healthy on-chip record for this config (scripts/bench_log.jsonl,
         # appended by every bench_capture.sh run) so the artifact still
         # carries a real number, clearly marked as prior
-        rec["note"] = ("transient TPU-relay outage at measurement time; "
-                       "last_healthy is the most recent on-chip capture of "
-                       "this config (see also BASELINE.md)")
         prior = _last_healthy_from_log(" ".join(sys.argv[1:]))
         if prior is not None:
+            rec["note"] = ("transient TPU-relay outage at measurement time; "
+                           "last_healthy is the most recent on-chip capture "
+                           "of this config (see also BASELINE.md)")
             rec["last_healthy"] = prior
+        else:
+            rec["note"] = ("transient TPU-relay outage at measurement time "
+                           "and no prior on-chip capture of this config in "
+                           "scripts/bench_log.jsonl; BASELINE.md's measured "
+                           "tables hold the last recorded numbers")
     print(json.dumps(rec), flush=True)
     if not last_was_timeout:
         sys.exit(1)
