@@ -826,11 +826,11 @@ class ComputationGraph(LazyScore):
 
     def rnn_get_previous_state(self):
         """Per-vertex streaming LSTM state (reference
-        ComputationGraph.rnnGetPreviousState:1827)."""
+        ComputationGraph.rnnGetPreviousState:1873)."""
         return self._rnn_state
 
     def rnn_set_previous_state(self, state) -> None:
-        """Install streaming state (reference rnnSetPreviousState:1850)."""
+        """Install streaming state (reference rnnSetPreviousState:1912)."""
         self._rnn_state = (jax.tree_util.tree_map(jnp.asarray, state)
                            if state is not None else None)
 
@@ -840,7 +840,7 @@ class ComputationGraph(LazyScore):
     def clone(self) -> "ComputationGraph":
         """Deep copy with REAL buffer copies (see MultiLayerNetwork.clone:
         the fused fit path donates param buffers to XLA, so clones must not
-        alias arrays). Reference ComputationGraph.clone:1663."""
+        alias arrays). Reference ComputationGraph.clone:1249."""
         import copy
 
         net = ComputationGraph(copy.deepcopy(self.conf))

@@ -425,7 +425,7 @@ class MultiLayerNetwork(LazyScore):
         return loss + _regularization(self.conf, params_list)
 
     def score_examples(self, x, y=None, add_regularization: bool = False):
-        """Per-example loss scores, un-reduced (reference scoreExamples:1755)
+        """Per-example loss scores, un-reduced (reference scoreExamples:1742/1759)
         — the anomaly-detection / example-weighting API. ``x`` may be a
         DataSet, whose labels mask weights each example's own loss (padded
         timesteps don't count, as in fit()). With ``add_regularization`` the
@@ -463,7 +463,7 @@ class MultiLayerNetwork(LazyScore):
         return jax.vmap(one)(h, y)
 
     def f1_score(self, x, y=None) -> float:
-        """F1 on a dataset or (x, y) arrays (reference f1Score:2292)."""
+        """F1 on a dataset or (x, y) arrays (reference f1Score:931/1683)."""
         from deeplearning4j_tpu.datasets.dataset import DataSet
 
         if y is None and isinstance(x, DataSet):
@@ -789,13 +789,13 @@ class MultiLayerNetwork(LazyScore):
         return out
 
     def rnn_get_previous_state(self):
-        """Per-layer streaming LSTM state (reference rnnGetPreviousState:2253);
+        """Per-layer streaming LSTM state (reference rnnGetPreviousState:2225);
         None until rnn_time_step has run."""
         return self._rnn_state
 
     def rnn_set_previous_state(self, state) -> None:
         """Install streaming state captured by rnn_get_previous_state
-        (reference rnnSetPreviousState:2269) — serving handoff/restore."""
+        (reference rnnSetPreviousState:2235) — serving handoff/restore."""
         self._rnn_state = (jax.tree_util.tree_map(jnp.asarray, state)
                            if state is not None else None)
 
