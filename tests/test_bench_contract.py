@@ -74,3 +74,27 @@ def test_outage_record_carries_last_healthy(tmp_path):
     assert got["ts"] == "t3"
     assert bench._last_healthy_from_log("--model word2vec",
                                         path=str(log)) is None
+
+
+def test_tile_sweep_isolates_failures_and_picks_best():
+    """The flash tile sweep runs unattended in the auto-capture window: a
+    failing config must record an error string (not kill the bench), the
+    best config is the fastest timed one, and the module tile globals are
+    restored afterwards."""
+    import bench
+    from deeplearning4j_tpu.ops import pallas_kernels as pk
+
+    calls = []
+
+    def fake_time_once():
+        calls.append((pk._BLK_Q, pk._BLK_K))
+        if pk._BLK_Q == 256 and pk._BLK_K == 128:
+            raise RuntimeError("VMEM OOM")
+        return 0.001 * pk._BLK_Q / pk._BLK_K  # fastest: 128x512
+
+    saved = pk._BLK_Q, pk._BLK_K
+    out = bench._sweep_tiles(fake_time_once, seq=2048)
+    assert (pk._BLK_Q, pk._BLK_K) == saved  # globals restored
+    assert out["best_tiles"] == "128x512"  # smallest bq/bk ratio timed
+    assert out["tile_sweep_ms"]["256x128"].startswith("error:")
+    assert len(calls) == 6  # every config visited despite the failure
