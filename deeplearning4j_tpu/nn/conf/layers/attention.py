@@ -24,6 +24,40 @@ from deeplearning4j_tpu.nn.conf.serde import register_config
 Array = jax.Array
 
 
+def attend(q: Array, k: Array, v: Array, causal: bool, mask=None) -> Array:
+    """The ONE attention-core dispatch every attention-bearing layer uses.
+
+    Single device (no active ParallelContext): flash_attention (Pallas on
+    TPU) or masked_attention. Under a trainer-published sequence-parallel
+    context (parallel/context.py) the same math runs distributed over the
+    mesh's sequence axis — Ulysses all_to_all by default, ring ppermute on
+    request — so a plain ``transformer_lm`` config becomes long-context
+    sequence-parallel through fit() alone, the way reference
+    ParallelWrapper.java:44 wraps any net without touching model code.
+    Masked (variable-length) batches fall back to the dense masked kernel:
+    correctness over parallelism, mirroring ParallelWrapper's own fallback
+    for semantics its sharded step doesn't cover.
+    """
+    from deeplearning4j_tpu.ops.pallas_kernels import (
+        flash_attention, masked_attention,
+    )
+    from deeplearning4j_tpu.parallel import context as pctx
+
+    ctx = pctx.current()
+    if ctx is not None and ctx.seq_axis is not None and mask is None:
+        from deeplearning4j_tpu.parallel.ring_attention import (
+            ring_attention_sharded, ulysses_attention_sharded)
+        if ctx.seq_mode == "ring":
+            return ring_attention_sharded(q, k, v, ctx.mesh, ctx.seq_axis,
+                                          causal, batch_axis=ctx.data_axis)
+        return ulysses_attention_sharded(q, k, v, ctx.mesh, ctx.seq_axis,
+                                         causal, ctx.interpret,
+                                         batch_axis=ctx.data_axis)
+    if mask is not None:
+        return masked_attention(q, k, v, mask, causal)
+    return flash_attention(q, k, v, causal)
+
+
 @register_config("SelfAttention")
 @dataclasses.dataclass
 class SelfAttentionLayer(FeedForwardLayer):
@@ -58,10 +92,6 @@ class SelfAttentionLayer(FeedForwardLayer):
         return InputType.recurrent(self.n_out, itype.timesteps)
 
     def apply(self, params, state, x, *, train=False, rng=None, mask=None):
-        from deeplearning4j_tpu.ops.pallas_kernels import (
-            flash_attention, masked_attention,
-        )
-
         pol = get_policy()
         x = self.apply_dropout(x, rng, train)
         B, T, _ = x.shape
@@ -73,10 +103,7 @@ class SelfAttentionLayer(FeedForwardLayer):
         q = q.reshape(B, T, H, D)
         k = k.reshape(B, T, H, D)
         v = v.reshape(B, T, H, D)
-        if mask is not None:
-            o = masked_attention(q, k, v, mask, self.causal)
-        else:
-            o = flash_attention(q, k, v, self.causal)
+        o = attend(q, k, v, self.causal, mask)
         o = o.reshape(B, T, self.n_out)
         out = jnp.matmul(o.astype(pol.compute_dtype),
                          params["Wo"].astype(pol.compute_dtype))
@@ -140,10 +167,6 @@ class TransformerBlock(FeedForwardLayer):
         return xhat * g.astype(x.dtype) + b.astype(x.dtype)
 
     def apply(self, params, state, x, *, train=False, rng=None, mask=None):
-        from deeplearning4j_tpu.ops.pallas_kernels import (
-            flash_attention, masked_attention,
-        )
-
         pol = get_policy()
         B, T, F = x.shape
         H = self.n_heads
@@ -155,12 +178,10 @@ class TransformerBlock(FeedForwardLayer):
         q = q.reshape(B, T, H, D)
         k = k.reshape(B, T, H, D)
         v = v.reshape(B, T, H, D)
-        if mask is not None:
-            # padded keys must not absorb softmax mass (LN/MLP are per-token
-            # on the last axis, so attention is the only cross-token leak)
-            o = masked_attention(q, k, v, mask, self.causal)
-        else:
-            o = flash_attention(q, k, v, self.causal)
+        # padded keys must not absorb softmax mass (LN/MLP are per-token on
+        # the last axis, so attention is the only cross-token leak); attend
+        # also dispatches sequence-parallel under an active ParallelContext
+        o = attend(q, k, v, self.causal, mask)
         o = o.reshape(B, T, F)
         att = jnp.matmul(o.astype(pol.compute_dtype),
                          params["Wo"].astype(pol.compute_dtype))

@@ -115,8 +115,27 @@ class MoELayer(FeedForwardLayer):
         y = jnp.einsum("se,esf->sf", sel, y_all) * gate[:, None].astype(y_all.dtype)
         return y, aux
 
+    def _ep_context(self):
+        """Active expert-parallel context, if a trainer published one while
+        tracing (parallel/context.py). None -> dense single-device path."""
+        from deeplearning4j_tpu.parallel import context as pctx
+        ctx = pctx.current()
+        if ctx is not None and ctx.expert_axis is not None \
+                and self.n_experts % ctx.mesh.shape[ctx.expert_axis] == 0:
+            return ctx
+        return None
+
     def apply(self, params, state, x, *, train=False, rng=None, mask=None):
         shape = x.shape
+        ctx = self._ep_context()
+        if ctx is not None:
+            from deeplearning4j_tpu.parallel.moe import expert_parallel_ffn
+            y, aux = expert_parallel_ffn(self, params, x, ctx.mesh,
+                                         ctx.expert_axis,
+                                         ctx.capacity_factor,
+                                         train=train, rng=rng)
+            new_state = {"aux_loss": aux if train else jnp.zeros_like(aux)}
+            return self.act_fn()(y.reshape(shape)), new_state
         x2d = x.reshape(-1, shape[-1])
         y, aux = self.moe_ffn_2d(params, x2d, train=train, rng=rng)
         # aux keeps its natural dtype (f32 in training, f64 under the
@@ -182,9 +201,8 @@ class MoETransformerBlock(MoELayer):
         return InputType.recurrent(self.n_out, itype.timesteps)
 
     def apply(self, params, state, x, *, train=False, rng=None, mask=None):
-        from deeplearning4j_tpu.nn.conf.layers.attention import TransformerBlock
-        from deeplearning4j_tpu.ops.pallas_kernels import (
-            flash_attention, masked_attention)
+        from deeplearning4j_tpu.nn.conf.layers.attention import (
+            TransformerBlock, attend)
 
         pol = get_policy()
         B, T, F = x.shape
@@ -195,18 +213,24 @@ class MoETransformerBlock(MoELayer):
                          params["Wqkv"].astype(pol.compute_dtype))
         q, k, v = jnp.split(qkv.astype(pol.output_dtype), 3, axis=-1)
         q, k, v = (a.reshape(B, T, H, D) for a in (q, k, v))
-        if mask is not None:
-            o = masked_attention(q, k, v, mask, self.causal)
-        else:
-            o = flash_attention(q, k, v, self.causal)
+        o = attend(q, k, v, self.causal, mask)
         att = jnp.matmul(o.reshape(B, T, F).astype(pol.compute_dtype),
                          params["Wo"].astype(pol.compute_dtype))
         x = x + att.astype(pol.output_dtype) + params["bo"].astype(pol.output_dtype)
 
         h = TransformerBlock._ln(x, params["ln2_g"], params["ln2_b"])
-        y2d, aux = self.moe_ffn_2d(params, h.reshape(-1, F), train=train,
-                                   rng=rng)
+        ctx = self._ep_context()
+        if ctx is not None:
+            from deeplearning4j_tpu.parallel.moe import expert_parallel_ffn
+            y, aux = expert_parallel_ffn(self, params, h, ctx.mesh,
+                                         ctx.expert_axis,
+                                         ctx.capacity_factor,
+                                         train=train, rng=rng)
+        else:
+            y2d, aux = self.moe_ffn_2d(params, h.reshape(-1, F), train=train,
+                                       rng=rng)
+            y = y2d.reshape(B, T, F)
         new_state = {"aux_loss": aux if train else jnp.zeros_like(aux)}
         # honor a user-configured activation on the block output (default is
         # identity — the standard residual-stream semantics)
-        return self.act_fn()(x + y2d.reshape(B, T, F)), new_state
+        return self.act_fn()(x + y.reshape(B, T, F)), new_state

@@ -143,16 +143,23 @@ def _aux_losses(layers, new_states):
     return total
 
 
-def make_train_step(conf: MultiLayerConfiguration):
+def make_train_step(conf: MultiLayerConfiguration, loss=None):
     """Build the fused train step: grads via autodiff, per-layer normalization + updater.
     Pure: (params, states, upd_states, x, y, rng, iteration, fmask, lmask) ->
-    (params', states', upd_states', loss)."""
+    (params', states', upd_states', loss).
+
+    ``loss`` optionally replaces the standard ``loss_fn`` with a callable of
+    the same signature (params_list, state_list, x, y, rng, fmask, lmask) ->
+    (loss, new_state_list) — e.g. PipelineTrainer's pipelined forward — while
+    keeping the updater/clipping/schedule semantics identical."""
     g = conf.global_conf
+    if loss is None:
+        loss = functools.partial(loss_fn, conf)
 
     def train_step(params_list, state_list, upd_state, x, y, rng, iteration,
                    fmask=None, lmask=None):
-        (loss, new_states), grads = jax.value_and_grad(
-            lambda p: loss_fn(conf, p, state_list, x, y, rng, fmask, lmask),
+        (loss_val, new_states), grads = jax.value_and_grad(
+            lambda p: loss(p, state_list, x, y, rng, fmask, lmask),
             has_aux=True)(params_list)
 
         new_params = []
@@ -182,7 +189,7 @@ def make_train_step(conf: MultiLayerConfiguration):
                 u_new[name] = ustate
             new_params.append(p_new)
             new_upd.append(u_new)
-        return new_params, new_states, new_upd, loss
+        return new_params, new_states, new_upd, loss_val
 
     # a config-declared dtype policy is baked in at trace time (GlobalConf.dtype)
     return common.wrap_with_policy(train_step, g.dtype)

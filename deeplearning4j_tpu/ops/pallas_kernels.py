@@ -37,7 +37,7 @@ _NEG = -1e30
 # Flash-attention tile sizes: MXU/VMEM-friendly defaults, overridable for
 # on-chip sweeps (DL4J_FLASH_BLK_Q / DL4J_FLASH_BLK_K).
 _BLK_Q = int(os.environ.get("DL4J_FLASH_BLK_Q", "128"))
-_BLK_K = int(os.environ.get("DL4J_FLASH_BLK_K", "128"))
+_BLK_K = int(os.environ.get("DL4J_FLASH_BLK_K", "512"))
 
 
 def _causal_mask(s, q0, k0):
@@ -111,7 +111,7 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, *rest, blk_k: int, causal: bool,
         v_blk = v_ref[0, pl.ds(j * blk_k, blk_k), :].astype(jnp.float32)
         s = q @ k_blk.T                                   # (blk_q, blk_k)
         if has_mask:
-            km_blk = km_ref[0, pl.ds(j * blk_k, blk_k)].astype(jnp.float32)
+            km_blk = km_ref[0, pl.ds(j * blk_k, blk_k), 0].astype(jnp.float32)
             s = jnp.where(km_blk[None, :] > 0, s, _NEG)
         if causal:
             s = _causal_mask(s, qi * blk_q, j * blk_k)
@@ -127,14 +127,20 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, *rest, blk_k: int, causal: bool,
     m, l, acc = jax.lax.fori_loop(0, n_k, body, (m, l, acc))
     l_safe = jnp.maximum(l, 1e-20)
     o_ref[0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
-    lse_ref[0] = m + jnp.log(l_safe)
+    lse_ref[0, :, 0] = m + jnp.log(l_safe)
 
 
 def _bh_mask(key_mask: Array, H: int) -> Array:
-    """[B, Tk] {0,1} key mask -> (B*H, Tk) f32 kernel operand."""
+    """[B, Tk] {0,1} key mask -> (B*H, Tk, 1) f32 kernel operand.
+
+    The trailing singleton is Mosaic block-layout armor shared by every
+    per-row vector the flash kernels touch (mask, lse, delta): a (1, blk)
+    block on a (B*H, X) array has sublane size 1, which the TPU lowering
+    rejects unless it equals the array dim; as (B*H, X, 1) the block
+    (1, blk, 1) is legal — blk is 8-divisible and the lane dim matches."""
     B, Tk = key_mask.shape
     return jnp.broadcast_to(key_mask.astype(jnp.float32)[:, None, :],
-                            (B, H, Tk)).reshape(B * H, Tk)
+                            (B, H, Tk)).reshape(B * H, Tk, 1)
 
 
 def _flash_forward(q: Array, k: Array, v: Array, causal: bool,
@@ -166,7 +172,7 @@ def _flash_forward(q: Array, k: Array, v: Array, causal: bool,
     ]
     operands = [qr, kr, vr]
     if has_mask:
-        in_specs.append(pl.BlockSpec((1, Tk), lambda bh, i: (bh, 0)))
+        in_specs.append(pl.BlockSpec((1, Tk, 1), lambda bh, i: (bh, 0, 0)))
         operands.append(_bh_mask(key_mask, H))
     out, lse = pl.pallas_call(
         kernel,
@@ -174,15 +180,16 @@ def _flash_forward(q: Array, k: Array, v: Array, causal: bool,
         in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, blk_q, D), lambda bh, i: (bh, i, 0)),
-            pl.BlockSpec((1, blk_q), lambda bh, i: (bh, i)),
+            # trailing singleton: see _bh_mask on Mosaic block-layout rules
+            pl.BlockSpec((1, blk_q, 1), lambda bh, i: (bh, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((B * H, Tq, D), q.dtype),
-            jax.ShapeDtypeStruct((B * H, Tq), jnp.float32),
+            jax.ShapeDtypeStruct((B * H, Tq, 1), jnp.float32),
         ],
         interpret=interpret,
     )(*operands)
-    return _unflatten_heads(out, B, H), lse
+    return _unflatten_heads(out, B, H), lse[:, :, 0]
 
 
 def _attention_xla(q, k, v, causal):
@@ -306,8 +313,8 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     qi = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32)              # (blk_q, D)
     do = do_ref[0].astype(jnp.float32)
-    lse = lse_ref[0].astype(jnp.float32)          # (blk_q,)
-    delta = delta_ref[0].astype(jnp.float32)      # (blk_q,)
+    lse = lse_ref[0, :, 0].astype(jnp.float32)    # (blk_q,)
+    delta = delta_ref[0, :, 0].astype(jnp.float32)  # (blk_q,)
     dq = jnp.zeros_like(q)
     n_k = seq_k // blk_k
 
@@ -316,7 +323,7 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         v_blk = v_ref[0, pl.ds(j * blk_k, blk_k), :].astype(jnp.float32)
         s = (q @ k_blk.T) * scale
         if has_mask:
-            km_blk = km_ref[0, pl.ds(j * blk_k, blk_k)].astype(jnp.float32)
+            km_blk = km_ref[0, pl.ds(j * blk_k, blk_k), 0].astype(jnp.float32)
             s = jnp.where(km_blk[None, :] > 0, s, _NEG)
         if causal:
             s = _causal_mask(s, qi * blk_q, j * blk_k)
@@ -344,7 +351,8 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     ki = pl.program_id(1)
     k_blk = k_ref[0].astype(jnp.float32)          # (blk_k, D)
     v_blk = v_ref[0].astype(jnp.float32)
-    km_blk = km_ref[0].astype(jnp.float32) if has_mask else None  # (blk_k,)
+    km_blk = (km_ref[0, :, 0].astype(jnp.float32)
+              if has_mask else None)              # (blk_k,)
     dk = jnp.zeros_like(k_blk)
     dv = jnp.zeros_like(v_blk)
     n_q = seq_q // blk_q
@@ -353,8 +361,8 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk, dv = carry
         q_blk = q_ref[0, pl.ds(i * blk_q, blk_q), :].astype(jnp.float32)
         do_blk = do_ref[0, pl.ds(i * blk_q, blk_q), :].astype(jnp.float32)
-        lse_blk = lse_ref[0, pl.ds(i * blk_q, blk_q)].astype(jnp.float32)
-        delta_blk = delta_ref[0, pl.ds(i * blk_q, blk_q)].astype(jnp.float32)
+        lse_blk = lse_ref[0, pl.ds(i * blk_q, blk_q), 0].astype(jnp.float32)
+        delta_blk = delta_ref[0, pl.ds(i * blk_q, blk_q), 0].astype(jnp.float32)
         s = (q_blk @ k_blk.T) * scale             # (blk_q, blk_k)
         if has_mask:
             s = jnp.where(km_blk[None, :] > 0, s, _NEG)
@@ -389,8 +397,11 @@ def _flash_backward(q, k, v, out, lse, g, causal, blk_q: int = None,
     scale = 1.0 / (D ** 0.5)
     qr, kr, vr = _flatten_heads(q), _flatten_heads(k), _flatten_heads(v)
     gr, outr = _flatten_heads(g), _flatten_heads(out)
-    # delta = rowsum(dO ∘ O): one cheap fused elementwise+reduce in XLA
-    delta = jnp.sum(gr.astype(jnp.float32) * outr.astype(jnp.float32), axis=-1)
+    # delta = rowsum(dO ∘ O): one cheap fused elementwise+reduce in XLA;
+    # lse/delta carry a trailing singleton for the kernels (see _bh_mask)
+    delta = jnp.sum(gr.astype(jnp.float32) * outr.astype(jnp.float32),
+                    axis=-1, keepdims=True)
+    lse3 = lse[:, :, None]
     has_mask = key_mask is not None
     km = _bh_mask(key_mask, H) if has_mask else None
 
@@ -402,12 +413,12 @@ def _flash_backward(q, k, v, out, lse, g, causal, blk_q: int = None,
         pl.BlockSpec((1, Tk, D), lambda bh, i: (bh, 0, 0)),
         pl.BlockSpec((1, Tk, D), lambda bh, i: (bh, 0, 0)),
         pl.BlockSpec((1, blk_q, D), lambda bh, i: (bh, i, 0)),
-        pl.BlockSpec((1, blk_q), lambda bh, i: (bh, i)),
-        pl.BlockSpec((1, blk_q), lambda bh, i: (bh, i)),
+        pl.BlockSpec((1, blk_q, 1), lambda bh, i: (bh, i, 0)),
+        pl.BlockSpec((1, blk_q, 1), lambda bh, i: (bh, i, 0)),
     ]
-    dq_operands = [qr, kr, vr, gr, lse, delta]
+    dq_operands = [qr, kr, vr, gr, lse3, delta]
     if has_mask:
-        dq_specs.append(pl.BlockSpec((1, Tk), lambda bh, i: (bh, 0)))
+        dq_specs.append(pl.BlockSpec((1, Tk, 1), lambda bh, i: (bh, 0, 0)))
         dq_operands.append(km)
     dq = pl.pallas_call(
         dq_kernel,
@@ -426,12 +437,12 @@ def _flash_backward(q, k, v, out, lse, g, causal, blk_q: int = None,
         pl.BlockSpec((1, blk_k, D), lambda bh, j: (bh, j, 0)),
         pl.BlockSpec((1, blk_k, D), lambda bh, j: (bh, j, 0)),
         pl.BlockSpec((1, Tq, D), lambda bh, j: (bh, 0, 0)),
-        pl.BlockSpec((1, Tq), lambda bh, j: (bh, 0)),
-        pl.BlockSpec((1, Tq), lambda bh, j: (bh, 0)),
+        pl.BlockSpec((1, Tq, 1), lambda bh, j: (bh, 0, 0)),
+        pl.BlockSpec((1, Tq, 1), lambda bh, j: (bh, 0, 0)),
     ]
-    dkv_operands = [qr, kr, vr, gr, lse, delta]
+    dkv_operands = [qr, kr, vr, gr, lse3, delta]
     if has_mask:
-        dkv_specs.append(pl.BlockSpec((1, blk_k), lambda bh, j: (bh, j)))
+        dkv_specs.append(pl.BlockSpec((1, blk_k, 1), lambda bh, j: (bh, j, 0)))
         dkv_operands.append(km)
     dk, dv = pl.pallas_call(
         dkv_kernel,

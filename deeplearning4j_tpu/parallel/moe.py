@@ -23,10 +23,17 @@ from jax import shard_map
 Array = jax.Array
 
 
-def _moe_local(router_params, expert_params, x, *, layer, axis_name: str,
-               capacity: int):
+def _moe_local(router_params, expert_params, x, rng, *, layer,
+               axis_name: str, capacity: int, train: bool):
     """Per-shard body. x: [Bl, T, F] local tokens; expert_params hold this
-    shard's experts on the leading axis [E_local, ...]."""
+    shard's experts on the leading axis [E_local, ...]. Returns (y, aux)
+    where aux is the GLOBAL Switch load-balance term E * sum_e f_e * P_e
+    (token fractions / router probs pmean-ed over the shards — every shard
+    holds the same token count, so the pmean of local means is the global
+    mean), matching MoELayer._balance_term on the full batch. ``rng``
+    (replicated) is folded per shard so router_noise jitters each shard's
+    routing independently at train time, like the dense path's jitter —
+    same distribution, different draws than single-device."""
     N = lax.psum(1, axis_name)
     E_local = expert_params["W1"].shape[0]
     E = N * E_local
@@ -34,7 +41,14 @@ def _moe_local(router_params, expert_params, x, *, layer, axis_name: str,
     S = Bl * T
     x2d = x.reshape(S, F)
 
-    eidx, gate, _ = layer.route(router_params, x2d)
+    rng_local = (jax.random.fold_in(rng, lax.axis_index(axis_name))
+                 if rng is not None else None)
+    eidx, gate, probs = layer.route(router_params, x2d, train=train,
+                                    rng=rng_local)
+    frac = lax.pmean(jnp.mean(jax.nn.one_hot(eidx, E, dtype=jnp.float32),
+                              axis=0), axis_name)
+    p_mean = lax.pmean(jnp.mean(probs.astype(jnp.float32), axis=0), axis_name)
+    aux = E * jnp.sum(frac * p_mean)
     # routing/position arithmetic is exact int32/float32 bookkeeping: under
     # the full-bf16 activation policy x2d.dtype can only count to 256 before
     # cumsum slots collide and tokens silently overwrite each other
@@ -62,7 +76,46 @@ def _moe_local(router_params, expert_params, x, *, layer, axis_name: str,
     # combine: gather each token's result from its (expert, slot) and gate it
     # (gate cast so the f32 router bookkeeping can't promote the activations)
     y = jnp.einsum("sec,ecf->sf", pos_oh, out) * gate[:, None].astype(out.dtype)
-    return y.astype(x2d.dtype).reshape(Bl, T, F)
+    return y.astype(x2d.dtype).reshape(Bl, T, F), aux
+
+
+def expert_parallel_ffn(layer, params: dict, x: Array, mesh: Mesh,
+                        axis_name: str, capacity_factor: float = 2.0,
+                        train: bool = False, rng=None):
+    """Trace-safe GShard dispatch: the in-jit target MoELayer.apply uses when
+    an active ParallelContext declares an expert axis (parallel/context.py).
+
+    x: [B, T, F] (or [S, F], treated as T=1) with B divisible by the axis
+    size. Returns (y, aux) — y WITHOUT the layer's output activation (callers
+    apply it exactly where their dense path does), aux the global Switch
+    load-balance term. Under jit, GSPMD reshards operands to the shard_map
+    in_specs, so this composes with the data-parallel wrapper step where the
+    data axis doubles as the expert axis (the standard EP layout).
+    """
+    n = mesh.shape[axis_name]
+    squeeze = x.ndim == 2
+    if squeeze:
+        x = x[:, None, :]
+    B, T, F = x.shape
+    if B % n:
+        raise ValueError(f"batch {B} not divisible by expert axis size {n}")
+    capacity = max(1, int(capacity_factor * (B // n) * T / layer.n_experts))
+    router = {"Wg": params["Wg"]}
+    experts = {k: params[k] for k in ("W1", "b1", "W2", "b2")}
+    has_rng = rng is not None
+    fn = shard_map(
+        functools.partial(_moe_local, layer=layer, axis_name=axis_name,
+                          capacity=capacity, train=train,
+                          **({} if has_rng else {"rng": None})),
+        mesh=mesh,
+        in_specs=(({"Wg": P()}, {k: P(axis_name) for k in experts},
+                   P(axis_name)) + ((P(),) if has_rng else ())),
+        out_specs=(P(axis_name), P()),
+    )
+    y, aux = fn(router, experts, x, *((rng,) if has_rng else ()))
+    if squeeze:
+        y = y[:, 0, :]
+    return y, aux
 
 
 class ExpertParallelMoE:
@@ -81,29 +134,15 @@ class ExpertParallelMoE:
 
     def __call__(self, params: dict, x: Array) -> Array:
         """x: [B, T, F] with B divisible by the axis size. Returns [B, T, F]."""
-        n = self.mesh.shape[self.axis_name]
-        B, T, F = x.shape
-        if B % n:
-            raise ValueError(f"batch {B} not divisible by axis size {n}")
-        tokens_per_shard = (B // n) * T
-        capacity = max(1, int(self.capacity_factor * tokens_per_shard
-                              / self.layer.n_experts))
-        router = {"Wg": params["Wg"]}
-        experts = {k: params[k] for k in ("W1", "b1", "W2", "b2")}
-        fn = shard_map(
-            functools.partial(_moe_local, layer=self.layer,
-                              axis_name=self.axis_name, capacity=capacity),
-            mesh=self.mesh,
-            in_specs=({"Wg": P()},
-                      {k: P(self.axis_name) for k in experts},
-                      P(self.axis_name)),
-            out_specs=P(self.axis_name),
-        )
-        router = jax.device_put(router,
+        router = jax.device_put({"Wg": params["Wg"]},
                                 {"Wg": NamedSharding(self.mesh, P())})
         experts = jax.device_put(
-            experts, {k: NamedSharding(self.mesh, P(self.axis_name))
-                      for k in experts})
+            {k: params[k] for k in ("W1", "b1", "W2", "b2")},
+            {k: NamedSharding(self.mesh, P(self.axis_name))
+             for k in ("W1", "b1", "W2", "b2")})
         x = jax.device_put(x, NamedSharding(self.mesh, P(self.axis_name)))
+        y, _ = expert_parallel_ffn(self.layer, {**router, **experts}, x,
+                                   self.mesh, self.axis_name,
+                                   self.capacity_factor)
         # same epilogue as the dense MoELayer.apply (activation after combine)
-        return self.layer.act_fn()(fn(router, experts, x))
+        return self.layer.act_fn()(y)

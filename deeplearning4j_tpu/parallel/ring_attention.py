@@ -68,7 +68,8 @@ def _online_block(q, k, v, m_prev, l_prev, o_prev, q_off, kv_off, causal):
     return m_new, l_new, o_new
 
 
-def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool):
+def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool,
+                          batch_axis=None):
     """Per-shard body: rotate K/V around the ring, accumulate online softmax."""
     n_dev = lax.psum(1, axis_name)
     my_idx = lax.axis_index(axis_name)
@@ -77,8 +78,10 @@ def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool):
     q_off = my_idx * Tq
 
     # accumulators are device-varying (they depend on this shard's q) — mark
-    # them so the fori_loop carry types line up under shard_map
-    vary = lambda x: lax.pcast(x, (axis_name,), to="varying")
+    # them so the fori_loop carry types line up under shard_map (over the
+    # batch axis too when the leading dim is data-sharded)
+    axes = (axis_name,) + ((batch_axis,) if batch_axis else ())
+    vary = lambda x: lax.pcast(x, axes, to="varying")
     m = vary(jnp.full((B, H, Tq), _NEG, q.dtype))
     l = vary(jnp.zeros((B, H, Tq), q.dtype))
     o = jnp.zeros_like(q)
@@ -101,6 +104,23 @@ def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool):
     return o / jnp.maximum(l_t, 1e-20)
 
 
+def ring_attention_sharded(q: Array, k: Array, v: Array, mesh: Mesh,
+                           axis_name: str = "sp", causal: bool = False,
+                           batch_axis: str = None) -> Array:
+    """Trace-safe ring attention: callable from inside a jitted train step
+    (no device_put — under jit, GSPMD reshards operands to the shard_map's
+    in_specs). This is what attention layers dispatch when an active
+    ParallelContext declares ``seq_mode="ring"`` (parallel/context.py).
+    ``batch_axis`` shards the leading (batch) dim too, so composing with
+    data parallelism never replicates attention work across DP replicas."""
+    spec = P(batch_axis, axis_name)
+    fn = shard_map(
+        functools.partial(_ring_attention_local, axis_name=axis_name,
+                          causal=causal, batch_axis=batch_axis),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    return fn(q, k, v)
+
+
 def ring_attention(q: Array, k: Array, v: Array, mesh: Mesh,
                    axis_name: str = "sp", causal: bool = False) -> Array:
     """Exact context-parallel attention over the mesh's ``axis_name`` axis.
@@ -108,14 +128,9 @@ def ring_attention(q: Array, k: Array, v: Array, mesh: Mesh,
     Inputs are (B, T, H, D) with T sharded over ``axis_name`` (global arrays or
     host arrays; sharding is applied here). Returns output sharded the same way.
     """
-    spec = P(None, axis_name)
-    fn = shard_map(
-        functools.partial(_ring_attention_local, axis_name=axis_name,
-                          causal=causal),
-        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
-    sh = NamedSharding(mesh, spec)
+    sh = NamedSharding(mesh, P(None, axis_name))
     q, k, v = (jax.device_put(x, sh) for x in (q, k, v))
-    return fn(q, k, v)
+    return ring_attention_sharded(q, k, v, mesh, axis_name, causal)
 
 
 def _ulysses_local(q, k, v, *, axis_name: str, causal: bool,
@@ -135,15 +150,16 @@ def _ulysses_local(q, k, v, *, axis_name: str, causal: bool,
     return lax.all_to_all(og, axis_name, split_axis=1, concat_axis=2, tiled=True)
 
 
-def ulysses_attention(q: Array, k: Array, v: Array, mesh: Mesh,
-                      axis_name: str = "sp", causal: bool = False,
-                      interpret: bool = False) -> Array:
-    """Sequence-parallel attention via head-sharding all-to-all. Requires the
-    head count to be divisible by the axis size."""
+def ulysses_attention_sharded(q: Array, k: Array, v: Array, mesh: Mesh,
+                              axis_name: str = "sp", causal: bool = False,
+                              interpret: bool = False,
+                              batch_axis: str = None) -> Array:
+    """Trace-safe Ulysses attention (see ring_attention_sharded): the
+    in-jit dispatch target for sequence-parallel attention layers."""
     n = mesh.shape[axis_name]
     if q.shape[2] % n != 0:
         raise ValueError(f"num heads {q.shape[2]} not divisible by axis size {n}")
-    spec = P(None, axis_name)
+    spec = P(batch_axis, axis_name)
     # check_vma=False: pallas_call's out_shape carries no varying-mesh-axes
     # annotation, so the flash kernel inside the body can't satisfy the vma
     # checker; correctness is pinned by the =reference tests instead
@@ -152,6 +168,15 @@ def ulysses_attention(q: Array, k: Array, v: Array, mesh: Mesh,
                           interpret=interpret),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         check_vma=False)
-    sh = NamedSharding(mesh, spec)
-    q, k, v = (jax.device_put(x, sh) for x in (q, k, v))
     return fn(q, k, v)
+
+
+def ulysses_attention(q: Array, k: Array, v: Array, mesh: Mesh,
+                      axis_name: str = "sp", causal: bool = False,
+                      interpret: bool = False) -> Array:
+    """Sequence-parallel attention via head-sharding all-to-all. Requires the
+    head count to be divisible by the axis size."""
+    sh = NamedSharding(mesh, P(None, axis_name))
+    q, k, v = (jax.device_put(x, sh) for x in (q, k, v))
+    return ulysses_attention_sharded(q, k, v, mesh, axis_name, causal,
+                                     interpret)

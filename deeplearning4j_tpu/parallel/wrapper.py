@@ -48,6 +48,10 @@ class ParallelWrapperBuilder:
         self._average_updaters = True
         self._report_score = False
         self._mesh: Optional[Mesh] = None
+        self._seq_axis: Optional[str] = None
+        self._seq_mode = "ulysses"
+        self._expert_axis: Optional[str] = None
+        self._capacity_factor = 2.0
 
     def workers(self, n: int) -> "ParallelWrapperBuilder":
         self._workers = n
@@ -73,21 +77,56 @@ class ParallelWrapperBuilder:
         self._mesh = mesh
         return self
 
+    def sequence_parallel(self, axis: str = "sp",
+                          mode: str = "ulysses") -> "ParallelWrapperBuilder":
+        """Run the net's attention layers sequence-parallel over the mesh
+        axis ``axis`` (Ulysses all_to_all or "ring" ppermute) — long-context
+        training from a plain transformer config, no model changes."""
+        self._seq_axis = axis
+        self._seq_mode = mode
+        return self
+
+    def expert_parallel(self, axis: str = "data",
+                        capacity_factor: float = 2.0) -> "ParallelWrapperBuilder":
+        """Route the net's MoE layers through GShard all_to_all dispatch over
+        ``axis`` (default: the data axis doubles as the expert axis — the
+        standard EP layout)."""
+        self._expert_axis = axis
+        self._capacity_factor = capacity_factor
+        return self
+
     def build(self) -> "ParallelWrapper":
         return ParallelWrapper(self._model, workers=self._workers,
                                prefetch=self._prefetch,
                                averaging_frequency=self._avg_freq,
                                average_updaters=self._average_updaters,
-                               report_score=self._report_score, mesh=self._mesh)
+                               report_score=self._report_score, mesh=self._mesh,
+                               sequence_parallel_axis=self._seq_axis,
+                               sequence_parallel_mode=self._seq_mode,
+                               expert_parallel_axis=self._expert_axis,
+                               capacity_factor=self._capacity_factor)
 
 
 class ParallelWrapper:
     def __init__(self, model, workers: Optional[int] = None, prefetch: int = 2,
                  averaging_frequency: int = 1, average_updaters: bool = True,
-                 report_score: bool = False, mesh: Optional[Mesh] = None):
+                 report_score: bool = False, mesh: Optional[Mesh] = None,
+                 sequence_parallel_axis: Optional[str] = None,
+                 sequence_parallel_mode: str = "ulysses",
+                 expert_parallel_axis: Optional[str] = None,
+                 capacity_factor: float = 2.0):
         self.model = model
         self.mesh = mesh or data_parallel_mesh(workers)
         self.n_workers = self.mesh.shape["data"]
+        self.seq_axis = sequence_parallel_axis
+        self.seq_mode = sequence_parallel_mode
+        self.expert_axis = expert_parallel_axis
+        self.capacity_factor = capacity_factor
+        if (self.seq_axis or self.expert_axis) and averaging_frequency != 1:
+            # the local-SGD step is itself a shard_map over 'data'; nesting
+            # the SP/EP shard_maps inside it is not supported
+            raise ValueError("sequence/expert parallelism requires "
+                             "averaging_frequency == 1 (synchronous DP)")
         self.prefetch = prefetch
         self.averaging_frequency = averaging_frequency
         self.average_updaters = average_updaters
@@ -114,6 +153,27 @@ class ParallelWrapper:
     def builder(model) -> ParallelWrapperBuilder:
         return ParallelWrapperBuilder(model)
 
+    def _trace_ctx(self):
+        """Context the jitted step's Python body is traced under: publishes
+        the mesh + axis roles so attention/MoE layers dispatch their
+        sequence-/expert-parallel paths (parallel/context.py)."""
+        if self.seq_axis or self.expert_axis:
+            from deeplearning4j_tpu.parallel import context as pctx
+            return pctx.parallel_context(
+                self.mesh, seq_axis=self.seq_axis, seq_mode=self.seq_mode,
+                expert_axis=self.expert_axis,
+                capacity_factor=self.capacity_factor, data_axis="data")
+        import contextlib
+        return contextlib.nullcontext()
+
+    def _batch_spec(self, arr) -> P:
+        """Leading dim over 'data'; with sequence parallelism active, the
+        time axis of [B, T, ...] batches is additionally sharded over the
+        sequence axis so long sequences never materialize unsharded."""
+        if self.seq_axis and getattr(arr, "ndim", 0) >= 3:
+            return P("data", self.seq_axis)
+        return P("data")
+
     # ------------------------------------------------------------------ public API
     def fit(self, iterator, epochs: int = 1) -> None:
         """Reference fit(DataSetIterator):322. Batches are sharded over the mesh;
@@ -132,7 +192,6 @@ class ParallelWrapper:
         net = self.model
         mesh = self.mesh
         repl = NamedSharding(mesh, P())
-        batch_sh = NamedSharding(mesh, P("data"))
         if isinstance(net, MultiLayerNetwork):
             base = make_train_step(net.conf)
         else:
@@ -140,11 +199,14 @@ class ParallelWrapper:
             base = make_graph_train_step(net.conf)
 
         def step(params, states, upd, x, y, rng, it):
-            return base(params, states, upd, x, y, rng, it)
+            with self._trace_ctx():
+                return base(params, states, upd, x, y, rng, it)
 
+        # batch in_shardings are left to the staged arrays' committed
+        # shardings (_stage picks P('data') or P('data', seq_axis) per rank)
         return jax.jit(
             step,
-            in_shardings=(repl, repl, repl, batch_sh, batch_sh, repl, repl),
+            in_shardings=(repl, repl, repl, None, None, repl, repl),
             out_shardings=(repl, repl, repl, repl),
         )
 
@@ -159,16 +221,20 @@ class ParallelWrapper:
         net = self.model
         mesh = self.mesh
         repl = NamedSharding(mesh, P())
-        stack_sh = NamedSharding(mesh, P(None, "data"))
         if isinstance(net, MultiLayerNetwork):
             base = make_multistep_train_step(net.conf)
         else:
             from deeplearning4j_tpu.nn.graph_network import (
                 make_graph_multistep_train_step)
             base = make_graph_multistep_train_step(net.conf)
+
+        def multi(params, states, upd, xs, ys, rng, it0):
+            with self._trace_ctx():
+                return base(params, states, upd, xs, ys, rng, it0)
+
         return jax.jit(
-            base,
-            in_shardings=(repl, repl, repl, stack_sh, stack_sh, repl, repl),
+            multi,
+            in_shardings=(repl, repl, repl, None, None, repl, repl),
             out_shardings=(repl, repl, repl, repl),
         )
 
@@ -182,9 +248,9 @@ class ParallelWrapper:
         reference's Spark executors each taking their partition of the RDD
         (ParameterAveragingTrainingMaster.executeTraining:344)."""
         arr = np.asarray(arr)
-        if jax.process_count() == 1:
-            return jnp.asarray(arr)
         sharding = NamedSharding(self.mesh, spec)
+        if jax.process_count() == 1:
+            return jax.device_put(jnp.asarray(arr), sharding)
         return jax.make_array_from_callback(arr.shape, sharding,
                                             lambda idx: arr[idx])
 
@@ -232,11 +298,11 @@ class ParallelWrapper:
 
         def dispatch_one(x, y):
             if is_graph:
-                x = [self._stage(a, P("data")) for a in x]
-                y = [self._stage(a, P("data")) for a in y]
+                x = [self._stage(a, self._batch_spec(a)) for a in x]
+                y = [self._stage(a, self._batch_spec(a)) for a in y]
             else:
-                x = self._stage(x, P("data"))
-                y = self._stage(y, P("data"))
+                x = self._stage(x, self._batch_spec(x))
+                y = self._stage(y, self._batch_spec(y))
             (net.params_list, net.state_list, net.updater_state, loss) = \
                 self._sync_step(net.params_list, net.state_list,
                                 net.updater_state, x, y, net._next_rng(),
@@ -246,23 +312,26 @@ class ParallelWrapper:
             for listener in net.listeners:
                 listener.iteration_done(net, net.iteration)
 
+        def stack_spec(arr):
+            # stacked (K, B, ...) batches: batch spec shifted one axis right
+            return P(None, *self._batch_spec(arr[0]))
+
         def dispatch(batches):
             if len(batches) == 1:
                 dispatch_one(*batches[0])
                 return
-            stack_spec = P(None, "data")
             if is_graph:
-                xs = [self._stage(np.stack([b[0][i] for b in batches]),
-                                  stack_spec)
-                      for i in range(len(batches[0][0]))]
-                ys = [self._stage(np.stack([b[1][i] for b in batches]),
-                                  stack_spec)
-                      for i in range(len(batches[0][1]))]
+                xs = [self._stage(a, stack_spec(a))
+                      for a in (np.stack([b[0][i] for b in batches])
+                                for i in range(len(batches[0][0])))]
+                ys = [self._stage(a, stack_spec(a))
+                      for a in (np.stack([b[1][i] for b in batches])
+                                for i in range(len(batches[0][1])))]
             else:
-                xs = self._stage(np.stack([b[0] for b in batches]),
-                                 stack_spec)
-                ys = self._stage(np.stack([b[1] for b in batches]),
-                                 stack_spec)
+                xs = np.stack([b[0] for b in batches])
+                xs = self._stage(xs, stack_spec(xs))
+                ys = np.stack([b[1] for b in batches])
+                ys = self._stage(ys, stack_spec(ys))
             (net.params_list, net.state_list, net.updater_state, losses) = \
                 self._sync_multi(net.params_list, net.state_list,
                                  net.updater_state, xs, ys, net._next_rng(),
