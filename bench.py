@@ -227,7 +227,7 @@ def bench_moe(batch: int, iters: int, ksteps: int, warmup: int = 2) -> dict:
 
 
 def bench_word2vec(batch: int, iters: int, ksteps: int, warmup: int = 2,
-                   vocab: int = 10000, dim: int = 100,
+                   vocab: int = None, dim: int = 100,
                    negative: int = 5) -> dict:
     """SkipGram negative-sampling pair-kernel throughput (BASELINE config 4).
 
@@ -242,6 +242,10 @@ def bench_word2vec(batch: int, iters: int, ksteps: int, warmup: int = 2,
 
     from deeplearning4j_tpu.nlp import learning
 
+    # DL4J_W2V_VOCAB: sweep vocab from the capture harness (the dense/scatter
+    # crossover is vocab-dependent — dense rewrites the whole V x D table
+    # per chunk; see nlp/learning.DENSE_UPDATE_MAX_VOCAB)
+    vocab = vocab or int(os.environ.get("DL4J_W2V_VOCAB", "10000"))
     step = make_train_step(use_hs=False, negative=negative)
     # A/B twin: the opposite embedding-update path (dense one-hot matmul vs
     # XLA scatter) so one record carries both on-chip numbers
@@ -319,7 +323,7 @@ def bench_word2vec(batch: int, iters: int, ksteps: int, warmup: int = 2,
 
 
 def bench_attention(batch: int, iters: int, ksteps: int, warmup: int = 2,
-                    seq: int = 2048, heads: int = 8, dim: int = 64) -> dict:
+                    seq: int = None, heads: int = 8, dim: int = 64) -> dict:
     """flash_attention (Pallas) vs the identical XLA math, fwd+bwd, causal.
 
     Reports both paths' timings so one BASELINE.md line can say which path ran
@@ -331,6 +335,9 @@ def bench_attention(batch: int, iters: int, ksteps: int, warmup: int = 2,
 
     from deeplearning4j_tpu.ops import pallas_kernels as pk
 
+    # DL4J_ATTN_SEQ: sweep the sequence length from the capture harness (the
+    # pallas-vs-XLA crossover is seq-dependent; see FLASH_MIN_SEQ)
+    seq = seq or int(os.environ.get("DL4J_ATTN_SEQ", "2048"))
     kq, kk, kv = jax.random.split(jax.random.PRNGKey(0), 3)
     shape = (batch, seq, heads, dim)
     q = jax.random.normal(kq, shape, jnp.float32)

@@ -63,6 +63,151 @@ class LocalFileSystemProvider(StorageProvider):
                       for p in base.rglob("*") if p.is_file())
 
 
+class HttpStorageProvider(StorageProvider):
+    """Object-store backend over plain HTTP PUT/GET/list — bytes move
+    through a real socket, the role reference S3Uploader.java fills (S3's
+    REST surface is exactly this shape: PUT object, GET object, GET
+    ?prefix= listing). Point it at any S3-compatible/HTTP object endpoint;
+    ``serve_storage()`` below stands up a loopback server so the contract
+    is exercised end-to-end without egress (tests/test_cloud_streaming.py).
+    """
+
+    def __init__(self, base_url: str, token: Optional[str] = None,
+                 timeout: float = 30.0):
+        self.base_url = base_url.rstrip("/")
+        self.token = token
+        self.timeout = timeout
+
+    def _request(self, method: str, path: str, data: Optional[bytes] = None):
+        import urllib.request
+
+        req = urllib.request.Request(
+            f"{self.base_url}/{path.lstrip('/')}", data=data, method=method)
+        if self.token:
+            req.add_header("Authorization", f"Bearer {self.token}")
+        return urllib.request.urlopen(req, timeout=self.timeout)
+
+    def upload(self, local_path: str, remote_path: str) -> str:
+        import urllib.request
+
+        # stream from disk: urllib sends a file object chunk-wise when
+        # Content-Length is set, so memory stays O(buffer), not O(artifact)
+        size = Path(local_path).stat().st_size
+        with open(local_path, "rb") as f:
+            req = urllib.request.Request(
+                f"{self.base_url}/{remote_path.lstrip('/')}", data=f,
+                method="PUT", headers={"Content-Length": str(size)})
+            if self.token:
+                req.add_header("Authorization", f"Bearer {self.token}")
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                if resp.status not in (200, 201, 204):
+                    raise IOError(f"upload failed: HTTP {resp.status}")
+        return f"{self.base_url}/{remote_path.lstrip('/')}"
+
+    def download(self, remote_path: str, local_path: str) -> str:
+        Path(local_path).parent.mkdir(parents=True, exist_ok=True)
+        with self._request("GET", remote_path) as resp:
+            with open(local_path, "wb") as f:
+                shutil.copyfileobj(resp, f)
+        return local_path
+
+    def list(self, remote_prefix: str = "") -> List[str]:
+        import urllib.parse
+
+        q = urllib.parse.urlencode({"prefix": remote_prefix})
+        with self._request("GET", f"?{q}") as resp:
+            body = resp.read().decode("utf-8")
+        return [line for line in body.splitlines() if line]
+
+
+def serve_storage(root: str, host: str = "127.0.0.1", port: int = 0,
+                  token: Optional[str] = None):
+    """Loopback artifact server backing HttpStorageProvider: PUT stores,
+    GET serves, ``GET /?prefix=`` lists. Returns (server, base_url); run
+    ``server.serve_forever()`` on a thread and ``server.shutdown()`` when
+    done. Storage is a LocalFileSystemProvider root, so the path-escape
+    guard applies to remote names too."""
+    import urllib.parse
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    store = LocalFileSystemProvider(root)
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):  # tests stay quiet
+            pass
+
+        def _authed(self) -> bool:
+            if token is None:
+                return True
+            if self.headers.get("Authorization") == f"Bearer {token}":
+                return True
+            self.send_response(401)
+            self.end_headers()
+            return False
+
+        def do_PUT(self):
+            if not self._authed():
+                return
+            try:
+                dst = store._resolve(urllib.parse.unquote(self.path))
+            except ValueError:
+                self.send_response(400)
+                self.end_headers()
+                return
+            n = int(self.headers.get("Content-Length", "0"))
+            dst.parent.mkdir(parents=True, exist_ok=True)
+            # stream to disk in chunks (multi-GB checkpoints must not
+            # materialize in handler memory)
+            with open(dst, "wb") as f:
+                remaining = n
+                while remaining > 0:
+                    chunk = self.rfile.read(min(1 << 20, remaining))
+                    if not chunk:
+                        break
+                    f.write(chunk)
+                    remaining -= len(chunk)
+            self.send_response(201)
+            self.end_headers()
+
+        def do_GET(self):
+            if not self._authed():
+                return
+            parsed = urllib.parse.urlsplit(self.path)
+            if parsed.path in ("", "/"):
+                prefix = urllib.parse.parse_qs(parsed.query).get(
+                    "prefix", [""])[0]
+                try:
+                    names = store.list(prefix)
+                except ValueError:  # escaping prefix -> clean 400, like PUT
+                    self.send_response(400)
+                    self.end_headers()
+                    return
+                body = "\n".join(names).encode("utf-8")
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+                return
+            try:
+                src = store._resolve(urllib.parse.unquote(parsed.path))
+            except ValueError:
+                self.send_response(400)
+                self.end_headers()
+                return
+            if not src.is_file():
+                self.send_response(404)
+                self.end_headers()
+                return
+            self.send_response(200)
+            self.send_header("Content-Length", str(src.stat().st_size))
+            self.end_headers()
+            with open(src, "rb") as f:
+                shutil.copyfileobj(f, self.wfile)
+
+    server = ThreadingHTTPServer((host, port), Handler)
+    return server, f"http://{host}:{server.server_address[1]}"
+
+
 class S3Provider(StorageProvider):
     """Gated object-store backend (reference S3Uploader/S3Downloader). This
     image has no egress and no boto3; constructing raises with instructions

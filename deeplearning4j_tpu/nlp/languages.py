@@ -112,7 +112,10 @@ _GODAN_VERBS = """行く 書く 聞く 歩く 働く 着く 泳ぐ 急ぐ 話す
 
 _ICHIDAN_VERBS = """見る 食べる 寝る 起きる 出る 着る 開ける 閉める 教える
 覚える 忘れる 借りる 降りる できる 考える 伝える 見せる 入れる 続ける
-あげる くれる""".split()
+あげる くれる 調べる 始める 決める 感じる 信じる 受ける 与える 比べる
+別れる 生まれる 変える 迎える 助ける 育てる 捨てる 並べる 逃げる
+投げる 上げる 下げる 集める 認める 求める 進める 止める 辞める
+答える 数える 加える 抱える 超える 越える""".split()
 
 _I_ADJECTIVES = """高い 安い 新しい 古い 大きい 小さい 良い 悪い 早い 遅い
 長い 短い 暑い 寒い 楽しい 難しい 面白い 美しい 強い 弱い 近い 遠い 多い
@@ -121,9 +124,16 @@ _I_ADJECTIVES = """高い 安い 新しい 古い 大きい 小さい 良い 悪
 _CONJ_COST = 240  # between closed-class morphemes and bare-noun kanji runs
 
 
+#: surface -> POS for generated paradigm forms (merged into _JA_POS below)
+_PARADIGM_POS: dict = {}
+
+
 def _expand_verb_paradigms(lexicon: dict) -> None:
+    pos = "動詞"
+
     def add(form: str) -> None:
         lexicon.setdefault(form, _CONJ_COST)
+        _PARADIGM_POS.setdefault(form, pos)
 
     for verb in _GODAN_VERBS:
         stem, ending = verb[:-1], verb[-1]
@@ -142,6 +152,7 @@ def _expand_verb_paradigms(lexicon: dict) -> None:
                   stem + "ません", stem + "られる", stem + "よう",
                   stem + "れば", stem + "たい"):
             add(f)
+    pos = "形容詞"
     for adj in _I_ADJECTIVES:
         stem = adj[:-1]
         for f in (adj, stem + "く", stem + "くて", stem + "かった",
@@ -150,6 +161,35 @@ def _expand_verb_paradigms(lexicon: dict) -> None:
 
 
 _expand_verb_paradigms(_JA_LEXICON)
+
+# ---- POS table (kuromoji emits POS per token; coarse tag set here) --------
+_JA_POS = {}
+for _w in ("は が を に で と の へ も や か ね よ な から まで より ので "
+           "のに には では とは でも だけ など について").split():
+    _JA_POS[_w] = "助詞"
+for _w in ("です だ である でした ます ました ません れる られる せる "
+           "たい ない なかった").split():
+    _JA_POS[_w] = "助動詞"
+for _v in (_GODAN_VERBS + _ICHIDAN_VERBS
+           + ("する した して します いる いた いて ある あった なる "
+              "なった という").split()):
+    _JA_POS.setdefault(_v, "動詞")
+for _a in _I_ADJECTIVES:
+    _JA_POS.setdefault(_a, "形容詞")
+for _w, _p in _PARADIGM_POS.items():
+    _JA_POS.setdefault(_w, _p)
+
+# ---- open-class dictionary (nlp/ja_lexicon.py): the hand-built stand-in
+# for IPADIC's open-class coverage. Merged AFTER the closed-class tables so
+# function-word costs keep priority; adds ~1.1k nouns/verbal-nouns/
+# na-adjectives/proper nouns with POS tags, which is what lets compound
+# kanji runs split at real word boundaries (日本語勉強中 -> 日本語/勉強/中).
+from deeplearning4j_tpu.nlp.ja_lexicon import OPEN_CLASS as _JA_OPEN_CLASS
+
+for _w, (_cost, _pos) in _JA_OPEN_CLASS.items():
+    _JA_LEXICON.setdefault(_w, _cost)
+    _JA_POS.setdefault(_w, _pos)
+
 _JA_MAX_WORD = max(len(w) for w in _JA_LEXICON)
 _JA_EDGE_COST = 50          # connection penalty per lattice edge
 _JA_UNK_BASE = 700          # unknown-word base cost
@@ -210,12 +250,35 @@ def _ja_viterbi(chunk: str) -> List[str]:
     return out[::-1]
 
 
+def ja_pos(token: str) -> str:
+    """Coarse POS for a segmented token (kuromoji's per-token POS seam):
+    lexicon tag if known, else a char-class-derived unknown tag."""
+    pos = _JA_POS.get(token)
+    if pos is not None:
+        return pos
+    if not token:
+        return "記号"
+    cls = _ja_char_class(token[0])
+    return {"kanji": "名詞", "katakana": "名詞", "latin": "名詞",
+            "hiragana": "未知語", "other": "記号"}[cls]
+
+
+def ja_tokenize_with_pos(text: str) -> List[tuple]:
+    """(surface, pos) pairs — the kuromoji Token.getPartOfSpeech analog."""
+    out = []
+    for chunk in text.split():
+        out.extend((t, ja_pos(t)) for t in _ja_viterbi(chunk))
+    return out
+
+
 class JapaneseTokenizerFactory(TokenizerFactory):
     """Lattice-Viterbi segmentation for Japanese (kuromoji-seam equivalent;
-    reference deeplearning4j-nlp-japanese). Closed-class morphemes come from
-    the embedded lexicon; unknown words are maximal script runs with
-    per-class costs — e.g. 私は東京へ行きます ->
-    [私, は, 東京, へ, 行きます...] with particles split correctly."""
+    reference deeplearning4j-nlp-japanese). Closed-class morphemes and the
+    hand-built open-class dictionary (nlp/ja_lexicon.py, ~1.1k entries with
+    POS) come from the merged lexicon; unknown words are maximal script
+    runs with per-class costs — e.g. 私は東京へ行きます ->
+    [私, は, 東京, へ, 行きます] with particles split correctly. POS per
+    token via ``ja_tokenize_with_pos``/``ja_pos``."""
 
     def create(self, text: str) -> Tokenizer:
         tokens: List[str] = []

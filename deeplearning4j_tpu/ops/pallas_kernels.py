@@ -208,11 +208,23 @@ def _in_shard_map(x) -> bool:
     return bool(getattr(jax.typeof(x), "vma", None))
 
 
+#: shortest sequence the flash kernel engages at. Short sequences lose to
+#: plain XLA attention INSIDE a model: the custom call is a fusion barrier,
+#: so surrounding projections lose their elementwise epilogues — measured
+#: on-chip (v5e, r5): transformer T=256 runs 24% faster on the XLA path,
+#: while standalone attention at T>=1024 runs 1.2-2.7x faster on pallas
+#: (scripts/bench_log.jsonl seq sweep). Long context is what the kernel is
+#: for; XLA also O(T^2)-materializes scores, so >= this length pallas is
+#: both faster and the only memory-safe path.
+_MIN_SEQ = int(os.environ.get("DL4J_FLASH_MIN_SEQ", "1024"))
+
+
 def _pallas_ok(q, k, interpret: bool) -> bool:
     """ONE dispatch predicate for every flash/masked entry point AND its
     custom_vjp fwd rule — they must agree, or a forward under jax.grad would
     silently take a different code path than the plain forward."""
     return ((use_pallas() or interpret) and _tileable(q.shape[1], k.shape[1])
+            and (interpret or max(q.shape[1], k.shape[1]) >= _MIN_SEQ)
             and not _in_shard_map(q))
 
 
@@ -257,7 +269,7 @@ def _masked_attention_vjp(q, k, v, key_mask, causal, interpret):
 
 
 def _masked_fwd_rule(q, k, v, key_mask, causal, interpret):
-    if _pallas_ok(q, k, interpret) and _pallas_bwd_enabled():
+    if _pallas_ok(q, k, interpret) and _pallas_bwd_enabled(k.shape[1]):
         out, lse = _flash_forward(q, k, v, causal, interpret=interpret,
                                   key_mask=key_mask)
         return out, (q, k, v, key_mask, out, lse)
@@ -515,12 +527,23 @@ def _attention_bwd_chunked(q, k, v, g, causal, blk_q: int = None):
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
-def _pallas_bwd_enabled() -> bool:
-    return os.environ.get("DL4J_FLASH_PALLAS_BWD", "1") != "0"
+#: shortest sequence the tiled pallas BACKWARD engages at (the forward has
+#: its own _MIN_SEQ gate). Below this the chunked lax.scan backward wins:
+#: measured on-chip (v5e, r5, 512-wide K tiles) T=2048 runs 7% faster
+#: chunked while T=4096 runs 35% faster tiled — the dq+dkv kernel pair's
+#: fixed overhead amortizes only on long sequences.
+_PBWD_MIN_SEQ = int(os.environ.get("DL4J_FLASH_PBWD_MIN_SEQ", "4096"))
+
+
+def _pallas_bwd_enabled(seq_k: int = None) -> bool:
+    env = os.environ.get("DL4J_FLASH_PALLAS_BWD")
+    if env is not None:
+        return env != "0"
+    return seq_k is None or seq_k >= _PBWD_MIN_SEQ
 
 
 def _flash_fwd_rule(q, k, v, causal, interpret):
-    if _pallas_ok(q, k, interpret) and _pallas_bwd_enabled():
+    if _pallas_ok(q, k, interpret) and _pallas_bwd_enabled(k.shape[1]):
         out, lse = _flash_forward(q, k, v, causal, interpret=interpret)
         return out, (q, k, v, out, lse)
     return flash_attention(q, k, v, causal, interpret), (q, k, v, None, None)

@@ -85,10 +85,13 @@ class PipelineTrainer:
                                  "run are not supported")
         self.block_range = (i0, i1)
         self._block = block
+        block_fn = lambda p, x: block.apply(p, {}, x, train=True, rng=None)[0]
+        if conf.global_conf.gradient_checkpointing:
+            # same remat contract as multilayer.loss_fn: backward recomputes
+            # each block's forward instead of holding its activations
+            block_fn = jax.checkpoint(block_fn)
         self.pipe = PipelineParallel(
-            self.mesh,
-            lambda p, x: block.apply(p, {}, x, train=True, rng=None)[0],
-            n_blocks=i1 - i0, axis_name=axis_name,
+            self.mesh, block_fn, n_blocks=i1 - i0, axis_name=axis_name,
             n_microbatches=n_microbatches)
         self._step = None
 
@@ -104,27 +107,34 @@ class PipelineTrainer:
         layers = conf.layers
         i0, i1 = self.block_range
         last = layers[-1]
+        remat = conf.global_conf.gradient_checkpointing
         rngs = (jax.random.split(rng, len(layers))
                 if rng is not None else [None] * len(layers))
-        h = x
-        new_states = []
-        for i in range(i0):
+
+        def apply_one(i, h):
+            # same remat contract as multilayer.loss_fn for the layers
+            # outside the pipelined run
             pp = conf.preprocessor(i)
             if pp is not None:
                 h = pp.pre_process(h, fmask)
-            h, ns = layers[i].apply(params_list[i], state_list[i], h,
-                                    train=True, rng=rngs[i], mask=fmask)
+            if remat:
+                def f(p, hh, _l=layers[i], _s=state_list[i], _r=rngs[i]):
+                    return _l.apply(p, _s, hh, train=True, rng=_r, mask=fmask)
+                return jax.checkpoint(f)(params_list[i], h)
+            return layers[i].apply(params_list[i], state_list[i], h,
+                                   train=True, rng=rngs[i], mask=fmask)
+
+        h = x
+        new_states = []
+        for i in range(i0):
+            h, ns = apply_one(i, h)
             new_states.append(ns)
         stacked = {k: jnp.stack([params_list[i][k] for i in range(i0, i1)])
                    for k in params_list[i0]}
         h = self.pipe(stacked, h)
         new_states.extend(state_list[i0:i1])
         for i in range(i1, len(layers) - 1):
-            pp = conf.preprocessor(i)
-            if pp is not None:
-                h = pp.pre_process(h, fmask)
-            h, ns = layers[i].apply(params_list[i], state_list[i], h,
-                                    train=True, rng=rngs[i], mask=fmask)
+            h, ns = apply_one(i, h)
             new_states.append(ns)
         pp = conf.preprocessor(len(layers) - 1)
         if pp is not None:

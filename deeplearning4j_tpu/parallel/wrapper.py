@@ -127,6 +127,26 @@ class ParallelWrapper:
             # the SP/EP shard_maps inside it is not supported
             raise ValueError("sequence/expert parallelism requires "
                              "averaging_frequency == 1 (synchronous DP)")
+        if self.expert_axis:
+            # requested EP must engage or fail loudly — the layer-side
+            # dispatch falls back to dense when expert counts don't divide
+            # the axis, which must never happen silently for an explicit
+            # .expert_parallel() request (ulysses raises on the analogous
+            # heads-divisibility violation)
+            n = self.mesh.shape[self.expert_axis]
+            layers = list(getattr(model.conf, "layers", []) or [])
+            for v in getattr(model.conf, "vertices", {}).values():
+                if getattr(v, "layer", None) is not None:
+                    layers.append(v.layer)
+            moe_layers = [l for l in layers if hasattr(l, "n_experts")]
+            bad = [l.n_experts for l in moe_layers if l.n_experts % n]
+            if bad:
+                raise ValueError(
+                    f"expert_parallel('{self.expert_axis}') with axis size "
+                    f"{n}: expert counts {bad} are not divisible by it")
+            if not moe_layers:
+                raise ValueError("expert_parallel() requested but the model "
+                                 "has no MoE layers")
         self.prefetch = prefetch
         self.averaging_frequency = averaging_frequency
         self.average_updaters = average_updaters

@@ -98,3 +98,50 @@ def test_japanese_conjugation_paradigm_fixtures():
     for text, expect in fixtures.items():
         assert tf.create(text).get_tokens() == expect, (
             text, tf.create(text).get_tokens())
+
+
+def test_japanese_open_class_dictionary_segmentation():
+    """Round-5 open-class dictionary (nlp/ja_lexicon.py): real sentences
+    whose correct boundaries REQUIRE open-class entries — compound kanji
+    runs must split at word boundaries the closed-class lexicon cannot see
+    (reference bar: kuromoji + IPADIC TokenInfoDictionary)."""
+    from deeplearning4j_tpu.nlp.ja_lexicon import entry_count
+
+    assert entry_count() >= 1000  # dictionary-scale, not a demo list
+    tf = JapaneseTokenizerFactory()
+    fixtures = {
+        # compound kanji runs split only via open-class boundaries
+        "日本語勉強中": ["日本語", "勉強", "中"],
+        "東京大学病院": ["東京", "大学", "病院"],
+        "自然言語処理": ["自然", "言語", "処理"],
+        "国際関係学部学生": ["国際", "関係", "学部", "学生"],
+        # full sentences mixing open + closed class
+        "先生は学生に宿題を出しました": ["先生", "は", "学生", "に", "宿題",
+                                         "を", "出しました"],
+        "来週友達と旅行します": ["来週", "友達", "と", "旅行", "します"],
+        "会議の資料を準備した": ["会議", "の", "資料", "を", "準備", "した"],
+        "新幹線で大阪へ帰りました": ["新幹線", "で", "大阪", "へ",
+                                     "帰りました"],
+        "インターネットで情報を調べる": ["インターネット", "で", "情報",
+                                         "を", "調べる"],
+        "経済成長の原因を分析する": ["経済", "成長", "の", "原因", "を",
+                                     "分析", "する"],
+    }
+    for text, expect in fixtures.items():
+        got = tf.create(text).get_tokens()
+        assert got == expect, (text, got)
+
+
+def test_japanese_pos_emission():
+    """kuromoji emits POS per token (Token.getPartOfSpeech); ja_tokenize_
+    with_pos is that seam: lexicon tags for known tokens, char-class tags
+    for unknowns."""
+    from deeplearning4j_tpu.nlp.languages import ja_pos, ja_tokenize_with_pos
+
+    pairs = ja_tokenize_with_pos("私は東京で勉強します")
+    tags = dict(pairs)
+    assert tags["は"] == "助詞"
+    assert tags["東京"] == "名詞-固有"
+    assert tags["勉強"] == "名詞-サ変"
+    assert tags["します"] == "動詞"
+    assert ja_pos("ブロックチェーン") == "名詞"  # unknown katakana run

@@ -122,6 +122,22 @@ def test_seq_and_expert_parallel_compose():
                                atol=5e-5, rtol=1e-4)
 
 
+def test_expert_parallel_rejects_indivisible_experts():
+    """Explicit .expert_parallel() must engage or fail loudly — a silent
+    dense fallback would defeat the request."""
+    conf = moe_transformer_lm(VOCAB, width=WIDTH, n_layers=1, n_heads=HEADS,
+                              n_experts=6, max_len=T)
+    net = MultiLayerNetwork(conf).init()
+    with pytest.raises(ValueError, match="not divisible"):
+        (ParallelWrapper.builder(net).workers(8)
+         .expert_parallel("data").build())
+    lm = MultiLayerNetwork(transformer_lm(VOCAB, width=WIDTH, n_layers=1,
+                                          n_heads=HEADS, max_len=T)).init()
+    with pytest.raises(ValueError, match="no MoE"):
+        (ParallelWrapper.builder(lm).workers(8)
+         .expert_parallel("data").build())
+
+
 def test_local_sgd_rejects_sp():
     conf = transformer_lm(VOCAB, width=WIDTH, n_layers=1, n_heads=HEADS,
                           max_len=T)
