@@ -78,28 +78,24 @@ class HttpStorageProvider(StorageProvider):
         self.token = token
         self.timeout = timeout
 
-    def _request(self, method: str, path: str, data: Optional[bytes] = None):
+    def _request(self, method: str, path: str, data=None,
+                 headers: Optional[dict] = None):
         import urllib.request
 
         req = urllib.request.Request(
-            f"{self.base_url}/{path.lstrip('/')}", data=data, method=method)
+            f"{self.base_url}/{path.lstrip('/')}", data=data, method=method,
+            headers=dict(headers or {}))
         if self.token:
             req.add_header("Authorization", f"Bearer {self.token}")
         return urllib.request.urlopen(req, timeout=self.timeout)
 
     def upload(self, local_path: str, remote_path: str) -> str:
-        import urllib.request
-
         # stream from disk: urllib sends a file object chunk-wise when
         # Content-Length is set, so memory stays O(buffer), not O(artifact)
         size = Path(local_path).stat().st_size
         with open(local_path, "rb") as f:
-            req = urllib.request.Request(
-                f"{self.base_url}/{remote_path.lstrip('/')}", data=f,
-                method="PUT", headers={"Content-Length": str(size)})
-            if self.token:
-                req.add_header("Authorization", f"Bearer {self.token}")
-            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+            with self._request("PUT", remote_path, data=f,
+                               headers={"Content-Length": str(size)}) as resp:
                 if resp.status not in (200, 201, 204):
                     raise IOError(f"upload failed: HTTP {resp.status}")
         return f"{self.base_url}/{remote_path.lstrip('/')}"
@@ -154,7 +150,11 @@ def serve_storage(root: str, host: str = "127.0.0.1", port: int = 0,
                 self.send_response(400)
                 self.end_headers()
                 return
-            n = int(self.headers.get("Content-Length", "0"))
+            if "Content-Length" not in self.headers:
+                self.send_response(411)  # length required — no silent empties
+                self.end_headers()
+                return
+            n = int(self.headers["Content-Length"])
             dst.parent.mkdir(parents=True, exist_ok=True)
             # stream to disk in chunks (multi-GB checkpoints must not
             # materialize in handler memory)
@@ -166,6 +166,12 @@ def serve_storage(root: str, host: str = "127.0.0.1", port: int = 0,
                         break
                     f.write(chunk)
                     remaining -= len(chunk)
+            if remaining:
+                # truncated body: never acknowledge a partial artifact
+                dst.unlink(missing_ok=True)
+                self.send_response(400)
+                self.end_headers()
+                return
             self.send_response(201)
             self.end_headers()
 

@@ -133,7 +133,8 @@ class MoELayer(FeedForwardLayer):
             y, aux = expert_parallel_ffn(self, params, x, ctx.mesh,
                                          ctx.expert_axis,
                                          ctx.capacity_factor,
-                                         train=train, rng=rng)
+                                         train=train, rng=rng,
+                                         seq_axis=ctx.seq_axis)
             new_state = {"aux_loss": aux if train else jnp.zeros_like(aux)}
             return self.act_fn()(y.reshape(shape)), new_state
         x2d = x.reshape(-1, shape[-1])
@@ -225,7 +226,8 @@ class MoETransformerBlock(MoELayer):
             y, aux = expert_parallel_ffn(self, params, h, ctx.mesh,
                                          ctx.expert_axis,
                                          ctx.capacity_factor,
-                                         train=train, rng=rng)
+                                         train=train, rng=rng,
+                                         seq_axis=ctx.seq_axis)
         else:
             y2d, aux = self.moe_ffn_2d(params, h.reshape(-1, F), train=train,
                                        rng=rng)

@@ -149,13 +149,11 @@ def _flash_forward(q: Array, k: Array, v: Array, causal: bool,
     """q,k,v: (B, T, H, D) -> (out (B, T, H, D), lse (B*H, Tq) f32). None
     block sizes -> env-tunable module defaults (_BLK_Q/_BLK_K). key_mask:
     optional [B, Tk] {0,1} key-padding mask."""
-    blk_q = blk_q or _BLK_Q
-    blk_k = blk_k or _BLK_K
     B, Tq, H, D = q.shape
     Tk = k.shape[1]
-    blk_q = min(blk_q, Tq)
-    blk_k = min(blk_k, Tk)
-    if Tq % blk_q or Tk % blk_k:
+    blk_q = min(blk_q, Tq) if blk_q else _pick_blk(Tq, _BLK_Q)
+    blk_k = min(blk_k, Tk) if blk_k else _pick_blk(Tk, _BLK_K)
+    if not blk_q or not blk_k or Tq % blk_q or Tk % blk_k:
         raise ValueError(f"sequence lengths ({Tq},{Tk}) must be divisible by "
                          f"block sizes ({blk_q},{blk_k})")
     scale = 1.0 / (D ** 0.5)
@@ -228,10 +226,22 @@ def _pallas_ok(q, k, interpret: bool) -> bool:
             and not _in_shard_map(q))
 
 
+def _pick_blk(t: int, pref: int):
+    """Largest supported block size dividing ``t`` (pref first, then the
+    smaller standard tiles). Without the fallback, raising the default
+    K-block to 512 would silently drop 128-divisible-but-not-512-divisible
+    lengths (1280, 3200, ...) to the O(T^2) XLA path."""
+    if t <= 128:
+        return t
+    for b in sorted({pref, 256, 128}, reverse=True):
+        if b <= t and t % b == 0:
+            return b
+    return None
+
+
 def _tileable(tq: int, tk: int, blk_q: int = None, blk_k: int = None) -> bool:
-    blk_q = blk_q or _BLK_Q
-    blk_k = blk_k or _BLK_K
-    return tq % min(blk_q, tq) == 0 and tk % min(blk_k, tk) == 0
+    return (_pick_blk(tq, blk_q or _BLK_Q) is not None
+            and _pick_blk(tk, blk_k or _BLK_K) is not None)
 
 
 def _masked_attention_xla(q: Array, k: Array, v: Array, key_mask: Array,
@@ -397,13 +407,11 @@ def _flash_backward(q, k, v, out, lse, g, causal, blk_q: int = None,
                     key_mask: Array = None):
     """Tiled pallas backward from the saved forward logsumexp. key_mask:
     optional [B, Tk] {0,1} key-padding mask, same semantics as forward."""
-    blk_q = blk_q or _BLK_Q
-    blk_k = blk_k or _BLK_K
     B, Tq, H, D = q.shape
     Tk = k.shape[1]
-    blk_q = min(blk_q, Tq)
-    blk_k = min(blk_k, Tk)
-    if Tq % blk_q or Tk % blk_k:
+    blk_q = min(blk_q, Tq) if blk_q else _pick_blk(Tq, _BLK_Q)
+    blk_k = min(blk_k, Tk) if blk_k else _pick_blk(Tk, _BLK_K)
+    if not blk_q or not blk_k or Tq % blk_q or Tk % blk_k:
         raise ValueError(f"sequence lengths ({Tq},{Tk}) must be divisible by "
                          f"block sizes ({blk_q},{blk_k})")
     scale = 1.0 / (D ** 0.5)

@@ -24,7 +24,8 @@ Array = jax.Array
 
 
 def _moe_local(router_params, expert_params, x, rng, *, layer,
-               axis_name: str, capacity: int, train: bool):
+               axis_name: str, capacity: int, train: bool,
+               mean_axes=None):
     """Per-shard body. x: [Bl, T, F] local tokens; expert_params hold this
     shard's experts on the leading axis [E_local, ...]. Returns (y, aux)
     where aux is the GLOBAL Switch load-balance term E * sum_e f_e * P_e
@@ -41,13 +42,16 @@ def _moe_local(router_params, expert_params, x, rng, *, layer,
     S = Bl * T
     x2d = x.reshape(S, F)
 
-    rng_local = (jax.random.fold_in(rng, lax.axis_index(axis_name))
-                 if rng is not None else None)
+    mean_axes = mean_axes or (axis_name,)
+    rng_local = rng
+    if rng is not None:
+        for ax in mean_axes:
+            rng_local = jax.random.fold_in(rng_local, lax.axis_index(ax))
     eidx, gate, probs = layer.route(router_params, x2d, train=train,
                                     rng=rng_local)
     frac = lax.pmean(jnp.mean(jax.nn.one_hot(eidx, E, dtype=jnp.float32),
-                              axis=0), axis_name)
-    p_mean = lax.pmean(jnp.mean(probs.astype(jnp.float32), axis=0), axis_name)
+                              axis=0), mean_axes)
+    p_mean = lax.pmean(jnp.mean(probs.astype(jnp.float32), axis=0), mean_axes)
     aux = E * jnp.sum(frac * p_mean)
     # routing/position arithmetic is exact int32/float32 bookkeeping: under
     # the full-bf16 activation policy x2d.dtype can only count to 256 before
@@ -81,7 +85,8 @@ def _moe_local(router_params, expert_params, x, rng, *, layer,
 
 def expert_parallel_ffn(layer, params: dict, x: Array, mesh: Mesh,
                         axis_name: str, capacity_factor: float = 2.0,
-                        train: bool = False, rng=None):
+                        train: bool = False, rng=None,
+                        seq_axis: str = None):
     """Trace-safe GShard dispatch: the in-jit target MoELayer.apply uses when
     an active ParallelContext declares an expert axis (parallel/context.py).
 
@@ -99,18 +104,29 @@ def expert_parallel_ffn(layer, params: dict, x: Array, mesh: Mesh,
     B, T, F = x.shape
     if B % n:
         raise ValueError(f"batch {B} not divisible by expert axis size {n}")
-    capacity = max(1, int(capacity_factor * (B // n) * T / layer.n_experts))
+    # composing with sequence parallelism: shard T over the seq axis too so
+    # sp shards route disjoint token slices instead of all-gathering the
+    # full sequence and redundantly recomputing the FFN on every sp shard
+    if seq_axis is not None and (seq_axis == axis_name
+                                 or T % mesh.shape[seq_axis]):
+        seq_axis = None
+    n_seq = mesh.shape[seq_axis] if seq_axis else 1
+    x_spec = P(axis_name, seq_axis) if seq_axis else P(axis_name)
+    mean_axes = (axis_name,) + ((seq_axis,) if seq_axis else ())
+    capacity = max(1, int(capacity_factor * (B // n) * (T // n_seq)
+                          / layer.n_experts))
     router = {"Wg": params["Wg"]}
     experts = {k: params[k] for k in ("W1", "b1", "W2", "b2")}
     has_rng = rng is not None
     fn = shard_map(
         functools.partial(_moe_local, layer=layer, axis_name=axis_name,
                           capacity=capacity, train=train,
+                          mean_axes=mean_axes,
                           **({} if has_rng else {"rng": None})),
         mesh=mesh,
         in_specs=(({"Wg": P()}, {k: P(axis_name) for k in experts},
-                   P(axis_name)) + ((P(),) if has_rng else ())),
-        out_specs=(P(axis_name), P()),
+                   x_spec) + ((P(),) if has_rng else ())),
+        out_specs=(x_spec, P()),
     )
     y, aux = fn(router, experts, x, *((rng,) if has_rng else ()))
     if squeeze:

@@ -15,24 +15,25 @@ run() {
     echo "$out" | head -c 300 >&2; echo >&2
 }
 
-# headline configs, default dtype (bf16 matmul)
+# headline configs: bare = per-model measured-best dtype (round-5);
+# --bf16-matmul is the A/B twin
 run --model resnet50
-run --model resnet50 --bf16-act
+run --model resnet50 --bf16-matmul
 run --model transformer
-run --model transformer --bf16-act
+run --model transformer --bf16-matmul
 if [ "$MODE" = full ]; then
     run --model lenet
-    run --model lenet --bf16-act
+    run --model lenet --bf16-matmul
     run --model char_rnn
-    run --model char_rnn --bf16-act
+    run --model char_rnn --bf16-matmul
     run --model moe
-    run --model moe --bf16-act
+    run --model moe --bf16-matmul
     run --model word2vec
     (export DL4J_FLASH_SWEEP=1; run --model attention)
     run --model fit_resnet50
     run --model fit_lenet
     # batch sweep for the flagship at the winning dtype
-    run --model resnet50 --bf16-act --batch 64
-    run --model resnet50 --bf16-act --batch 256
+    run --model resnet50 --batch 64
+    run --model resnet50 --batch 256
 fi
 echo "done -> $LOG" >&2

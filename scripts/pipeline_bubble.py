@@ -1,0 +1,72 @@
+"""Measure the GPipe bubble fraction of parallel/pipeline.py.
+
+Round-4 verdict item 4: "measure bubble fraction at M in {4,8,16}". The
+schedule runs S + M - 1 ticks for M microbatches, so the idle ("bubble")
+fraction is (S-1)/(S+M-1); this script measures it as wall-clock per
+microbatch vs the M -> inf asymptote on the virtual 8-device CPU mesh
+(the same SPMD program that runs over ICI on hardware).
+
+Run: XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+     python scripts/pipeline_bubble.py
+Prints one JSON line per M with measured_bubble vs theoretical.
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.layers import TransformerBlock
+from deeplearning4j_tpu.parallel.mesh import build_mesh
+from deeplearning4j_tpu.parallel.pipeline import (
+    PipelineParallel, stack_block_params)
+
+
+def main():
+    S = len(jax.devices())
+    mesh = build_mesh({"stage": S})
+    F, T, mb = 128, 64, 4
+    block = TransformerBlock(n_in=F, n_out=F, n_heads=4, causal=True,
+                             activation="identity")
+    params = [block.init_params(k, InputType.recurrent(F, T))
+              for k in jax.random.split(jax.random.PRNGKey(0), S)]
+    stacked = stack_block_params(params)
+
+    results = []
+    for M in (4, 8, 16, 32):
+        pipe = PipelineParallel(
+            mesh, lambda p, x: block.apply(p, {}, x, train=False, rng=None)[0],
+            n_blocks=S, n_microbatches=M)
+        x = jax.random.normal(jax.random.PRNGKey(1), (M * mb, T, F),
+                              jnp.float32)
+        fn = jax.jit(pipe)
+        fn(stacked, x).block_until_ready()          # compile
+        n = 10
+        t0 = time.perf_counter()
+        for _ in range(n):
+            out = fn(stacked, x)
+        out.block_until_ready()
+        dt = (time.perf_counter() - t0) / n
+        results.append({"M": M, "ticks": S + M - 1,
+                        "sec_per_microbatch": dt / M,
+                        "theoretical_bubble": round((S - 1) / (S + M - 1), 4)})
+
+    # measured bubble: per-microbatch time inflates by ticks/M over the
+    # asymptote; use the largest M as the asymptote estimate
+    base = results[-1]["sec_per_microbatch"] / (results[-1]["ticks"]
+                                                / results[-1]["M"])
+    for r in results:
+        r["measured_bubble"] = round(
+            max(0.0, 1.0 - base / r["sec_per_microbatch"]), 4)
+        print(json.dumps(r))
+
+
+if __name__ == "__main__":
+    main()

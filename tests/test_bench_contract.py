@@ -51,7 +51,7 @@ def test_outage_record_carries_last_healthy(tmp_path):
 
     log = tmp_path / "bench_log.jsonl"
     rows = [
-        {"args": "--model resnet50", "ts": "t1",
+        {"args": "--model resnet50 --bf16-matmul", "ts": "t1",
          "rec": {"metric": "m", "value": 100.0}},
         {"args": "--model resnet50 --bf16-act", "ts": "t2",
          "rec": {"metric": "m", "value": 200.0}},
@@ -61,19 +61,25 @@ def test_outage_record_carries_last_healthy(tmp_path):
          "rec": {"metric": "m", "value": 0.0, "error": "down"}},
     ]
     log.write_text("\n".join(json.dumps(r) for r in rows))
-    # SAME config only: a bf16/batch-swept row must not stand in for the
-    # fp32 default run (and vice versa); measurement-only flags are ignored
+    # SAME config only: a different-dtype or batch-swept row must not stand
+    # in for the default run; measurement-only flags are ignored. Since
+    # round 5 a bare invocation IS the model's measured-best dtype
+    # (bf16_act for resnet50), so it matches explicit --bf16-act rows.
     got = bench._last_healthy_from_log("--model resnet50 --attempts 1",
                                        path=str(log))
-    assert got["ts"] == "t1" and got["record"]["value"] == 100.0
-    got = bench._last_healthy_from_log("--model resnet50 --bf16-act",
+    assert got["ts"] == "t2" and got["record"]["value"] == 200.0
+    got = bench._last_healthy_from_log("--model resnet50 --bf16-matmul",
                                        path=str(log))
-    assert got["ts"] == "t2"
+    assert got["ts"] == "t1"
     got = bench._last_healthy_from_log(
         "--model resnet50 --bf16-act --batch 256", path=str(log))
     assert got["ts"] == "t3"
     assert bench._last_healthy_from_log("--model word2vec",
                                         path=str(log)) is None
+    # per-model dtype defaults: tiny models keep bf16-matmul (bf16-act
+    # measured slower there — BASELINE.md round-5)
+    assert bench._config_key("--model lenet")["dtype"] == "bf16"
+    assert bench._config_key("--model transformer")["dtype"] == "bf16_act"
 
 
 def test_tile_sweep_isolates_failures_and_picks_best():
