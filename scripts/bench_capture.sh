@@ -30,6 +30,9 @@ if [ "$MODE" = full ]; then
     run --model moe --bf16-matmul
     run --model word2vec
     (export DL4J_FLASH_SWEEP=1; run --model attention)
+    # long-context proof: T=16384 runs ONLY via the pallas flash path
+    # (XLA would materialize a 16k x 16k score matrix per head)
+    (export DL4J_ATTN_SEQ=16384; run --model attention)
     run --model fit_resnet50
     run --model fit_lenet
     # batch sweep for the flagship at the winning dtype
