@@ -330,3 +330,42 @@ def test_fused_xent_integrations_bf16_and_lbfgs():
         assert net2.score_value <= s0
     finally:
         os.environ.pop("DL4J_FUSED_XENT", None)
+
+
+def test_pick_blk_divisor_fallback():
+    """Round-5 calibration raised the default K block to 512; _pick_blk must
+    fall back to smaller standard tiles for 128-divisible-but-not-512-
+    divisible lengths instead of silently dropping to the O(T^2) XLA path."""
+    from deeplearning4j_tpu.ops.pallas_kernels import _pick_blk, _tileable
+
+    assert _pick_blk(2048, 512) == 512
+    assert _pick_blk(1280, 512) == 256
+    assert _pick_blk(3200, 512) == 128
+    assert _pick_blk(1000, 512) is None       # not 128-divisible
+    assert _pick_blk(64, 512) == 64           # short seq: one block
+    assert _tileable(1280, 3200)
+    assert not _tileable(2048, 1000)
+
+
+def test_min_seq_gates_pallas_dispatch(monkeypatch):
+    """Production dispatch engages the flash kernel only at/above
+    DL4J_FLASH_MIN_SEQ (short sequences measured faster on the fused XLA
+    path in-model); interpret mode bypasses the gate so CPU tests keep
+    exercising the kernel."""
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.ops import pallas_kernels as pk
+
+    q = jnp.zeros((1, 256, 2, 8), jnp.float32)
+    qlong = jnp.zeros((1, 2048, 2, 8), jnp.float32)
+    monkeypatch.setattr(pk, "use_pallas", lambda: True)
+    assert not pk._pallas_ok(q, q, interpret=False)       # 256 < 1024
+    assert pk._pallas_ok(qlong, qlong, interpret=False)   # 2048 >= 1024
+    assert pk._pallas_ok(q, q, interpret=True)            # tests bypass
+
+    # the tiled backward has its own, higher threshold
+    assert not pk._pallas_bwd_enabled(2048)
+    assert pk._pallas_bwd_enabled(4096)
+    monkeypatch.setenv("DL4J_FLASH_PALLAS_BWD", "1")
+    assert pk._pallas_bwd_enabled(64)                     # explicit override
