@@ -58,12 +58,33 @@ def _cmd_parallel_train(args) -> int:
             CSVRecordReader(args.dataset), args.batch,
             label_index=args.label_index, num_classes=args.num_classes,
             regression=args.regression)
-    wrapper = (ParallelWrapper.builder(net)
-               .workers(args.workers)
-               .averaging_frequency(args.averaging_frequency)
-               .prefetch_buffer(args.prefetch)
-               .build())
-    wrapper.fit(it, epochs=args.epochs)
+    if args.pipeline:
+        from deeplearning4j_tpu.parallel.mesh import build_mesh
+        from deeplearning4j_tpu.parallel.pipeline_trainer import (
+            PipelineTrainer)
+        import jax
+
+        stages = args.workers or len(jax.devices())
+        PipelineTrainer(net, mesh=build_mesh({"stage": stages}),
+                        n_microbatches=args.microbatches) \
+            .fit(it, epochs=args.epochs)
+    else:
+        builder = (ParallelWrapper.builder(net)
+                   .workers(args.workers)
+                   .averaging_frequency(args.averaging_frequency)
+                   .prefetch_buffer(args.prefetch))
+        if args.sequence_parallel:
+            from deeplearning4j_tpu.parallel.mesh import build_mesh
+            import jax
+
+            n = len(jax.devices())
+            sp = args.sequence_parallel
+            builder = (builder.mesh(build_mesh({"data": n // sp, "sp": sp}))
+                       .sequence_parallel("sp", mode=args.sp_mode))
+        if args.expert_parallel:
+            builder = builder.expert_parallel("data")
+        wrapper = builder.build()
+        wrapper.fit(it, epochs=args.epochs)
     if args.output:
         write_model(net, args.output)
         print(f"trained model written to {args.output}")
@@ -109,6 +130,17 @@ def build_parser() -> argparse.ArgumentParser:
     tr.add_argument("--num-classes", type=int, default=None)
     tr.add_argument("--regression", action="store_true")
     tr.add_argument("--output", help="write trained model zip here")
+    tr.add_argument("--sequence-parallel", type=int, default=0, metavar="N",
+                    help="shard the sequence axis over N devices "
+                         "(Ulysses/ring attention; transformer configs)")
+    tr.add_argument("--sp-mode", choices=("ulysses", "ring"),
+                    default="ulysses")
+    tr.add_argument("--expert-parallel", action="store_true",
+                    help="GShard all_to_all MoE dispatch over the data axis")
+    tr.add_argument("--pipeline", action="store_true",
+                    help="GPipe pipeline over the model's homogeneous "
+                         "block stack (stages = --workers or all devices)")
+    tr.add_argument("--microbatches", type=int, default=4)
     tr.set_defaults(fn=_cmd_parallel_train)
 
     ks = sub.add_parser("keras-server", help="start the Keras gateway")

@@ -96,6 +96,41 @@ def test_cli_parallel_train_and_parser(tmp_path):
     assert rc == 0 and out.exists()
 
 
+def test_cli_pipeline_train(tmp_path):
+    """ParallelWrapperMain-equivalent CLI drives pipeline parallelism too:
+    --pipeline trains any model zip with a homogeneous block stack through
+    PipelineTrainer from the command line."""
+    from deeplearning4j_tpu import NeuralNetConfiguration, MultiLayerNetwork
+    from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.utils.model_serializer import write_model
+    from deeplearning4j_tpu.cli import main
+
+    conf = (NeuralNetConfiguration.builder().seed(0).learning_rate(0.05)
+            .list()
+            .layer(DenseLayer(n_in=2, n_out=8, activation="relu"))
+            .layer(DenseLayer(n_in=8, n_out=8, activation="tanh"))
+            .layer(DenseLayer(n_in=8, n_out=8, activation="tanh"))
+            .layer(OutputLayer(n_in=8, n_out=2, loss="mcxent",
+                               activation="softmax")).build())
+    net = MultiLayerNetwork(conf).init()
+    mpath = tmp_path / "m.zip"
+    write_model(net, str(mpath))
+    csv = tmp_path / "d.csv"
+    rng = np.random.default_rng(0)
+    rows = []
+    for i in range(64):
+        lab = i % 2
+        a, b = rng.normal(lab, 0.2), rng.normal(-lab, 0.2)
+        rows.append(f"{a},{b},{lab}")
+    csv.write_text("\n".join(rows) + "\n")
+    out = tmp_path / "trained.zip"
+    rc = main(["parallel-train", "--model", str(mpath), "--dataset", str(csv),
+               "--pipeline", "--workers", "2", "--microbatches", "2",
+               "--batch", "16", "--num-classes", "2", "--label-index", "2",
+               "--output", str(out)])
+    assert rc == 0 and out.exists()
+
+
 def test_early_stopping_parallel_trainer():
     from deeplearning4j_tpu import NeuralNetConfiguration, MultiLayerNetwork
     from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
