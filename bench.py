@@ -728,13 +728,15 @@ def _config_key(args_str: str) -> dict:
                                               and toks.index(flag) + 1
                                               < len(toks)) else None
 
-    # dtype resolution mirrors _dtype_mode so a bare invocation and an
-    # explicit flag for the model's default are the SAME config
-    mode = _dtype_mode(val("--model") or "resnet50",
+    # normalize argparse defaults so a BARE invocation (the driver's
+    # end-of-round run) is the SAME config as explicit '--model resnet50
+    # --bf16-act' capture rows; dtype resolution mirrors _dtype_mode
+    model = val("--model") or "resnet50"
+    mode = _dtype_mode(model,
                        bf16_act="--bf16-act" in toks,
                        bf16_matmul="--bf16-matmul" in toks,
                        f32="--f32" in toks)
-    return {"model": val("--model"), "batch": val("--batch"),
+    return {"model": model, "batch": val("--batch"),
             "ksteps": val("--ksteps"), "dtype": mode}
 
 

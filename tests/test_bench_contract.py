@@ -80,6 +80,11 @@ def test_outage_record_carries_last_healthy(tmp_path):
     # measured slower there — BASELINE.md round-5)
     assert bench._config_key("--model lenet")["dtype"] == "bf16"
     assert bench._config_key("--model transformer")["dtype"] == "bf16_act"
+    # the driver's end-of-round run is BARE: it must resolve to the same
+    # config as explicit '--model resnet50 --bf16-act' capture rows, or an
+    # outage round serves no last_healthy at all (the round-3/4 failure)
+    got = bench._last_healthy_from_log("--attempts 1", path=str(log))
+    assert got is not None and got["ts"] == "t2"
 
 
 def test_tile_sweep_isolates_failures_and_picks_best():
