@@ -106,3 +106,36 @@ def test_serving_route_predicts():
         route.stop()
     assert rid == "req-1" and out.shape == (3, 2)
     np.testing.assert_allclose(out.sum(axis=1), 1.0, rtol=1e-5)
+
+
+def test_http_storage_server_rejects_bad_uploads(tmp_path):
+    """Truncated or length-less PUTs must not be acknowledged (a corrupt
+    checkpoint stored as success is worse than a failed upload)."""
+    import http.client
+    import threading
+
+    from deeplearning4j_tpu.cloud import serve_storage
+
+    server, base_url = serve_storage(str(tmp_path / "remote"))
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    try:
+        host = base_url.split("//")[1]
+        # no Content-Length -> 411, nothing stored
+        c = http.client.HTTPConnection(host, timeout=10)
+        c.putrequest("PUT", "/a.bin", skip_accept_encoding=True)
+        c.endheaders()
+        assert c.getresponse().status == 411
+        assert not (tmp_path / "remote" / "a.bin").exists()
+        # truncated body -> 400, partial file removed
+        c2 = http.client.HTTPConnection(host, timeout=10)
+        c2.putrequest("PUT", "/b.bin")
+        c2.putheader("Content-Length", "1000000")
+        c2.endheaders()
+        c2.send(b"short")
+        c2.close()  # disconnect mid-body
+        import time
+        time.sleep(0.3)
+        assert not (tmp_path / "remote" / "b.bin").exists()
+    finally:
+        server.shutdown()
