@@ -95,6 +95,17 @@ def summarize(logdir: str, top: int = 25) -> dict:
               if any(t in p.name.lower() for t in ("tpu", "gpu", "device"))]
     planes = device or list(xspace.planes)
     out["summarized_planes"] = [p.name for p in planes]
+    import re
+
+    def opcode(nm: str) -> str:
+        """The defining HLO opcode of '%name = type opcode(args)'. Bucketing
+        must use THIS, not substring search over the whole HLO string —
+        operand text routinely contains 'transpose'/'reshape', which round
+        4's parser misread as ~38%% 'datamovement' on every model."""
+        m = re.search(r"=\s*(?:\([^=]*?\)\s*|\S+\s+)?([a-z][a-z0-9\-_.]*)\(",
+                      nm)
+        return m.group(1) if m else nm.split(".")[0].lstrip("%")
+
     op_time: dict = {}
     total_ns = 0
     for plane in planes:
@@ -108,6 +119,10 @@ def summarize(logdir: str, top: int = 25) -> dict:
         for line in (op_lines or lines):
             for ev in line.events:
                 nm = ev.name
+                # control-flow wrappers (the K-step scan loop) span their
+                # whole body and would double-count every inner op
+                if opcode(nm) in ("while", "conditional", "call"):
+                    continue
                 dur = int(ev.duration_ns)
                 op_time[nm] = op_time.get(nm, 0) + dur
                 total_ns += dur
@@ -119,26 +134,38 @@ def summarize(logdir: str, top: int = 25) -> dict:
         for k, v in ranked]
 
     def bucket(nm: str) -> str:
-        n = nm.lower()
-        if "conv" in n:
+        op = opcode(nm)
+        # fusions: classify by the name prefix XLA gives them (it encodes
+        # the fused ops: transpose_..., convert_reduce_..., maximum_add_...)
+        label = nm.lstrip("%").split(" ")[0].split(".")[0].lower()
+        if "conv" in op or label.startswith("convolution"):
             return "conv"
-        if "dot" in n or "matmul" in n or "einsum" in n:
-            return "matmul"
-        if any(t in n for t in ("all-reduce", "all-gather", "collective",
-                                "reduce-scatter")):
+        if op in ("dot", "custom-call") or "matmul" in label:
+            return "matmul/custom"
+        if any(t in op for t in ("all-reduce", "all-gather", "collective",
+                                 "reduce-scatter", "permute")):
             return "collective"
-        if any(t in n for t in ("copy", "transpose", "reshape", "bitcast")):
+        if op in ("copy", "transpose", "reshape", "bitcast",
+                  "dynamic-slice", "dynamic-update-slice") \
+                or label.startswith(("copy", "transpose", "bitcast")):
             return "datamovement"
-        if "fusion" in n:
-            return "fusion"
-        return "other"
+        if op == "fusion":
+            # TPU traces do not expose fusion bodies; the big kOutput
+            # fusions CONTAIN the convolutions/matmuls plus their
+            # elementwise epilogues, so this bucket is "compute", not
+            # "elementwise overhead"
+            if label.startswith(("convert_reduce", "multiply_reduce",
+                                 "reduce")):
+                return "fusion:reduce"
+            return "fusion:compute"
+        return op
 
     cats: dict = {}
     for k, v in op_time.items():
         cats[bucket(k)] = cats.get(bucket(k), 0) + v
     out["categories_pct"] = {
         k: round(100.0 * v / total_ns, 2) if total_ns else 0.0
-        for k, v in sorted(cats.items(), key=lambda kv: -kv[1])}
+        for k, v in sorted(cats.items(), key=lambda kv: -kv[1])[:12]}
     return out
 
 
