@@ -522,6 +522,8 @@ _METRICS = {
     "attention": "flash_attention_tokens_per_sec",
 }
 
+_DEFAULT_MODEL = "resnet50"  # the flagship; bare bench.py runs it
+
 _DEFAULTS = {  # model -> (batch, iters, ksteps)
     "lenet": (128, 20, 16),
     "fit_lenet": (128, 20, 16),
@@ -607,7 +609,8 @@ def main() -> None:
     import sys
 
     ap = argparse.ArgumentParser()
-    ap.add_argument("--model", default="resnet50", choices=sorted(_METRICS))
+    ap.add_argument("--model", default=_DEFAULT_MODEL,
+                    choices=sorted(_METRICS))
     ap.add_argument("--batch", type=int, default=None)
     ap.add_argument("--iters", type=int, default=None)
     ap.add_argument("--ksteps", type=int, default=None,
@@ -731,7 +734,7 @@ def _config_key(args_str: str) -> dict:
     # normalize argparse defaults so a BARE invocation (the driver's
     # end-of-round run) is the SAME config as explicit '--model resnet50
     # --bf16-act' capture rows; dtype resolution mirrors _dtype_mode
-    model = val("--model") or "resnet50"
+    model = val("--model") or _DEFAULT_MODEL
     mode = _dtype_mode(model,
                        bf16_act="--bf16-act" in toks,
                        bf16_matmul="--bf16-matmul" in toks,

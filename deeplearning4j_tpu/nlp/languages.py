@@ -129,9 +129,7 @@ _PARADIGM_POS: dict = {}
 
 
 def _expand_verb_paradigms(lexicon: dict) -> None:
-    pos = "動詞"
-
-    def add(form: str) -> None:
+    def add(form: str, pos: str = "動詞") -> None:
         lexicon.setdefault(form, _CONJ_COST)
         _PARADIGM_POS.setdefault(form, pos)
 
@@ -152,12 +150,11 @@ def _expand_verb_paradigms(lexicon: dict) -> None:
                   stem + "ません", stem + "られる", stem + "よう",
                   stem + "れば", stem + "たい"):
             add(f)
-    pos = "形容詞"
     for adj in _I_ADJECTIVES:
         stem = adj[:-1]
         for f in (adj, stem + "く", stem + "くて", stem + "かった",
                   stem + "くない", stem + "くなかった", stem + "ければ"):
-            add(f)
+            add(f, pos="形容詞")
 
 
 _expand_verb_paradigms(_JA_LEXICON)

@@ -117,18 +117,20 @@ def expert_parallel_ffn(layer, params: dict, x: Array, mesh: Mesh,
                           / layer.n_experts))
     router = {"Wg": params["Wg"]}
     experts = {k: params[k] for k in ("W1", "b1", "W2", "b2")}
-    has_rng = rng is not None
+    # router noise needs an rng; without one the routing is deterministic,
+    # so a placeholder key + train=False keeps the operand list static
+    if rng is None:
+        rng, train = jax.random.PRNGKey(0), False
     fn = shard_map(
         functools.partial(_moe_local, layer=layer, axis_name=axis_name,
                           capacity=capacity, train=train,
-                          mean_axes=mean_axes,
-                          **({} if has_rng else {"rng": None})),
+                          mean_axes=mean_axes),
         mesh=mesh,
-        in_specs=(({"Wg": P()}, {k: P(axis_name) for k in experts},
-                   x_spec) + ((P(),) if has_rng else ())),
+        in_specs=({"Wg": P()}, {k: P(axis_name) for k in experts},
+                  x_spec, P()),
         out_specs=(x_spec, P()),
     )
-    y, aux = fn(router, experts, x, *((rng,) if has_rng else ()))
+    y, aux = fn(router, experts, x, rng)
     if squeeze:
         y = y[:, 0, :]
     return y, aux
