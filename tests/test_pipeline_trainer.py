@@ -145,3 +145,25 @@ def test_rejects_non_homogeneous():
     net = MultiLayerNetwork(conf).init()
     with pytest.raises(ValueError, match="homogeneous"):
         PipelineTrainer(net, mesh=build_mesh({"stage": 2}))
+
+
+def test_pipeline_with_gradient_checkpointing():
+    """PipelineTrainer honors the config's gradient_checkpointing flag
+    (remat inside each stage block and for the non-pipelined layers) and
+    still matches single-device training — remat changes memory, not math."""
+    batches = _lm_batches(2)
+
+    def conf():
+        c = _conf(2)
+        c.global_conf.gradient_checkpointing = True
+        return c
+
+    single = MultiLayerNetwork(conf()).init()
+    for ds in batches:
+        single.fit(ds.features, ds.labels)
+    net = MultiLayerNetwork(conf()).init()
+    PipelineTrainer(net, mesh=build_mesh({"stage": 2}), n_microbatches=2) \
+        .fit(ListDataSetIterator(batches))
+    np.testing.assert_allclose(np.asarray(single.params()),
+                               np.asarray(net.params()),
+                               atol=5e-5, rtol=1e-4)
