@@ -52,6 +52,7 @@ class ParallelWrapperBuilder:
         self._seq_mode = "ulysses"
         self._expert_axis: Optional[str] = None
         self._capacity_factor = 2.0
+        self._zero1 = False
 
     def workers(self, n: int) -> "ParallelWrapperBuilder":
         self._workers = n
@@ -95,6 +96,13 @@ class ParallelWrapperBuilder:
         self._capacity_factor = capacity_factor
         return self
 
+    def shard_optimizer_state(self, flag: bool = True) -> "ParallelWrapperBuilder":
+        """ZeRO-1: shard updater state (Adam moments etc.) over the data
+        axis — per-device optimizer memory drops by the axis size; XLA
+        inserts the gather around the parameter update."""
+        self._zero1 = flag
+        return self
+
     def build(self) -> "ParallelWrapper":
         return ParallelWrapper(self._model, workers=self._workers,
                                prefetch=self._prefetch,
@@ -104,7 +112,8 @@ class ParallelWrapperBuilder:
                                sequence_parallel_axis=self._seq_axis,
                                sequence_parallel_mode=self._seq_mode,
                                expert_parallel_axis=self._expert_axis,
-                               capacity_factor=self._capacity_factor)
+                               capacity_factor=self._capacity_factor,
+                               shard_optimizer_state=self._zero1)
 
 
 class ParallelWrapper:
@@ -114,7 +123,8 @@ class ParallelWrapper:
                  sequence_parallel_axis: Optional[str] = None,
                  sequence_parallel_mode: str = "ulysses",
                  expert_parallel_axis: Optional[str] = None,
-                 capacity_factor: float = 2.0):
+                 capacity_factor: float = 2.0,
+                 shard_optimizer_state: bool = False):
         self.model = model
         self.mesh = mesh or data_parallel_mesh(workers)
         self.n_workers = self.mesh.shape["data"]
@@ -122,6 +132,10 @@ class ParallelWrapper:
         self.seq_mode = sequence_parallel_mode
         self.expert_axis = expert_parallel_axis
         self.capacity_factor = capacity_factor
+        self.zero1 = shard_optimizer_state
+        if self.zero1 and averaging_frequency != 1:
+            raise ValueError("shard_optimizer_state (ZeRO-1) requires "
+                             "averaging_frequency == 1 (synchronous DP)")
         if (self.seq_axis or self.expert_axis) and averaging_frequency != 1:
             # the local-SGD step is itself a shard_map over 'data'; nesting
             # the SP/EP shard_maps inside it is not supported
@@ -194,6 +208,26 @@ class ParallelWrapper:
             return P("data", self.seq_axis)
         return P("data")
 
+    def _upd_shardings(self, repl):
+        """jit shardings for the updater-state pytree: replicated, or —
+        under ZeRO-1 (shard_optimizer_state) — each leaf's leading dim
+        sharded over 'data' when divisible (Adam moments etc. are
+        param-shaped, so per-device optimizer memory drops n_workers-fold;
+        GSPMD inserts the gather feeding the parameter update, the
+        reduce-scatter/all-gather decomposition ZeRO-1 prescribes).
+        Indivisible leaves (small biases) stay replicated."""
+        if not self.zero1:
+            return repl
+        D = self.n_workers
+
+        def leaf(a):
+            if getattr(a, "ndim", 0) >= 1 and a.shape[0] % D == 0 \
+                    and a.shape[0] >= D:
+                return NamedSharding(self.mesh, P("data"))
+            return NamedSharding(self.mesh, P())
+
+        return jax.tree_util.tree_map(leaf, self.model.updater_state)
+
     # ------------------------------------------------------------------ public API
     def fit(self, iterator, epochs: int = 1) -> None:
         """Reference fit(DataSetIterator):322. Batches are sharded over the mesh;
@@ -224,10 +258,11 @@ class ParallelWrapper:
 
         # batch in_shardings are left to the staged arrays' committed
         # shardings (_stage picks P('data') or P('data', seq_axis) per rank)
+        upd_sh = self._upd_shardings(repl)
         return jax.jit(
             step,
-            in_shardings=(repl, repl, repl, None, None, repl, repl),
-            out_shardings=(repl, repl, repl, repl),
+            in_shardings=(repl, repl, upd_sh, None, None, repl, repl),
+            out_shardings=(repl, repl, upd_sh, repl),
         )
 
     def _make_sync_multistep(self):
@@ -252,10 +287,11 @@ class ParallelWrapper:
             with self._trace_ctx():
                 return base(params, states, upd, xs, ys, rng, it0)
 
+        upd_sh = self._upd_shardings(repl)
         return jax.jit(
             multi,
-            in_shardings=(repl, repl, repl, None, None, repl, repl),
-            out_shardings=(repl, repl, repl, repl),
+            in_shardings=(repl, repl, upd_sh, None, None, repl, repl),
+            out_shardings=(repl, repl, upd_sh, repl),
         )
 
     def _stage(self, arr, spec: P):

@@ -138,6 +138,52 @@ def test_expert_parallel_rejects_indivisible_experts():
          .expert_parallel("data").build())
 
 
+def test_zero1_optimizer_sharding_equals_single_device():
+    """ZeRO-1 (.shard_optimizer_state()): Adam moments live sharded over the
+    data axis — per-device optimizer memory drops n_workers-fold — and
+    training still equals single-device fit exactly (the sharding only
+    changes WHERE the state lives; GSPMD inserts the collectives)."""
+    import jax
+
+    from deeplearning4j_tpu.nn.conf.builders import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+
+    def conf():
+        return (NeuralNetConfiguration.builder().seed(3).learning_rate(0.05)
+                .updater("adam").list()
+                .layer(DenseLayer(n_in=6, n_out=16, activation="tanh"))
+                .layer(OutputLayer(n_in=16, n_out=3, loss="mcxent",
+                                   activation="softmax")).build())
+
+    rng = np.random.default_rng(0)
+    batches = []
+    for _ in range(4):
+        x = rng.normal(size=(32, 6)).astype(np.float32)
+        y = np.zeros((32, 3), np.float32)
+        y[np.arange(32), rng.integers(0, 3, 32)] = 1
+        batches.append(DataSet(x, y))
+
+    single = MultiLayerNetwork(conf()).init()
+    for ds in batches:
+        single.fit(ds.features, ds.labels)
+
+    net = MultiLayerNetwork(conf()).init()
+    pw = (ParallelWrapper.builder(net).workers(8).prefetch_buffer(0)
+          .shard_optimizer_state().build())
+    pw.fit(ListDataSetIterator(batches))
+
+    np.testing.assert_allclose(np.asarray(single.params()),
+                               np.asarray(net.params()), atol=2e-6)
+    # the memory contract: a shardable moment leaf holds 1/8 per device
+    m = net.updater_state[1]["W"]["m"]          # (16, 3): 16 % 8 == 0
+    assert m.addressable_shards[0].data.nbytes * 8 == m.nbytes
+    b = net.updater_state[1]["b"]["m"]          # (3,): indivisible -> full
+    assert b.addressable_shards[0].data.nbytes == b.nbytes
+    with pytest.raises(ValueError, match="ZeRO-1"):
+        (ParallelWrapper.builder(net).workers(8).averaging_frequency(2)
+         .shard_optimizer_state().build())
+
+
 def test_local_sgd_rejects_sp():
     conf = transformer_lm(VOCAB, width=WIDTH, n_layers=1, n_heads=HEADS,
                           max_len=T)
