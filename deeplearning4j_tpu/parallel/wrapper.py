@@ -221,12 +221,34 @@ class ParallelWrapper:
         D = self.n_workers
 
         def leaf(a):
-            if getattr(a, "ndim", 0) >= 1 and a.shape[0] % D == 0 \
-                    and a.shape[0] >= D:
-                return NamedSharding(self.mesh, P("data"))
+            # shard ANY divisible dim (prefer the largest) — ZeRO-1 is a
+            # storage layout, so which dim is split doesn't matter; leading-
+            # dim-only would silently replicate every weight whose fan-in
+            # isn't a multiple of n_workers
+            dims = [d for d in range(getattr(a, "ndim", 0))
+                    if a.shape[d] % D == 0 and a.shape[d] > 0]
+            if dims:
+                best = max(dims, key=lambda d: a.shape[d])
+                spec = [None] * a.ndim
+                spec[best] = "data"
+                return NamedSharding(self.mesh, P(*spec))
             return NamedSharding(self.mesh, P())
 
-        return jax.tree_util.tree_map(leaf, self.model.updater_state)
+        tree = jax.tree_util.tree_map(leaf, self.model.updater_state)
+        total = sum(int(np.prod(a.shape)) * a.dtype.itemsize
+                    for a in jax.tree_util.tree_leaves(self.model.updater_state))
+        sharded = sum(
+            int(np.prod(a.shape)) * a.dtype.itemsize
+            for a, sh in zip(jax.tree_util.tree_leaves(self.model.updater_state),
+                             jax.tree_util.tree_leaves(tree))
+            if sh.spec != P())
+        if total and not sharded:
+            # an explicit request must engage or fail loudly (same principle
+            # as expert_parallel validation above)
+            raise ValueError(
+                "shard_optimizer_state(): no updater-state dimension is "
+                f"divisible by the data axis size {D}; nothing would shard")
+        return tree
 
     # ------------------------------------------------------------------ public API
     def fit(self, iterator, epochs: int = 1) -> None:

@@ -143,8 +143,6 @@ def test_zero1_optimizer_sharding_equals_single_device():
     data axis — per-device optimizer memory drops n_workers-fold — and
     training still equals single-device fit exactly (the sharding only
     changes WHERE the state lives; GSPMD inserts the collectives)."""
-    import jax
-
     from deeplearning4j_tpu.nn.conf.builders import NeuralNetConfiguration
     from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
 
