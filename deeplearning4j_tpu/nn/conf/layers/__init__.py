@@ -29,6 +29,9 @@ from deeplearning4j_tpu.nn.conf.layers.variational import (
 from deeplearning4j_tpu.nn.conf.layers.attention import (
     SelfAttentionLayer, TransformerBlock,
 )
+# imported for registration side effects too: a saved MoE model zip must
+# restore without the caller having imported the module first
+from deeplearning4j_tpu.nn.conf.layers.moe import MoELayer, MoETransformerBlock
 
 __all__ = [
     "Layer", "FeedForwardLayer", "PretrainLayer",
@@ -38,5 +41,5 @@ __all__ = [
     "GlobalPoolingLayer",
     "BatchNormalization", "LocalResponseNormalization",
     "GravesLSTM", "LSTM", "GravesBidirectionalLSTM", "RnnOutputLayer",
-    "VariationalAutoencoder", "SelfAttentionLayer", "TransformerBlock",
+    "VariationalAutoencoder", "SelfAttentionLayer", "TransformerBlock", "MoELayer", "MoETransformerBlock",
 ]

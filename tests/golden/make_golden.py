@@ -69,6 +69,38 @@ def build_cg():
     return net, xa, xb
 
 
+def build_lm():
+    """Transformer + Switch-MoE blocks: the round-5 first-class layer types
+    get the same forever-loadable guarantee as the original fixtures."""
+    from deeplearning4j_tpu.nn.conf.builders import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf.inputs import InputType
+    from deeplearning4j_tpu.nn.conf.layers import (
+        EmbeddingLayer, RnnOutputLayer, TransformerBlock)
+    from deeplearning4j_tpu.nn.conf.layers.moe import MoETransformerBlock
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    V, W, T = 8, 16, 6
+    conf = (NeuralNetConfiguration.builder()
+            .seed(73).learning_rate(0.01).updater("adam")
+            .weight_init("xavier")
+            .list()
+            .layer(EmbeddingLayer(n_in=V, n_out=W))
+            .layer(TransformerBlock(n_in=W, n_out=W, n_heads=2, causal=True))
+            .layer(MoETransformerBlock(n_in=W, n_out=W, n_heads=2,
+                                       n_experts=4, causal=True))
+            .layer(RnnOutputLayer(n_in=W, n_out=V, loss="mcxent",
+                                  activation="softmax"))
+            .build())
+    conf.layers[0].set_n_in(InputType.recurrent(V, T))
+    net = MultiLayerNetwork(conf).init()
+    rng = np.random.default_rng(73)
+    ids = rng.integers(0, V, size=(4, T + 1))
+    eye = np.eye(V, dtype=np.float32)
+    for _ in range(3):
+        net.fit(eye[ids[:, :-1]], eye[ids[:, 1:]])
+    return net, eye[ids[:, :-1]]
+
+
 def main():
     from deeplearning4j_tpu.datasets.dataset import (
         DataSet, NormalizerStandardize)
@@ -96,10 +128,25 @@ def main():
     print("golden fixtures written to", HERE)
 
 
+def main_lm():
+    """Additive fixture (round 5): written to its OWN files so regenerating
+    it can never silently rewrite the earlier committed expectations."""
+    from deeplearning4j_tpu.utils.model_serializer import write_model
+
+    lm, lm_x = build_lm()
+    write_model(lm, os.path.join(HERE, "lm_golden.zip"), save_updater=True)
+    lm_out = np.asarray(lm.output(lm_x))
+    np.savez(os.path.join(HERE, "lm_golden_expected.npz"),
+             lm_in=lm_x, lm_out=lm_out,
+             lm_updater_flat=np.asarray(_flat(lm.updater_state), np.float32))
+    print("lm golden fixture written to", HERE)
+
+
 def _flat(tree):
     from deeplearning4j_tpu.utils.pytree import flatten_params
     return flatten_params(tree, None)
 
 
 if __name__ == "__main__":
-    main()
+    import sys
+    main_lm() if "--lm-only" in sys.argv else main()

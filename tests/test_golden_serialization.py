@@ -66,3 +66,21 @@ def test_guess_model_distinguishes_golden_fixtures():
                       MultiLayerNetwork)
     assert isinstance(guess_model(os.path.join(GOLDEN, "cg_golden.zip")),
                       ComputationGraph)
+
+
+def test_lm_golden_loads_and_reproduces_outputs():
+    """Round-5 fixture: a trained transformer + Switch-MoE LM zip (attention,
+    MoE router/expert tensors, aux-loss state schema) must stay loadable and
+    bit-reproduce its recorded outputs and updater state forever."""
+    from deeplearning4j_tpu.utils.model_serializer import (
+        restore_multi_layer_network)
+    from deeplearning4j_tpu.utils.pytree import flatten_params
+
+    exp = np.load(os.path.join(GOLDEN, "lm_golden_expected.npz"))
+    net = restore_multi_layer_network(os.path.join(GOLDEN, "lm_golden.zip"),
+                                      load_updater=True)
+    out = np.asarray(net.output(exp["lm_in"]))
+    np.testing.assert_allclose(out, exp["lm_out"], atol=1e-5, rtol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(flatten_params(net.updater_state, None)),
+        exp["lm_updater_flat"], atol=1e-6)
