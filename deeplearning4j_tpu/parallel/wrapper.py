@@ -99,7 +99,12 @@ class ParallelWrapperBuilder:
     def shard_optimizer_state(self, flag: bool = True) -> "ParallelWrapperBuilder":
         """ZeRO-1: shard updater state (Adam moments etc.) over the data
         axis — per-device optimizer memory drops by the axis size; XLA
-        inserts the gather around the parameter update."""
+        inserts the gather around the parameter update. This is a MEMORY
+        feature: training math is exactly unchanged (tested), and GSPMD may
+        log involuntary-remat warnings where the sharding propagates through
+        reshapes in the backward pass — a compile-time layout fallback on
+        small tensors, not a correctness issue. Profile before assuming a
+        throughput effect either way."""
         self._zero1 = flag
         return self
 
@@ -221,17 +226,17 @@ class ParallelWrapper:
         D = self.n_workers
 
         def leaf(a):
-            # shard ANY divisible dim (prefer the largest) — ZeRO-1 is a
-            # storage layout, so which dim is split doesn't matter; leading-
-            # dim-only would silently replicate every weight whose fan-in
-            # isn't a multiple of n_workers
-            dims = [d for d in range(getattr(a, "ndim", 0))
-                    if a.shape[d] % D == 0 and a.shape[d] > 0]
-            if dims:
-                best = max(dims, key=lambda d: a.shape[d])
-                spec = [None] * a.ndim
-                spec[best] = "data"
-                return NamedSharding(self.mesh, P(*spec))
+            # shard the FIRST divisible dim — ZeRO-1 is a storage layout, so
+            # any split works, but leading-dim splits propagate most cleanly
+            # through GSPMD (later dims invited involuntary-remat reshards in
+            # practice); leading-dim-ONLY would silently replicate every
+            # weight whose fan-in isn't a multiple of n_workers, hence the
+            # fallback scan over the remaining dims
+            for d in range(getattr(a, "ndim", 0)):
+                if a.shape[d] % D == 0 and a.shape[d] > 0:
+                    spec = [None] * a.ndim
+                    spec[d] = "data"
+                    return NamedSharding(self.mesh, P(*spec))
             return NamedSharding(self.mesh, P())
 
         tree = jax.tree_util.tree_map(leaf, self.model.updater_state)
