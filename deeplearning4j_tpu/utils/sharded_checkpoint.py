@@ -33,6 +33,12 @@ def _checkpointer():
     return ocp.PyTreeCheckpointer()
 
 
+def _async_checkpointer():
+    import orbax.checkpoint as ocp
+
+    return ocp.AsyncCheckpointer(ocp.PyTreeCheckpointHandler())
+
+
 def save_sharded(directory: str, net, *, step: Optional[int] = None) -> str:
     """Write a sharded checkpoint of the network's full training state.
 
@@ -55,6 +61,50 @@ def save_sharded(directory: str, net, *, step: Optional[int] = None) -> str:
                        "step": step,
                        "network_type": type(net).__name__}, f)
     return directory
+
+
+class AsyncShardedSaver:
+    """Non-blocking sharded saves: device buffers are snapshotted, then
+    TensorStore writes proceed on background threads while training
+    continues — the save no longer stalls the step loop (the same reason
+    the reference runs checkpoint listeners off the hot path). One
+    in-flight save at a time: a new ``save`` waits for the previous write
+    to land (orbax AsyncCheckpointer semantics), and ``wait()`` must be
+    called (or the object used as a context manager) before reading the
+    checkpoint or exiting the process.
+    """
+
+    def __init__(self):
+        self._ckpt = _async_checkpointer()
+
+    def save(self, directory: str, net, *, step: Optional[int] = None) -> str:
+        directory = os.path.abspath(directory)
+        tree = {_PARAMS: net.params_list, _STATES: net.state_list,
+                _UPDATER: net.updater_state}
+        self._ckpt.save(os.path.join(directory, "state"), tree)
+        if jax.process_index() == 0:
+            os.makedirs(directory, exist_ok=True)
+            with open(os.path.join(directory, _CONFIG_FILE), "w") as f:
+                f.write(net.conf.to_json())
+            with open(os.path.join(directory, _META_FILE), "w") as f:
+                json.dump({"iteration": int(getattr(net, "iteration", 0)),
+                           "epoch": int(getattr(net, "epoch", 0)),
+                           "step": step,
+                           "network_type": type(net).__name__}, f)
+        return directory
+
+    def wait(self) -> None:
+        self._ckpt.wait_until_finished()
+
+    def close(self) -> None:
+        self.wait()
+        self._ckpt.close()
+
+    def __enter__(self) -> "AsyncShardedSaver":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
 
 
 def restore_sharded(directory: str, net=None, *, shardings=None):
