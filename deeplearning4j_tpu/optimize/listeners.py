@@ -219,7 +219,8 @@ class CheckpointListener(IterationListener):
     last ``keep_last`` files plus `latest.zip`."""
 
     def __init__(self, directory: str, every_n_iterations: Optional[int] = None,
-                 every_n_epochs: Optional[int] = 1, keep_last: int = 3):
+                 every_n_epochs: Optional[int] = 1, keep_last: int = 3,
+                 sharded: bool = False):
         import glob
         import os
         self.directory = directory
@@ -227,14 +228,41 @@ class CheckpointListener(IterationListener):
         self.every_n_iterations = every_n_iterations
         self.every_n_epochs = every_n_epochs
         self.keep_last = keep_last
+        #: sharded=True writes orbax sharded checkpoint DIRECTORIES
+        #: (utils/sharded_checkpoint) instead of zip files — no host gather
+        #: for mesh-distributed params; LATEST is a pointer file
+        self.sharded = sharded
         # rotation must honor keep_last across restarts: seed from disk
+        pattern = ("checkpoint_*" if sharded else "checkpoint_*.zip")
         self._written: list = sorted(
-            glob.glob(os.path.join(directory, "checkpoint_*.zip")),
+            (p for p in glob.glob(os.path.join(directory, pattern))
+             if sharded == os.path.isdir(p)),
             key=os.path.getmtime)
+
+    def _save_sharded(self, model, tag: str) -> str:
+        import os
+        import shutil
+        from deeplearning4j_tpu.utils.sharded_checkpoint import save_sharded
+        path = os.path.join(self.directory, f"checkpoint_{tag}")
+        if os.path.isdir(path):  # re-saved tag: orbax requires a fresh dir
+            shutil.rmtree(path)
+        save_sharded(path, model)
+        tmp = os.path.join(self.directory, "LATEST.tmp")
+        with open(tmp, "w") as f:
+            f.write(os.path.basename(path))
+        os.replace(tmp, os.path.join(self.directory, "LATEST"))
+        if path in self._written:
+            self._written.remove(path)
+        self._written.append(path)
+        while len(self._written) > self.keep_last:
+            shutil.rmtree(self._written.pop(0), ignore_errors=True)
+        return path
 
     def _save(self, model, tag: str) -> str:
         import os
         import shutil
+        if self.sharded:
+            return self._save_sharded(model, tag)
         from deeplearning4j_tpu.utils.model_serializer import write_model
         path = os.path.join(self.directory, f"checkpoint_{tag}.zip")
         tmp = path + ".tmp"
@@ -268,7 +296,15 @@ class CheckpointListener(IterationListener):
     def last_checkpoint(directory: str) -> Optional[str]:
         import os
         p = os.path.join(directory, "latest.zip")
-        return p if os.path.exists(p) else None
+        if os.path.exists(p):
+            return p
+        ptr = os.path.join(directory, "LATEST")  # sharded-mode pointer file
+        if os.path.exists(ptr):
+            with open(ptr) as f:
+                cand = os.path.join(directory, f.read().strip())
+            if os.path.isdir(cand):
+                return cand
+        return None
 
 
 class NanScoreWatcher(IterationListener):
