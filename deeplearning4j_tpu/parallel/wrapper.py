@@ -53,6 +53,7 @@ class ParallelWrapperBuilder:
         self._expert_axis: Optional[str] = None
         self._capacity_factor = 2.0
         self._zero1 = False
+        self._fsdp = False
 
     def workers(self, n: int) -> "ParallelWrapperBuilder":
         self._workers = n
@@ -96,6 +97,15 @@ class ParallelWrapperBuilder:
         self._capacity_factor = capacity_factor
         return self
 
+    def shard_parameters(self, flag: bool = True) -> "ParallelWrapperBuilder":
+        """FSDP / ZeRO-3: shard the parameters themselves over the data
+        axis — per-device parameter memory drops by the axis size; XLA
+        all-gathers each weight just-in-time and reduce-scatters its
+        gradient. Usually combined with .shard_optimizer_state(). Same
+        memory-feature caveats as ZeRO-1 apply."""
+        self._fsdp = flag
+        return self
+
     def shard_optimizer_state(self, flag: bool = True) -> "ParallelWrapperBuilder":
         """ZeRO-1: shard updater state (Adam moments etc.) over the data
         axis — per-device optimizer memory drops by the axis size; XLA
@@ -118,7 +128,8 @@ class ParallelWrapperBuilder:
                                sequence_parallel_mode=self._seq_mode,
                                expert_parallel_axis=self._expert_axis,
                                capacity_factor=self._capacity_factor,
-                               shard_optimizer_state=self._zero1)
+                               shard_optimizer_state=self._zero1,
+                               shard_parameters=self._fsdp)
 
 
 class ParallelWrapper:
@@ -129,7 +140,8 @@ class ParallelWrapper:
                  sequence_parallel_mode: str = "ulysses",
                  expert_parallel_axis: Optional[str] = None,
                  capacity_factor: float = 2.0,
-                 shard_optimizer_state: bool = False):
+                 shard_optimizer_state: bool = False,
+                 shard_parameters: bool = False):
         self.model = model
         self.mesh = mesh or data_parallel_mesh(workers)
         self.n_workers = self.mesh.shape["data"]
@@ -138,9 +150,11 @@ class ParallelWrapper:
         self.expert_axis = expert_parallel_axis
         self.capacity_factor = capacity_factor
         self.zero1 = shard_optimizer_state
-        if self.zero1 and averaging_frequency != 1:
-            raise ValueError("shard_optimizer_state (ZeRO-1) requires "
-                             "averaging_frequency == 1 (synchronous DP)")
+        self.fsdp = shard_parameters
+        if (self.zero1 or self.fsdp) and averaging_frequency != 1:
+            raise ValueError("shard_optimizer_state/shard_parameters "
+                             "(ZeRO/FSDP) require averaging_frequency == 1 "
+                             "(synchronous DP)")
         if (self.seq_axis or self.expert_axis) and averaging_frequency != 1:
             # the local-SGD step is itself a shard_map over 'data'; nesting
             # the SP/EP shard_maps inside it is not supported
@@ -213,25 +227,21 @@ class ParallelWrapper:
             return P("data", self.seq_axis)
         return P("data")
 
-    def _upd_shardings(self, repl):
-        """jit shardings for the updater-state pytree: replicated, or —
-        under ZeRO-1 (shard_optimizer_state) — each leaf's leading dim
-        sharded over 'data' when divisible (Adam moments etc. are
-        param-shaped, so per-device optimizer memory drops n_workers-fold;
-        GSPMD inserts the gather feeding the parameter update, the
-        reduce-scatter/all-gather decomposition ZeRO-1 prescribes).
-        Indivisible leaves (small biases) stay replicated."""
-        if not self.zero1:
-            return repl
+    def _tree_shardings(self, state_tree, what: str):
+        """Per-leaf 'data'-axis shardings for a param-shaped pytree — the
+        ONE layout rule behind ZeRO-1 (updater state) and FSDP (params).
+
+        Shards the FIRST divisible dim — any split works for storage, but
+        leading-dim splits propagate most cleanly through GSPMD (later dims
+        invited involuntary-remat reshards in practice); leading-dim-ONLY
+        would silently replicate every weight whose fan-in isn't a multiple
+        of n_workers, hence the fallback scan over the remaining dims.
+        Indivisible leaves (small biases) stay replicated; an explicit
+        request that would shard NOTHING raises (same engage-or-fail
+        principle as expert_parallel validation)."""
         D = self.n_workers
 
         def leaf(a):
-            # shard the FIRST divisible dim — ZeRO-1 is a storage layout, so
-            # any split works, but leading-dim splits propagate most cleanly
-            # through GSPMD (later dims invited involuntary-remat reshards in
-            # practice); leading-dim-ONLY would silently replicate every
-            # weight whose fan-in isn't a multiple of n_workers, hence the
-            # fallback scan over the remaining dims
             for d in range(getattr(a, "ndim", 0)):
                 if a.shape[d] % D == 0 and a.shape[d] > 0:
                     spec = [None] * a.ndim
@@ -239,21 +249,35 @@ class ParallelWrapper:
                     return NamedSharding(self.mesh, P(*spec))
             return NamedSharding(self.mesh, P())
 
-        tree = jax.tree_util.tree_map(leaf, self.model.updater_state)
-        total = sum(int(np.prod(a.shape)) * a.dtype.itemsize
-                    for a in jax.tree_util.tree_leaves(self.model.updater_state))
-        sharded = sum(
-            int(np.prod(a.shape)) * a.dtype.itemsize
-            for a, sh in zip(jax.tree_util.tree_leaves(self.model.updater_state),
-                             jax.tree_util.tree_leaves(tree))
-            if sh.spec != P())
-        if total and not sharded:
-            # an explicit request must engage or fail loudly (same principle
-            # as expert_parallel validation above)
+        tree = jax.tree_util.tree_map(leaf, state_tree)
+        leaves = jax.tree_util.tree_leaves(state_tree)
+        sharded = any(sh.spec != P()
+                      for sh in jax.tree_util.tree_leaves(tree))
+        if leaves and not sharded:
             raise ValueError(
-                "shard_optimizer_state(): no updater-state dimension is "
-                f"divisible by the data axis size {D}; nothing would shard")
+                f"{what}: no dimension is divisible by the data axis size "
+                f"{D}; nothing would shard")
         return tree
+
+    def _upd_shardings(self, repl):
+        """ZeRO-1: updater state (Adam moments etc.) sharded over 'data' —
+        per-device optimizer memory drops n_workers-fold; GSPMD inserts the
+        gather feeding the parameter update (the reduce-scatter/all-gather
+        decomposition ZeRO-1 prescribes)."""
+        if not self.zero1:
+            return repl
+        return self._tree_shardings(self.model.updater_state,
+                                    "shard_optimizer_state()")
+
+    def _param_shardings(self, repl):
+        """FSDP / ZeRO-3: parameters themselves sharded over 'data' —
+        per-device param memory drops n_workers-fold; GSPMD all-gathers
+        each weight just-in-time for its layer and reduce-scatters its
+        gradient, the standard fully-sharded decomposition."""
+        if not self.fsdp:
+            return repl
+        return self._tree_shardings(self.model.params_list,
+                                    "shard_parameters()")
 
     # ------------------------------------------------------------------ public API
     def fit(self, iterator, epochs: int = 1) -> None:
@@ -286,10 +310,11 @@ class ParallelWrapper:
         # batch in_shardings are left to the staged arrays' committed
         # shardings (_stage picks P('data') or P('data', seq_axis) per rank)
         upd_sh = self._upd_shardings(repl)
+        par_sh = self._param_shardings(repl)
         return jax.jit(
             step,
-            in_shardings=(repl, repl, upd_sh, None, None, repl, repl),
-            out_shardings=(repl, repl, upd_sh, repl),
+            in_shardings=(par_sh, repl, upd_sh, None, None, repl, repl),
+            out_shardings=(par_sh, repl, upd_sh, repl),
         )
 
     def _make_sync_multistep(self):
@@ -315,10 +340,11 @@ class ParallelWrapper:
                 return base(params, states, upd, xs, ys, rng, it0)
 
         upd_sh = self._upd_shardings(repl)
+        par_sh = self._param_shardings(repl)
         return jax.jit(
             multi,
-            in_shardings=(repl, repl, upd_sh, None, None, repl, repl),
-            out_shardings=(repl, repl, upd_sh, repl),
+            in_shardings=(par_sh, repl, upd_sh, None, None, repl, repl),
+            out_shardings=(par_sh, repl, upd_sh, repl),
         )
 
     def _stage(self, arr, spec: P):
