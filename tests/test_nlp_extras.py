@@ -145,3 +145,27 @@ def test_japanese_pos_emission():
     assert tags["勉強"] == "名詞-サ変"
     assert tags["します"] == "動詞"
     assert ja_pos("ブロックチェーン") == "名詞"  # unknown katakana run
+
+
+def test_japanese_segmentation_is_lossless():
+    """Property: segmentation never drops, duplicates, or reorders a single
+    character — for arbitrary text including chars outside every lexicon
+    (kuromoji's lattice guarantees the same by construction)."""
+    import random
+
+    from deeplearning4j_tpu.nlp.languages import _ja_viterbi
+
+    rng = random.Random(0)
+    pools = [
+        "".join(chr(c) for c in range(0x3041, 0x3097)),   # hiragana
+        "".join(chr(c) for c in range(0x30A1, 0x30FB)),   # katakana
+        "".join(chr(c) for c in range(0x4E00, 0x4E80)),   # kanji slice
+        "abcXYZ0189",                                     # latin/digits
+        "、。！？・「」…─𝕏",                              # punct + astral
+    ]
+    for _ in range(60):
+        n = rng.randint(1, 40)
+        chunk = "".join(rng.choice(rng.choice(pools)) for _ in range(n))
+        toks = _ja_viterbi(chunk)
+        assert "".join(toks) == chunk, chunk
+        assert all(toks), chunk  # no empty tokens
