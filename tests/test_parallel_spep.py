@@ -138,6 +138,44 @@ def test_expert_parallel_rejects_indivisible_experts():
          .expert_parallel("data").build())
 
 
+def test_sequence_parallel_computation_graph():
+    """The SP context also reaches attention layers inside a
+    ComputationGraph (the wrapper serves both network types; reference
+    ParallelWrapper wraps either)."""
+    from deeplearning4j_tpu.nn.conf.builders import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf.layers import (
+        EmbeddingLayer, RnnOutputLayer, TransformerBlock)
+    from deeplearning4j_tpu.nn.graph_network import ComputationGraph
+
+    def conf():
+        return (NeuralNetConfiguration.builder().seed(2).learning_rate(0.01)
+                .updater("adam").graph_builder()
+                .add_inputs("ids")
+                .add_layer("emb", EmbeddingLayer(n_in=VOCAB, n_out=WIDTH),
+                           "ids")
+                .add_layer("blk", TransformerBlock(n_in=WIDTH, n_out=WIDTH,
+                                                   n_heads=HEADS, causal=True),
+                           "emb")
+                .add_layer("out", RnnOutputLayer(n_in=WIDTH, n_out=VOCAB,
+                                                 loss="mcxent",
+                                                 activation="softmax"), "blk")
+                .set_outputs("out").build())
+
+    batches = _lm_batches(2)
+    single = ComputationGraph(conf()).init()
+    for ds in batches:
+        single.fit([ds.features], [ds.labels])
+
+    net = ComputationGraph(conf()).init()
+    pw = (ParallelWrapper.builder(net)
+          .mesh(build_mesh({"data": 2, "sp": 4})).prefetch_buffer(0)
+          .sequence_parallel("sp").build())
+    pw.fit(ListDataSetIterator(batches))
+    np.testing.assert_allclose(np.asarray(single.params()),
+                               np.asarray(net.params()),
+                               atol=5e-5, rtol=1e-4)
+
+
 def test_zero1_optimizer_sharding_equals_single_device():
     """ZeRO-1 (.shard_optimizer_state()): Adam moments live sharded over the
     data axis — per-device optimizer memory drops n_workers-fold — and
