@@ -176,6 +176,43 @@ def test_sequence_parallel_computation_graph():
                                atol=5e-5, rtol=1e-4)
 
 
+def test_expert_parallel_computation_graph():
+    """EP dispatch also reaches MoE layers inside a ComputationGraph, and
+    the wrapper's expert-count validation sees graph vertices."""
+    from deeplearning4j_tpu.nn.conf.builders import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf.layers import (
+        EmbeddingLayer, RnnOutputLayer)
+    from deeplearning4j_tpu.nn.conf.layers.moe import MoETransformerBlock
+    from deeplearning4j_tpu.nn.graph_network import ComputationGraph
+
+    def conf():
+        return (NeuralNetConfiguration.builder().seed(5).learning_rate(0.01)
+                .updater("adam").graph_builder()
+                .add_inputs("ids")
+                .add_layer("emb", EmbeddingLayer(n_in=VOCAB, n_out=WIDTH),
+                           "ids")
+                .add_layer("moe", MoETransformerBlock(
+                    n_in=WIDTH, n_out=WIDTH, n_heads=HEADS, n_experts=8,
+                    causal=True), "emb")
+                .add_layer("out", RnnOutputLayer(n_in=WIDTH, n_out=VOCAB,
+                                                 loss="mcxent",
+                                                 activation="softmax"), "moe")
+                .set_outputs("out").build())
+
+    batches = _lm_batches(2)
+    single = ComputationGraph(conf()).init()
+    for ds in batches:
+        single.fit([ds.features], [ds.labels])
+
+    net = ComputationGraph(conf()).init()
+    pw = (ParallelWrapper.builder(net).workers(8).prefetch_buffer(0)
+          .expert_parallel("data", capacity_factor=8.0).build())
+    pw.fit(ListDataSetIterator(batches))
+    np.testing.assert_allclose(np.asarray(single.params()),
+                               np.asarray(net.params()),
+                               atol=5e-5, rtol=1e-4)
+
+
 def test_zero1_optimizer_sharding_equals_single_device():
     """ZeRO-1 (.shard_optimizer_state()): Adam moments live sharded over the
     data axis — per-device optimizer memory drops n_workers-fold — and
