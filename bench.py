@@ -370,24 +370,42 @@ def bench_attention(batch: int, iters: int, ksteps: int, warmup: int = 2,
         float(jnp.ravel(out)[0])
         return (time.perf_counter() - t0) / (iters * ksteps), flops
 
-    t_xla, flops_per_step = time_path(
-        lambda q, k, v: pk._attention_xla(q, k, v, True), want_flops=True)
+    # the XLA twin materializes [B, H, T, T] scores; at long-context lengths
+    # that alone exceeds HBM (16k: ~64 GiB vs 16 GB on v5e), so past a
+    # score-bytes budget only the flash path runs and model flops come from
+    # the standard analytic count instead of XLA cost analysis
+    xla_score_bytes = 4 * batch * heads * seq * seq * 4  # fwd+bwd tiles, f32
+    xla_feasible = xla_score_bytes < 8 * 1024 ** 3
     pallas_engaged = pk.use_pallas()
+    if xla_feasible:
+        t_xla, flops_per_step = time_path(
+            lambda q, k, v: pk._attention_xla(q, k, v, True), want_flops=True)
+    else:
+        t_xla = None
+        # fwd: QK^T + PV = 2 matmuls of 2*B*H*T^2*D flops; bwd ~2.5x fwd;
+        # causal halves the realized work; x ksteps per dispatch
+        flops_per_step = 3.5 * 2 * 2 * batch * heads * seq * seq * dim / 2 \
+            * ksteps
     t_pallas = (time_path(lambda q, k, v: pk.flash_attention(q, k, v, True))[0]
                 if pallas_engaged else None)
 
     t_prod = t_pallas if pallas_engaged else t_xla
+    if t_prod is None:
+        raise RuntimeError(
+            f"seq {seq}: XLA attention infeasible ({xla_score_bytes >> 30} "
+            "GiB scores) and pallas not engaged — nothing to measure")
     rec = {
         "samples_per_sec": batch * seq / t_prod,
         "step_time_ms": t_prod * 1000,
         "batch": batch, "iters": iters, "ksteps": ksteps,
         "seq": seq, "heads": heads, "head_dim": dim,
         "pallas_engaged": pallas_engaged,
-        "xla_ms": round(t_xla * 1000, 3),
+        "xla_ms": round(t_xla * 1000, 3) if t_xla is not None else None,
         "pallas_ms": (round(t_pallas * 1000, 3)
                       if t_pallas is not None else None),
         "pallas_speedup": (round(t_xla / t_pallas, 3)
-                           if t_pallas else None),
+                           if (t_xla and t_pallas) else None),
+        "flops_source": "xla_cost" if xla_feasible else "analytic",
     }
 
     # DL4J_FLASH_SWEEP=1: time the pallas kernel across tile configs so one
@@ -551,7 +569,8 @@ def _bench_fns():
 #: convert ops dominate (LeNet: 240k vs 374k samples/s). A bare
 #: `python bench.py --model X` therefore reports each model's production
 #: configuration; --f32/--bf16-matmul/--bf16-act force a specific one.
-_DTYPE_DEFAULT = {"lenet": "bf16", "fit_lenet": "bf16", "word2vec": "bf16"}
+_DTYPE_DEFAULT = {"lenet": "bf16", "fit_lenet": "bf16",
+                  "word2vec": "bf16", "attention": "bf16"}
 
 
 def _dtype_mode(model: str, *, bf16_act: bool, bf16_matmul: bool,
@@ -576,6 +595,10 @@ def _child_main(args) -> None:
         from deeplearning4j_tpu.common import full_bf16_policy
         full_bf16_policy()
 
+    if args.seq:
+        os.environ["DL4J_ATTN_SEQ"] = str(args.seq)
+    if args.vocab:
+        os.environ["DL4J_W2V_VOCAB"] = str(args.vocab)
     db, di, dk = _DEFAULTS[args.model]
     r = _bench_fns()[args.model](args.batch or db, args.iters or di,
                                  args.ksteps or dk)
@@ -613,6 +636,11 @@ def main() -> None:
                     choices=sorted(_METRICS))
     ap.add_argument("--batch", type=int, default=None)
     ap.add_argument("--iters", type=int, default=None)
+    ap.add_argument("--seq", type=int, default=None,
+                    help="attention bench sequence length (config-distinct "
+                         "in bench_log matching, unlike the env override)")
+    ap.add_argument("--vocab", type=int, default=None,
+                    help="word2vec bench vocab size (config-distinct)")
     ap.add_argument("--ksteps", type=int, default=None,
                     help="train steps fused per host dispatch")
     dt = ap.add_mutually_exclusive_group()
@@ -721,7 +749,12 @@ def main() -> None:
         sys.exit(1)
 
 
-def _config_key(args_str: str) -> dict:
+#: when the per-model dtype defaults landed (round 5) — bare rows logged
+#: before this instant were measured under the old global bf16-matmul default
+_DTYPE_DEFAULT_CHANGE_TS = "2026-07-31T04:35:00Z"
+
+
+def _config_key(args_str: str, ts: str = None) -> dict:
     """The fields that make two bench invocations the SAME config: model,
     dtype mode, explicit batch/ksteps. Unrecognized flags are ignored."""
     toks = args_str.split()
@@ -739,8 +772,16 @@ def _config_key(args_str: str) -> dict:
                        bf16_act="--bf16-act" in toks,
                        bf16_matmul="--bf16-matmul" in toks,
                        f32="--f32" in toks)
+    if ts is not None and ts < _DTYPE_DEFAULT_CHANGE_TS \
+            and not any(f in toks for f in ("--bf16-act", "--bf16-matmul",
+                                            "--f32")):
+        # rows logged before round 5's per-model defaults ran bare under the
+        # old bf16-matmul default; reinterpreting them as bf16_act would let
+        # an outage serve a wrong-dtype number (+22-52%% apart on flagships)
+        mode = "bf16"
     return {"model": model, "batch": val("--batch"),
-            "ksteps": val("--ksteps"), "dtype": mode}
+            "ksteps": val("--ksteps"), "dtype": mode,
+            "seq": val("--seq"), "vocab": val("--vocab")}
 
 
 def _last_healthy_from_log(args_str: str, path: str = None):
@@ -765,7 +806,8 @@ def _last_healthy_from_log(args_str: str, path: str = None):
             continue
         r = row.get("rec")
         if (isinstance(r, dict) and r.get("value") and not r.get("error")
-                and _config_key(row.get("args", "")) == want):
+                and _config_key(row.get("args", ""),
+                                ts=row.get("ts")) == want):
             return {"ts": row.get("ts"), "args": row.get("args"),
                     "record": r}
     return None

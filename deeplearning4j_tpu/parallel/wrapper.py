@@ -160,6 +160,26 @@ class ParallelWrapper:
             # the SP/EP shard_maps inside it is not supported
             raise ValueError("sequence/expert parallelism requires "
                              "averaging_frequency == 1 (synchronous DP)")
+        if self.seq_axis:
+            # requested SP must engage or fail loudly (same principle as EP
+            # below): without attention layers the context changes nothing
+            layers = list(getattr(model.conf, "layers", []) or [])
+            for v in getattr(model.conf, "vertices", {}).values():
+                if getattr(v, "layer", None) is not None:
+                    layers.append(v.layer)
+            attn = [l for l in layers
+                    if hasattr(l, "n_heads") and hasattr(l, "causal")]
+            if not attn:
+                raise ValueError("sequence_parallel() requested but the "
+                                 "model has no attention layers")
+            n = self.mesh.shape[self.seq_axis]
+            if self.seq_mode == "ulysses":
+                bad = [l.n_heads for l in attn if l.n_heads % n]
+                if bad:
+                    raise ValueError(
+                        f"sequence_parallel('{self.seq_axis}', ulysses) with "
+                        f"axis size {n}: head counts {bad} are not divisible "
+                        "by it (use mode='ring' or adjust heads)")
         if self.expert_axis:
             # requested EP must engage or fail loudly — the layer-side
             # dispatch falls back to dense when expert counts don't divide
@@ -223,7 +243,7 @@ class ParallelWrapper:
         """Leading dim over 'data'; with sequence parallelism active, the
         time axis of [B, T, ...] batches is additionally sharded over the
         sequence axis so long sequences never materialize unsharded."""
-        if self.seq_axis and getattr(arr, "ndim", 0) >= 3:
+        if self.seq_axis and getattr(arr, "ndim", 0) == 3:
             return P("data", self.seq_axis)
         return P("data")
 

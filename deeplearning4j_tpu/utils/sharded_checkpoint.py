@@ -39,27 +39,46 @@ def _async_checkpointer():
     return ocp.AsyncCheckpointer(ocp.PyTreeCheckpointHandler())
 
 
+def _write_sidecar(directory: str, net, step: Optional[int]) -> None:
+    """Config + bookkeeping JSON beside the array state — the ONE writer
+    shared by sync and async saves so the schema can never diverge.
+    (Tiny host-side files; process 0 writes.)"""
+    if jax.process_index() != 0:
+        return
+    os.makedirs(directory, exist_ok=True)
+    with open(os.path.join(directory, _CONFIG_FILE), "w") as f:
+        f.write(net.conf.to_json())
+    with open(os.path.join(directory, _META_FILE), "w") as f:
+        json.dump({"iteration": int(getattr(net, "iteration", 0)),
+                   "epoch": int(getattr(net, "epoch", 0)),
+                   "step": step,
+                   "network_type": type(net).__name__}, f)
+
+
+def _clear_state_dir(directory: str) -> None:
+    """orbax refuses to overwrite an existing checkpoint dir; rolling saves
+    to one directory must clear the previous array state first."""
+    import shutil
+
+    state = os.path.join(directory, "state")
+    if os.path.exists(state):
+        shutil.rmtree(state)
+
+
 def save_sharded(directory: str, net, *, step: Optional[int] = None) -> str:
     """Write a sharded checkpoint of the network's full training state.
 
-    ``directory`` must be empty/absent; each leaf keeps its current
-    ``jax.sharding`` layout on disk, so no host gather happens for
-    distributed params. Returns the directory.
+    Each leaf keeps its current ``jax.sharding`` layout on disk, so no host
+    gather happens for distributed params. Re-saving to the same directory
+    replaces the previous state. Returns the directory.
     """
     directory = os.path.abspath(directory)
+    _clear_state_dir(directory)
     ckpt = _checkpointer()
     tree = {_PARAMS: net.params_list, _STATES: net.state_list,
             _UPDATER: net.updater_state}
     ckpt.save(os.path.join(directory, "state"), tree)
-    # config + bookkeeping are tiny host-side JSON (process 0 writes)
-    if jax.process_index() == 0:
-        with open(os.path.join(directory, _CONFIG_FILE), "w") as f:
-            f.write(net.conf.to_json())
-        with open(os.path.join(directory, _META_FILE), "w") as f:
-            json.dump({"iteration": int(getattr(net, "iteration", 0)),
-                       "epoch": int(getattr(net, "epoch", 0)),
-                       "step": step,
-                       "network_type": type(net).__name__}, f)
+    _write_sidecar(directory, net, step)
     return directory
 
 
@@ -79,18 +98,14 @@ class AsyncShardedSaver:
 
     def save(self, directory: str, net, *, step: Optional[int] = None) -> str:
         directory = os.path.abspath(directory)
+        # rolling saves to one dir: wait out any in-flight write, then clear
+        # the previous state (orbax refuses to overwrite)
+        self._ckpt.wait_until_finished()
+        _clear_state_dir(directory)
         tree = {_PARAMS: net.params_list, _STATES: net.state_list,
                 _UPDATER: net.updater_state}
         self._ckpt.save(os.path.join(directory, "state"), tree)
-        if jax.process_index() == 0:
-            os.makedirs(directory, exist_ok=True)
-            with open(os.path.join(directory, _CONFIG_FILE), "w") as f:
-                f.write(net.conf.to_json())
-            with open(os.path.join(directory, _META_FILE), "w") as f:
-                json.dump({"iteration": int(getattr(net, "iteration", 0)),
-                           "epoch": int(getattr(net, "epoch", 0)),
-                           "step": step,
-                           "network_type": type(net).__name__}, f)
+        _write_sidecar(directory, net, step)
         return directory
 
     def wait(self) -> None:

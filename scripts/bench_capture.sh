@@ -23,7 +23,7 @@ run --model transformer
 run --model transformer --bf16-matmul
 if [ "$MODE" = full ]; then
     run --model lenet
-    run --model lenet --bf16-matmul
+    run --model lenet --bf16-act
     run --model char_rnn
     run --model char_rnn --bf16-matmul
     run --model moe
@@ -31,8 +31,8 @@ if [ "$MODE" = full ]; then
     run --model word2vec
     (export DL4J_FLASH_SWEEP=1; run --model attention)
     # long-context proof: T=16384 runs ONLY via the pallas flash path
-    # (XLA would materialize a 16k x 16k score matrix per head)
-    (export DL4J_ATTN_SEQ=16384; run --model attention)
+    # (bench.py skips the XLA twin past its score-bytes budget)
+    run --model attention --seq 16384
     run --model fit_resnet50
     run --model fit_lenet
     # batch sweep for the flagship at the winning dtype

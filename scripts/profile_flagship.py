@@ -163,9 +163,14 @@ def summarize(logdir: str, top: int = 25) -> dict:
     cats: dict = {}
     for k, v in op_time.items():
         cats[bucket(k)] = cats.get(bucket(k), 0) + v
+    ranked_cats = sorted(cats.items(), key=lambda kv: -kv[1])
+    head, tail = ranked_cats[:11], ranked_cats[11:]
+    if tail:  # roll the long tail up so the split still sums to ~100%
+        head.append((f"other({len(tail)} buckets)",
+                     sum(v for _, v in tail)))
     out["categories_pct"] = {
         k: round(100.0 * v / total_ns, 2) if total_ns else 0.0
-        for k, v in sorted(cats.items(), key=lambda kv: -kv[1])[:12]}
+        for k, v in head}
     return out
 
 

@@ -136,3 +136,24 @@ def test_checkpoint_listener_sharded_mode(tmp_path):
     restored = restore_sharded(last)
     assert np.isfinite(np.asarray(restored.output(x))).all()
     assert restored.iteration == net.iteration
+
+
+def test_rolling_saves_to_one_directory(tmp_path):
+    """Repeated saves to the same directory replace the previous state
+    (orbax refuses overwrites; the savers clear stale state first) — both
+    sync and async paths."""
+    from deeplearning4j_tpu.utils.sharded_checkpoint import (
+        AsyncShardedSaver, restore_sharded, save_sharded)
+
+    net, x, y = _trained_net()
+    d = str(tmp_path / "roll")
+    save_sharded(d, net)
+    net.fit(x, y)
+    save_sharded(d, net)          # second sync save, same dir
+    assert restore_sharded(d).iteration == net.iteration
+    with AsyncShardedSaver() as saver:
+        net.fit(x, y)
+        saver.save(d, net)        # async over an existing sync checkpoint
+        net.fit(x, y)
+        saver.save(d, net)        # rolling async save
+    assert restore_sharded(d).iteration == net.iteration
