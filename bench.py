@@ -10,7 +10,8 @@ same config (null when none exists yet).
 TPU-first measurement methodology:
  - K train steps run per host dispatch (`lax.scan` inside one XLA program,
    see make_multistep_train_step) so relay/host dispatch latency is amortized;
- - compute dtype defaults to bfloat16 (MXU-native; pass --f32 to disable);
+ - compute dtype defaults to the model's measured-best policy (--f32 /
+   --bf16-matmul / --bf16-act force one);
  - inputs are staged device-side once (a (K, B, ...) stack in HBM);
  - only a host read (`float(loss)`) is trusted as a sync point — through the
    axon relay `block_until_ready` returns before remote execution completes;
@@ -19,9 +20,10 @@ TPU-first measurement methodology:
    197e12 = TPU v5e).
 
 Usage: python bench.py [--model lenet|resnet50|char_rnn|transformer|word2vec]
-                       [--batch N] [--iters N] [--ksteps K]
-                       [--f32 | --bf16-act]   (default: bf16 matmul, f32
-                       activations; --bf16-act keeps activations bf16 too)
+                       [--batch N] [--iters N] [--ksteps K] [--seq T]
+                       [--vocab V] [--f32 | --bf16-matmul | --bf16-act]
+       (default dtype = each model's measured-best config: bf16 activations
+       for the flagships, bf16-matmul for the tiny models — BASELINE.md r5)
 """
 from __future__ import annotations
 
