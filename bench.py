@@ -586,16 +586,39 @@ def _dtype_mode(model: str, *, bf16_act: bool, bf16_matmul: bool,
     return _DTYPE_DEFAULT.get(model, "bf16_act")
 
 
+def _reduction_mode(dtype_mode: str, reduction_dtype: str | None) -> str:
+    """Resolved reduction policy: explicit --reduction-dtype wins; the
+    bf16-act flagship path defaults to bf16 single-pass statistics (the
+    round-6 reduction-precision subsystem — see BASELINE.md), every other
+    mode defaults to classic at-least-f32 statistics."""
+    if reduction_dtype:
+        return reduction_dtype
+    return "bf16" if dtype_mode == "bf16_act" else "f32"
+
+
 def _child_main(args) -> None:
     """Run one benchmark in-process and print its JSON record."""
     mode = _dtype_mode(args.model, bf16_act=args.bf16_act,
                        bf16_matmul=args.bf16_matmul, f32=args.f32)
+    rmode = _reduction_mode(mode, args.reduction_dtype)
     if mode == "bf16":
         from deeplearning4j_tpu.common import bf16_matmul_policy
         bf16_matmul_policy()
     elif mode == "bf16_act":
-        from deeplearning4j_tpu.common import full_bf16_policy
-        full_bf16_policy()
+        if rmode == "bf16":
+            # the measured flagship recipe: bf16 single-pass norm statistics
+            # + f32-pinned weight-grad accumulation
+            from deeplearning4j_tpu.common import flagship_bf16_policy
+            flagship_bf16_policy()
+        else:
+            from deeplearning4j_tpu.common import full_bf16_policy
+            full_bf16_policy()
+    if mode != "bf16_act" and rmode == "bf16":
+        # explicit opt-in on a non-flagship mode: bf16 stats + f32 grad accum
+        # on top of whatever base policy is installed
+        import jax.numpy as jnp
+        from deeplearning4j_tpu.common import set_policy
+        set_policy(reduction_dtype=jnp.bfloat16, grad_accum_dtype=jnp.float32)
 
     if args.seq:
         os.environ["DL4J_ATTN_SEQ"] = str(args.seq)
@@ -610,6 +633,7 @@ def _child_main(args) -> None:
     import jax
     r["backend"] = jax.default_backend()
     r["dtype"] = mode
+    r["reduction_dtype"] = rmode
     print(json.dumps({
         "metric": _METRICS[args.model],
         "value": round(r["samples_per_sec"], 2),
@@ -657,6 +681,15 @@ def main() -> None:
                          "f32). THE DEFAULT since round 5: on-chip it is "
                          "+22%% on ResNet-50 and +52%% on the transformer "
                          "with loss curves matching (BASELINE.md round-5)")
+    ap.add_argument("--reduction-dtype", choices=("f32", "bf16"), default=None,
+                    help="normalization-statistics reduction dtype. Default: "
+                         "bf16 under --bf16-act (the flagship single-pass "
+                         "recipe — kills the standalone f32 upcast-reduce "
+                         "fusions, ~23%% of r5 ResNet-50 bf16 device time; "
+                         "weight-grad accumulation stays f32-pinned via "
+                         "preferred_element_type), f32 everywhere else. "
+                         "'f32' restores the classic at-least-f32 statistics "
+                         "on the bf16-act path")
     ap.add_argument("--child", action="store_true", help=argparse.SUPPRESS)
     # worst case must finish inside the harness's own command timeout
     # (round-1 artifacts show it kills at ~600s): 2 x 240s + 5s backoff < 500s
@@ -755,6 +788,10 @@ def main() -> None:
 #: before this instant were measured under the old global bf16-matmul default
 _DTYPE_DEFAULT_CHANGE_TS = "2026-07-31T04:35:00Z"
 
+#: when bf16 reductions became the bf16-act default (round 6) — bf16-act rows
+#: logged before this instant ran classic at-least-f32 statistics
+_RDTYPE_DEFAULT_CHANGE_TS = "2026-08-05T00:00:00Z"
+
 
 def _config_key(args_str: str, ts: str = None) -> dict:
     """The fields that make two bench invocations the SAME config: model,
@@ -781,8 +818,14 @@ def _config_key(args_str: str, ts: str = None) -> dict:
         # old bf16-matmul default; reinterpreting them as bf16_act would let
         # an outage serve a wrong-dtype number (+22-52%% apart on flagships)
         mode = "bf16"
+    rdtype = val("--reduction-dtype") or _reduction_mode(mode, None)
+    if ts is not None and ts < _RDTYPE_DEFAULT_CHANGE_TS \
+            and "--reduction-dtype" not in toks:
+        # pre-round-6 rows predate the reduction-precision subsystem: they
+        # all ran at-least-f32 statistics regardless of dtype mode
+        rdtype = "f32"
     return {"model": model, "batch": val("--batch"),
-            "ksteps": val("--ksteps"), "dtype": mode,
+            "ksteps": val("--ksteps"), "dtype": mode, "rdtype": rdtype,
             "seq": val("--seq"), "vocab": val("--vocab")}
 
 

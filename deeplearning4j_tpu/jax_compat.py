@@ -1,0 +1,95 @@
+"""Version bridge for the handful of JAX APIs that moved between releases.
+
+The package targets the current JAX surface (``jax.shard_map`` with
+``check_vma``, ``jax.typeof(...).vma``, ``jax.enable_x64``); deployment
+images sometimes pin an older jaxlib where those live under
+``jax.experimental`` with earlier names (``check_rep``).  Everything in the
+repo imports the moved names from here so the skew stays in one file.
+
+Beyond renames, this module is the ONE place that knows whether a trace is
+inside a shard_map body and whether that body is varying-mesh-axes checked:
+new JAX exposes it as ``jax.typeof(x).vma``; old JAX has no aval-level
+signal, so our ``shard_map`` wrapper brackets the body with a contextvar.
+``ops/pallas_kernels.py`` dispatch predicates consume the merged answer via
+:func:`in_checked_shard_map` — pallas_call is rejected by the vma/rep
+checker, so kernels must yield to XLA math exactly when that returns True.
+"""
+from __future__ import annotations
+
+import contextvars
+import functools
+
+import jax
+
+# innermost shard_map body's guard state: None = not in a shard_map body,
+# True/False = the body's check_vma (new) / check_rep (old) setting
+_SHARD_MAP_GUARD: contextvars.ContextVar = contextvars.ContextVar(
+    "dl4j_shard_map_guard", default=None)
+
+_NEW_SHARD_MAP = hasattr(jax, "shard_map")
+if not _NEW_SHARD_MAP:  # pragma: no cover - exercised on old-jax images
+    from jax.experimental.shard_map import shard_map as _old_shard_map
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True):
+    """``jax.shard_map`` with the body bracketed by the guard contextvar.
+
+    ``check_vma`` follows the current JAX name; on older releases it is
+    forwarded as ``check_rep`` (same semantics for our purposes: both
+    reject pallas_call inside a guarded body).
+    """
+    @functools.wraps(f)
+    def bracketed(*args, **kwargs):
+        token = _SHARD_MAP_GUARD.set(check_vma)
+        try:
+            return f(*args, **kwargs)
+        finally:
+            _SHARD_MAP_GUARD.reset(token)
+
+    if _NEW_SHARD_MAP:
+        return jax.shard_map(bracketed, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=check_vma)
+    return _old_shard_map(bracketed, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, check_rep=check_vma)
+
+
+def in_checked_shard_map(x) -> bool:
+    """True when ``x`` is being traced inside a vma/rep-CHECKED shard_map
+    body — the contexts where the checker rejects ``pallas_call`` and
+    kernels must fall back to XLA math.  Bodies built with
+    ``check_vma=False`` (ulysses/ring attention) return False: the kernel
+    may engage there.
+
+    New JAX answers from the aval (``jax.typeof(x).vma`` is non-empty only
+    under a checked shard_map); old JAX answers from the contextvar set by
+    this module's :func:`shard_map` wrapper.
+    """
+    typeof = getattr(jax, "typeof", None)
+    if typeof is not None:
+        try:
+            if bool(getattr(typeof(x), "vma", None)):
+                return True
+        except Exception:
+            pass
+    return _SHARD_MAP_GUARD.get() is True
+
+
+def pcast(x, axes, to: str = "varying"):
+    """``jax.lax.pcast`` (new JAX: adjusts an aval's varying-mesh-axes set,
+    e.g. marking loop-carry accumulators device-varying so carry types line
+    up under a checked shard_map).  Older releases have no vma aval axis at
+    all — their ``check_rep`` tracker infers replication from data flow — so
+    the cast is an identity there."""
+    fn = getattr(jax.lax, "pcast", None)
+    if fn is not None:
+        return fn(x, axes, to=to)
+    return x
+
+
+def enable_x64(enabled: bool = True):
+    """``jax.enable_x64`` (new) / ``jax.experimental.enable_x64`` (old)."""
+    ctx = getattr(jax, "enable_x64", None)
+    if ctx is not None:
+        return ctx(enabled)
+    from jax.experimental import enable_x64 as _x64
+    return _x64(enabled)

@@ -16,7 +16,7 @@ import dataclasses
 import jax
 import jax.numpy as jnp
 
-from deeplearning4j_tpu.common import at_least_f32, get_policy
+from deeplearning4j_tpu.common import accum_dtype, at_least_f32, get_policy
 from deeplearning4j_tpu.nn.conf.inputs import InputType
 from deeplearning4j_tpu.nn.conf.layers.base import FeedForwardLayer
 from deeplearning4j_tpu.nn.conf.serde import register_config
@@ -98,7 +98,8 @@ class SelfAttentionLayer(FeedForwardLayer):
         H = self.n_heads
         D = self.n_out // H
         qkv = jnp.matmul(x.astype(pol.compute_dtype),
-                         params["Wqkv"].astype(pol.compute_dtype))
+                         params["Wqkv"].astype(pol.compute_dtype),
+                         preferred_element_type=accum_dtype(pol.compute_dtype))
         q, k, v = jnp.split(qkv.astype(pol.output_dtype), 3, axis=-1)
         q = q.reshape(B, T, H, D)
         k = k.reshape(B, T, H, D)
@@ -106,7 +107,8 @@ class SelfAttentionLayer(FeedForwardLayer):
         o = attend(q, k, v, self.causal, mask)
         o = o.reshape(B, T, self.n_out)
         out = jnp.matmul(o.astype(pol.compute_dtype),
-                         params["Wo"].astype(pol.compute_dtype))
+                         params["Wo"].astype(pol.compute_dtype),
+                         preferred_element_type=accum_dtype(pol.compute_dtype))
         out = out.astype(pol.output_dtype) + params["b"].astype(pol.output_dtype)
         return self.act_fn()(out), state
 
@@ -173,7 +175,8 @@ class TransformerBlock(FeedForwardLayer):
         D = F // H
         h = self._ln(x, params["ln1_g"], params["ln1_b"])
         qkv = jnp.matmul(h.astype(pol.compute_dtype),
-                         params["Wqkv"].astype(pol.compute_dtype))
+                         params["Wqkv"].astype(pol.compute_dtype),
+                         preferred_element_type=accum_dtype(pol.compute_dtype))
         q, k, v = jnp.split(qkv.astype(pol.output_dtype), 3, axis=-1)
         q = q.reshape(B, T, H, D)
         k = k.reshape(B, T, H, D)
@@ -184,13 +187,16 @@ class TransformerBlock(FeedForwardLayer):
         o = attend(q, k, v, self.causal, mask)
         o = o.reshape(B, T, F)
         att = jnp.matmul(o.astype(pol.compute_dtype),
-                         params["Wo"].astype(pol.compute_dtype))
+                         params["Wo"].astype(pol.compute_dtype),
+                         preferred_element_type=accum_dtype(pol.compute_dtype))
         x = x + att.astype(pol.output_dtype) + params["bo"].astype(pol.output_dtype)
         h = self._ln(x, params["ln2_g"], params["ln2_b"])
         h = jnp.matmul(h.astype(pol.compute_dtype),
-                       params["W1"].astype(pol.compute_dtype))
+                       params["W1"].astype(pol.compute_dtype),
+                       preferred_element_type=accum_dtype(pol.compute_dtype))
         h = jax.nn.gelu(h.astype(pol.output_dtype) + params["b1"].astype(pol.output_dtype))
         h = self.apply_dropout(h, rng, train)
         h = jnp.matmul(h.astype(pol.compute_dtype),
-                       params["W2"].astype(pol.compute_dtype))
+                       params["W2"].astype(pol.compute_dtype),
+                       preferred_element_type=accum_dtype(pol.compute_dtype))
         return x + h.astype(pol.output_dtype) + params["b2"].astype(pol.output_dtype), state

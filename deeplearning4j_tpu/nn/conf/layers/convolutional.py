@@ -13,13 +13,14 @@ Layout: NHWC activations, HWIO kernels (XLA:TPU preferred). ConvolutionMode pari
 from __future__ import annotations
 
 import dataclasses
+import functools
 from typing import Sequence
 
 import jax
 import jax.numpy as jnp
 from jax import lax
 
-from deeplearning4j_tpu.common import get_policy
+from deeplearning4j_tpu.common import accum_dtype, get_policy
 from deeplearning4j_tpu.nn.conf.inputs import InputType
 from deeplearning4j_tpu.nn.conf.layers.base import Layer
 from deeplearning4j_tpu.nn.conf.serde import register_config
@@ -42,7 +43,47 @@ def _out_dim(size: int, k: int, s: int, p: int, mode: str) -> int:
 def _padding_config(mode: str, pad: tuple[int, int]):
     if mode == "same":
         return "SAME"
-    return [(pad[0], pad[0]), (pad[1], pad[1])]
+    return ((pad[0], pad[0]), (pad[1], pad[1]))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5, 6))
+def _conv_wide(x, w, strides, padding, rhs_dilation, compute, accum):
+    """Conv with policy-routed wide accumulation: compute-dtype operands on
+    the MXU, ``preferred_element_type=accum`` output. A custom vjp because
+    the builtin conv transpose rule feeds the wide cotangent straight back
+    into ``conv_general_dilated`` against a compute-dtype operand, and conv
+    (unlike dot_general) rejects mixed operand dtypes. The gradient convs
+    instead run with both operands upcast to ``accum`` — on TPU an f32
+    conv at DEFAULT precision lowers to the same bf16-multiply /
+    f32-accumulate MXU passes, so the weight gradient still accumulates
+    wide without a post-hoc upcast-reduce."""
+    return lax.conv_general_dilated(
+        x.astype(compute), w.astype(compute), window_strides=strides,
+        padding=padding, rhs_dilation=rhs_dilation,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        preferred_element_type=accum)
+
+
+def _conv_wide_fwd(x, w, strides, padding, rhs_dilation, compute, accum):
+    return _conv_wide(x, w, strides, padding, rhs_dilation, compute,
+                      accum), (x, w)
+
+
+def _conv_wide_bwd(strides, padding, rhs_dilation, compute, accum, res, g):
+    x, w = res
+
+    def conv(xa, wa):
+        return lax.conv_general_dilated(
+            xa, wa, window_strides=strides, padding=padding,
+            rhs_dilation=rhs_dilation,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+    _, vjp = jax.vjp(conv, x.astype(accum), w.astype(accum))
+    dx, dw = vjp(g.astype(accum))
+    return dx.astype(x.dtype), dw.astype(w.dtype)
+
+
+_conv_wide.defvjp(_conv_wide_fwd, _conv_wide_bwd)
 
 
 @register_config("Convolution")
@@ -77,14 +118,24 @@ class ConvolutionLayer(Layer):
         pol = get_policy()
         kh, kw = _pair(self.kernel_size)
         mode = self.convolution_mode.lower()
-        out = lax.conv_general_dilated(
-            x.astype(pol.compute_dtype),
-            params["W"].astype(pol.compute_dtype),
-            window_strides=_pair(self.stride),
-            padding=_padding_config(mode, _pair(self.padding)),
-            rhs_dilation=_pair(self.dilation),
-            dimension_numbers=("NHWC", "HWIO", "NHWC"),
-        ).astype(pol.output_dtype)
+        accum = accum_dtype(pol.compute_dtype)
+        if accum is None:
+            out = lax.conv_general_dilated(
+                x.astype(pol.compute_dtype),
+                params["W"].astype(pol.compute_dtype),
+                window_strides=_pair(self.stride),
+                padding=_padding_config(mode, _pair(self.padding)),
+                rhs_dilation=_pair(self.dilation),
+                dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            ).astype(pol.output_dtype)
+        else:
+            # policy-routed wide accumulation; raw (uncast) params in so the
+            # weight cotangent stays in accum dtype end-to-end (see _conv_wide)
+            out = _conv_wide(
+                x, params["W"], _pair(self.stride),
+                _padding_config(mode, _pair(self.padding)),
+                _pair(self.dilation), pol.compute_dtype, accum,
+            ).astype(pol.output_dtype)
         if self.has_bias:
             out = out + params["b"].astype(out.dtype)
         return self.act_fn()(out), state

@@ -13,7 +13,7 @@ import dataclasses
 import jax
 import jax.numpy as jnp
 
-from deeplearning4j_tpu.common import get_policy
+from deeplearning4j_tpu.common import accum_dtype, get_policy
 from deeplearning4j_tpu.nn.conf.inputs import InputType
 from deeplearning4j_tpu.nn.conf.layers.base import FeedForwardLayer, Layer, PretrainLayer
 from deeplearning4j_tpu.nn.conf.serde import register_config
@@ -23,11 +23,18 @@ Array = jax.Array
 
 
 def _dense(params: dict, x: Array) -> Array:
-    """x @ W + b with the configured MXU compute dtype."""
+    """x @ W + b with the configured MXU compute dtype.
+
+    ``preferred_element_type`` follows the policy's grad_accum_dtype: JAX's
+    transpose rule carries it into the dW/dx contractions, pinning wide
+    accumulation of the weight gradients without a post-hoc upcast-reduce.
+    """
     pol = get_policy()
     w = params["W"].astype(pol.compute_dtype)
-    out = jnp.matmul(x.astype(pol.compute_dtype), w)
-    return (out + params["b"].astype(pol.compute_dtype)).astype(pol.output_dtype)
+    out = jnp.matmul(x.astype(pol.compute_dtype), w,
+                     preferred_element_type=accum_dtype(pol.compute_dtype))
+    return (out.astype(pol.compute_dtype)
+            + params["b"].astype(pol.compute_dtype)).astype(pol.output_dtype)
 
 
 @register_config("Dense")

@@ -19,7 +19,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-from deeplearning4j_tpu.common import get_policy
+from deeplearning4j_tpu.common import accum_dtype, get_policy
 from deeplearning4j_tpu.nn.conf.inputs import InputType
 from deeplearning4j_tpu.nn.conf.layers.base import FeedForwardLayer
 from deeplearning4j_tpu.nn.conf.serde import register_config
@@ -83,11 +83,13 @@ class MoELayer(FeedForwardLayer):
         """Apply every expert to its token buffer: buf [E, C, F] -> [E, C, F]."""
         pol = get_policy()
         h = (jnp.einsum("ecf,efh->ech", buf.astype(pol.compute_dtype),
-                        params["W1"].astype(pol.compute_dtype))
+                        params["W1"].astype(pol.compute_dtype),
+                        preferred_element_type=accum_dtype(pol.compute_dtype))
              .astype(pol.output_dtype) + params["b1"][:, None].astype(pol.output_dtype))
         h = jax.nn.relu(h)
         return (jnp.einsum("ech,ehf->ecf", h.astype(pol.compute_dtype),
-                           params["W2"].astype(pol.compute_dtype))
+                           params["W2"].astype(pol.compute_dtype),
+                           preferred_element_type=accum_dtype(pol.compute_dtype))
                 .astype(pol.output_dtype)
                 + params["b2"][:, None].astype(pol.output_dtype))
 
@@ -104,11 +106,13 @@ class MoELayer(FeedForwardLayer):
         # tokens were actually dispatched with)
         aux = self._balance_term(eidx, probs)
         h = (jnp.einsum("sf,efh->esh", x2d.astype(pol.compute_dtype),
-                        params["W1"].astype(pol.compute_dtype))
+                        params["W1"].astype(pol.compute_dtype),
+                        preferred_element_type=accum_dtype(pol.compute_dtype))
              .astype(pol.output_dtype) + params["b1"][:, None].astype(pol.output_dtype))
         h = jax.nn.relu(h)
         y_all = (jnp.einsum("esh,ehf->esf", h.astype(pol.compute_dtype),
-                            params["W2"].astype(pol.compute_dtype))
+                            params["W2"].astype(pol.compute_dtype),
+                            preferred_element_type=accum_dtype(pol.compute_dtype))
                  .astype(pol.output_dtype)
                  + params["b2"][:, None].astype(pol.output_dtype))  # [E, S, F]
         sel = jax.nn.one_hot(eidx, self.n_experts, dtype=y_all.dtype)  # [S, E]
@@ -211,12 +215,14 @@ class MoETransformerBlock(MoELayer):
         D = F // H
         h = TransformerBlock._ln(x, params["ln1_g"], params["ln1_b"])
         qkv = jnp.matmul(h.astype(pol.compute_dtype),
-                         params["Wqkv"].astype(pol.compute_dtype))
+                         params["Wqkv"].astype(pol.compute_dtype),
+                         preferred_element_type=accum_dtype(pol.compute_dtype))
         q, k, v = jnp.split(qkv.astype(pol.output_dtype), 3, axis=-1)
         q, k, v = (a.reshape(B, T, H, D) for a in (q, k, v))
         o = attend(q, k, v, self.causal, mask)
         att = jnp.matmul(o.reshape(B, T, F).astype(pol.compute_dtype),
-                         params["Wo"].astype(pol.compute_dtype))
+                         params["Wo"].astype(pol.compute_dtype),
+                         preferred_element_type=accum_dtype(pol.compute_dtype))
         x = x + att.astype(pol.output_dtype) + params["bo"].astype(pol.output_dtype)
 
         h = TransformerBlock._ln(x, params["ln2_g"], params["ln2_b"])

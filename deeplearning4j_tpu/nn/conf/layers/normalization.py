@@ -17,7 +17,7 @@ import dataclasses
 import jax
 import jax.numpy as jnp
 
-from deeplearning4j_tpu.common import at_least_f32
+from deeplearning4j_tpu.common import at_least_f32, get_policy
 from deeplearning4j_tpu.nn.conf.inputs import InputType
 from deeplearning4j_tpu.nn.conf.layers.base import Layer
 from deeplearning4j_tpu.nn.conf.serde import register_config
@@ -55,16 +55,28 @@ class BatchNormalization(Layer):
                 "var": jnp.ones((self.n_in,), jnp.float32)}
 
     def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        from deeplearning4j_tpu.ops.pallas_kernels import batch_norm_train
+
         axes = tuple(range(x.ndim - 1))
-        # statistics in at least float32: under the full-bf16 activation
-        # policy x arrives as bfloat16, and mean/var of many small values is
-        # exactly where bf16's 8-bit mantissa loses training accuracy (the
-        # float64 gradient-check path flows through undowncast)
-        stat_dtype = at_least_f32(x.dtype)
+        # statistics dtype comes from the policy: at-least-f32 by default
+        # (bf16's 8-bit mantissa is exactly where mean/var of many small
+        # values loses training accuracy; the float64 gradient-check path
+        # flows through undowncast), bf16 under the flagship reduction
+        # policy — then the whole stat pass is convert-free single-pass
+        # (batch_norm_train: one variadic reduce fwd, one bwd) instead of
+        # the standalone f32 upcast-reduce fusions of jnp.mean + jnp.var
+        stat_dtype = get_policy().stat_dtype(x.dtype)
+        if self.lock_gamma_beta:
+            gamma = jnp.full((self.n_in,), self.gamma, jnp.float32)
+            beta = jnp.full((self.n_in,), self.beta, jnp.float32)
+        else:
+            gamma, beta = params["gamma"], params["beta"]
         if train:
-            xf = x.astype(stat_dtype)
-            mean = jnp.mean(xf, axis=axes)
-            var = jnp.var(xf, axis=axes)
+            out, mean, var = batch_norm_train(x, gamma, beta, axes,
+                                              self.eps, stat_dtype)
+            mean = jax.lax.stop_gradient(mean).astype(state["mean"].dtype)
+            var = jax.lax.stop_gradient(var).astype(state["var"].dtype)
+            # EMA update in the f32 state dtype regardless of stat precision
             new_state = {
                 "mean": self.decay * state["mean"] + (1 - self.decay) * mean,
                 "var": self.decay * state["var"] + (1 - self.decay) * var,
@@ -72,12 +84,14 @@ class BatchNormalization(Layer):
         else:
             mean, var = state["mean"], state["var"]
             new_state = state
-        inv = jax.lax.rsqrt(var + self.eps)
-        xhat = ((x.astype(stat_dtype) - mean) * inv).astype(x.dtype)
-        if self.lock_gamma_beta:
-            out = jnp.asarray(self.gamma, x.dtype) * xhat + jnp.asarray(self.beta, x.dtype)
-        else:
-            out = params["gamma"].astype(x.dtype) * xhat + params["beta"].astype(x.dtype)
+            # inference: fold to one channel-sized scale/shift, elementwise
+            # pass stays in x.dtype (no full-tensor upcast); channel math in
+            # at-least-f32 (f64 under the gradient-check policy)
+            wide = at_least_f32(x.dtype)
+            inv = jax.lax.rsqrt(var.astype(wide) + self.eps)
+            scale = gamma.astype(wide) * inv
+            shift = beta.astype(wide) - mean.astype(wide) * scale
+            out = x * scale.astype(x.dtype) + shift.astype(x.dtype)
         return self.act_fn()(out), new_state
 
 

@@ -23,7 +23,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from deeplearning4j_tpu.common import get_policy
+from deeplearning4j_tpu.common import accum_dtype, get_policy
 from deeplearning4j_tpu.nn.conf.inputs import InputType
 from deeplearning4j_tpu.nn.conf.layers.base import FeedForwardLayer
 from deeplearning4j_tpu.nn.conf.layers.feedforward import _dense
@@ -42,8 +42,13 @@ def _lstm_scan(params: dict, x: Array, act, gate_act, h0: Array, c0: Array,
     b = params["b"].astype(pol.compute_dtype)
     hidden = rw.shape[0]
 
-    # Precompute input contributions for all timesteps in one big MXU matmul: [B,T,4H]
-    xw = jnp.einsum("btf,fg->btg", x.astype(pol.compute_dtype), w) + b
+    # Precompute input contributions for all timesteps in one big MXU matmul:
+    # [B,T,4H]. preferred_element_type routes the dW contraction through the
+    # policy's grad-accum dtype; cast straight back so the scan carry dtype
+    # below never changes.
+    xw = jnp.einsum("btf,fg->btg", x.astype(pol.compute_dtype), w,
+                    preferred_element_type=accum_dtype(pol.compute_dtype)
+                    ).astype(pol.compute_dtype) + b
 
     def step(carry, inputs):
         h, c = carry

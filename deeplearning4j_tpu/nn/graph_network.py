@@ -23,7 +23,8 @@ from deeplearning4j_tpu.nn.conf.graphconf import ComputationGraphConfiguration
 from deeplearning4j_tpu.nn.conf.vertices import LayerVertex
 from deeplearning4j_tpu.nn.multilayer import LazyScore, _updater_spec
 from deeplearning4j_tpu.nn.updaters import (
-    effective_lr, normalize_gradients, updater_init, updater_step_with_param,
+    effective_lr, grads_to_param_dtype, normalize_gradients, updater_init,
+    updater_step_with_param,
 )
 from deeplearning4j_tpu.utils.pytree import flatten_params, num_params, unflatten_params
 
@@ -156,6 +157,8 @@ def _apply_graph_updates(conf, params, grads, upd_state, iteration):
     """Per-vertex gradient normalization + updater math (shared by the
     standard and TBPTT train steps)."""
     g = conf.global_conf
+    grads = grads_to_param_dtype(
+        grads, {n: {k: params[n][k] for k in gv} for n, gv in grads.items()})
     new_params = {}
     new_upd = {}
     for name in conf.topological_order:
@@ -378,6 +381,7 @@ def make_graph_pretrain_step(conf: ComputationGraphConfiguration, name: str):
             return layer.pretrain_loss(p, h, rng=rng)
 
         loss, grads = jax.value_and_grad(lf)(params[name])
+        grads = grads_to_param_dtype(grads, params[name])
         grads = normalize_gradients(grads, layer.gradient_normalization,
                                     layer.gradient_normalization_threshold or 1.0)
         spec = _updater_spec(layer)

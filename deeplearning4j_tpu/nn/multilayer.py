@@ -30,8 +30,8 @@ from deeplearning4j_tpu.nn.conf.multilayer import MultiLayerConfiguration
 from deeplearning4j_tpu.nn.conf.layers.base import PretrainLayer
 from deeplearning4j_tpu.nn.conf.layers.recurrent import LSTM
 from deeplearning4j_tpu.nn.updaters import (
-    UpdaterSpec, effective_lr, normalize_gradients, updater_init,
-    updater_step, updater_step_with_param,
+    UpdaterSpec, effective_lr, grads_to_param_dtype, normalize_gradients,
+    updater_init, updater_step, updater_step_with_param,
 )
 from deeplearning4j_tpu.utils.pytree import flatten_params, num_params, unflatten_params
 
@@ -161,6 +161,7 @@ def make_train_step(conf: MultiLayerConfiguration, loss=None):
         (loss_val, new_states), grads = jax.value_and_grad(
             lambda p: loss(p, state_list, x, y, rng, fmask, lmask),
             has_aux=True)(params_list)
+        grads = grads_to_param_dtype(grads, params_list)
 
         new_params = []
         new_upd = []
@@ -906,6 +907,7 @@ def make_tbptt_step(conf: MultiLayerConfiguration):
             return loss + _regularization(conf, p), new_rnn
 
         (loss, new_rnn), grads = jax.value_and_grad(lf, has_aux=True)(params_list)
+        grads = grads_to_param_dtype(grads, params_list)
         new_params = []
         new_upd = []
         for i, layer in enumerate(conf.layers):
@@ -958,6 +960,7 @@ def make_pretrain_step(conf: MultiLayerConfiguration, layer_idx: int):
             return layer.pretrain_loss(p, h, rng=rng)
 
         loss, grads = jax.value_and_grad(lf)(params_list[layer_idx])
+        grads = grads_to_param_dtype(grads, params_list[layer_idx])
         grads = normalize_gradients(grads, layer.gradient_normalization,
                                     layer.gradient_normalization_threshold or 1.0)
         spec = _updater_spec(layer)

@@ -205,6 +205,15 @@ def updater_step_with_param(spec: UpdaterSpec, grad: Array, param: Array,
 
 
 # ------------------------------------------------------------- gradient normalization
+def grads_to_param_dtype(grads, params):
+    """Explicit grad-dtype boundary at the autodiff/updater seam: cotangents
+    arrive in whatever dtype the backward contraction accumulated in (f32
+    under a ``grad_accum_dtype`` policy even for bf16 params); updater state
+    and parameter deltas follow the PARAM dtype, so cast exactly here rather
+    than letting promotion decide inside each updater rule."""
+    return jax.tree_util.tree_map(lambda g, p: g.astype(p.dtype), grads, params)
+
+
 def normalize_gradients(grads: dict, kind: Optional[str], threshold: float) -> dict:
     """Per-layer gradient normalization/clipping applied BEFORE the updater, matching
     reference LayerUpdater.preApply ordering (:182-221). ``grads`` is one layer's

@@ -12,9 +12,12 @@ Kernels:
 * softmax_cross_entropy — fused row-softmax + NLL loss per row.
 
 Sharding interactions (validated on the virtual CPU mesh):
-* inside a vma-checked shard_map trace every kernel yields to the XLA math
-  (_in_shard_map) — the checker rejects pallas_call there; shard_map callers
-  that want the kernel set check_vma=False (parallel/ring_attention.py).
+* inside a vma-checked shard_map trace the flash/masked kernels yield to the
+  XLA math (_in_checked_shard_map) — the checker rejects pallas_call there.
+  shard_map callers that want the kernel set check_vma=False
+  (parallel/ring_attention.py ulysses/ring) and the kernel ENGAGES in those
+  bodies; the fused xent kernel stays XLA in every shard_map body
+  (_in_shard_map — its interpret lowering also trips on the body trace).
 * under plain GSPMD sharded jit (ParallelWrapper sync DP) the pallas custom
   call is not batch-partitioned: XLA gathers operands and replicates the
   output. Multi-chip attention should ride ring/ulysses_attention (sequence
@@ -30,6 +33,8 @@ import os
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+
+from deeplearning4j_tpu import jax_compat
 
 Array = jax.Array
 _NEG = -1e30
@@ -198,12 +203,22 @@ def _attention_xla(q, k, v, causal):
 
 
 def _in_shard_map(x) -> bool:
-    """True when ``x`` is device-varying under a shard_map trace. Every
-    pallas dispatch must yield to XLA math there: the vma checker rejects
-    pallas_call out_shapes inside shard_map (check_vma default) — shard_map
-    callers that DO want the kernel wrap it with check_vma=False themselves
-    (parallel/ring_attention.py does)."""
-    return bool(getattr(jax.typeof(x), "vma", None))
+    """True when ``x`` is being traced inside ANY shard_map body, guarded or
+    not. The fused softmax-xent kernel yields to XLA math in every shard_map
+    body (its interpret lowering's while_loop carry trips the checker even
+    with the guard off); the flash kernels only need the narrower
+    :func:`_in_checked_shard_map` test."""
+    return (jax_compat._SHARD_MAP_GUARD.get() is not None
+            or jax_compat.in_checked_shard_map(x))
+
+
+def _in_checked_shard_map(x) -> bool:
+    """True when ``x`` is device-varying under a vma/rep-CHECKED shard_map
+    trace — the contexts whose checker rejects pallas_call, so flash/masked
+    dispatch must yield to XLA math. Bodies opened with ``check_vma=False``
+    (parallel/ring_attention.py ulysses/ring) return False: the kernel
+    engages there, which is the whole point of the sequence-parallel path."""
+    return jax_compat.in_checked_shard_map(x)
 
 
 #: shortest sequence the flash kernel engages at. Short sequences lose to
@@ -217,13 +232,22 @@ def _in_shard_map(x) -> bool:
 _MIN_SEQ = int(os.environ.get("DL4J_FLASH_MIN_SEQ", "1024"))
 
 
-def _pallas_ok(q, k, interpret: bool) -> bool:
+def _pallas_ok(q, k, interpret: bool, force: bool = False) -> bool:
     """ONE dispatch predicate for every flash/masked entry point AND its
     custom_vjp fwd rule — they must agree, or a forward under jax.grad would
-    silently take a different code path than the plain forward."""
-    return ((use_pallas() or interpret) and _tileable(q.shape[1], k.shape[1])
-            and (interpret or max(q.shape[1], k.shape[1]) >= _MIN_SEQ)
-            and not _in_shard_map(q))
+    silently take a different code path than the plain forward.
+
+    ``force`` is the per-call ``force_pallas`` opt-in: it bypasses the
+    _MIN_SEQ length heuristic but never the hard constraints — hardware
+    support (``use_pallas()``/interpret), tileable sequence lengths, and the
+    vma-checked shard_map guard (the checker rejects pallas_call outright;
+    engaging there would crash, not run slowly)."""
+    if not ((use_pallas() or interpret)
+            and _tileable(q.shape[1], k.shape[1])):
+        return False
+    if _in_checked_shard_map(q):
+        return False
+    return force or interpret or max(q.shape[1], k.shape[1]) >= _MIN_SEQ
 
 
 def _pick_blk(t: int, pref: int):
@@ -260,34 +284,41 @@ def _masked_attention_xla(q: Array, k: Array, v: Array, key_mask: Array,
 
 
 def masked_attention(q: Array, k: Array, v: Array, key_mask: Array,
-                     causal: bool = False, interpret: bool = False) -> Array:
+                     causal: bool = False, interpret: bool = False,
+                     force_pallas: bool = False) -> Array:
     """Attention with a {0,1} key/padding mask [B, Tk]: masked keys get -inf
     logits (NOT zeroed k/v — zeroing still leaves them e^0 softmax mass).
     Shapes as flash_attention: (B, T, H, D). On TPU this rides the same
     tiled Pallas kernels as flash_attention (O(blk·T) memory); elsewhere or
-    on non-tileable shapes it runs the identical XLA math."""
+    on non-tileable shapes it runs the identical XLA math.
+
+    Dispatch thresholds and ``force_pallas`` are exactly as documented on
+    :func:`flash_attention` — both entry points share one predicate
+    (``_pallas_ok``)."""
     return _masked_attention_vjp(q, k, v, key_mask.astype(jnp.float32),
-                                 causal, interpret)
+                                 causal, interpret, force_pallas)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
-def _masked_attention_vjp(q, k, v, key_mask, causal, interpret):
-    if _pallas_ok(q, k, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def _masked_attention_vjp(q, k, v, key_mask, causal, interpret, force):
+    if _pallas_ok(q, k, interpret, force):
         return _flash_forward(q, k, v, causal, interpret=interpret,
                               key_mask=key_mask)[0]
     return _masked_attention_xla(q, k, v, key_mask, causal)
 
 
-def _masked_fwd_rule(q, k, v, key_mask, causal, interpret):
-    if _pallas_ok(q, k, interpret) and _pallas_bwd_enabled(k.shape[1]):
+def _masked_fwd_rule(q, k, v, key_mask, causal, interpret, force):
+    if _pallas_ok(q, k, interpret, force) \
+            and _pallas_bwd_enabled(k.shape[1], force):
         out, lse = _flash_forward(q, k, v, causal, interpret=interpret,
                                   key_mask=key_mask)
         return out, (q, k, v, key_mask, out, lse)
-    return (_masked_attention_vjp(q, k, v, key_mask, causal, interpret),
+    return (_masked_attention_vjp(q, k, v, key_mask, causal, interpret,
+                                  force),
             (q, k, v, key_mask, None, None))
 
 
-def _masked_bwd_rule(causal, interpret, res, g):
+def _masked_bwd_rule(causal, interpret, force, res, g):
     q, k, v, km, out, lse = res
     if lse is not None:
         dq, dk, dv = _flash_backward(q, k, v, out, lse, g, causal,
@@ -303,16 +334,35 @@ def _masked_bwd_rule(causal, interpret, res, g):
 _masked_attention_vjp.defvjp(_masked_fwd_rule, _masked_bwd_rule)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
 def flash_attention(q: Array, k: Array, v: Array, causal: bool = False,
-                    interpret: bool = False) -> Array:
+                    interpret: bool = False,
+                    force_pallas: bool = False) -> Array:
     """Tiled attention: pallas forward on TPU (shapes that don't tile fall
     back to the identical XLA math rather than erroring), XLA elsewhere.
     Backward is tiled pallas too (dQ + dK/dV kernels recomputing P from the
     saved logsumexp — flash-attention practice: trade FLOPs for HBM; peak
     extra memory O(blk·T), never O(Tq·Tk)); set DL4J_FLASH_PALLAS_BWD=0 to
-    use the XLA chunked-scan backward instead."""
-    if _pallas_ok(q, k, interpret):
+    use the XLA chunked-scan backward instead.
+
+    Dispatch thresholds (measured on-chip, v5e round 5):
+
+    * The forward kernel engages only at ``max(Tq, Tk) >=`` **_MIN_SEQ**
+      (default 1024, env ``DL4J_FLASH_MIN_SEQ``). Shorter sequences run
+      faster on the XLA path inside a model — the custom call is a fusion
+      barrier, so neighbouring projections lose their epilogues.
+    * The tiled pallas backward engages only at ``Tk >=`` **_PBWD_MIN_SEQ**
+      (default 4096, env ``DL4J_FLASH_PBWD_MIN_SEQ``); below that the
+      chunked lax.scan backward wins. ``DL4J_FLASH_PALLAS_BWD=0/1``
+      overrides unconditionally.
+
+    ``force_pallas=True`` is the per-call opt-in that bypasses both length
+    heuristics (for workloads whose measured crossover differs — e.g. a
+    sequence-parallel body whose per-shard lengths sit under the gate). It
+    never overrides the hard constraints: TPU/interpret availability,
+    tileable lengths, and the vma-checked shard_map guard, where
+    pallas_call would be rejected outright."""
+    if _pallas_ok(q, k, interpret, force_pallas):
         return _flash_forward(q, k, v, causal, interpret=interpret)[0]
     return _attention_xla(q, k, v, causal)
 
@@ -543,21 +593,23 @@ def _attention_bwd_chunked(q, k, v, g, causal, blk_q: int = None):
 _PBWD_MIN_SEQ = int(os.environ.get("DL4J_FLASH_PBWD_MIN_SEQ", "4096"))
 
 
-def _pallas_bwd_enabled(seq_k: int = None) -> bool:
+def _pallas_bwd_enabled(seq_k: int = None, force: bool = False) -> bool:
     env = os.environ.get("DL4J_FLASH_PALLAS_BWD")
     if env is not None:
         return env != "0"
-    return seq_k is None or seq_k >= _PBWD_MIN_SEQ
+    return force or seq_k is None or seq_k >= _PBWD_MIN_SEQ
 
 
-def _flash_fwd_rule(q, k, v, causal, interpret):
-    if _pallas_ok(q, k, interpret) and _pallas_bwd_enabled(k.shape[1]):
+def _flash_fwd_rule(q, k, v, causal, interpret, force):
+    if _pallas_ok(q, k, interpret, force) \
+            and _pallas_bwd_enabled(k.shape[1], force):
         out, lse = _flash_forward(q, k, v, causal, interpret=interpret)
         return out, (q, k, v, out, lse)
-    return flash_attention(q, k, v, causal, interpret), (q, k, v, None, None)
+    return (flash_attention(q, k, v, causal, interpret, force),
+            (q, k, v, None, None))
 
 
-def _flash_bwd_rule(causal, interpret, res, g):
+def _flash_bwd_rule(causal, interpret, force, res, g):
     q, k, v, out, lse = res
     if lse is not None:
         return _flash_backward(q, k, v, out, lse, g, causal,
@@ -617,3 +669,101 @@ def softmax_cross_entropy(logits: Array, labels: Array, blk: int = 256,
     loss = -jnp.sum(labels * logp, axis=-1)
     grad = (jnp.exp(logp) - labels).astype(logits.dtype)
     return loss, grad
+
+
+# ----------------------------------------------- fused batch-norm statistics
+def _add2(acc, val):
+    return acc[0] + val[0], acc[1] + val[1]
+
+
+def batch_norm_stats(x: Array, axes, stat_dtype):
+    """Single-pass batch statistics: (mean, biased var) over ``axes``.
+
+    ONE variadic ``lax.reduce`` accumulates sum(x) and sum(x*x) together, so
+    the whole computation is a single fused pass over the tensor — unlike
+    ``jnp.mean`` + ``jnp.var``, which lowers to two full passes (the second
+    re-reading x to form (x - mean)^2) with a standalone f32 upcast-reduce
+    fusion each on the bf16 path (23% of ResNet-50 device time, r5 profile).
+
+    ``stat_dtype`` is the reduce operand/accumulator dtype
+    (DtypePolicy.reduction_dtype): bf16 keeps the pass convert-free on bf16
+    activations; f32/f64 inserts one fused upcast prologue. var clamps at 0
+    against E[x^2]-mean^2 cancellation noise.
+    """
+    n = 1
+    for a in axes:
+        n *= x.shape[a]
+    xs = x.astype(stat_dtype)
+    zero = jnp.zeros((), stat_dtype)
+    s1, s2 = jax.lax.reduce((xs, xs * xs), (zero, zero), _add2, tuple(axes))
+    inv_n = jnp.asarray(1.0 / n, stat_dtype)
+    mean = s1 * inv_n
+    var = jnp.maximum(s2 * inv_n - mean * mean, jnp.zeros((), stat_dtype))
+    return mean, var
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def batch_norm_train(x: Array, gamma: Array, beta: Array, axes, eps,
+                     stat_dtype):
+    """Train-mode batch norm with policy-controlled reduction precision.
+
+    Returns ``(out, mean, var)``; ``axes`` are the leading statistic axes
+    (channel axis trailing, reference BN convention). Forward: single-pass
+    stats (:func:`batch_norm_stats`) + a folded ``x * scale + shift``
+    elementwise pass in x.dtype — no full-tensor upcast. Backward
+    (hand-written): dgamma/dbeta in ONE variadic reduce pass, dx as one
+    elementwise pass, instead of autodiff's mean/var chains (several
+    standalone f32 reduce fusions on the bf16 path).
+
+    The ``mean``/``var`` outputs exist for the EMA running-state update and
+    are treated as NON-differentiable — their cotangents are discarded, so
+    do not differentiate through them.
+    """
+    out, mean, var = _bn_train_impl(x, gamma, beta, axes, eps, stat_dtype)
+    return out, mean, var
+
+
+def _bn_train_impl(x, gamma, beta, axes, eps, stat_dtype):
+    mean, var = batch_norm_stats(x, axes, stat_dtype)
+    # inv in f32-at-least: rsqrt of a bf16 var costs accuracy on a
+    # channel-sized vector for no bandwidth win
+    wide = jnp.promote_types(stat_dtype, jnp.float32)
+    inv = jax.lax.rsqrt(var.astype(wide) + eps)
+    scale = gamma.astype(wide) * inv
+    shift = beta.astype(wide) - mean.astype(wide) * scale
+    out = x * scale.astype(x.dtype) + shift.astype(x.dtype)
+    return out, mean, var
+
+
+def _bn_train_fwd(x, gamma, beta, axes, eps, stat_dtype):
+    out, mean, var = _bn_train_impl(x, gamma, beta, axes, eps, stat_dtype)
+    wide = jnp.promote_types(stat_dtype, jnp.float32)
+    inv = jax.lax.rsqrt(var.astype(wide) + eps)
+    return (out, mean, var), (x, gamma, mean, inv)
+
+
+def _bn_train_bwd(axes, eps, stat_dtype, res, cts):
+    x, gamma, mean, inv = res
+    dy = cts[0]  # mean/var cotangents: EMA plumbing only, not differentiated
+    n = 1
+    for a in axes:
+        n *= x.shape[a]
+    wide = inv.dtype
+    xhat = (x - mean.astype(x.dtype)) * inv.astype(x.dtype)
+    # dbeta = sum(dy), dgamma = sum(dy * xhat): one fused variadic pass in
+    # the policy reduction dtype, same shape discipline as the forward stats
+    t1 = dy.astype(stat_dtype)
+    t2 = (dy * xhat).astype(stat_dtype)
+    zero = jnp.zeros((), stat_dtype)
+    dbeta, dgamma = jax.lax.reduce((t1, t2), (zero, zero), _add2,
+                                   tuple(axes))
+    k = gamma.astype(wide) * inv
+    inv_n = 1.0 / n
+    dx = k.astype(x.dtype) * (
+        dy - (dbeta.astype(wide) * inv_n).astype(x.dtype)
+        - xhat * (dgamma.astype(wide) * inv_n).astype(x.dtype))
+    return (dx.astype(x.dtype), dgamma.astype(gamma.dtype),
+            dbeta.astype(gamma.dtype))
+
+
+batch_norm_train.defvjp(_bn_train_fwd, _bn_train_bwd)

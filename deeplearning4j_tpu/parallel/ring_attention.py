@@ -22,7 +22,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
+from deeplearning4j_tpu.jax_compat import pcast, shard_map
 
 Array = jax.Array
 _NEG = -1e30
@@ -81,7 +81,7 @@ def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool,
     # them so the fori_loop carry types line up under shard_map (over the
     # batch axis too when the leading dim is data-sharded)
     axes = (axis_name,) + ((batch_axis,) if batch_axis else ())
-    vary = lambda x: lax.pcast(x, axes, to="varying")
+    vary = lambda x: pcast(x, axes, to="varying")
     m = vary(jnp.full((B, H, Tq), _NEG, q.dtype))
     l = vary(jnp.zeros((B, H, Tq), q.dtype))
     o = jnp.zeros_like(q)
@@ -143,9 +143,12 @@ def _ulysses_local(q, k, v, *, axis_name: str, causal: bool,
     qg = lax.all_to_all(q, axis_name, split_axis=2, concat_axis=1, tiled=True)
     kg = lax.all_to_all(k, axis_name, split_axis=2, concat_axis=1, tiled=True)
     vg = lax.all_to_all(v, axis_name, split_axis=2, concat_axis=1, tiled=True)
-    # full-sequence attention on 1/N of the heads: the tiled flash kernel
-    # keeps memory O(blk*T) on TPU (identical XLA math elsewhere; interpret
-    # lets tests exercise the pallas-under-shard_map path on CPU)
+    # full-sequence attention on 1/N of the heads. This body is built with
+    # check_vma=False (see ulysses_attention_sharded), so the pallas flash
+    # kernel ENGAGES here on TPU — O(blk*T) attention memory per device for
+    # the gathered sequence; below the kernel's dispatch thresholds (or off
+    # TPU) the same call runs the identical XLA math at O(T^2) scores memory.
+    # interpret lets tests exercise the pallas-under-shard_map path on CPU.
     og = flash_attention(qg, kg, vg, causal, interpret)
     return lax.all_to_all(og, axis_name, split_axis=1, concat_axis=2, tiled=True)
 

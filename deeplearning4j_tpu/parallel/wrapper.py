@@ -31,7 +31,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
+from deeplearning4j_tpu.jax_compat import shard_map
 
 from deeplearning4j_tpu.datasets.iterators import AsyncDataSetIterator
 from deeplearning4j_tpu.parallel.mesh import data_parallel_mesh
@@ -242,8 +242,20 @@ class ParallelWrapper:
     def _batch_spec(self, arr) -> P:
         """Leading dim over 'data'; with sequence parallelism active, the
         time axis of [B, T, ...] batches is additionally sharded over the
-        sequence axis so long sequences never materialize unsharded."""
+        sequence axis so long sequences never materialize unsharded.
+
+        Divisibility is validated HERE, at staging, so a bad sequence length
+        raises with the axis and length named instead of surfacing as an
+        opaque device_put/sharding failure deep inside jit dispatch."""
         if self.seq_axis and getattr(arr, "ndim", 0) == 3:
+            n = self.mesh.shape[self.seq_axis]
+            t = arr.shape[1]
+            if t % n:
+                raise ValueError(
+                    f"sequence_parallel('{self.seq_axis}'): sequence length "
+                    f"{t} (axis 1 of a batch shaped {tuple(arr.shape)}) is "
+                    f"not divisible by the '{self.seq_axis}' mesh axis size "
+                    f"{n}; pad or re-bucket the batch")
             return P("data", self.seq_axis)
         return P("data")
 
@@ -328,7 +340,12 @@ class ParallelWrapper:
                 return base(params, states, upd, x, y, rng, it)
 
         # batch in_shardings are left to the staged arrays' committed
-        # shardings (_stage picks P('data') or P('data', seq_axis) per rank)
+        # shardings (_stage picks P('data') or P('data', seq_axis) per rank).
+        # The cross-replica gradient psum GSPMD inserts for the sharded-batch
+        # mean loss inherits the cotangent dtype: under a grad_accum_dtype
+        # policy the weight-grad contractions emit wide (f32) cotangents
+        # (preferred_element_type routing in the layers), so the DP reduce
+        # itself accumulates wide — no extra plumbing needed here.
         upd_sh = self._upd_shardings(repl)
         par_sh = self._param_shardings(repl)
         return jax.jit(
@@ -516,8 +533,16 @@ class ParallelWrapper:
         ))
 
         def average(params, upd, states):
-            mean_bcast = lambda a: jnp.broadcast_to(
-                jnp.mean(a, axis=0, keepdims=True), a.shape)
+            from deeplearning4j_tpu import common
+
+            def mean_bcast(a):
+                # cross-replica averaging follows the policy's grad-accum
+                # dtype when that widens the leaf (bf16 replicas average in
+                # f32); already-wide leaves average in their own dtype
+                wide = common.accum_dtype(a.dtype)
+                m = jnp.mean(a.astype(wide) if wide is not None else a,
+                             axis=0, keepdims=True)
+                return jnp.broadcast_to(m.astype(a.dtype), a.shape)
             avg = jax.tree_util.tree_map(mean_bcast, params)
             if self.average_updaters:
                 upd = jax.tree_util.tree_map(mean_bcast, upd)

@@ -39,20 +39,45 @@ def _async_checkpointer():
     return ocp.AsyncCheckpointer(ocp.PyTreeCheckpointHandler())
 
 
-def _write_sidecar(directory: str, net, step: Optional[int]) -> None:
+def _snapshot_sidecar(net, step: Optional[int]) -> dict:
+    """Capture the sidecar payload at save() time — the async writer flushes
+    it later, by which point ``net`` may have trained further."""
+    return {"config": net.conf.to_json(),
+            "meta": {"iteration": int(getattr(net, "iteration", 0)),
+                     "epoch": int(getattr(net, "epoch", 0)),
+                     "step": step,
+                     "network_type": type(net).__name__}}
+
+
+def _write_sidecar_payload(directory: str, payload: dict) -> None:
     """Config + bookkeeping JSON beside the array state — the ONE writer
-    shared by sync and async saves so the schema can never diverge.
-    (Tiny host-side files; process 0 writes.)"""
+    shared by sync and async saves so the schema can never diverge. The
+    sidecar doubles as the COMMIT MARKER: restore_sharded refuses array
+    state that lacks it, so it must only be written once the array state is
+    known to be on disk. (Tiny host-side files; process 0 writes.)"""
     if jax.process_index() != 0:
         return
     os.makedirs(directory, exist_ok=True)
     with open(os.path.join(directory, _CONFIG_FILE), "w") as f:
-        f.write(net.conf.to_json())
+        f.write(payload["config"])
     with open(os.path.join(directory, _META_FILE), "w") as f:
-        json.dump({"iteration": int(getattr(net, "iteration", 0)),
-                   "epoch": int(getattr(net, "epoch", 0)),
-                   "step": step,
-                   "network_type": type(net).__name__}, f)
+        json.dump(payload["meta"], f)
+
+
+def _write_sidecar(directory: str, net, step: Optional[int]) -> None:
+    _write_sidecar_payload(directory, _snapshot_sidecar(net, step))
+
+
+def _uncommit_sidecar(directory: str) -> None:
+    """Remove a previous save's commit marker before new array state starts
+    writing, so a crash mid-write can't leave a stale sidecar endorsing a
+    half-written state directory."""
+    if jax.process_index() != 0:
+        return
+    for name in (_CONFIG_FILE, _META_FILE):
+        path = os.path.join(directory, name)
+        if os.path.exists(path):
+            os.remove(path)
 
 
 def _clear_state_dir(directory: str) -> None:
@@ -91,25 +116,48 @@ class AsyncShardedSaver:
     to land (orbax AsyncCheckpointer semantics), and ``wait()`` must be
     called (or the object used as a context manager) before reading the
     checkpoint or exiting the process.
+
+    Commit ordering: the config/meta sidecar is the checkpoint's commit
+    marker, so it is written only AFTER ``wait_until_finished`` confirms the
+    background array write landed — never alongside the in-flight write. A
+    crash mid-save therefore leaves a state directory without a sidecar,
+    which ``restore_sharded`` rejects as incomplete instead of restoring a
+    torn checkpoint. The payload is still snapshotted at ``save()`` time, so
+    the committed iteration/epoch match the arrays, not whatever the net
+    trained on to while the write was in flight.
     """
 
     def __init__(self):
         self._ckpt = _async_checkpointer()
+        self._pending: Optional[tuple[str, dict]] = None
 
     def save(self, directory: str, net, *, step: Optional[int] = None) -> str:
         directory = os.path.abspath(directory)
-        # rolling saves to one dir: wait out any in-flight write, then clear
-        # the previous state (orbax refuses to overwrite)
+        # rolling saves to one dir: wait out any in-flight write (committing
+        # its sidecar), then clear the previous state (orbax refuses to
+        # overwrite) and uncommit so no stale sidecar endorses the new
+        # partially-written state
         self._ckpt.wait_until_finished()
+        self._flush_pending()
         _clear_state_dir(directory)
+        _uncommit_sidecar(directory)
         tree = {_PARAMS: net.params_list, _STATES: net.state_list,
                 _UPDATER: net.updater_state}
         self._ckpt.save(os.path.join(directory, "state"), tree)
-        _write_sidecar(directory, net, step)
+        self._pending = (directory, _snapshot_sidecar(net, step))
         return directory
+
+    def _flush_pending(self) -> None:
+        """Commit the sidecar for a landed write (call only after
+        ``wait_until_finished``)."""
+        if self._pending is not None:
+            pending_dir, payload = self._pending
+            self._pending = None
+            _write_sidecar_payload(pending_dir, payload)
 
     def wait(self) -> None:
         self._ckpt.wait_until_finished()
+        self._flush_pending()
 
     def close(self) -> None:
         self.wait()
@@ -134,6 +182,15 @@ def restore_sharded(directory: str, net=None, *, shardings=None):
     import orbax.checkpoint as ocp
 
     directory = os.path.abspath(directory)
+    # the sidecar is the commit marker (written only after the array write
+    # landed — AsyncShardedSaver docstring): array state without it means a
+    # save crashed mid-write and the checkpoint must not be trusted
+    if (os.path.exists(os.path.join(directory, "state"))
+            and not os.path.exists(os.path.join(directory, _META_FILE))):
+        raise RuntimeError(
+            f"checkpoint at {directory} has array state but no committed "
+            f"sidecar ({_META_FILE}); an async save likely crashed before "
+            "wait()/close() — refusing to restore an incomplete checkpoint")
     if net is None:
         with open(os.path.join(directory, _CONFIG_FILE)) as f:
             net = _net_from_config(f.read(), directory)

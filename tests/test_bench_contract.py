@@ -109,3 +109,61 @@ def test_tile_sweep_isolates_failures_and_picks_best():
     assert out["best_tiles"] == "128x512"  # smallest bq/bk ratio timed
     assert out["tile_sweep_ms"]["256x128"].startswith("error:")
     assert len(calls) == 6  # every config visited despite the failure
+
+
+def test_reduction_dtype_config_resolution():
+    """--reduction-dtype resolution and bench_log config matching: explicit
+    flag wins; bf16-act defaults to bf16 statistics (round 6); every other
+    mode defaults to f32; and rows logged BEFORE the round-6 default change
+    are reinterpreted as f32 so an outage can never serve a wrong-reduction
+    number as 'the same config'."""
+    import bench
+
+    assert bench._reduction_mode("bf16_act", None) == "bf16"
+    assert bench._reduction_mode("bf16_act", "f32") == "f32"
+    assert bench._reduction_mode("bf16", None) == "f32"
+    assert bench._reduction_mode("f32", "bf16") == "bf16"
+
+    # ts after the round-6 change: bare bf16-act rows mean bf16 statistics
+    key = bench._config_key("--model resnet50 --bf16-act",
+                            ts="2026-08-06T00:00:00Z")
+    assert key["rdtype"] == "bf16"
+    # ts before the change: the same args ran at-least-f32 statistics
+    key = bench._config_key("--model resnet50 --bf16-act",
+                            ts="2026-08-01T00:00:00Z")
+    assert key["rdtype"] == "f32"
+    # an explicit flag is authoritative regardless of age
+    key = bench._config_key("--model resnet50 --bf16-act "
+                            "--reduction-dtype f32",
+                            ts="2026-08-06T00:00:00Z")
+    assert key["rdtype"] == "f32"
+    # the two reduction modes are DIFFERENT configs for outage matching
+    a = bench._config_key("--model resnet50 --bf16-act")
+    b = bench._config_key("--model resnet50 --bf16-act --reduction-dtype f32")
+    assert a != b
+
+
+def test_bench_reduction_dtype_flag_end_to_end(tmp_path):
+    """bench.py --reduction-dtype runs the flagship recipe clean on CPU and
+    stamps the resolved reduction mode into the record (the BASELINE.md
+    provenance requirement: every number names its reduction policy)."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    import bench
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="")
+    cmd = [sys.executable, os.path.join(os.path.dirname(bench.__file__),
+                                        "bench.py"),
+           "--model", "lenet", "--batch", "8", "--iters", "2",
+           "--ksteps", "1", "--bf16-act", "--reduction-dtype", "bf16",
+           "--attempts", "1", "--attempt-timeout", "180"]
+    proc = subprocess.run(cmd, capture_output=True, text=True, timeout=200,
+                          env=env)
+    rec = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert "error" not in rec, rec
+    assert rec["value"] > 0
+    assert rec["detail"]["dtype"] == "bf16_act"
+    assert rec["detail"]["reduction_dtype"] == "bf16"

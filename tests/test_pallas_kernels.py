@@ -225,7 +225,7 @@ def test_fused_xent_falls_back_under_shard_map():
     import jax.numpy as jnp
     import numpy as np
     from jax.sharding import PartitionSpec as P
-    from jax import shard_map
+    from deeplearning4j_tpu.jax_compat import shard_map
 
     from deeplearning4j_tpu.ops import losses
     from deeplearning4j_tpu.parallel.mesh import build_mesh
@@ -261,7 +261,7 @@ def test_flash_attention_falls_back_under_checked_shard_map():
     import jax
     import jax.numpy as jnp
     import numpy as np
-    from jax import shard_map
+    from deeplearning4j_tpu.jax_compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     from deeplearning4j_tpu.ops import pallas_kernels as pk
@@ -369,3 +369,61 @@ def test_min_seq_gates_pallas_dispatch(monkeypatch):
     assert pk._pallas_bwd_enabled(4096)
     monkeypatch.setenv("DL4J_FLASH_PALLAS_BWD", "1")
     assert pk._pallas_bwd_enabled(64)                     # explicit override
+
+
+def test_force_pallas_bypasses_length_gate_not_hard_constraints(monkeypatch):
+    """force_pallas is the per-call opt-in for workloads whose measured
+    crossover differs from _MIN_SEQ: it must bypass the length heuristic on
+    both flash and masked entry points, and must NEVER override the
+    vma-checked shard_map guard (pallas_call is rejected there outright)."""
+    from jax.sharding import PartitionSpec as P
+
+    from deeplearning4j_tpu.jax_compat import shard_map
+    from deeplearning4j_tpu.ops import pallas_kernels as pk
+    from deeplearning4j_tpu.parallel.mesh import build_mesh
+
+    rng = np.random.default_rng(0)
+    # T=64: tileable, but far below _MIN_SEQ (1024)
+    q, k, v = (jnp.asarray(rng.normal(size=(4, 64, 2, 8)).astype(np.float32))
+               for _ in range(3))
+
+    calls = []
+
+    def fake_forward(qq, kk, vv, causal, interpret=False, key_mask=None):
+        calls.append(1)
+        if key_mask is not None:
+            return pk._masked_attention_xla(qq, kk, vv, key_mask, causal), None
+        return pk._attention_xla(qq, kk, vv, causal), None
+
+    # pretend the TPU kernel path is available so the length heuristic (not
+    # hardware support) is what decides
+    monkeypatch.setattr(pk, "use_pallas", lambda: True)
+    monkeypatch.setattr(pk, "_flash_forward", fake_forward)
+
+    out = pk.flash_attention(q, k, v, False)
+    assert not calls, "short sequence must stay on the XLA path by default"
+    forced = pk.flash_attention(q, k, v, False, force_pallas=True)
+    assert calls, "force_pallas did not bypass the _MIN_SEQ gate"
+    np.testing.assert_allclose(np.asarray(forced), np.asarray(out),
+                               rtol=1e-5, atol=1e-6)
+
+    # masked entry point shares the one dispatch predicate
+    km = jnp.ones((4, 64), jnp.float32)
+    calls.clear()
+    pk.masked_attention(q, k, v, km, False)
+    assert not calls
+    pk.masked_attention(q, k, v, km, False, force_pallas=True)
+    assert calls
+
+    # hard constraint wins over force: inside a CHECKED shard_map the kernel
+    # must still fall back (engaging would crash on the vma checker, not
+    # merely run slow)
+    mesh = build_mesh({"data": 4})
+    calls.clear()
+    got = jax.jit(shard_map(
+        lambda a, b, c: pk.flash_attention(a, b, c, False, force_pallas=True),
+        mesh=mesh, in_specs=(P("data"), P("data"), P("data")),
+        out_specs=P("data")))(q, k, v)
+    assert not calls, "force_pallas must not override the checked-shard_map guard"
+    np.testing.assert_allclose(np.asarray(got), np.asarray(out),
+                               rtol=1e-5, atol=1e-6)

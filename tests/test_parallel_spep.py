@@ -305,3 +305,30 @@ def test_local_sgd_rejects_sp():
         (ParallelWrapper.builder(net)
          .mesh(build_mesh({"data": 2, "sp": 4}))
          .averaging_frequency(4).sequence_parallel("sp").build())
+
+
+def test_sequence_parallel_rejects_indivisible_sequence_length():
+    """A batch whose time axis doesn't divide the sequence mesh axis must
+    fail at staging with the axis and length NAMED — not as an opaque
+    device_put/sharding error deep inside jit dispatch."""
+    conf = transformer_lm(VOCAB, width=WIDTH, n_layers=1, n_heads=HEADS,
+                          max_len=32)
+    net = MultiLayerNetwork(conf).init()
+    mesh = build_mesh({"data": 2, "sp": 4})
+    pw = (ParallelWrapper.builder(net)
+          .mesh(mesh).prefetch_buffer(0)
+          .sequence_parallel("sp")
+          .build())
+
+    # divisible lengths stage with the [data, sp] spec
+    from jax.sharding import PartitionSpec as P
+    good = np.zeros((8, 16, VOCAB), np.float32)
+    assert pw._batch_spec(good) == P("data", "sp")
+
+    bad = np.zeros((8, 18, VOCAB), np.float32)  # 18 % 4 != 0
+    with pytest.raises(ValueError) as ei:
+        pw._batch_spec(bad)
+    msg = str(ei.value)
+    assert "'sp'" in msg and "18" in msg and "4" in msg
+    # 2-D batches (no time axis) are untouched by the validation
+    assert pw._batch_spec(np.zeros((8, 5), np.float32)) == P("data")

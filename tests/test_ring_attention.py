@@ -93,3 +93,33 @@ def test_ulysses_pallas_interpret_matches_reference():
     want = attention_reference(q, k, v, causal=True)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=2e-4, atol=2e-5)
+
+
+def test_ulysses_flash_kernel_engages_in_sharded_body(monkeypatch):
+    """The equivalence test above can pass even if dispatch silently routes
+    to the O(T^2) XLA fallback (both paths compute the same math). This pins
+    ENGAGEMENT: inside ulysses' check_vma=False shard_map body, _pallas_ok
+    must accept and the flash kernel must actually be entered."""
+    from deeplearning4j_tpu.ops import pallas_kernels as pk
+    from deeplearning4j_tpu.parallel.mesh import build_mesh
+
+    calls = []
+    real = pk._flash_forward
+
+    def counting(*args, **kwargs):
+        calls.append(1)
+        return real(*args, **kwargs)
+
+    monkeypatch.setattr(pk, "_flash_forward", counting)
+
+    n = 4
+    mesh = build_mesh({"sp": n})
+    rng = np.random.default_rng(1)
+    q, k, v = (jnp.asarray(rng.normal(size=(2, 16 * n, n, 8))
+                           .astype(np.float32)) for _ in range(3))
+    got = ulysses_attention(q, k, v, mesh, causal=True, interpret=True)
+    assert calls, ("flash kernel never engaged inside the ulysses shard_map "
+                   "body — dispatch regressed to the XLA fallback")
+    want = attention_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-4, atol=2e-5)

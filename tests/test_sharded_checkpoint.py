@@ -157,3 +157,78 @@ def test_rolling_saves_to_one_directory(tmp_path):
         net.fit(x, y)
         saver.save(d, net)        # rolling async save
     assert restore_sharded(d).iteration == net.iteration
+
+
+def test_async_sidecar_commits_only_after_wait(tmp_path):
+    """The config/meta sidecar is the checkpoint's COMMIT MARKER: it must
+    not exist while the background array write is (possibly still) in
+    flight, and must appear once wait() confirms the write landed — so a
+    crash mid-save can never leave a sidecar endorsing torn array state."""
+    import os
+
+    from deeplearning4j_tpu.utils.sharded_checkpoint import (
+        AsyncShardedSaver, restore_sharded)
+
+    net, x, _ = _trained_net()
+    d = str(tmp_path / "commit_ck")
+    with AsyncShardedSaver() as saver:
+        saver.save(d, net)
+        assert not os.path.exists(os.path.join(d, "meta.json"))
+        assert not os.path.exists(os.path.join(d, "config.json"))
+        saver.wait()
+        assert os.path.exists(os.path.join(d, "meta.json"))
+        assert os.path.exists(os.path.join(d, "config.json"))
+
+    restored = restore_sharded(d)
+    assert restored.iteration == net.iteration
+    np.testing.assert_allclose(np.asarray(restored.output(x)),
+                               np.asarray(net.output(x)), rtol=1e-6)
+
+
+def test_async_rolling_save_commits_previous_directory(tmp_path):
+    """A second save() first waits out the in-flight write and commits ITS
+    sidecar — rolling saves across directories leave every completed
+    checkpoint committed, with the snapshot taken at save() time (the
+    committed iteration matches the arrays, not later training)."""
+    import json
+    import os
+
+    from deeplearning4j_tpu.utils.sharded_checkpoint import AsyncShardedSaver
+
+    net, x, y = _trained_net()
+    d1 = str(tmp_path / "ck1")
+    d2 = str(tmp_path / "ck2")
+    with AsyncShardedSaver() as saver:
+        saver.save(d1, net)
+        it1 = int(net.iteration)
+        net.fit(x, y)  # train on while the write is in flight
+        saver.save(d2, net)
+        # the first checkpoint must now be fully committed...
+        assert os.path.exists(os.path.join(d1, "meta.json"))
+        # ...with the iteration captured at ITS save() time
+        with open(os.path.join(d1, "meta.json")) as f:
+            assert json.load(f)["iteration"] == it1
+        # the second is still uncommitted until wait()
+        assert not os.path.exists(os.path.join(d2, "meta.json"))
+    assert os.path.exists(os.path.join(d2, "meta.json"))
+
+
+def test_restore_refuses_uncommitted_checkpoint(tmp_path):
+    """Array state without the sidecar == a save that crashed before
+    wait()/close(): restore must refuse loudly instead of resurrecting a
+    torn checkpoint."""
+    import os
+
+    import pytest as _pytest
+
+    from deeplearning4j_tpu.utils.sharded_checkpoint import (
+        restore_sharded, save_sharded)
+
+    net, _, _ = _trained_net()
+    d = str(tmp_path / "torn_ck")
+    save_sharded(d, net)
+    # simulate the crash window: arrays landed, commit marker never written
+    os.remove(os.path.join(d, "meta.json"))
+    os.remove(os.path.join(d, "config.json"))
+    with _pytest.raises(RuntimeError, match="no committed sidecar"):
+        restore_sharded(d)
