@@ -634,6 +634,15 @@ def _child_main(args) -> None:
     r["backend"] = jax.default_backend()
     r["dtype"] = mode
     r["reduction_dtype"] = rmode
+    if args.telemetry_out:
+        # registry snapshot goes to a FILE beside the headline JSON — stdout
+        # carries exactly one JSON line (the parent's parse contract)
+        from deeplearning4j_tpu.observability import (global_registry,
+                                                      global_tracker)
+        global_registry().write_jsonl(
+            args.telemetry_out, source="bench",
+            model=args.model, dtype=mode, reduction_dtype=rmode,
+            compile_events=global_tracker().snapshot_events())
     print(json.dumps({
         "metric": _METRICS[args.model],
         "value": round(r["samples_per_sec"], 2),
@@ -690,6 +699,10 @@ def main() -> None:
                          "preferred_element_type), f32 everywhere else. "
                          "'f32' restores the classic at-least-f32 statistics "
                          "on the bf16-act path")
+    ap.add_argument("--telemetry-out", default=None,
+                    help="append a metrics-registry snapshot (JSONL) to this "
+                         "file beside the headline JSON; measurement-only — "
+                         "ignored for bench_log config matching")
     ap.add_argument("--child", action="store_true", help=argparse.SUPPRESS)
     # worst case must finish inside the harness's own command timeout
     # (round-1 artifacts show it kills at ~600s): 2 x 240s + 5s backoff < 500s

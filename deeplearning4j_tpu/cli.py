@@ -88,6 +88,13 @@ def _cmd_parallel_train(args) -> int:
     if args.output:
         write_model(net, args.output)
         print(f"trained model written to {args.output}")
+    if args.telemetry_out:
+        from deeplearning4j_tpu.observability import (global_registry,
+                                                      global_tracker)
+        global_registry().write_jsonl(
+            args.telemetry_out, source="cli.parallel-train",
+            compile_events=global_tracker().snapshot_events())
+        print(f"telemetry snapshot appended to {args.telemetry_out}")
     print(f"final score: {net.score_value}")
     return 0
 
@@ -141,6 +148,9 @@ def build_parser() -> argparse.ArgumentParser:
                     help="GPipe pipeline over the model's homogeneous "
                          "block stack (stages = --workers or all devices)")
     tr.add_argument("--microbatches", type=int, default=4)
+    tr.add_argument("--telemetry-out", default=None, metavar="PATH",
+                    help="append a metrics-registry snapshot (JSONL, incl. "
+                         "compile events) to PATH after training")
     tr.set_defaults(fn=_cmd_parallel_train)
 
     ks = sub.add_parser("keras-server", help="start the Keras gateway")

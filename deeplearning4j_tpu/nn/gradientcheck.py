@@ -11,6 +11,7 @@ float32 finite differences are too noisy for 1e-6-level checks.
 """
 from __future__ import annotations
 
+import logging
 from typing import Optional
 
 import jax
@@ -19,6 +20,8 @@ import numpy as np
 
 from deeplearning4j_tpu import jax_compat
 from deeplearning4j_tpu.utils.pytree import flatten_params, unflatten_params
+
+log = logging.getLogger(__name__)
 
 
 def check_gradients(net, x, y, *, eps: float = 1e-6, max_rel_error: float = 1e-3,
@@ -153,13 +156,13 @@ def _fd_check_subtree(score, params_subtree, *, eps, max_rel_error,
         if rel > max_rel_error and abs(numeric - a) > min_abs_error:
             fails += 1
             if verbose:
-                print(f"param {i}: analytic={a:.8g} "
-                      f"numeric={numeric:.8g} rel={rel:.3g}")
+                log.info("param %d: analytic=%.8g numeric=%.8g rel=%.3g",
+                         i, a, numeric, rel)
         max_err = max(max_err,
                       rel if abs(numeric - a) > min_abs_error else 0.0)
     if verbose:
-        print(f"{tag} gradient check: {len(indices)} params, "
-              f"max rel err {max_err:.3g}, {fails} failures")
+        log.info("%s gradient check: %d params, max rel err %.3g, "
+                 "%d failures", tag, len(indices), max_err, fails)
     return fails == 0
 
 
@@ -207,9 +210,10 @@ def _check_gradients_x64(net, x, y, *, eps, max_rel_error, min_abs_error, subset
             if rel > max_rel_error and abs(numeric - a) > min_abs_error:
                 fails += 1
                 if verbose:
-                    print(f"param {i}: analytic={a:.8g} numeric={numeric:.8g} rel={rel:.3g}")
+                    log.info("param %d: analytic=%.8g numeric=%.8g "
+                             "rel=%.3g", i, a, numeric, rel)
             max_err = max(max_err, rel if abs(numeric - a) > min_abs_error else 0.0)
         if verbose:
-            print(f"gradient check: {len(indices)} params, max rel err {max_err:.3g}, "
-                  f"{fails} failures")
+            log.info("gradient check: %d params, max rel err %.3g, "
+                     "%d failures", len(indices), max_err, fails)
         return fails == 0

@@ -21,7 +21,12 @@ import numpy as np
 from deeplearning4j_tpu import common
 from deeplearning4j_tpu.nn.conf.graphconf import ComputationGraphConfiguration
 from deeplearning4j_tpu.nn.conf.vertices import LayerVertex
-from deeplearning4j_tpu.nn.multilayer import LazyScore, _updater_spec
+from deeplearning4j_tpu.nn.multilayer import (
+    LazyScore, _updater_spec, _t_staging, _t_dispatch, _t_listeners,
+)
+from deeplearning4j_tpu.observability.compile_tracker import (
+    global_tracker as _compile_tracker,
+)
 from deeplearning4j_tpu.nn.updaters import (
     effective_lr, grads_to_param_dtype, normalize_gradients, updater_init,
     updater_step_with_param,
@@ -541,8 +546,10 @@ class ComputationGraph(LazyScore):
         dispatch (see MultiLayerNetwork._fit_repeated)."""
         from deeplearning4j_tpu.nn.multilayer import _stage_host
 
-        xd = [jnp.asarray(_stage_host(a, self.stage_dtype)) for a in xs]
-        yd = [jnp.asarray(a) for a in ys]
+        with _t_staging.time():
+            xd = [jnp.asarray(_stage_host(a, self.stage_dtype)) for a in xs]
+            yd = [jnp.asarray(a) for a in ys]
+        self.last_batch_size = int(xd[0].shape[0]) if xd and xd[0].ndim else 0
         multi = self._jit("multistep",
                           make_graph_multistep_train_step(self.conf),
                           donate=(0, 1, 2))
@@ -551,15 +558,18 @@ class ComputationGraph(LazyScore):
             k = min(self.dispatch_ksteps, remaining)
             xk = [jnp.broadcast_to(a[None], (k,) + a.shape) for a in xd]
             yk = [jnp.broadcast_to(a[None], (k,) + a.shape) for a in yd]
-            (self.params_list, self.state_list, self.updater_state,
-             losses) = multi(self.params_list, self.state_list,
-                             self.updater_state, xk, yk, self._next_rng(),
-                             jnp.int32(self.iteration))
-            for i in range(k):
-                self.iteration += 1
-                self.score_value = (lambda ls=losses, j=i: ls[j])
-                for listener in self.listeners:
-                    listener.iteration_done(self, self.iteration)
+            with _t_dispatch.time():
+                (self.params_list, self.state_list, self.updater_state,
+                 losses) = multi(self.params_list, self.state_list,
+                                 self.updater_state, xk, yk, self._next_rng(),
+                                 jnp.int32(self.iteration))
+            _compile_tracker().note_step(k)
+            with _t_listeners.time():
+                for i in range(k):
+                    self.iteration += 1
+                    self.score_value = (lambda ls=losses, j=i: ls[j])
+                    for listener in self.listeners:
+                        listener.iteration_done(self, self.iteration)
             remaining -= k
 
     #: train steps fused per host dispatch in fit_iterator (see
@@ -625,24 +635,30 @@ class ComputationGraph(LazyScore):
 
         from deeplearning4j_tpu.nn.multilayer import _stage_host
 
-        xs = [jnp.asarray(_stage_host(np.stack([b[0][i] for b in batches]),
-                                      self.stage_dtype))
-              for i in range(n_in)]
-        ys = [jnp.asarray(np.stack([b[1][i] for b in batches]))
-              for i in range(n_out)]
+        with _t_staging.time():
+            xs = [jnp.asarray(_stage_host(np.stack([b[0][i] for b in batches]),
+                                          self.stage_dtype))
+                  for i in range(n_in)]
+            ys = [jnp.asarray(np.stack([b[1][i] for b in batches]))
+                  for i in range(n_out)]
+        self.last_batch_size = int(xs[0].shape[1]) if xs else 0
         # donated params/states/updater: in-place XLA update (see
         # MultiLayerNetwork._dispatch_multistep)
         multi = self._jit("multistep",
                           make_graph_multistep_train_step(self.conf),
                           donate=(0, 1, 2))
-        (self.params_list, self.state_list, self.updater_state, losses) = multi(
-            self.params_list, self.state_list, self.updater_state, xs, ys,
-            self._next_rng(), jnp.int32(self.iteration))
-        for i in range(len(batches)):
-            self.iteration += 1
-            self.score_value = (lambda ls=losses, j=i: ls[j])
-            for listener in self.listeners:
-                listener.iteration_done(self, self.iteration)
+        with _t_dispatch.time():
+            (self.params_list, self.state_list, self.updater_state,
+             losses) = multi(
+                self.params_list, self.state_list, self.updater_state, xs, ys,
+                self._next_rng(), jnp.int32(self.iteration))
+        _compile_tracker().note_step(len(batches))
+        with _t_listeners.time():
+            for i in range(len(batches)):
+                self.iteration += 1
+                self.score_value = (lambda ls=losses, j=i: ls[j])
+                for listener in self.listeners:
+                    listener.iteration_done(self, self.iteration)
 
     #: Solver facade instance when optimization_algo != SGD (built lazily)
     _solver = None
@@ -669,20 +685,25 @@ class ComputationGraph(LazyScore):
         if self._tbptt_active():
             self._fit_tbptt(xs, ys, fmasks, lmasks)
             return
-        xs = [jnp.asarray(x) for x in xs]
-        ys = [jnp.asarray(y) for y in ys]
-        fmasks = [jnp.asarray(m) for m in fmasks] if fmasks else None
-        lmasks = [jnp.asarray(m) for m in lmasks] if lmasks else None
+        with _t_staging.time():
+            xs = [jnp.asarray(x) for x in xs]
+            ys = [jnp.asarray(y) for y in ys]
+            fmasks = [jnp.asarray(m) for m in fmasks] if fmasks else None
+            lmasks = [jnp.asarray(m) for m in lmasks] if lmasks else None
+        self.last_batch_size = int(xs[0].shape[0]) if xs and xs[0].ndim else 0
         step = self._jit("train_step", make_graph_train_step(self.conf))
         for _ in range(max(1, self.conf.global_conf.iterations)):
-            (self.params_list, self.state_list, self.updater_state,
-             loss) = step(self.params_list, self.state_list, self.updater_state,
-                          xs, ys, self._next_rng(), jnp.int32(self.iteration),
-                          fmasks, lmasks)
+            with _t_dispatch.time():
+                (self.params_list, self.state_list, self.updater_state,
+                 loss) = step(self.params_list, self.state_list,
+                              self.updater_state, xs, ys, self._next_rng(),
+                              jnp.int32(self.iteration), fmasks, lmasks)
+            _compile_tracker().note_step()
             self.score_value = loss  # device scalar; synced lazily (LazyScore)
             self.iteration += 1
-            for listener in self.listeners:
-                listener.iteration_done(self, self.iteration)
+            with _t_listeners.time():
+                for listener in self.listeners:
+                    listener.iteration_done(self, self.iteration)
 
     # ------------------------------------------------------------------ pretrain
     def pretrain(self, iterator) -> None:

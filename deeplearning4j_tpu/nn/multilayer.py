@@ -26,6 +26,12 @@ import jax.numpy as jnp
 import numpy as np
 
 from deeplearning4j_tpu import common
+from deeplearning4j_tpu.observability.compile_tracker import (
+    global_tracker as _compile_tracker,
+)
+from deeplearning4j_tpu.observability.metrics import (
+    global_registry as _obs_registry,
+)
 from deeplearning4j_tpu.nn.conf.multilayer import MultiLayerConfiguration
 from deeplearning4j_tpu.nn.conf.layers.base import PretrainLayer
 from deeplearning4j_tpu.nn.conf.layers.recurrent import LSTM
@@ -36,6 +42,17 @@ from deeplearning4j_tpu.nn.updaters import (
 from deeplearning4j_tpu.utils.pytree import flatten_params, num_params, unflatten_params
 
 Array = jax.Array
+
+# step-time attribution series (resolved once — per-step cost is two
+# perf_counter reads and one locked float add per phase; budget pinned by
+# tests/test_bench_contract.py::test_telemetry_overhead_budget)
+_phase_hist = _obs_registry().histogram(
+    "dl4j_fit_phase_seconds",
+    "host wall seconds per fit-loop phase (staging: host cast+transfer "
+    "submit; dispatch: jitted-call submit; listeners: callback overhead)")
+_t_staging = _phase_hist.labels(phase="staging")
+_t_dispatch = _phase_hist.labels(phase="dispatch")
+_t_listeners = _phase_hist.labels(phase="listeners")
 
 
 def _updater_spec(layer) -> UpdaterSpec:
@@ -253,6 +270,11 @@ class LazyScore:
 
     _score_raw = float("nan")
 
+    #: batch size of the most recently fitted minibatch — set by every fit
+    #: path on both network types; PerformanceListener reads it to compute
+    #: samples/sec (the reference tracks it on the DataSet instead)
+    last_batch_size: int = 0
+
     @property
     def score_value(self) -> float:
         raw = self._score_raw
@@ -299,8 +321,15 @@ class LazyScore:
             # without bound (each entry pins a compiled XLA program)
             for stale in [k for k in self._jit_cache if k[1:] != pol]:
                 del self._jit_cache[stale]
-            self._jit_cache[key] = (jax.jit(fn, donate_argnums=donate)
-                                    if donate else jax.jit(fn))
+            jitted = (jax.jit(fn, donate_argnums=donate)
+                      if donate else jax.jit(fn))
+            # every cache miss is a (future) compile: the tracker wraps the
+            # fresh jit so its first call per abstract signature is timed and
+            # recorded. A dtype-policy flip re-keys this cache, lands here
+            # again, and thus counts as a new compile of the same name —
+            # which is what the recompile-storm detector watches.
+            self._jit_cache[key] = _compile_tracker().wrap(
+                f"{type(self).__name__}.{name}", jitted, cache_key=key)
         return self._jit_cache[key]
 
 
@@ -521,8 +550,10 @@ class MultiLayerNetwork(LazyScore):
         """``epochs`` repeated steps on one device-resident batch, K per
         dispatch via the scanned train step (broadcast along the scan axis —
         XLA reads the same HBM buffer each step, no K-fold staging)."""
-        xd = jnp.asarray(_stage_host(x, self.stage_dtype))
-        yd = jnp.asarray(y)
+        with _t_staging.time():
+            xd = jnp.asarray(_stage_host(x, self.stage_dtype))
+            yd = jnp.asarray(y)
+        self.last_batch_size = int(np.shape(x)[0]) if np.ndim(x) else 0
         multi = self._jit("multistep", make_multistep_train_step(self.conf),
                           donate=(0, 1, 2))
         remaining = epochs
@@ -530,15 +561,18 @@ class MultiLayerNetwork(LazyScore):
             k = min(self.dispatch_ksteps, remaining)
             xs = jnp.broadcast_to(xd[None], (k,) + xd.shape)
             ys = jnp.broadcast_to(yd[None], (k,) + yd.shape)
-            (self.params_list, self.state_list, self.updater_state,
-             losses) = multi(self.params_list, self.state_list,
-                             self.updater_state, xs, ys, self._next_rng(),
-                             jnp.int32(self.iteration))
-            for i in range(k):
-                self.iteration += 1
-                self.score_value = (lambda ls=losses, j=i: ls[j])
-                for listener in self.listeners:
-                    listener.iteration_done(self, self.iteration)
+            with _t_dispatch.time():
+                (self.params_list, self.state_list, self.updater_state,
+                 losses) = multi(self.params_list, self.state_list,
+                                 self.updater_state, xs, ys, self._next_rng(),
+                                 jnp.int32(self.iteration))
+            _compile_tracker().note_step(k)
+            with _t_listeners.time():
+                for i in range(k):
+                    self.iteration += 1
+                    self.score_value = (lambda ls=losses, j=i: ls[j])
+                    for listener in self.listeners:
+                        listener.iteration_done(self, self.iteration)
             remaining -= k
 
     #: train steps fused per host dispatch in fit_iterator (lax.scan); 1
@@ -615,9 +649,11 @@ class MultiLayerNetwork(LazyScore):
         if len(batches) == 1:
             self._fit_batch(batches[0][0], batches[0][1])
             return
-        xs = jnp.asarray(_stage_host(np.stack([b[0] for b in batches]),
-                                     self.stage_dtype))
-        ys = jnp.asarray(np.stack([b[1] for b in batches]))
+        with _t_staging.time():
+            xs = jnp.asarray(_stage_host(np.stack([b[0] for b in batches]),
+                                         self.stage_dtype))
+            ys = jnp.asarray(np.stack([b[1] for b in batches]))
+        self.last_batch_size = int(xs.shape[1])
         # params/states/updater buffers are DONATED: XLA updates them in
         # place (no 2x param HBM during the step). The previous arrays are
         # consumed — anyone holding stale references gets a loud
@@ -625,14 +661,18 @@ class MultiLayerNetwork(LazyScore):
         # copies for this reason. (Donation is a no-op on CPU.)
         multi = self._jit("multistep", make_multistep_train_step(self.conf),
                           donate=(0, 1, 2))
-        (self.params_list, self.state_list, self.updater_state, losses) = multi(
-            self.params_list, self.state_list, self.updater_state, xs, ys,
-            self._next_rng(), jnp.int32(self.iteration))
-        for i in range(len(batches)):
-            self.iteration += 1
-            self.score_value = (lambda ls=losses, j=i: ls[j])
-            for listener in self.listeners:
-                listener.iteration_done(self, self.iteration)
+        with _t_dispatch.time():
+            (self.params_list, self.state_list, self.updater_state,
+             losses) = multi(
+                self.params_list, self.state_list, self.updater_state, xs, ys,
+                self._next_rng(), jnp.int32(self.iteration))
+        _compile_tracker().note_step(len(batches))
+        with _t_listeners.time():
+            for i in range(len(batches)):
+                self.iteration += 1
+                self.score_value = (lambda ls=losses, j=i: ls[j])
+                for listener in self.listeners:
+                    listener.iteration_done(self, self.iteration)
 
     #: Solver facade instance when optimization_algo != SGD (built lazily)
     _solver = None
@@ -656,19 +696,24 @@ class MultiLayerNetwork(LazyScore):
                 and any(isinstance(l, LSTM) for l in self.conf.layers)):
             self._fit_tbptt(x, y, fmask, lmask)
             return
-        x, y = jnp.asarray(x), jnp.asarray(y)
-        fmask = jnp.asarray(fmask) if fmask is not None else None
-        lmask = jnp.asarray(lmask) if lmask is not None else None
+        with _t_staging.time():
+            x, y = jnp.asarray(x), jnp.asarray(y)
+            fmask = jnp.asarray(fmask) if fmask is not None else None
+            lmask = jnp.asarray(lmask) if lmask is not None else None
+        self.last_batch_size = int(x.shape[0]) if x.ndim else 0
         step = self._jit("train_step", make_train_step(self.conf))
         for _ in range(max(1, self.conf.global_conf.iterations)):
-            (self.params_list, self.state_list, self.updater_state,
-             loss) = step(self.params_list, self.state_list, self.updater_state,
-                          x, y, self._next_rng(), jnp.int32(self.iteration),
-                          fmask, lmask)
+            with _t_dispatch.time():
+                (self.params_list, self.state_list, self.updater_state,
+                 loss) = step(self.params_list, self.state_list,
+                              self.updater_state, x, y, self._next_rng(),
+                              jnp.int32(self.iteration), fmask, lmask)
+            _compile_tracker().note_step()
             self.score_value = loss  # device scalar; synced lazily (LazyScore)
             self.iteration += 1
-            for listener in self.listeners:
-                listener.iteration_done(self, self.iteration)
+            with _t_listeners.time():
+                for listener in self.listeners:
+                    listener.iteration_done(self, self.iteration)
 
     # ------------------------------------------------------------------ TBPTT
     def _fit_tbptt(self, x, y, fmask=None, lmask=None) -> None:
@@ -676,6 +721,7 @@ class MultiLayerNetwork(LazyScore):
         tbptt_fwd_length chunks; RNN state carries across chunks via lax.stop_gradient
         (the truncation). Time axis = 1 ([B,T,F] layout)."""
         x, y = jnp.asarray(x), jnp.asarray(y)
+        self.last_batch_size = int(x.shape[0]) if x.ndim else 0
         T = x.shape[1]
         L = self.conf.tbptt_fwd_length
         n_chunks = max(1, math.ceil(T / L))
@@ -690,6 +736,7 @@ class MultiLayerNetwork(LazyScore):
              loss) = step(self.params_list, self.state_list, self.updater_state,
                           rnn_state, xc, yc, self._next_rng(),
                           jnp.int32(self.iteration), fm, lm)
+            _compile_tracker().note_step()
             self.score_value = loss  # device scalar; synced lazily (LazyScore)
             self.iteration += 1
             for listener in self.listeners:

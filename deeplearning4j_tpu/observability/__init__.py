@@ -1,0 +1,23 @@
+"""Always-on, low-overhead telemetry for the TPU port.
+
+The reference's observability stack (listeners + StatsStorage + training UI)
+is event-push per iteration; this package adds the aggregate layer the
+TPU-native failure modes need — silent jit recompiles, host/device skew,
+HBM growth, collective traffic — exposed as Prometheus text on the UI
+server's ``/metrics`` route and as JSONL snapshots via ``--telemetry-out``.
+
+    from deeplearning4j_tpu.observability import (
+        global_registry, global_tracker, span, TelemetryListener)
+"""
+from .metrics import (MetricsRegistry, global_registry, DEFAULT_BUCKETS,
+                      tree_nbytes)
+from .compile_tracker import CompileTracker, global_tracker
+from .spans import span
+from .listener import TelemetryListener, record_hbm_gauges
+
+__all__ = [
+    "MetricsRegistry", "global_registry", "DEFAULT_BUCKETS", "tree_nbytes",
+    "CompileTracker", "global_tracker",
+    "span",
+    "TelemetryListener", "record_hbm_gauges",
+]

@@ -1,0 +1,245 @@
+"""Compile/retrace tracker for the framework's jit seams.
+
+JAX recompiles silently: a `DtypePolicy` flip, a stray Python-float hparam,
+or an unpadded final batch each mint a new executable, and the only symptom
+is a step that takes seconds instead of milliseconds. The reference never had
+this failure mode (ND4J ops are eager), so its listener pipeline has no slot
+for it. This tracker closes the gap: every policy-keyed cache miss in
+``LazyScore._jit`` (multilayer + graph networks), every parallel-wrapper /
+training-master / pipeline-trainer program build, goes through ``wrap()``,
+which records the compile — cache key, wall time, triggering abstract
+shapes, active dtype-policy key — and raises a rate-limited warning when the
+same function recompiles often enough to look like a retrace storm.
+
+Two timing sources are recorded when available:
+
+* **wall**: ``perf_counter`` around the first call for a new abstract
+  signature — dispatch + trace + lower + compile as the user experiences it.
+* **backend**: ``jax.monitoring`` duration events whose key mentions
+  compile/lowering, attributed to whichever tracked call is active on this
+  thread. This isolates genuine XLA compile time from tracing overhead.
+
+Steps are counted by the fit loops calling ``note_step()``; the storm window
+is measured in those steps so the warning threshold reads as "N compiles of
+one function within M training steps" regardless of dispatch fusion.
+"""
+from __future__ import annotations
+
+import logging
+import threading
+from collections import deque
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import numpy as np
+
+from .metrics import global_registry
+
+log = logging.getLogger(__name__)
+
+#: storm defaults: >= STORM_THRESHOLD compiles of one function within
+#: STORM_WINDOW_STEPS training steps -> one warning (then suppressed for a
+#: window so a pathological loop logs once per window, not once per step)
+STORM_THRESHOLD = 3
+STORM_WINDOW_STEPS = 200
+
+_MAX_EVENTS = 1000
+
+
+def _abstract(x: Any) -> Any:
+    """Abstract one argument leaf the way jit's cache does: arrays by
+    (shape, dtype), everything else by value (static/hashable) or type."""
+    if hasattr(x, "shape") and hasattr(x, "dtype"):
+        return ("array", tuple(x.shape), str(x.dtype))
+    try:
+        hash(x)
+        return x
+    except TypeError:
+        return type(x).__name__
+
+
+def _signature(args: tuple, kwargs: dict) -> Tuple:
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten((args, kwargs))
+    return (tuple(_abstract(l) for l in leaves), str(treedef))
+
+
+def _policy_key() -> Tuple:
+    from deeplearning4j_tpu import common
+
+    return common.policy_key()
+
+
+class CompileTracker:
+    """Records compile events and watches for retrace storms.
+
+    One process-global instance (``global_tracker()``) is shared by every
+    seam; tests may construct private ones and lower the storm knobs.
+    """
+
+    def __init__(self, registry=None, storm_threshold: int = STORM_THRESHOLD,
+                 storm_window_steps: int = STORM_WINDOW_STEPS):
+        self._lock = threading.Lock()
+        self._registry = registry
+        self.storm_threshold = storm_threshold
+        self.storm_window_steps = storm_window_steps
+        self._step = 0
+        #: fn name -> deque of step indices at which it compiled
+        self._compile_steps: Dict[str, deque] = {}
+        #: fn name -> step of last storm warning (rate limit)
+        self._last_warned: Dict[str, int] = {}
+        self.events: deque = deque(maxlen=_MAX_EVENTS)
+        # thread-local stack of active tracked calls, so jax.monitoring
+        # compile-duration events can be attributed to the right function
+        self._active = threading.local()
+        self._monitoring_hooked = False
+
+    # ------------------------------------------------------------ registry
+    @property
+    def registry(self):
+        return self._registry if self._registry is not None else global_registry()
+
+    def _metrics(self):
+        reg = self.registry
+        return (
+            reg.counter("dl4j_jit_compile_total",
+                        "jit/pjit compiles recorded at framework seams"),
+            reg.histogram("dl4j_jit_compile_seconds",
+                          "wall time of first-call trace+lower+compile"),
+            reg.histogram("dl4j_jit_backend_compile_seconds",
+                          "backend compile time from jax.monitoring events"),
+            reg.counter("dl4j_recompile_storm_warnings_total",
+                        "rate-limited retrace-storm warnings emitted"),
+        )
+
+    # ------------------------------------------------------------ stepping
+    def note_step(self, n: int = 1) -> None:
+        """Advance the training-step clock (fit loops call this; a K-step
+        fused dispatch advances by K)."""
+        with self._lock:
+            self._step += n
+
+    @property
+    def step(self) -> int:
+        return self._step
+
+    # -------------------------------------------------- monitoring bridge
+    def _ensure_monitoring(self) -> None:
+        if self._monitoring_hooked:
+            return
+        self._monitoring_hooked = True
+        try:
+            from jax import monitoring as jmon
+
+            def _on_duration(event: str, duration: float, **kw):
+                if "compile" not in event and "lower" not in event:
+                    return
+                stack = getattr(self._active, "stack", None)
+                if not stack:
+                    return
+                name = stack[-1]
+                _, _, backend_hist, _ = self._metrics()
+                backend_hist.labels(fn=name).observe(duration)
+
+            jmon.register_event_duration_secs_listener(_on_duration)
+        except Exception:  # pragma: no cover - monitoring API moved/absent
+            pass
+
+    # ------------------------------------------------------------ tracking
+    def record_compile(self, name: str, *, cache_key: Any = None,
+                       wall_s: float = 0.0, shapes: Any = None,
+                       policy: Any = None) -> dict:
+        """Record one compile event (the wrap() path calls this; seams that
+        build executables eagerly may call it directly)."""
+        total, wall_hist, _, storm_total = self._metrics()
+        total.labels(fn=name).inc()
+        if wall_s:
+            wall_hist.labels(fn=name).observe(wall_s)
+        if policy is None:
+            try:
+                policy = _policy_key()
+            except Exception:
+                policy = None
+        with self._lock:
+            step = self._step
+            event = {"fn": name, "step": step, "wall_s": wall_s,
+                     "cache_key": repr(cache_key), "shapes": repr(shapes),
+                     "policy": repr(policy)}
+            self.events.append(event)
+            dq = self._compile_steps.setdefault(
+                name, deque(maxlen=max(64, self.storm_threshold * 4)))
+            dq.append(step)
+            lo = step - self.storm_window_steps
+            recent = sum(1 for s in dq if s >= lo)
+            warned = self._last_warned.get(name)
+            storm = (recent >= self.storm_threshold
+                     and (warned is None
+                          or step - warned > self.storm_window_steps))
+            if storm:
+                self._last_warned[name] = step
+        if storm:
+            storm_total.labels(fn=name).inc()
+            log.warning(
+                "recompile storm: %s compiled %d times in the last %d steps "
+                "(step %d, policy=%s) — check for shape churn or dtype-policy "
+                "flips; further warnings suppressed for %d steps",
+                name, recent, self.storm_window_steps, step, event["policy"],
+                self.storm_window_steps)
+        return event
+
+    def wrap(self, name: str, fn: Callable, *,
+             cache_key: Any = None) -> Callable:
+        """Wrap a freshly-built jitted callable. The first call for each new
+        abstract argument signature is timed and recorded as a compile; later
+        calls with a seen signature pay one dict lookup and a tree-flatten.
+
+        Seams create a NEW wrap per cache entry (``LazyScore._jit`` et al.),
+        so a dtype-policy flip — which changes the cache key and rebuilds the
+        jit — naturally lands here again and is counted as a fresh compile
+        of the same ``name``, which is exactly what the storm detector
+        watches for.
+        """
+        self._ensure_monitoring()
+        seen: Dict[Tuple, bool] = {}
+        tracker = self
+
+        def tracked(*args, **kwargs):
+            try:
+                sig = _signature(args, kwargs)
+            except Exception:
+                sig = None
+            if sig is not None and sig in seen:
+                return fn(*args, **kwargs)
+            stack = getattr(tracker._active, "stack", None)
+            if stack is None:
+                stack = tracker._active.stack = []
+            stack.append(name)
+            import time as _time
+            t0 = _time.perf_counter()
+            try:
+                out = fn(*args, **kwargs)
+            finally:
+                stack.pop()
+            wall = _time.perf_counter() - t0
+            if sig is not None:
+                seen[sig] = True
+            tracker.record_compile(name, cache_key=cache_key, wall_s=wall,
+                                   shapes=None if sig is None else sig[0])
+            return out
+
+        tracked.__wrapped__ = fn  # type: ignore[attr-defined]
+        tracked.__name__ = getattr(fn, "__name__", name)
+        return tracked
+
+    # ------------------------------------------------------------ export
+    def snapshot_events(self) -> list:
+        with self._lock:
+            return list(self.events)
+
+
+_GLOBAL = CompileTracker()
+
+
+def global_tracker() -> CompileTracker:
+    """THE process-global tracker the framework seams report into."""
+    return _GLOBAL

@@ -1,0 +1,304 @@
+"""Process-global metrics registry: counters, gauges, fixed-bucket histograms.
+
+The reference's observability pipeline (BaseStatsListener + SBE wire format +
+training UI) is event-per-iteration push; what it cannot express is always-on
+aggregate state in the Prometheus/xprof mold — monotonic counters a scraper
+can rate(), HBM gauges, latency histograms. This registry is that layer: the
+instrumentation spine the compile tracker, step-time attribution, span API,
+``/metrics`` route, and ``--telemetry-out`` snapshots all write through.
+
+Design constraints (why this is not just a dict of floats):
+
+* **Hot-path cost.** Instrument points sit inside the fit loops between jitted
+  dispatches, budgeted at <=2% of a LeNet step (pinned in
+  tests/test_bench_contract.py). Every ``inc``/``observe``/``set`` is one lock
+  acquire plus float arithmetic; label resolution (the dict work) happens once
+  at ``labels()`` time, so call sites hold a pre-resolved series handle.
+* **Lock-safe.** Listeners, the UI server thread, and async prefetch threads
+  all touch the registry; one registry-wide ``threading.Lock`` guards series
+  creation and every mutation (uncontended CPython lock ops are ~100ns —
+  far inside the budget — and keep snapshot/exposition trivially consistent).
+* **Kill switch.** ``set_enabled(False)`` turns every mutation into a no-op
+  for overhead A/Bs; exposition still works on whatever was recorded.
+"""
+from __future__ import annotations
+
+import json
+import math
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+#: default histogram buckets (seconds): 100us .. ~100s, log-ish spacing —
+#: covers everything from a listener callback to a cold XLA compile
+DEFAULT_BUCKETS = (1e-4, 5e-4, 1e-3, 5e-3, 1e-2, 5e-2, 0.1, 0.5, 1.0, 5.0,
+                   10.0, 60.0, 120.0)
+
+_VALID_TYPES = ("counter", "gauge", "histogram")
+
+
+class _Series:
+    """One (metric, labelset) time series. Mutations take the registry lock."""
+
+    __slots__ = ("family", "labels", "value", "bucket_counts", "sum", "count")
+
+    def __init__(self, family: "_Family", labels: Tuple[Tuple[str, str], ...]):
+        self.family = family
+        self.labels = labels
+        self.value = 0.0                      # counter / gauge
+        if family.type == "histogram":
+            self.bucket_counts = [0] * (len(family.buckets) + 1)  # +inf last
+            self.sum = 0.0
+            self.count = 0
+
+    # -- mutation (call-site API; handles are cached by callers) ------------
+    def inc(self, amount: float = 1.0) -> None:
+        reg = self.family.registry
+        if not reg._enabled:
+            return
+        if amount < 0:
+            raise ValueError("counters only go up; use a gauge")
+        with reg._lock:
+            self.value += amount
+
+    def set(self, value: float) -> None:
+        reg = self.family.registry
+        if not reg._enabled:
+            return
+        with reg._lock:
+            self.value = float(value)
+
+    def observe(self, value: float) -> None:
+        reg = self.family.registry
+        if not reg._enabled:
+            return
+        fam = self.family
+        with reg._lock:
+            self.sum += value
+            self.count += 1
+            i = 0
+            n = len(fam.buckets)
+            while i < n and value > fam.buckets[i]:
+                i += 1
+            self.bucket_counts[i] += 1
+
+    def time(self):
+        """``with series.time():`` — observe the block's wall seconds."""
+        return _Timer(self)
+
+
+class _Timer:
+    __slots__ = ("series", "_t0")
+
+    def __init__(self, series: _Series):
+        self.series = series
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self.series.observe(time.perf_counter() - self._t0)
+        return False
+
+
+class _Family:
+    """A named metric with a help string; holds one series per labelset."""
+
+    def __init__(self, registry: "MetricsRegistry", name: str, help: str,
+                 type: str, buckets: Sequence[float] = DEFAULT_BUCKETS):
+        self.registry = registry
+        self.name = name
+        self.help = help
+        self.type = type
+        self.buckets = tuple(buckets) if type == "histogram" else ()
+        self._series: Dict[Tuple[Tuple[str, str], ...], _Series] = {}
+
+    def labels(self, **labels: str) -> _Series:
+        """Resolve (and memoize) the series for this labelset. Do this ONCE
+        per call site, not per step — the returned handle is the hot path."""
+        key = tuple(sorted((k, str(v)) for k, v in labels.items()))
+        with self.registry._lock:
+            s = self._series.get(key)
+            if s is None:
+                s = self._series[key] = _Series(self, key)
+            return s
+
+    # label-less convenience: family acts as its own default series
+    def inc(self, amount: float = 1.0) -> None:
+        self.labels().inc(amount)
+
+    def set(self, value: float) -> None:
+        self.labels().set(value)
+
+    def observe(self, value: float) -> None:
+        self.labels().observe(value)
+
+    def time(self):
+        return self.labels().time()
+
+
+class MetricsRegistry:
+    """Prometheus-style registry: get-or-create families, text exposition,
+    JSONL snapshots. One process-global instance (``global_registry()``)
+    backs the framework instrumentation; tests construct private ones."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._families: Dict[str, _Family] = {}
+        self._enabled = True
+
+    # ------------------------------------------------------------- creation
+    def _family(self, name: str, help: str, type: str,
+                buckets: Sequence[float] = DEFAULT_BUCKETS) -> _Family:
+        if type not in _VALID_TYPES:
+            raise ValueError(f"unknown metric type {type!r}")
+        with self._lock:
+            fam = self._families.get(name)
+            if fam is None:
+                fam = self._families[name] = _Family(self, name, help, type,
+                                                     buckets)
+            elif fam.type != type:
+                raise ValueError(
+                    f"metric {name!r} already registered as {fam.type}, "
+                    f"not {type}")
+            return fam
+
+    def counter(self, name: str, help: str = "") -> _Family:
+        return self._family(name, help, "counter")
+
+    def gauge(self, name: str, help: str = "") -> _Family:
+        return self._family(name, help, "gauge")
+
+    def histogram(self, name: str, help: str = "",
+                  buckets: Sequence[float] = DEFAULT_BUCKETS) -> _Family:
+        return self._family(name, help, "histogram", buckets)
+
+    # -------------------------------------------------------------- control
+    def set_enabled(self, flag: bool) -> None:
+        """Kill switch: False turns every inc/set/observe into a no-op
+        (the overhead-A/B lever; exposition of recorded data still works)."""
+        self._enabled = bool(flag)
+
+    @property
+    def enabled(self) -> bool:
+        return self._enabled
+
+    def clear(self) -> None:
+        """Drop all recorded series (keeps family definitions). Test hook."""
+        with self._lock:
+            for fam in self._families.values():
+                fam._series.clear()
+
+    # ----------------------------------------------------------- exposition
+    @staticmethod
+    def _fmt_labels(labels: Tuple[Tuple[str, str], ...],
+                    extra: Optional[Tuple[Tuple[str, str], ...]] = None) -> str:
+        pairs = list(labels) + list(extra or ())
+        if not pairs:
+            return ""
+        def esc(v: str) -> str:
+            return v.replace("\\", "\\\\").replace('"', '\\"').replace(
+                "\n", "\\n")
+        return "{" + ",".join(f'{k}="{esc(v)}"' for k, v in pairs) + "}"
+
+    @staticmethod
+    def _fmt_value(v: float) -> str:
+        if math.isinf(v):
+            return "+Inf" if v > 0 else "-Inf"
+        return repr(float(v))
+
+    def prometheus_text(self) -> str:
+        """Prometheus text exposition format (the ``/metrics`` payload):
+        ``# HELP`` / ``# TYPE`` headers, histogram ``_bucket``/``_sum``/
+        ``_count`` expansion with cumulative ``le`` labels."""
+        lines: List[str] = []
+        with self._lock:
+            for name in sorted(self._families):
+                fam = self._families[name]
+                if not fam._series:
+                    continue
+                if fam.help:
+                    lines.append(f"# HELP {name} {fam.help}")
+                lines.append(f"# TYPE {name} {fam.type}")
+                for key in sorted(fam._series):
+                    s = fam._series[key]
+                    if fam.type == "histogram":
+                        cum = 0
+                        for i, le in enumerate(fam.buckets):
+                            cum += s.bucket_counts[i]
+                            lbl = self._fmt_labels(key,
+                                                   (("le", f"{le:g}"),))
+                            lines.append(f"{name}_bucket{lbl} {cum}")
+                        cum += s.bucket_counts[-1]
+                        lbl = self._fmt_labels(key, (("le", "+Inf"),))
+                        lines.append(f"{name}_bucket{lbl} {cum}")
+                        lbl = self._fmt_labels(key)
+                        lines.append(f"{name}_sum{lbl} "
+                                     f"{self._fmt_value(s.sum)}")
+                        lines.append(f"{name}_count{lbl} {s.count}")
+                    else:
+                        lbl = self._fmt_labels(key)
+                        lines.append(f"{name}{lbl} "
+                                     f"{self._fmt_value(s.value)}")
+        return "\n".join(lines) + ("\n" if lines else "")
+
+    def snapshot(self) -> dict:
+        """JSON-ready dump of every series (the ``/train/telemetry/data``
+        payload and the ``--telemetry-out`` record body)."""
+        out: Dict[str, dict] = {}
+        with self._lock:
+            for name, fam in sorted(self._families.items()):
+                series = []
+                for key in sorted(fam._series):
+                    s = fam._series[key]
+                    row: dict = {"labels": dict(key)}
+                    if fam.type == "histogram":
+                        row.update(sum=s.sum, count=s.count,
+                                   buckets=list(fam.buckets),
+                                   bucket_counts=list(s.bucket_counts))
+                    else:
+                        row["value"] = s.value
+                    series.append(row)
+                if series:
+                    out[name] = {"type": fam.type, "help": fam.help,
+                                 "series": series}
+        return out
+
+    def write_jsonl(self, path: str, **meta) -> None:
+        """Append ONE JSON line (`{"ts": ..., "metrics": {...}, **meta}`) to
+        ``path`` — the snapshot export format bench.py/cli.py dump beside
+        their headline JSON. Appending (not truncating) keeps one file per
+        run valid across retries."""
+        rec = {"ts": time.time(), **meta, "metrics": self.snapshot()}
+        with open(path, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+
+
+_GLOBAL = MetricsRegistry()
+
+
+def global_registry() -> MetricsRegistry:
+    """THE process-global registry every framework instrument writes to."""
+    return _GLOBAL
+
+
+def tree_nbytes(tree) -> int:
+    """Total bytes of the array leaves of a pytree — works on concrete
+    arrays AND tracers (both carry shape/dtype), so collective-traffic
+    accounting can size a transfer at trace time or dispatch time."""
+    import numpy as _np
+    try:
+        import jax
+        leaves = jax.tree_util.tree_leaves(tree)
+    except Exception:  # pragma: no cover - no jax (pure-host tooling)
+        leaves = tree if isinstance(tree, (list, tuple)) else [tree]
+    total = 0
+    for leaf in leaves:
+        shape = getattr(leaf, "shape", None)
+        dtype = getattr(leaf, "dtype", None)
+        if shape is None or dtype is None:
+            continue
+        total += int(_np.prod(shape, dtype=_np.int64)) * \
+            _np.dtype(dtype).itemsize
+    return total

@@ -1,0 +1,47 @@
+"""Span API: one ``with span("epoch/3/fwd")`` feeds BOTH trace viewers.
+
+``ProfilerListener`` already captures XPlane windows, but user-defined
+phases only show up there if the code annotates them — and ad-hoc
+``jax.profiler.TraceAnnotation`` calls leave no persistent record once the
+trace window closes. A span does double duty: the annotation makes the
+phase visible in xprof/perfetto timelines, and the registry histogram keeps
+an always-on latency distribution a ``/metrics`` scraper can watch between
+(or without) profiler windows.
+
+Span names are hierarchical-by-convention (``"epoch/3/stage"``); the
+registry series is labeled with the name verbatim, so high-cardinality
+names (per-step indices) belong in the annotation half only — pass
+``metric_name`` to collapse them for the histogram.
+"""
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Optional
+
+from .metrics import global_registry
+
+
+@contextlib.contextmanager
+def span(name: str, metric_name: Optional[str] = None, registry=None):
+    """Annotate a phase in XPlane traces AND record its wall time in the
+    registry histogram ``dl4j_span_seconds{name=...}``.
+
+    ``metric_name`` overrides the histogram label (use it to collapse
+    per-index names like ``epoch/3`` into a bounded series like ``epoch``).
+    """
+    reg = registry if registry is not None else global_registry()
+    hist = reg.histogram("dl4j_span_seconds",
+                         "wall seconds of user/framework span() phases")
+    series = hist.labels(name=metric_name or name)
+    try:
+        import jax.profiler as _prof
+        ann = _prof.TraceAnnotation(name)
+    except Exception:  # pragma: no cover - profiler API absent
+        ann = contextlib.nullcontext()
+    t0 = time.perf_counter()
+    with ann:
+        try:
+            yield
+        finally:
+            series.observe(time.perf_counter() - t0)

@@ -232,6 +232,25 @@ def _in_checked_shard_map(x) -> bool:
 _MIN_SEQ = int(os.environ.get("DL4J_FLASH_MIN_SEQ", "1024"))
 
 
+#: dispatch accounting: these call sites execute at TRACE time (the branch
+#: is baked into the compiled program), so each increment is one compiled
+#: program embedding the pallas-vs-XLA choice — retraces show up as extra
+#: counts, which is exactly what an engagement dashboard wants to see
+from deeplearning4j_tpu.observability.metrics import (  # noqa: E402
+    global_registry as _obs_registry,
+)
+
+_pallas_dispatch = _obs_registry().counter(
+    "dl4j_pallas_dispatch_total",
+    "pallas-vs-XLA dispatch decisions at kernel call sites, counted per "
+    "trace, by kernel and whether the pallas path engaged")
+
+
+def _note_dispatch(kernel: str, engaged: bool) -> None:
+    _pallas_dispatch.labels(kernel=kernel,
+                            engaged="true" if engaged else "false").inc()
+
+
 def _pallas_ok(q, k, interpret: bool, force: bool = False) -> bool:
     """ONE dispatch predicate for every flash/masked entry point AND its
     custom_vjp fwd rule — they must agree, or a forward under jax.grad would
@@ -301,7 +320,9 @@ def masked_attention(q: Array, k: Array, v: Array, key_mask: Array,
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
 def _masked_attention_vjp(q, k, v, key_mask, causal, interpret, force):
-    if _pallas_ok(q, k, interpret, force):
+    ok = _pallas_ok(q, k, interpret, force)
+    _note_dispatch("masked_attention", ok)
+    if ok:
         return _flash_forward(q, k, v, causal, interpret=interpret,
                               key_mask=key_mask)[0]
     return _masked_attention_xla(q, k, v, key_mask, causal)
@@ -362,7 +383,9 @@ def flash_attention(q: Array, k: Array, v: Array, causal: bool = False,
     never overrides the hard constraints: TPU/interpret availability,
     tileable lengths, and the vma-checked shard_map guard, where
     pallas_call would be rejected outright."""
-    if _pallas_ok(q, k, interpret, force_pallas):
+    ok = _pallas_ok(q, k, interpret, force_pallas)
+    _note_dispatch("flash_attention", ok)
+    if ok:
         return _flash_forward(q, k, v, causal, interpret=interpret)[0]
     return _attention_xla(q, k, v, causal)
 
@@ -644,8 +667,10 @@ def softmax_cross_entropy(logits: Array, labels: Array, blk: int = 256,
     out_shape and the interpret lowering its internal while_loop carry, and
     XLA fuses this row-wise chain well anyway."""
     N, C = logits.shape
-    if (use_pallas() or interpret) and N % min(blk, N) == 0 \
-            and not _in_shard_map(logits):
+    engaged = ((use_pallas() or interpret) and N % min(blk, N) == 0
+               and not _in_shard_map(logits))
+    _note_dispatch("softmax_cross_entropy", engaged)
+    if engaged:
         blk = min(blk, N)
         loss, grad = pl.pallas_call(
             _sm_xent_kernel,

@@ -32,29 +32,43 @@ TrainingListener = IterationListener  # epoch hooks included above
 
 
 class ScoreIterationListener(IterationListener):
-    """Log score every N iterations (reference ScoreIterationListener.java)."""
+    """Log score every N iterations (reference ScoreIterationListener.java).
 
-    def __init__(self, print_iterations: int = 10):
+    Emits through the logger ONCE per report (the old behaviour double-
+    reported via log.info AND print). ``echo=True`` additionally mirrors to
+    stdout for bare scripts with no logging configured.
+    """
+
+    def __init__(self, print_iterations: int = 10, echo: bool = False):
         self.print_iterations = max(1, print_iterations)
+        self.echo = echo
 
     def iteration_done(self, model, iteration: int) -> None:
         if iteration % self.print_iterations == 0:
-            log.info("Score at iteration %d is %s", iteration, model.score_value)
-            print(f"Score at iteration {iteration} is {model.score_value}")
+            log.info("Score at iteration %d is %s", iteration,
+                     model.score_value)
+            if self.echo:
+                print(f"Score at iteration {iteration} is "
+                      f"{model.score_value}")
 
 
 class PerformanceListener(IterationListener):
     """Throughput reporting: samples/sec + batches/sec (reference
     PerformanceListener.java). Used by bench.py for the headline metric."""
 
-    def __init__(self, frequency: int = 1, report: bool = True):
+    def __init__(self, frequency: int = 1, report: bool = True,
+                 batch_size: int = 0):
         self.frequency = max(1, frequency)
         self.report = report
         self.last_time: Optional[float] = None
         self.last_iter = 0
         self.samples_per_sec = 0.0
         self.batches_per_sec = 0.0
-        self.batch_size = 0
+        # 0 = infer per report from the model's last fitted batch (every fit
+        # path sets model.last_batch_size); a nonzero value pins it. The old
+        # behaviour — a 0 default that nothing populated — made
+        # samples_per_sec always 0.0 unless the caller poked the attribute.
+        self.batch_size = batch_size
 
     def iteration_done(self, model, iteration: int) -> None:
         now = time.perf_counter()
@@ -62,11 +76,13 @@ class PerformanceListener(IterationListener):
             dt = now - self.last_time
             iters = iteration - self.last_iter
             if dt > 0 and iters > 0:
+                bs = self.batch_size or getattr(model, "last_batch_size", 0)
                 self.batches_per_sec = iters / dt
-                self.samples_per_sec = self.batches_per_sec * self.batch_size
+                self.samples_per_sec = self.batches_per_sec * bs
                 if self.report:
-                    print(f"iteration {iteration}: {self.batches_per_sec:.1f} batches/sec, "
-                          f"{self.samples_per_sec:.1f} samples/sec")
+                    log.info("iteration %d: %.1f batches/sec, "
+                             "%.1f samples/sec", iteration,
+                             self.batches_per_sec, self.samples_per_sec)
         if iteration % self.frequency == 0:
             self.last_time = now
             self.last_iter = iteration
@@ -87,17 +103,19 @@ class CollectScoresIterationListener(IterationListener):
 class TimeIterationListener(IterationListener):
     """Estimate remaining training time (reference TimeIterationListener.java)."""
 
-    def __init__(self, total_iterations: int):
+    def __init__(self, total_iterations: int, frequency: int = 50):
         self.total_iterations = total_iterations
+        # report cadence in iterations (was hardcoded at 50 — useless for
+        # workloads shorter than 50 iterations)
+        self.frequency = max(1, frequency)
         self.start = time.perf_counter()
 
     def iteration_done(self, model, iteration: int) -> None:
         elapsed = time.perf_counter() - self.start
-        if iteration > 0:
+        if iteration > 0 and iteration % self.frequency == 0:
             remaining = elapsed / iteration * (self.total_iterations - iteration)
-            if iteration % 50 == 0:
-                print(f"iteration {iteration}/{self.total_iterations}, "
-                      f"ETA {remaining:.0f}s")
+            log.info("iteration %d/%d, ETA %.0fs", iteration,
+                     self.total_iterations, remaining)
 
 
 class ParamAndGradientIterationListener(IterationListener):

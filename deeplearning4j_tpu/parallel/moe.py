@@ -19,6 +19,16 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from deeplearning4j_tpu.jax_compat import shard_map
+from deeplearning4j_tpu.observability.metrics import (
+    global_registry as _obs_registry,
+)
+
+# trace-time traffic gauge (see parallel/ring_attention.py: the local bodies
+# run inside jit traces, so traffic is sized from static shapes per trace)
+_collective_per_step = _obs_registry().gauge(
+    "dl4j_collective_bytes_per_step",
+    "bytes one executed step moves through a traced collective, from "
+    "static shapes at trace time, by op and site")
 
 Array = jax.Array
 
@@ -115,6 +125,11 @@ def expert_parallel_ffn(layer, params: dict, x: Array, mesh: Mesh,
     mean_axes = (axis_name,) + ((seq_axis,) if seq_axis else ())
     capacity = max(1, int(capacity_factor * (B // n) * (T // n_seq)
                           / layer.n_experts))
+    # two all-to-alls (dispatch + return) on per-shard [N, E_local, C, F]
+    # capacity buffers, across all n shards
+    _collective_per_step.labels(op="all_to_all", site="moe_dispatch").set(
+        2 * n * n * (layer.n_experts // n) * capacity * F
+        * jnp.dtype(x.dtype).itemsize)
     router = {"Wg": params["Wg"]}
     experts = {k: params[k] for k in ("W1", "b1", "W2", "b2")}
     # router noise needs an rng; without one the routing is deterministic,

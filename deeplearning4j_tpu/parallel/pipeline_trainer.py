@@ -22,8 +22,14 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh
 
+from deeplearning4j_tpu.observability.compile_tracker import (
+    global_tracker as _compile_tracker,
+)
 from deeplearning4j_tpu.parallel.mesh import build_mesh
 from deeplearning4j_tpu.parallel.pipeline import PipelineParallel
+from deeplearning4j_tpu.parallel.wrapper import (
+    _t_staging, _t_dispatch, _t_listeners,
+)
 
 
 def find_block_run(layers) -> tuple:
@@ -148,8 +154,10 @@ class PipelineTrainer:
     # ------------------------------------------------------------------- fit
     def _make_step(self):
         from deeplearning4j_tpu.nn.multilayer import make_train_step
-        return jax.jit(make_train_step(self.net.conf,
-                                       loss=self._pipeline_loss))
+        return _compile_tracker().wrap(
+            "PipelineTrainer.train_step",
+            jax.jit(make_train_step(self.net.conf,
+                                    loss=self._pipeline_loss)))
 
     def fit(self, iterator, epochs: int = 1) -> None:
         """Reference ParallelWrapper.fit(DataSetIterator):322 shape: every
@@ -168,13 +176,19 @@ class PipelineTrainer:
                     # training here would silently weight padded steps
                     raise ValueError("PipelineTrainer does not support "
                                      "masked batches; use net.fit()")
-                x = jnp.asarray(np.asarray(ds.features))
-                y = jnp.asarray(np.asarray(ds.labels))
-                (net.params_list, net.state_list, net.updater_state,
-                 loss) = self._step(net.params_list, net.state_list,
-                                    net.updater_state, x, y, net._next_rng(),
-                                    jnp.int32(net.iteration))
+                with _t_staging.time():
+                    x = jnp.asarray(np.asarray(ds.features))
+                    y = jnp.asarray(np.asarray(ds.labels))
+                net.last_batch_size = int(x.shape[0]) if x.ndim else 0
+                with _t_dispatch.time():
+                    (net.params_list, net.state_list, net.updater_state,
+                     loss) = self._step(net.params_list, net.state_list,
+                                        net.updater_state, x, y,
+                                        net._next_rng(),
+                                        jnp.int32(net.iteration))
+                _compile_tracker().note_step()
                 net.score_value = loss
                 net.iteration += 1
-                for listener in net.listeners:
-                    listener.iteration_done(net, net.iteration)
+                with _t_listeners.time():
+                    for listener in net.listeners:
+                        listener.iteration_done(net, net.iteration)

@@ -23,9 +23,20 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from deeplearning4j_tpu.jax_compat import pcast, shard_map
+from deeplearning4j_tpu.observability.metrics import (
+    global_registry as _obs_registry, tree_nbytes as _tree_nbytes,
+)
 
 Array = jax.Array
 _NEG = -1e30
+
+# trace-time traffic accounting: these entry points run INSIDE jit traces,
+# so a per-execution counter is impossible — instead each (re)trace sizes
+# the collective from the static operand shapes and records a per-step gauge
+_collective_per_step = _obs_registry().gauge(
+    "dl4j_collective_bytes_per_step",
+    "bytes one executed step moves through a traced collective, from "
+    "static shapes at trace time, by op and site")
 
 
 def attention_reference(q: Array, k: Array, v: Array, causal: bool = False) -> Array:
@@ -114,6 +125,11 @@ def ring_attention_sharded(q: Array, k: Array, v: Array, mesh: Mesh,
     ``batch_axis`` shards the leading (batch) dim too, so composing with
     data parallelism never replicates attention work across DP replicas."""
     spec = P(batch_axis, axis_name)
+    # each ring step rotates the full K/V through ppermute once per device;
+    # total traffic per executed attention = global K+V bytes
+    _collective_per_step.labels(op="ppermute_kv",
+                                site="ring_attention").set(
+        _tree_nbytes((k, v)))
     fn = shard_map(
         functools.partial(_ring_attention_local, axis_name=axis_name,
                           causal=causal, batch_axis=batch_axis),
@@ -162,6 +178,11 @@ def ulysses_attention_sharded(q: Array, k: Array, v: Array, mesh: Mesh,
     n = mesh.shape[axis_name]
     if q.shape[2] % n != 0:
         raise ValueError(f"num heads {q.shape[2]} not divisible by axis size {n}")
+    # four all-to-alls (q/k/v gather + output scatter), each moving one
+    # q-sized global array across the axis
+    _collective_per_step.labels(op="all_to_all",
+                                site="ulysses_attention").set(
+        4 * _tree_nbytes(q))
     spec = P(batch_axis, axis_name)
     # check_vma=False: pallas_call's out_shape carries no varying-mesh-axes
     # annotation, so the flash kernel inside the body can't satisfy the vma

@@ -29,6 +29,12 @@ from deeplearning4j_tpu.jax_compat import shard_map
 
 from deeplearning4j_tpu import common
 
+from deeplearning4j_tpu.observability.compile_tracker import (
+    global_tracker as _compile_tracker,
+)
+from deeplearning4j_tpu.observability.metrics import (
+    global_registry as _obs_registry, tree_nbytes as _tree_nbytes,
+)
 from deeplearning4j_tpu.parallel.mesh import data_parallel_mesh
 
 
@@ -212,7 +218,10 @@ class ParameterAveragingTrainingMaster(TrainingMaster):
                 upd = jax.tree_util.tree_map(mean_b, upd)
             return params, states, upd
 
-        fns = (local, jax.jit(average))
+        fns = (_compile_tracker().wrap("TrainingMaster.local_steps",
+                                       local, cache_key=key),
+               _compile_tracker().wrap("TrainingMaster.average",
+                                       jax.jit(average), cache_key=key))
         self._local_fns[key] = fns
         return fns
 
@@ -240,6 +249,12 @@ class ParameterAveragingTrainingMaster(TrainingMaster):
         split: List = []
         if hasattr(data_iterator, "reset"):
             data_iterator.reset()
+        # each averaging round psum-means ~per-replica param bytes
+        avg_bytes = _obs_registry().counter(
+            "dl4j_collective_bytes_total",
+            "bytes moved by host-dispatched collectives, by op and site"
+        ).labels(op="parameter_average", site="training_master")
+        param_bytes = _tree_nbytes(model.params_list)
 
         def run_split(split_batches):
             nonlocal params, states, upd
@@ -263,8 +278,10 @@ class ParameterAveragingTrainingMaster(TrainingMaster):
                 # in the split and only happens when stats are collected
                 self.stats.add("WorkerFit", t1, time.time() - t1,
                                loss=float(loss))
+            _compile_tracker().note_step(F)
             t2 = time.time()
             params, states, upd = average(params, states, upd)
+            avg_bytes.inc(param_bytes)
             if self.stats:
                 self.stats.add("AverageParameters", t2, time.time() - t2)
             model.score_value = loss

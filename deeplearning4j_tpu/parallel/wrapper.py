@@ -34,7 +34,28 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from deeplearning4j_tpu.jax_compat import shard_map
 
 from deeplearning4j_tpu.datasets.iterators import AsyncDataSetIterator
+from deeplearning4j_tpu.observability.compile_tracker import (
+    global_tracker as _compile_tracker,
+)
+from deeplearning4j_tpu.observability.metrics import (
+    global_registry as _obs_registry, tree_nbytes as _tree_nbytes,
+)
 from deeplearning4j_tpu.parallel.mesh import data_parallel_mesh
+
+# step-time attribution shares the fit-phase histogram with the single-chip
+# loops; the collective counter sizes DP traffic host-side per dispatch (the
+# gradient psum moves ~param bytes per step; traced collectives inside
+# ring/ulysses/moe report trace-time per-step gauges instead)
+_phase_hist = _obs_registry().histogram(
+    "dl4j_fit_phase_seconds",
+    "host wall seconds per fit-loop phase (staging: host cast+transfer "
+    "submit; dispatch: jitted-call submit; listeners: callback overhead)")
+_t_staging = _phase_hist.labels(phase="staging")
+_t_dispatch = _phase_hist.labels(phase="dispatch")
+_t_listeners = _phase_hist.labels(phase="listeners")
+_collective_bytes = _obs_registry().counter(
+    "dl4j_collective_bytes_total",
+    "bytes moved by host-dispatched collectives, by op and site")
 
 
 class ParallelWrapperBuilder:
@@ -348,11 +369,14 @@ class ParallelWrapper:
         # itself accumulates wide — no extra plumbing needed here.
         upd_sh = self._upd_shardings(repl)
         par_sh = self._param_shardings(repl)
-        return jax.jit(
-            step,
-            in_shardings=(par_sh, repl, upd_sh, None, None, repl, repl),
-            out_shardings=(par_sh, repl, upd_sh, repl),
-        )
+        return _compile_tracker().wrap(
+            "ParallelWrapper.sync_step",
+            jax.jit(
+                step,
+                in_shardings=(par_sh, repl, upd_sh, None, None, repl, repl),
+                out_shardings=(par_sh, repl, upd_sh, repl),
+            ),
+            cache_key=self._traced_policy)
 
     def _make_sync_multistep(self):
         """K-step scanned train step with the stacked batch axis sharded over
@@ -378,11 +402,14 @@ class ParallelWrapper:
 
         upd_sh = self._upd_shardings(repl)
         par_sh = self._param_shardings(repl)
-        return jax.jit(
-            multi,
-            in_shardings=(par_sh, repl, upd_sh, None, None, repl, repl),
-            out_shardings=(par_sh, repl, upd_sh, repl),
-        )
+        return _compile_tracker().wrap(
+            "ParallelWrapper.sync_multistep",
+            jax.jit(
+                multi,
+                in_shardings=(par_sh, repl, upd_sh, None, None, repl, repl),
+                out_shardings=(par_sh, repl, upd_sh, repl),
+            ),
+            cache_key=self._traced_policy)
 
     def _stage(self, arr, spec: P):
         """Host batch -> device array laid out for the jit's in_shardings.
@@ -442,21 +469,33 @@ class ParallelWrapper:
                 net._fit_batch(ds.features, ds.labels, ds.features_mask,
                                ds.labels_mask)
 
+        # DP gradient psum moves ~param bytes per executed train step; sized
+        # host-side here because the collective itself is inside the jit
+        param_bytes = _tree_nbytes(net.params_list)
+        psum_bytes = _collective_bytes.labels(op="psum_grad",
+                                              site="wrapper_sync")
+
         def dispatch_one(x, y):
-            if is_graph:
-                x = [self._stage(a, self._batch_spec(a)) for a in x]
-                y = [self._stage(a, self._batch_spec(a)) for a in y]
-            else:
-                x = self._stage(x, self._batch_spec(x))
-                y = self._stage(y, self._batch_spec(y))
-            (net.params_list, net.state_list, net.updater_state, loss) = \
-                self._sync_step(net.params_list, net.state_list,
-                                net.updater_state, x, y, net._next_rng(),
-                                jnp.int32(net.iteration))
+            with _t_staging.time():
+                if is_graph:
+                    x = [self._stage(a, self._batch_spec(a)) for a in x]
+                    y = [self._stage(a, self._batch_spec(a)) for a in y]
+                else:
+                    net.last_batch_size = int(np.shape(x)[0])
+                    x = self._stage(x, self._batch_spec(x))
+                    y = self._stage(y, self._batch_spec(y))
+            with _t_dispatch.time():
+                (net.params_list, net.state_list, net.updater_state, loss) = \
+                    self._sync_step(net.params_list, net.state_list,
+                                    net.updater_state, x, y, net._next_rng(),
+                                    jnp.int32(net.iteration))
+            _compile_tracker().note_step()
+            psum_bytes.inc(param_bytes)
             net.score_value = loss  # synced lazily (LazyScore)
             net.iteration += 1
-            for listener in net.listeners:
-                listener.iteration_done(net, net.iteration)
+            with _t_listeners.time():
+                for listener in net.listeners:
+                    listener.iteration_done(net, net.iteration)
 
         def stack_spec(arr):
             # stacked (K, B, ...) batches: batch spec shifted one axis right
@@ -466,27 +505,35 @@ class ParallelWrapper:
             if len(batches) == 1:
                 dispatch_one(*batches[0])
                 return
-            if is_graph:
-                xs = [self._stage(a, stack_spec(a))
-                      for a in (np.stack([b[0][i] for b in batches])
-                                for i in range(len(batches[0][0])))]
-                ys = [self._stage(a, stack_spec(a))
-                      for a in (np.stack([b[1][i] for b in batches])
-                                for i in range(len(batches[0][1])))]
-            else:
-                xs = np.stack([b[0] for b in batches])
-                xs = self._stage(xs, stack_spec(xs))
-                ys = np.stack([b[1] for b in batches])
-                ys = self._stage(ys, stack_spec(ys))
-            (net.params_list, net.state_list, net.updater_state, losses) = \
-                self._sync_multi(net.params_list, net.state_list,
-                                 net.updater_state, xs, ys, net._next_rng(),
-                                 jnp.int32(net.iteration))
-            for i in range(len(batches)):
-                net.iteration += 1
-                net.score_value = (lambda ls=losses, j=i: ls[j])
-                for listener in net.listeners:
-                    listener.iteration_done(net, net.iteration)
+            with _t_staging.time():
+                if is_graph:
+                    xs = [self._stage(a, stack_spec(a))
+                          for a in (np.stack([b[0][i] for b in batches])
+                                    for i in range(len(batches[0][0])))]
+                    ys = [self._stage(a, stack_spec(a))
+                          for a in (np.stack([b[1][i] for b in batches])
+                                    for i in range(len(batches[0][1])))]
+                else:
+                    xs = np.stack([b[0] for b in batches])
+                    net.last_batch_size = int(xs.shape[1])
+                    xs = self._stage(xs, stack_spec(xs))
+                    ys = np.stack([b[1] for b in batches])
+                    ys = self._stage(ys, stack_spec(ys))
+            with _t_dispatch.time():
+                (net.params_list, net.state_list, net.updater_state,
+                 losses) = \
+                    self._sync_multi(net.params_list, net.state_list,
+                                     net.updater_state, xs, ys,
+                                     net._next_rng(),
+                                     jnp.int32(net.iteration))
+            _compile_tracker().note_step(len(batches))
+            psum_bytes.inc(param_bytes * len(batches))
+            with _t_listeners.time():
+                for i in range(len(batches)):
+                    net.iteration += 1
+                    net.score_value = (lambda ls=losses, j=i: ls[j])
+                    for listener in net.listeners:
+                        listener.iteration_done(net, net.iteration)
 
         for _ in range(epochs):
             if hasattr(iterator, "reset"):
@@ -526,11 +573,15 @@ class ParallelWrapper:
             p2, s2, u2, loss = base(p, s, u, x, y, rng_local, it)
             return ex(p2), ex(s2), ex(u2), jax.lax.pmean(loss, "data")
 
-        local = jax.jit(shard_map(
-            local_step, mesh=mesh,
-            in_specs=(stacked, stacked, stacked, stacked, stacked, repl, repl),
-            out_specs=(stacked, stacked, stacked, repl),
-        ))
+        local = _compile_tracker().wrap(
+            "ParallelWrapper.local_sgd_step",
+            jax.jit(shard_map(
+                local_step, mesh=mesh,
+                in_specs=(stacked, stacked, stacked, stacked, stacked, repl,
+                          repl),
+                out_specs=(stacked, stacked, stacked, repl),
+            )),
+            cache_key=self._traced_policy)
 
         def average(params, upd, states):
             from deeplearning4j_tpu import common
@@ -551,7 +602,9 @@ class ParallelWrapper:
             states = jax.tree_util.tree_map(mean_bcast, states)
             return avg, upd, states
 
-        avg_fn = jax.jit(average)
+        avg_fn = _compile_tracker().wrap(
+            "ParallelWrapper.average", jax.jit(average),
+            cache_key=self._traced_policy)
         return local, avg_fn
 
     def _fit_local_sgd(self, iterator, epochs: int) -> None:
@@ -573,29 +626,41 @@ class ParallelWrapper:
             ComputationGraph, _coerce_graph_batch)
 
         is_graph = isinstance(net, ComputationGraph)
+        # each psum-mean resync moves ~per-replica param bytes across the ring
+        avg_bytes = _collective_bytes.labels(op="parameter_average",
+                                             site="wrapper_local_sgd")
+        param_bytes = _tree_nbytes(net.params_list)
         since_avg = 0
         for _ in range(epochs):
             if hasattr(iterator, "reset"):
                 iterator.reset()
             for ds in iterator:
-                if is_graph:
-                    xs, ys, _, _ = _coerce_graph_batch(ds)
-                    x = [jax.device_put(jnp.asarray(a), batch_sh) for a in xs]
-                    y = [jax.device_put(jnp.asarray(a), batch_sh) for a in ys]
-                else:
-                    x = jax.device_put(jnp.asarray(ds.features), batch_sh)
-                    y = jax.device_put(jnp.asarray(ds.labels), batch_sh)
-                params, states, upd, loss = self._local_step(
-                    params, states, upd, x, y, net._next_rng(),
-                    jnp.int32(net.iteration))
+                with _t_staging.time():
+                    if is_graph:
+                        xs, ys, _, _ = _coerce_graph_batch(ds)
+                        x = [jax.device_put(jnp.asarray(a), batch_sh)
+                             for a in xs]
+                        y = [jax.device_put(jnp.asarray(a), batch_sh)
+                             for a in ys]
+                    else:
+                        net.last_batch_size = int(np.shape(ds.features)[0])
+                        x = jax.device_put(jnp.asarray(ds.features), batch_sh)
+                        y = jax.device_put(jnp.asarray(ds.labels), batch_sh)
+                with _t_dispatch.time():
+                    params, states, upd, loss = self._local_step(
+                        params, states, upd, x, y, net._next_rng(),
+                        jnp.int32(net.iteration))
+                _compile_tracker().note_step()
                 net.score_value = loss  # synced lazily (LazyScore)
                 net.iteration += 1
                 since_avg += 1
                 if since_avg >= self.averaging_frequency:
                     params, upd, states = self._avg_fn(params, upd, states)
+                    avg_bytes.inc(param_bytes)
                     since_avg = 0
-                for listener in net.listeners:
-                    listener.iteration_done(net, net.iteration)
+                with _t_listeners.time():
+                    for listener in net.listeners:
+                        listener.iteration_done(net, net.iteration)
         # final sync + unstack back into the model
         params, upd, states = self._avg_fn(params, upd, states)
         unstack = functools.partial(jax.tree_util.tree_map, lambda a: a[0])

@@ -303,6 +303,14 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
+    def _text(self, text: str, content_type: str = "text/plain") -> None:
+        body = text.encode()
+        self.send_response(200)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
     def do_GET(self):
         path = urlparse(self.path).path
         lang = self._request_lang()
@@ -340,6 +348,13 @@ class _Handler(BaseHTTPRequestHandler):
             self._json(self.ui.model_data(q.get("session", [None])[0]))
         elif path == "/train/system/data":
             self._json(self.ui.system_data())
+        elif path == "/metrics":
+            # Prometheus text exposition of the process-global registry
+            # (version 0.0.4 is what prometheus scrapers negotiate)
+            self._text(self.ui.metrics_text(),
+                       "text/plain; version=0.0.4; charset=utf-8")
+        elif path == "/train/telemetry/data":
+            self._json(self.ui.telemetry_data())
         elif path == "/train/histograms/data":
             # HistogramModule equivalent: latest param/gradient/update
             # histograms per variable
@@ -496,6 +511,24 @@ class UIServer:
             "deviceMemBytes": [r.device_mem_bytes for r in reports],
             "timestamps": [r.timestamp for r in reports],
         }
+
+    def metrics_text(self) -> str:
+        """Prometheus text for ``/metrics``: the process-global registry
+        that the fit loops, compile tracker, and spans write into."""
+        from deeplearning4j_tpu.observability import global_registry
+
+        return global_registry().prometheus_text()
+
+    def telemetry_data(self) -> dict:
+        """JSON registry snapshot + recent compile events for
+        ``/train/telemetry/data`` (same data as /metrics plus the compile
+        event log, which has no Prometheus shape)."""
+        from deeplearning4j_tpu.observability import (global_registry,
+                                                      global_tracker)
+
+        return {"metrics": global_registry().snapshot(),
+                "compile_events": global_tracker().snapshot_events(),
+                "step": global_tracker().step}
 
     def histogram_data(self, session: Optional[str] = None) -> dict:
         """Latest histograms per variable (reference HistogramModule)."""

@@ -1,0 +1,357 @@
+"""Telemetry subsystem tests: MetricsRegistry semantics, Prometheus
+exposition, CompileTracker compile/retrace accounting, the dtype-policy
+recompile-storm acceptance path, TelemetryListener end-to-end, and the
+/metrics + /train/telemetry/data UI endpoints."""
+import json
+import logging
+import re
+import threading
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deeplearning4j_tpu.common as C
+from deeplearning4j_tpu.nn.conf.builders import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.observability import (
+    CompileTracker, MetricsRegistry, TelemetryListener, global_registry,
+    global_tracker, record_hbm_gauges, span, tree_nbytes,
+)
+from deeplearning4j_tpu.observability import compile_tracker as ct_mod
+from deeplearning4j_tpu.ui import UIServer
+
+
+def _small_net():
+    conf = (NeuralNetConfiguration.builder()
+            .seed(0).learning_rate(0.1)
+            .list()
+            .layer(DenseLayer(n_in=4, n_out=8, activation="tanh"))
+            .layer(OutputLayer(n_in=8, n_out=3, loss="mcxent",
+                               activation="softmax"))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _xy(n=16, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, 4)).astype(np.float32)
+    y = np.zeros((n, 3), np.float32)
+    y[np.arange(n), rng.integers(0, 3, n)] = 1
+    return x, y
+
+
+# --------------------------------------------------------------- registry
+
+def test_counter_semantics():
+    reg = MetricsRegistry()
+    c = reg.counter("req_total", "requests")
+    c.labels(route="/a").inc()
+    c.labels(route="/a").inc(2)
+    c.labels(route="/b").inc()
+    snap = reg.snapshot()["req_total"]
+    assert snap["type"] == "counter"
+    by_route = {dict(s["labels"])["route"]: s["value"]
+                for s in snap["series"]}
+    assert by_route == {"/a": 3.0, "/b": 1.0}
+    with pytest.raises(ValueError):
+        c.labels(route="/a").inc(-1)
+
+
+def test_gauge_and_histogram_semantics():
+    reg = MetricsRegistry()
+    g = reg.gauge("temp", "temperature")
+    g.set(3.5)
+    g.set(-2.0)
+    assert reg.snapshot()["temp"]["series"][0]["value"] == -2.0
+
+    h = reg.histogram("lat", "latency", buckets=(0.1, 1.0))
+    for v in (0.05, 0.5, 5.0):
+        h.observe(v)
+    s = reg.snapshot()["lat"]["series"][0]
+    assert s["count"] == 3
+    assert s["sum"] == pytest.approx(5.55)
+    # per-bucket (non-cumulative) counts: <=0.1, <=1.0, +Inf overflow
+    assert s["bucket_counts"] == [1, 1, 1]
+
+
+def test_labels_memoized_and_type_conflict():
+    reg = MetricsRegistry()
+    c = reg.counter("x_total")
+    assert c.labels(a="1") is c.labels(a="1")
+    assert reg.counter("x_total") is c          # get-or-create
+    with pytest.raises(ValueError):
+        reg.gauge("x_total")                    # same name, different type
+
+
+def test_kill_switch_disables_mutation():
+    reg = MetricsRegistry()
+    c = reg.counter("k_total")
+    c.inc()
+    reg.set_enabled(False)
+    c.inc(100)
+    reg.gauge("k_gauge").set(9)
+    reg.set_enabled(True)
+    c.inc()
+    snap = reg.snapshot()
+    assert snap["k_total"]["series"][0]["value"] == 2.0
+    assert snap["k_gauge"]["series"][0]["value"] == 0.0
+
+
+def test_concurrent_increments_are_exact():
+    reg = MetricsRegistry()
+    c = reg.counter("conc_total").labels(t="x")
+    h = reg.histogram("conc_hist").labels(t="x")
+    n_threads, n_incs = 8, 1000
+    barrier = threading.Barrier(n_threads)
+
+    def worker():
+        barrier.wait()
+        for _ in range(n_incs):
+            c.inc()
+            h.observe(0.01)
+
+    threads = [threading.Thread(target=worker) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    snap = reg.snapshot()
+    assert snap["conc_total"]["series"][0]["value"] == n_threads * n_incs
+    assert snap["conc_hist"]["series"][0]["count"] == n_threads * n_incs
+
+
+_PROM_LINE = re.compile(
+    r'^(# (HELP|TYPE) [a-zA-Z_:][a-zA-Z0-9_:]* .*'
+    r'|[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})? '
+    r'(-?[0-9]+(\.[0-9]+)?([eE][+-]?[0-9]+)?|[+-]?Inf|NaN))$')
+
+
+def _assert_valid_prometheus(text):
+    lines = [ln for ln in text.splitlines() if ln]
+    assert lines, "empty exposition"
+    for ln in lines:
+        assert _PROM_LINE.match(ln), f"invalid Prometheus line: {ln!r}"
+
+
+def test_prometheus_text_parses():
+    reg = MetricsRegistry()
+    reg.counter("c_total", "a counter").labels(op="x").inc(2)
+    reg.gauge("g_bytes", "a gauge").set(1.5e9)
+    h = reg.histogram("h_seconds", "a histogram", buckets=(0.1, 1.0))
+    h.labels(phase="fit").observe(0.05)
+    h.labels(phase="fit").observe(0.5)
+    text = reg.prometheus_text()
+    _assert_valid_prometheus(text)
+    assert '# TYPE c_total counter' in text
+    assert 'c_total{op="x"} 2' in text
+    # histogram: cumulative buckets, +Inf last, _sum and _count present
+    assert 'h_seconds_bucket{le="0.1",phase="fit"} 1' in text \
+        or 'h_seconds_bucket{phase="fit",le="0.1"} 1' in text
+    assert '+Inf' in text
+    assert "h_seconds_sum" in text and "h_seconds_count" in text
+
+
+def test_write_jsonl_appends_snapshot(tmp_path):
+    reg = MetricsRegistry()
+    reg.counter("w_total").inc(4)
+    path = tmp_path / "telemetry.jsonl"
+    reg.write_jsonl(str(path), source="test")
+    reg.write_jsonl(str(path), source="test")
+    lines = path.read_text().splitlines()
+    assert len(lines) == 2
+    rec = json.loads(lines[0])
+    assert rec["source"] == "test" and "ts" in rec
+    assert rec["metrics"]["w_total"]["series"][0]["value"] == 4.0
+
+
+def test_tree_nbytes():
+    tree = {"w": np.zeros((4, 8), np.float32), "b": np.zeros((8,), np.float32)}
+    assert tree_nbytes(tree) == 4 * 8 * 4 + 8 * 4
+    # works on abstract/traced values too (shape+dtype only)
+    assert tree_nbytes(jax.ShapeDtypeStruct((2, 2), jnp.bfloat16)) == 8
+
+
+def test_span_records_histogram():
+    reg = MetricsRegistry()
+    with span("epoch/0/stage", registry=reg):
+        pass
+    s = reg.snapshot()["dl4j_span_seconds"]["series"][0]
+    assert dict(s["labels"]) == {"name": "epoch/0/stage"}
+    assert s["count"] == 1 and s["sum"] >= 0.0
+
+
+# --------------------------------------------------------- compile tracker
+
+def test_compile_tracker_cached_call_and_forced_retrace():
+    reg = MetricsRegistry()
+    tracker = CompileTracker(registry=reg)
+
+    def f(x):
+        return x * 2.0
+
+    tracked = tracker.wrap("test.f", jax.jit(f))
+    x4 = np.ones((4,), np.float32)
+    tracked(x4)
+    tracked(x4)                       # cached re-call: no new compile
+    assert len(tracker.snapshot_events()) == 1
+    ev = tracker.snapshot_events()[0]
+    assert ev["fn"] == "test.f" and ev["wall_s"] > 0.0
+
+    tracked(np.ones((8,), np.float32))  # forced retrace: new shape
+    assert len(tracker.snapshot_events()) == 2
+    snap = reg.snapshot()
+    assert snap["dl4j_jit_compile_total"]["series"][0]["value"] == 2.0
+    assert snap["dl4j_jit_compile_seconds"]["series"][0]["count"] == 2
+
+
+def test_compile_tracker_storm_warning_rate_limited(caplog):
+    tracker = CompileTracker(registry=MetricsRegistry(),
+                             storm_threshold=3, storm_window_steps=100)
+    with caplog.at_level(logging.WARNING,
+                         logger="deeplearning4j_tpu.observability"
+                                ".compile_tracker"):
+        for i in range(6):
+            tracker.record_compile("storm.fn", wall_s=0.01)
+            tracker.note_step()
+    storms = [r for r in caplog.records if "recompile storm" in r.message]
+    assert len(storms) == 1          # rate-limited: one warning per window
+    snap = tracker.registry.snapshot()
+    assert snap["dl4j_recompile_storm_warnings_total"]["series"][0]["value"] \
+        == 1.0
+
+
+@pytest.fixture
+def _restore_policy():
+    yield
+    C.set_policy(jnp.float32, jnp.float32, jnp.float32,
+                 reduction_dtype=None, grad_accum_dtype=None)
+
+
+def test_policy_flip_counts_new_compile_and_trips_storm(
+        monkeypatch, caplog, _restore_policy):
+    """Acceptance: a deliberate dtype-policy flip mid-run is counted as a
+    fresh compile of the same step function and trips the storm warning."""
+    fresh = CompileTracker(registry=MetricsRegistry(),
+                           storm_threshold=2, storm_window_steps=50)
+    monkeypatch.setattr(ct_mod, "_GLOBAL", fresh)
+
+    net = _small_net()
+    x, y = _xy()
+    with caplog.at_level(logging.WARNING,
+                         logger="deeplearning4j_tpu.observability"
+                                ".compile_tracker"):
+        net.fit(x, y)
+        events_before = [e for e in fresh.snapshot_events()
+                         if "train_step" in e["fn"]]
+        assert len(events_before) == 1
+        C.set_policy(jnp.bfloat16, jnp.float32, jnp.float32)
+        net.fit(x, y)
+    events_after = [e for e in fresh.snapshot_events()
+                    if "train_step" in e["fn"]]
+    assert len(events_after) == 2     # policy flip re-keyed -> new compile
+    assert events_after[0]["policy"] != events_after[1]["policy"]
+    assert any("recompile storm" in r.message for r in caplog.records)
+    snap = fresh.registry.snapshot()
+    assert snap["dl4j_recompile_storm_warnings_total"]["series"][0]["value"] \
+        >= 1.0
+
+
+# ------------------------------------------------- listener + endpoints
+
+@pytest.fixture(scope="module")
+def telemetry_run():
+    """One instrumented training run feeding the process-global registry:
+    2-layer net + TelemetryListener, 5 iterations."""
+    net = _small_net()
+    listener = TelemetryListener(sync_every=1, hbm_every=1,
+                                 worker_id="obs_test")
+    net.set_listeners(listener)
+    x, y = _xy()
+    for _ in range(5):
+        net.fit(x, y)
+    return net
+
+
+def test_telemetry_listener_acceptance(telemetry_run):
+    snap = global_registry().snapshot()
+    # >= 1 compile event with a wall time
+    total = sum(s["value"]
+                for s in snap["dl4j_jit_compile_total"]["series"])
+    assert total >= 1
+    assert any(s["count"] >= 1 and s["sum"] > 0.0
+               for s in snap["dl4j_jit_compile_seconds"]["series"])
+    assert any(e["wall_s"] > 0.0 for e in global_tracker().snapshot_events())
+    # per-step host-time histogram
+    hosts = [s for s in snap["dl4j_step_host_seconds"]["series"]
+             if dict(s["labels"])["worker"] == "obs_test"]
+    assert hosts and hosts[0]["count"] >= 4     # 5 iters -> >= 4 deltas
+    # device sync time sampled from the trusted float(loss) point
+    syncs = [s for s in snap["dl4j_step_device_sync_seconds"]["series"]
+             if dict(s["labels"])["worker"] == "obs_test"]
+    assert syncs and syncs[0]["count"] >= 1
+    # HBM gauge exists per local device (0.0 on CPU: memory_stats is None)
+    assert len(snap["dl4j_device_hbm_bytes"]["series"]) \
+        == len(jax.local_devices())
+    # fit-phase attribution populated by the instrumented fit loop
+    phases = {dict(s["labels"])["phase"]
+              for s in snap["dl4j_fit_phase_seconds"]["series"]}
+    assert {"staging", "dispatch", "listeners"} <= phases
+
+
+def test_record_hbm_gauges_direct():
+    record_hbm_gauges(global_registry())
+    series = global_registry().snapshot()["dl4j_device_hbm_bytes"]["series"]
+    assert all(s["value"] >= 0.0 for s in series)
+
+
+def test_metrics_endpoint_serves_prometheus(telemetry_run):
+    server = UIServer(port=0)
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+        with urllib.request.urlopen(base + "/metrics") as r:
+            assert r.status == 200
+            assert r.headers.get("Content-Type", "").startswith("text/plain")
+            text = r.read().decode()
+        _assert_valid_prometheus(text)
+        for series in ("dl4j_jit_compile_total",
+                       "dl4j_step_host_seconds_bucket",
+                       "dl4j_device_hbm_bytes",
+                       "dl4j_fit_phase_seconds_sum"):
+            assert series in text, f"missing {series} in /metrics"
+    finally:
+        server.stop()
+
+
+def test_telemetry_data_endpoint(telemetry_run):
+    server = UIServer(port=0)
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+        with urllib.request.urlopen(base + "/train/telemetry/data") as r:
+            assert r.status == 200
+            data = json.loads(r.read())
+    finally:
+        server.stop()
+    assert "dl4j_jit_compile_total" in data["metrics"]
+    assert isinstance(data["compile_events"], list) and data["compile_events"]
+    assert isinstance(data["step"], int) and data["step"] >= 5
+
+
+def test_telemetry_listener_snapshot_path(tmp_path):
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+
+    net = _small_net()
+    out = tmp_path / "epochs.jsonl"
+    net.set_listeners(TelemetryListener(sync_every=1, hbm_every=1,
+                                        snapshot_path=str(out),
+                                        worker_id="snap_test"))
+    x, y = _xy(8, seed=1)
+    net.fit_iterator([DataSet(x, y)], epochs=2)   # epoch hooks fire here
+    lines = out.read_text().splitlines()
+    assert len(lines) == 2
+    rec = json.loads(lines[0])
+    assert rec["source"] == "TelemetryListener"
+    assert "dl4j_step_host_seconds" in rec["metrics"]
