@@ -156,6 +156,13 @@ def flagship_setup(model: str, batch: int, ksteps: int):
         y = jnp.asarray(_onehot_batch(rng, batch, 1000))
         return (resnet50(n_classes=1000, image_size=224),
                 [_stack(x, ksteps)], [_stack(y, ksteps)], True)
+    if model == "vgg16":
+        from deeplearning4j_tpu.models.vgg import vgg16
+        x = jnp.asarray(rng.normal(size=(batch, 224, 224, 3))
+                        .astype(np.float32))
+        y = jnp.asarray(_onehot_batch(rng, batch, 1000))
+        return (vgg16(n_classes=1000, image_size=224),
+                _stack(x, ksteps), _stack(y, ksteps), False)
     if model == "lenet":
         from deeplearning4j_tpu.models.lenet import lenet_mnist
         x = jnp.asarray(rng.normal(size=(batch, 784)).astype(np.float32))
@@ -185,14 +192,28 @@ def bench_resnet50(batch: int, iters: int, ksteps: int, warmup: int = 2) -> dict
     return _measure_multistep(conf, xs, ys, iters, warmup, graph=graph)
 
 
+def bench_vgg16(batch: int, iters: int, ksteps: int, warmup: int = 2) -> dict:
+    """VGG-16 single-chip throughput (VERDICT #7 grid completion): the
+    classic dense-conv stack — ~4x the per-sample flops of ResNet-50 with no
+    BN, so it isolates pure conv/matmul throughput from the norm-reduce
+    lever."""
+    conf, xs, ys, graph = flagship_setup("vgg16", batch, ksteps)
+    return _measure_multistep(conf, xs, ys, iters, warmup, graph=graph)
+
+
 def bench_char_rnn(batch: int, iters: int, ksteps: int, warmup: int = 2,
-                   vocab: int = 64, seq: int = 50) -> dict:
-    """GravesLSTM char-RNN (BASELINE config 3): TBPTT-length sequences."""
+                   vocab: int = 64, seq: int = 50,
+                   hidden: int = 200) -> dict:
+    """GravesLSTM char-RNN (BASELINE config 3): TBPTT-length sequences.
+
+    ``hidden`` >= 1024 is the grid's worst-number config (0.5%% MFU at the
+    default 200) — the [F, 4H] fused-gate weight layout in recurrent.py is
+    what this row measures at MXU-filling widths (VERDICT #7)."""
     import jax.numpy as jnp
 
     from deeplearning4j_tpu.models.char_rnn import char_rnn_lstm
 
-    conf = char_rnn_lstm(vocab_size=vocab, hidden=200, tbptt_length=seq)
+    conf = char_rnn_lstm(vocab_size=vocab, hidden=hidden, tbptt_length=seq)
     conf.backprop_type = "Standard"  # one jitted step over the tbptt window
     rng = np.random.default_rng(0)
     ids = rng.integers(0, vocab, (batch, seq))
@@ -200,6 +221,7 @@ def bench_char_rnn(batch: int, iters: int, ksteps: int, warmup: int = 2,
     r = _measure_multistep(conf, _stack(x, ksteps), _stack(x, ksteps),
                            iters, warmup)
     r["chars_per_sec"] = r["samples_per_sec"] * seq
+    r["hidden"] = hidden
     return r
 
 
@@ -454,6 +476,49 @@ def _sweep_tiles(time_once, seq: int) -> dict:
     return out
 
 
+def _staging_phase_seconds() -> float:
+    """Cumulative dl4j_fit_phase_seconds{phase="staging"} across fit loops.
+    Under device prefetch the phase records only the consumer-visible wait
+    for the already-staged batch, so the fit-bench A/B shows it collapse
+    versus the synchronous path (the PR's acceptance signal; the full
+    prefetch counters land in the --telemetry-out snapshot)."""
+    from deeplearning4j_tpu.observability import global_registry
+    fam = global_registry().snapshot().get("dl4j_fit_phase_seconds", {})
+    return sum(s.get("sum", 0.0) for s in fam.get("series", [])
+               if s.get("labels", {}).get("phase") == "staging")
+
+
+def _fit_ab(net, data, warmup_data) -> dict:
+    """Shared fit-API measurement: warm up, run the epoch once with
+    synchronous staging (prefetch off), then once with the default
+    double-buffered device prefetch — the headline number. Same net, same
+    batches; params advance across both passes (throughput-only bench)."""
+    net.fit_iterator(iter(warmup_data))  # compile + warm relay
+    float(net.score_value)  # hard sync (see module docstring)
+
+    net.prefetch_depth = 0
+    s0 = _staging_phase_seconds()
+    t0 = time.perf_counter()
+    net.fit_iterator(iter(data))
+    float(net.score_value)
+    dt_sync = time.perf_counter() - t0
+    staging_sync = _staging_phase_seconds() - s0
+
+    net.prefetch_depth = type(net).prefetch_depth  # the shipped default
+    s0 = _staging_phase_seconds()
+    t0 = time.perf_counter()
+    net.fit_iterator(iter(data))
+    float(net.score_value)  # waits on the whole param-dependency chain
+    dt = time.perf_counter() - t0
+    return {
+        "dt": dt,
+        "staging_s_sync": round(staging_sync, 4),
+        "staging_s_prefetch": round(_staging_phase_seconds() - s0, 4),
+        "sync_step_time_ms_total": round(dt_sync * 1000, 2),
+        "prefetch_speedup": round(dt_sync / dt, 3) if dt else None,
+    }
+
+
 def bench_fit_resnet50(batch: int, iters: int, ksteps: int,
                        warmup: int = 1) -> dict:
     """The PRODUCTION fit(DataSetIterator) path on ResNet-50 — not the raw
@@ -480,18 +545,15 @@ def bench_fit_resnet50(batch: int, iters: int, ksteps: int,
     n_batches = iters * ksteps
     data = [DataSet(x, y) for _ in range(n_batches)]
 
-    net.fit_iterator(iter(data[:warmup * ksteps]))  # compile + warm relay
-    float(net.score_value)  # hard sync (see module docstring)
-    t0 = time.perf_counter()
-    net.fit_iterator(iter(data))
-    float(net.score_value)  # waits on the whole param-dependency chain
-    dt = time.perf_counter() - t0
+    ab = _fit_ab(net, data, data[:warmup * ksteps])
+    dt = ab.pop("dt")
     return {
         "samples_per_sec": batch * n_batches / dt,
         "step_time_ms": dt / n_batches * 1000,
         "batch": batch, "iters": iters, "ksteps": ksteps,
         "tflops_per_sec": 0.0, "mfu": 0.0,  # same program as resnet50 bench
         "api": "ComputationGraph.fit_iterator",
+        **ab,
     }
 
 
@@ -515,18 +577,15 @@ def bench_fit_lenet(batch: int, iters: int, ksteps: int,
     n_batches = iters * ksteps
     data = [DataSet(x, y) for _ in range(n_batches)]
 
-    net.fit_iterator(iter(data[:warmup * ksteps]))
-    float(net.score_value)  # hard sync (see module docstring)
-    t0 = time.perf_counter()
-    net.fit_iterator(iter(data))
-    float(net.score_value)
-    dt = time.perf_counter() - t0
+    ab = _fit_ab(net, data, data[:warmup * ksteps])
+    dt = ab.pop("dt")
     return {
         "samples_per_sec": batch * n_batches / dt,
         "step_time_ms": dt / n_batches * 1000,
         "batch": batch, "iters": iters, "ksteps": ksteps,
         "tflops_per_sec": 0.0, "mfu": 0.0,
         "api": "MultiLayerNetwork.fit_iterator",
+        **ab,
     }
 
 
@@ -538,6 +597,7 @@ _METRICS = {
     "transformer": "transformer_lm_samples_per_sec",
     "moe": "moe_transformer_samples_per_sec",
     "resnet50": "resnet50_samples_per_sec_per_chip",
+    "vgg16": "vgg16_samples_per_sec_per_chip",
     "word2vec": "word2vec_pairs_per_sec",
     "attention": "flash_attention_tokens_per_sec",
 }
@@ -548,6 +608,7 @@ _DEFAULTS = {  # model -> (batch, iters, ksteps)
     "lenet": (128, 20, 16),
     "fit_lenet": (128, 20, 16),
     "resnet50": (128, 5, 16),  # K=16 measured +1.5% over K=8 (r5)
+    "vgg16": (64, 4, 8),  # ~4x ResNet-50 flops/sample: half the batch
     "fit_resnet50": (64, 4, 8),
     "char_rnn": (32, 5, 8),
     "transformer": (16, 5, 8),
@@ -559,6 +620,7 @@ _DEFAULTS = {  # model -> (batch, iters, ksteps)
 
 def _bench_fns():
     return {"lenet": bench_lenet, "resnet50": bench_resnet50,
+            "vgg16": bench_vgg16,
             "fit_lenet": bench_fit_lenet, "fit_resnet50": bench_fit_resnet50,
             "char_rnn": bench_char_rnn, "transformer": bench_transformer,
             "moe": bench_moe,
@@ -625,8 +687,11 @@ def _child_main(args) -> None:
     if args.vocab:
         os.environ["DL4J_W2V_VOCAB"] = str(args.vocab)
     db, di, dk = _DEFAULTS[args.model]
+    kwargs = {}
+    if args.hidden and args.model == "char_rnn":
+        kwargs["hidden"] = args.hidden
     r = _bench_fns()[args.model](args.batch or db, args.iters or di,
-                                 args.ksteps or dk)
+                                 args.ksteps or dk, **kwargs)
 
     base = BASELINE_SAMPLES_PER_SEC.get(args.model)
     vs = round(r["samples_per_sec"] / base, 3) if base else None
@@ -676,6 +741,9 @@ def main() -> None:
                          "in bench_log matching, unlike the env override)")
     ap.add_argument("--vocab", type=int, default=None,
                     help="word2vec bench vocab size (config-distinct)")
+    ap.add_argument("--hidden", type=int, default=None,
+                    help="char_rnn LSTM hidden width (config-distinct); "
+                         ">=1024 is the MFU-floor grid row")
     ap.add_argument("--ksteps", type=int, default=None,
                     help="train steps fused per host dispatch")
     dt = ap.add_mutually_exclusive_group()
@@ -839,7 +907,8 @@ def _config_key(args_str: str, ts: str = None) -> dict:
         rdtype = "f32"
     return {"model": model, "batch": val("--batch"),
             "ksteps": val("--ksteps"), "dtype": mode, "rdtype": rdtype,
-            "seq": val("--seq"), "vocab": val("--vocab")}
+            "seq": val("--seq"), "vocab": val("--vocab"),
+            "hidden": val("--hidden")}
 
 
 def _last_healthy_from_log(args_str: str, path: str = None):

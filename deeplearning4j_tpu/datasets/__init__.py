@@ -3,3 +3,4 @@ from deeplearning4j_tpu.datasets.iterators import (
     DataSetIterator, ListDataSetIterator, ArrayDataSetIterator,
     AsyncDataSetIterator, MultipleEpochsIterator, SamplingDataSetIterator,
 )
+from deeplearning4j_tpu.datasets.prefetch import DevicePrefetcher

@@ -8,8 +8,6 @@ lookahead suffices to keep the device fed).
 """
 from __future__ import annotations
 
-import queue
-import threading
 from typing import Iterator, Optional
 
 import numpy as np
@@ -92,37 +90,33 @@ class ArrayDataSetIterator(DataSetIterator):
 
 
 class AsyncDataSetIterator(DataSetIterator):
-    """Background-thread prefetch wrapper (reference AsyncDataSetIterator.java:36)."""
+    """Background-thread prefetch wrapper (reference AsyncDataSetIterator.java:36).
+
+    Built on datasets.prefetch.DevicePrefetcher (identity stage: host batches
+    only — device staging belongs to the fit loops). The prefetcher's bounded
+    put polls a stop flag, so a consumer that exits early (early-stopping
+    break, listener exception) shuts the producer down instead of leaving it
+    blocked on a full queue forever (pinned by tests/test_prefetch.py)."""
 
     def __init__(self, base: DataSetIterator, queue_size: int = 4):
         self.base = base
         self.queue_size = queue_size
+        self._pf = None  # most recent producer, exposed for shutdown/tests
 
     def __iter__(self):
-        q: queue.Queue = queue.Queue(maxsize=self.queue_size)
-        sentinel = object()
-        error: list = []
+        from deeplearning4j_tpu.datasets.prefetch import DevicePrefetcher
 
-        def producer():
-            try:
-                for ds in self.base:
-                    q.put(ds)
-            except BaseException as e:  # propagate into consumer
-                error.append(e)
-            finally:
-                q.put(sentinel)
+        self.close()  # a re-iteration abandons the previous producer
+        self._pf = DevicePrefetcher(self.base, depth=max(1, self.queue_size),
+                                    path=None)
+        return iter(self._pf)
 
-        t = threading.Thread(target=producer, daemon=True)
-        t.start()
-        while True:
-            item = q.get()
-            if item is sentinel:
-                if error:
-                    raise error[0]
-                return
-            yield item
+    def close(self) -> None:
+        if self._pf is not None:
+            self._pf.close()
 
     def reset(self) -> None:
+        self.close()
         if hasattr(self.base, "reset"):  # base may be a plain iterable/list
             self.base.reset()
 

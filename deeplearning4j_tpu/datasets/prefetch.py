@@ -1,0 +1,215 @@
+"""Device prefetch: overlap host->device transfer with the running dispatch.
+
+Reference AsyncDataSetIterator.java:36 prefetches *host* batches on a worker
+thread. On TPU that is only half the win: the staging phase the telemetry
+attributes per step (``dl4j_fit_phase_seconds{phase="staging"}``) is the host
+stack + ``stage_dtype`` cast + transfer SUBMIT, and in the synchronous fit
+loops it ran serially before every donated dispatch. ``DevicePrefetcher``
+moves that work to a background thread: while step *n*'s dispatch executes,
+the producer pulls the next K-step group from the iterator, stages it, and
+issues a **non-blocking** ``jax.device_put`` — so batch *n+1* is in flight to
+HBM behind the compute (the tf.data/GPipe input-pipeline overlap pattern).
+
+Donation safety — the ownership hand-off, explicitly:
+
+* The jitted train steps donate ONLY ``(params, states, updater_state)``
+  (``donate_argnums=(0, 1, 2)``); batch inputs are never donated, so XLA
+  never reuses a staged batch buffer for step outputs.
+* Every staged item is produced from host numpy by ``jax.device_put`` /
+  ``make_array_from_callback`` — a FRESH device buffer per group, never a
+  view of a buffer an in-flight step reads.
+* Each queue slot is consumed by exactly one dispatch: the consumer pops an
+  item, hands it to the train step, and drops its reference. The producer
+  holds no reference after ``put``. Nothing ever aliases the donated
+  params/state buffers, so depth-2 prefetch cannot trigger a
+  "deleted buffer" error (pinned by tests/test_prefetch.py).
+
+Bounded depth (default 2 = double buffering) caps HBM held by staged batches
+at ``depth * group_bytes``; depth <= 0 degrades to synchronous inline staging
+(the pre-prefetch behavior, used by the numerical-equivalence tests and the
+bench A/B).
+"""
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Callable, Iterable, Optional
+
+from deeplearning4j_tpu.observability.metrics import (
+    global_registry as _obs_registry, tree_nbytes as _tree_nbytes,
+)
+
+# families resolved once at import; one series per `path` label (which fit
+# loop is prefetching). Budget pinned by test_telemetry_overhead_budget.
+_depth_gauge = _obs_registry().gauge(
+    "dl4j_prefetch_depth",
+    "staged items currently queued ahead of the dispatch loop, by fit path")
+_bytes_total = _obs_registry().counter(
+    "dl4j_prefetch_bytes_total",
+    "bytes of staged device arrays handed to the prefetch queue, by fit path")
+_staging_total = _obs_registry().counter(
+    "dl4j_prefetch_staging_seconds_total",
+    "producer-thread seconds spent pulling + staging items (the work hidden "
+    "behind dispatch when overlap works), by fit path")
+_wait_total = _obs_registry().counter(
+    "dl4j_prefetch_wait_seconds_total",
+    "consumer seconds blocked waiting for a staged item (staging NOT hidden "
+    "behind dispatch), by fit path")
+_overlap_gauge = _obs_registry().gauge(
+    "dl4j_prefetch_overlap_ratio",
+    "1 - wait/staging over this prefetcher's lifetime: fraction of staging "
+    "time hidden behind dispatch (1.0 = fully overlapped)")
+
+_DONE = object()  # queue sentinel: producer finished (or was stopped)
+
+
+class DevicePrefetcher:
+    """Pull items from ``source`` on a background thread, run ``stage`` on
+    each (stack + cast + non-blocking ``jax.device_put`` — staging decides
+    the sharding, e.g. a ``NamedSharding`` from ParallelWrapper._batch_spec),
+    and yield staged items in order through a bounded queue.
+
+    Single-use iterable. Errors raised by the iterator or by ``stage``
+    propagate to the consumer AFTER every item staged before them — the
+    consumer observes the same prefix of work as the synchronous loop.
+    ``close()`` (also called when iteration ends or the consumer's for-loop
+    exits early) shuts the producer down deterministically; the thread never
+    stays blocked on a full queue.
+
+    ``wait_series``: optional histogram series (e.g. the fit loops'
+    ``dl4j_fit_phase_seconds{phase="staging"}``) observing what the consumer
+    actually waited per item — under working overlap it collapses toward 0.
+    ``path=None`` disables all metrics (host-only use, AsyncDataSetIterator).
+    """
+
+    def __init__(self, source: Iterable, stage: Optional[Callable] = None,
+                 *, depth: int = 2, path: Optional[str] = "default",
+                 wait_series=None):
+        self._source = source
+        self._stage = stage
+        self._depth = depth
+        self._wait_series = wait_series
+        if path is not None:
+            self._m_depth = _depth_gauge.labels(path=path)
+            self._m_bytes = _bytes_total.labels(path=path)
+            self._m_staging = _staging_total.labels(path=path)
+            self._m_wait = _wait_total.labels(path=path)
+            self._m_overlap = _overlap_gauge.labels(path=path)
+        else:
+            self._m_depth = self._m_bytes = self._m_staging = None
+            self._m_wait = self._m_overlap = None
+        self._q: queue.Queue = queue.Queue(maxsize=max(1, depth))
+        self._stop = threading.Event()
+        self._error: Optional[BaseException] = None
+        self._staged_s = 0.0  # producer-side total (GIL-atomic float adds)
+        self._wait_s = 0.0
+        self.thread: Optional[threading.Thread] = None
+
+    # ---------------------------------------------------------------- producer
+    def _put(self, item) -> bool:
+        """Bounded put that polls the stop flag — a consumer that went away
+        can never strand the producer on a full queue (the reference
+        AsyncDataSetIterator leak)."""
+        while not self._stop.is_set():
+            try:
+                self._q.put(item, timeout=0.05)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def _run(self) -> None:
+        try:
+            it = iter(self._source)
+            while not self._stop.is_set():
+                t0 = time.perf_counter()
+                try:
+                    item = next(it)
+                except StopIteration:
+                    break
+                if self._stage is not None:
+                    item = self._stage(item)
+                dt = time.perf_counter() - t0
+                self._staged_s += dt
+                if self._m_staging is not None:
+                    self._m_staging.inc(dt)
+                    nbytes = _tree_nbytes(item)
+                    if nbytes:
+                        self._m_bytes.inc(nbytes)
+                if not self._put(item):
+                    return
+                if self._m_depth is not None:
+                    self._m_depth.set(self._q.qsize())
+        except BaseException as e:  # propagate into the consumer, in order
+            self._error = e
+        finally:
+            self._put(_DONE)
+
+    # ---------------------------------------------------------------- consumer
+    def __iter__(self):
+        if self._depth <= 0:
+            yield from self._iter_sync()
+            return
+        self.thread = threading.Thread(
+            target=self._run, daemon=True,
+            name="dl4j-prefetch" if self._wait_series is None
+            else "dl4j-prefetch-staging")
+        self.thread.start()
+        try:
+            while True:
+                t0 = time.perf_counter()
+                item = self._q.get()
+                wait = time.perf_counter() - t0
+                if item is _DONE:
+                    if self._error is not None:
+                        raise self._error
+                    return
+                self._wait_s += wait
+                if self._m_wait is not None:
+                    self._m_wait.inc(wait)
+                    self._m_depth.set(self._q.qsize())
+                    if self._staged_s > 0.0:
+                        self._m_overlap.set(max(0.0, min(1.0,
+                            1.0 - self._wait_s / self._staged_s)))
+                if self._wait_series is not None:
+                    self._wait_series.observe(wait)
+                yield item
+        finally:
+            self.close()
+
+    def _iter_sync(self):
+        """depth <= 0: the exact pre-prefetch behavior — stage inline on the
+        consumer thread, full staging cost visible in ``wait_series``."""
+        for item in self._source:
+            t0 = time.perf_counter()
+            if self._stage is not None:
+                item = self._stage(item)
+            dt = time.perf_counter() - t0
+            if self._m_staging is not None:
+                self._m_staging.inc(dt)
+                nbytes = _tree_nbytes(item)
+                if nbytes:
+                    self._m_bytes.inc(nbytes)
+            if self._wait_series is not None:
+                self._wait_series.observe(dt)
+            yield item
+
+    def close(self) -> None:
+        """Deterministic shutdown: stop the producer, unblock it by draining
+        the queue, and join. Safe to call more than once."""
+        self._stop.set()
+        if self.thread is None:
+            return
+        while True:
+            try:
+                self._q.get_nowait()
+            except queue.Empty:
+                break
+        self.thread.join(timeout=5.0)
+
+    def __enter__(self) -> "DevicePrefetcher":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -580,6 +580,10 @@ class ComputationGraph(LazyScore):
     #: MultiLayerNetwork.stage_dtype); None keeps exact f32 staging
     stage_dtype = None
 
+    #: staged K-groups prefetched ahead of the dispatch loop (see
+    #: MultiLayerNetwork.prefetch_depth); 0 = synchronous staging
+    prefetch_depth: int = 2
+
     def fit_iterator(self, iterator, epochs: int = 1,
                      ksteps: Optional[int] = None) -> None:
         """Iterator fit with K-step fused dispatch (TPU fast path — see
@@ -611,6 +615,8 @@ class ComputationGraph(LazyScore):
             self.epoch += 1
 
     def _fit_epoch_multistep(self, iterator, k: int) -> None:
+        from deeplearning4j_tpu.datasets.prefetch import DevicePrefetcher
+        from deeplearning4j_tpu.nn.multilayer import _stage_host
         from deeplearning4j_tpu.utils.batching import k_step_groups
 
         def to_batch(ds):
@@ -619,13 +625,35 @@ class ComputationGraph(LazyScore):
                 return None  # masked -> per-batch fallback
             return ([np.asarray(x) for x in xs], [np.asarray(y) for y in ys])
 
-        for kind, item in k_step_groups(iterator, k, to_batch):
+        def stage(kind_item):
+            # producer thread: per-stream stack + cast + non-blocking
+            # device_put (see MultiLayerNetwork._fit_epoch_multistep)
+            kind, item = kind_item
+            if kind != "group" or len(item) < 2:
+                return kind_item
+            n_in, n_out = len(item[0][0]), len(item[0][1])
+            xs = [jax.device_put(_stage_host(
+                      np.stack([b[0][i] for b in item]), self.stage_dtype))
+                  for i in range(n_in)]
+            ys = [jax.device_put(np.stack([b[1][i] for b in item]))
+                  for i in range(n_out)]
+            return "staged", (xs, ys, len(item))
+
+        pf = DevicePrefetcher(k_step_groups(iterator, k, to_batch), stage,
+                              depth=self.prefetch_depth, path="graph",
+                              wait_series=_t_staging)
+        for kind, item in pf:
             if kind == "single":
                 self._fit_batch(*_coerce_graph_batch(item))
+            elif kind == "group":
+                if item:
+                    self._fit_batch(item[0][0], item[0][1])
             else:
-                self._dispatch_multistep(item)
+                self._dispatch_staged(*item)
 
     def _dispatch_multistep(self, batches: list) -> None:
+        """Synchronous-staging compatibility path (prefetch_depth=0 semantics
+        for a pre-built group)."""
         if not batches:
             return
         if len(batches) == 1:
@@ -641,9 +669,14 @@ class ComputationGraph(LazyScore):
                   for i in range(n_in)]
             ys = [jnp.asarray(np.stack([b[1][i] for b in batches]))
                   for i in range(n_out)]
+        self._dispatch_staged(xs, ys, len(batches))
+
+    def _dispatch_staged(self, xs, ys, n: int) -> None:
+        # donated params/states/updater: in-place XLA update; staged xs/ys
+        # are fresh, non-donated buffers so prefetched groups never alias
+        # what the in-flight step consumes (see
+        # MultiLayerNetwork._dispatch_staged)
         self.last_batch_size = int(xs[0].shape[1]) if xs else 0
-        # donated params/states/updater: in-place XLA update (see
-        # MultiLayerNetwork._dispatch_multistep)
         multi = self._jit("multistep",
                           make_graph_multistep_train_step(self.conf),
                           donate=(0, 1, 2))
@@ -652,9 +685,9 @@ class ComputationGraph(LazyScore):
              losses) = multi(
                 self.params_list, self.state_list, self.updater_state, xs, ys,
                 self._next_rng(), jnp.int32(self.iteration))
-        _compile_tracker().note_step(len(batches))
+        _compile_tracker().note_step(n)
         with _t_listeners.time():
-            for i in range(len(batches)):
+            for i in range(n):
                 self.iteration += 1
                 self.score_value = (lambda ls=losses, j=i: ls[j])
                 for listener in self.listeners:

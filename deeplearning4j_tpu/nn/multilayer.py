@@ -49,7 +49,8 @@ Array = jax.Array
 _phase_hist = _obs_registry().histogram(
     "dl4j_fit_phase_seconds",
     "host wall seconds per fit-loop phase (staging: host cast+transfer "
-    "submit; dispatch: jitted-call submit; listeners: callback overhead)")
+    "submit, or with device prefetch the visible wait for the staged batch; "
+    "dispatch: jitted-call submit; listeners: callback overhead)")
 _t_staging = _phase_hist.labels(phase="staging")
 _t_dispatch = _phase_hist.labels(phase="dispatch")
 _t_listeners = _phase_hist.labels(phase="listeners")
@@ -586,6 +587,13 @@ class MultiLayerNetwork(LazyScore):
     #: keeps exact f32 staging.
     stage_dtype = None
 
+    #: K-step groups staged + transferred ahead of the dispatch loop on a
+    #: background thread (datasets.prefetch.DevicePrefetcher): 2 = double
+    #: buffering (batch n+1 in flight to HBM while step n executes), 0 =
+    #: synchronous staging (the pre-prefetch behavior; bit-identical params
+    #: either way — tests/test_prefetch.py).
+    prefetch_depth: int = 2
+
     def fit_iterator(self, iterator: Iterable, epochs: int = 1,
                      ksteps: Optional[int] = None) -> None:
         """Fit from a DataSetIterator (reference fit(DataSetIterator):978).
@@ -629,6 +637,7 @@ class MultiLayerNetwork(LazyScore):
             self.epoch += 1
 
     def _fit_epoch_multistep(self, iterator, k: int) -> None:
+        from deeplearning4j_tpu.datasets.prefetch import DevicePrefetcher
         from deeplearning4j_tpu.utils.batching import k_step_groups
 
         def to_batch(ds):
@@ -636,14 +645,35 @@ class MultiLayerNetwork(LazyScore):
                 return None  # masked -> per-batch fallback
             return np.asarray(ds.features), np.asarray(ds.labels)
 
-        for kind, item in k_step_groups(iterator, k, to_batch):
+        def stage(kind_item):
+            # producer thread: stack + cast + NON-BLOCKING device_put — the
+            # (K, B, ...) group is in flight to HBM while the previous
+            # dispatch executes. Singles and len<2 groups pass through to
+            # the host fallback path unchanged.
+            kind, item = kind_item
+            if kind != "group" or len(item) < 2:
+                return kind_item
+            xs = jax.device_put(_stage_host(np.stack([b[0] for b in item]),
+                                            self.stage_dtype))
+            ys = jax.device_put(np.stack([b[1] for b in item]))
+            return "staged", (xs, ys, len(item))
+
+        pf = DevicePrefetcher(k_step_groups(iterator, k, to_batch), stage,
+                              depth=self.prefetch_depth, path="multilayer",
+                              wait_series=_t_staging)
+        for kind, item in pf:
             if kind == "single":
                 self._fit_batch(item.features, item.labels,
                                 item.features_mask, item.labels_mask)
+            elif kind == "group":
+                if item:
+                    self._fit_batch(item[0][0], item[0][1])
             else:
-                self._dispatch_multistep(item)
+                self._dispatch_staged(*item)
 
     def _dispatch_multistep(self, batches: list) -> None:
+        """Synchronous-staging compatibility path (prefetch_depth=0 semantics
+        for a pre-built group)."""
         if not batches:
             return
         if len(batches) == 1:
@@ -653,12 +683,21 @@ class MultiLayerNetwork(LazyScore):
             xs = jnp.asarray(_stage_host(np.stack([b[0] for b in batches]),
                                          self.stage_dtype))
             ys = jnp.asarray(np.stack([b[1] for b in batches]))
+        self._dispatch_staged(xs, ys, len(batches))
+
+    def _dispatch_staged(self, xs, ys, n: int) -> None:
+        """Run a K-step group whose (K, B, ...) stacks are already device-
+        resident (or in flight — dispatch never blocks on the transfer).
+
+        Donation hand-off: params/states/updater buffers are DONATED — XLA
+        updates them in place (no 2x param HBM during the step) and the
+        previous arrays are consumed; anyone holding stale references gets a
+        loud "deleted buffer" error, never silent corruption (clone() deep-
+        copies for this reason; donation is a no-op on CPU). The staged
+        xs/ys are NOT in the donated argnums and were freshly created by
+        device_put on the prefetch thread, so a prefetched group can never
+        alias a buffer the in-flight step is consuming."""
         self.last_batch_size = int(xs.shape[1])
-        # params/states/updater buffers are DONATED: XLA updates them in
-        # place (no 2x param HBM during the step). The previous arrays are
-        # consumed — anyone holding stale references gets a loud
-        # "deleted buffer" error, never silent corruption; clone() deep-
-        # copies for this reason. (Donation is a no-op on CPU.)
         multi = self._jit("multistep", make_multistep_train_step(self.conf),
                           donate=(0, 1, 2))
         with _t_dispatch.time():
@@ -666,9 +705,9 @@ class MultiLayerNetwork(LazyScore):
              losses) = multi(
                 self.params_list, self.state_list, self.updater_state, xs, ys,
                 self._next_rng(), jnp.int32(self.iteration))
-        _compile_tracker().note_step(len(batches))
+        _compile_tracker().note_step(n)
         with _t_listeners.time():
-            for i in range(len(batches)):
+            for i in range(n):
                 self.iteration += 1
                 self.score_value = (lambda ls=losses, j=i: ls[j])
                 for listener in self.listeners:

@@ -159,26 +159,41 @@ class PipelineTrainer:
             jax.jit(make_train_step(self.net.conf,
                                     loss=self._pipeline_loss)))
 
+    #: batches staged + transferred ahead of the dispatch loop (see
+    #: MultiLayerNetwork.prefetch_depth); 0 = synchronous staging
+    prefetch_depth: int = 2
+
     def fit(self, iterator, epochs: int = 1) -> None:
         """Reference ParallelWrapper.fit(DataSetIterator):322 shape: every
-        batch runs one pipelined train step; listeners fire per iteration."""
+        batch runs one pipelined train step; listeners fire per iteration.
+        The next batch is staged + transferred on a background thread
+        (DevicePrefetcher) while the current pipelined step executes."""
+        from deeplearning4j_tpu.datasets.prefetch import DevicePrefetcher
+
         net = self.net
+
+        def stage(ds):
+            if (getattr(ds, "features_mask", None) is not None
+                    or getattr(ds, "labels_mask", None) is not None):
+                # siblings fall back to net._fit_batch for masked batches;
+                # the pipeline body threads no masks, so training here would
+                # silently weight padded steps. Raised on the producer, the
+                # error reaches the consumer AFTER every earlier batch ran —
+                # same observable prefix as the synchronous loop.
+                raise ValueError("PipelineTrainer does not support "
+                                 "masked batches; use net.fit()")
+            x = jax.device_put(np.asarray(ds.features))
+            y = jax.device_put(np.asarray(ds.labels))
+            return x, y
+
         if self._step is None:
             self._step = self._make_step()
         for _ in range(epochs):
             if hasattr(iterator, "reset"):
                 iterator.reset()
-            for ds in iterator:
-                if (getattr(ds, "features_mask", None) is not None
-                        or getattr(ds, "labels_mask", None) is not None):
-                    # siblings fall back to net._fit_batch for masked
-                    # batches; the pipeline body threads no masks, so
-                    # training here would silently weight padded steps
-                    raise ValueError("PipelineTrainer does not support "
-                                     "masked batches; use net.fit()")
-                with _t_staging.time():
-                    x = jnp.asarray(np.asarray(ds.features))
-                    y = jnp.asarray(np.asarray(ds.labels))
+            pf = DevicePrefetcher(iterator, stage, depth=self.prefetch_depth,
+                                  path="pipeline", wait_series=_t_staging)
+            for x, y in pf:
                 net.last_batch_size = int(x.shape[0]) if x.ndim else 0
                 with _t_dispatch.time():
                     (net.params_list, net.state_list, net.updater_state,

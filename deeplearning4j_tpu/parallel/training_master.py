@@ -124,7 +124,8 @@ class ParameterAveragingTrainingMaster(TrainingMaster):
                  averaging_frequency: int = 1,
                  average_updaters: bool = True,
                  collect_training_stats: bool = False,
-                 mesh: Optional[Mesh] = None):
+                 mesh: Optional[Mesh] = None,
+                 prefetch: int = 2):
         self.mesh = mesh or data_parallel_mesh(num_workers)
         self.num_workers = self.mesh.shape["data"]
         self.batch_size_per_worker = batch_size_per_worker
@@ -132,6 +133,9 @@ class ParameterAveragingTrainingMaster(TrainingMaster):
         self.average_updaters = average_updaters
         self.collect_training_stats = collect_training_stats
         self.stats = SparkTrainingStats() if collect_training_stats else None
+        #: splits staged + transferred ahead of the shard_map dispatch loop
+        #: (see MultiLayerNetwork.prefetch_depth); 0 = synchronous staging
+        self.prefetch = prefetch
         self._local_fns = {}
 
     class Builder:
@@ -156,6 +160,10 @@ class ParameterAveragingTrainingMaster(TrainingMaster):
 
         def mesh(self, mesh: Mesh):
             self._kw["mesh"] = mesh
+            return self
+
+        def prefetch(self, n: int):
+            self._kw["prefetch"] = n
             return self
 
         def build(self) -> "ParameterAveragingTrainingMaster":
@@ -256,29 +264,51 @@ class ParameterAveragingTrainingMaster(TrainingMaster):
         ).labels(op="parameter_average", site="training_master")
         param_bytes = _tree_nbytes(model.params_list)
 
-        def run_split(split_batches):
-            nonlocal params, states, upd
+        def splits():
+            rows: List[List] = [[] for _ in range(D)]
+            filled = 0
+            for ds in data_iterator:
+                rows[filled % D].append(ds)
+                filled += 1
+                if filled == D * F:
+                    yield rows
+                    rows = [[] for _ in range(D)]
+                    filled = 0
+            if filled and filled % D == 0:
+                # partial split: fewer sequential steps, same worker count
+                yield rows
+            # else: drop the ragged tail (reference repartitions to avoid
+            # this; batch counts not divisible by the worker count skipped)
+
+        def stage(split_batches):
+            # producer thread: the next split's (D, F, B, ...) stacks are
+            # built and put in flight (non-blocking sharded device_put)
+            # while the current split's shard_map local steps execute
             t0 = time.time()
-            # (D, F, B, ...) feature/label stacks
             xs = np.stack([np.stack([np.asarray(ds.features) for ds in row])
                            for row in split_batches])
             ys = np.stack([np.stack([np.asarray(ds.labels) for ds in row])
                            for row in split_batches])
-            xs = jax.device_put(jnp.asarray(xs), sharding)
-            ys = jax.device_put(jnp.asarray(ys), sharding)
+            xs = jax.device_put(xs, sharding)
+            ys = jax.device_put(ys, sharding)
             if self.stats:
                 self.stats.add("SplitData", t0, time.time() - t0)
+            return xs, ys
+
+        def run_split(xs, ys):
+            nonlocal params, states, upd
+            f = int(xs.shape[1])  # F, or fewer on a partial split
             t1 = time.time()
             params, states, upd, loss = local(
                 params, states, upd, xs, ys, model._next_rng(),
                 jnp.int32(model.iteration))
-            model.iteration += F
+            model.iteration += f
             if self.stats:
                 # stats want the realized loss; this is the only host sync
                 # in the split and only happens when stats are collected
                 self.stats.add("WorkerFit", t1, time.time() - t1,
                                loss=float(loss))
-            _compile_tracker().note_step(F)
+            _compile_tracker().note_step(f)
             t2 = time.time()
             params, states, upd = average(params, states, upd)
             avg_bytes.inc(param_bytes)
@@ -288,21 +318,12 @@ class ParameterAveragingTrainingMaster(TrainingMaster):
             for listener in model.listeners:
                 listener.iteration_done(model, model.iteration)
 
-        rows: List[List] = [[] for _ in range(D)]
-        filled = 0
-        for ds in data_iterator:
-            rows[filled % D].append(ds)
-            filled += 1
-            if filled == D * F:
-                run_split(rows)
-                rows = [[] for _ in range(D)]
-                filled = 0
-        if filled:
-            if filled % D == 0:
-                # partial split: fewer sequential steps, same worker count
-                run_split([row for row in rows])
-            # else: drop the ragged tail (reference repartitions to avoid this;
-            # here batch counts not divisible by the worker count are skipped)
+        from deeplearning4j_tpu.datasets.prefetch import DevicePrefetcher
+
+        pf = DevicePrefetcher(splits(), stage, depth=self.prefetch,
+                              path="training_master")
+        for xs, ys in pf:
+            run_split(xs, ys)
 
         t3 = time.time()
         unstack = functools.partial(jax.tree_util.tree_map, lambda a: np.asarray(a[0]))

@@ -49,7 +49,8 @@ from deeplearning4j_tpu.parallel.mesh import data_parallel_mesh
 _phase_hist = _obs_registry().histogram(
     "dl4j_fit_phase_seconds",
     "host wall seconds per fit-loop phase (staging: host cast+transfer "
-    "submit; dispatch: jitted-call submit; listeners: callback overhead)")
+    "submit, or with device prefetch the visible wait for the staged batch; "
+    "dispatch: jitted-call submit; listeners: callback overhead)")
 _t_staging = _phase_hist.labels(phase="staging")
 _t_dispatch = _phase_hist.labels(phase="dispatch")
 _t_listeners = _phase_hist.labels(phase="listeners")
@@ -475,15 +476,9 @@ class ParallelWrapper:
         psum_bytes = _collective_bytes.labels(op="psum_grad",
                                               site="wrapper_sync")
 
-        def dispatch_one(x, y):
-            with _t_staging.time():
-                if is_graph:
-                    x = [self._stage(a, self._batch_spec(a)) for a in x]
-                    y = [self._stage(a, self._batch_spec(a)) for a in y]
-                else:
-                    net.last_batch_size = int(np.shape(x)[0])
-                    x = self._stage(x, self._batch_spec(x))
-                    y = self._stage(y, self._batch_spec(y))
+        def dispatch_one(x, y, batch_size):
+            if not is_graph:
+                net.last_batch_size = batch_size
             with _t_dispatch.time():
                 (net.params_list, net.state_list, net.updater_state, loss) = \
                     self._sync_step(net.params_list, net.state_list,
@@ -501,24 +496,9 @@ class ParallelWrapper:
             # stacked (K, B, ...) batches: batch spec shifted one axis right
             return P(None, *self._batch_spec(arr[0]))
 
-        def dispatch(batches):
-            if len(batches) == 1:
-                dispatch_one(*batches[0])
-                return
-            with _t_staging.time():
-                if is_graph:
-                    xs = [self._stage(a, stack_spec(a))
-                          for a in (np.stack([b[0][i] for b in batches])
-                                    for i in range(len(batches[0][0])))]
-                    ys = [self._stage(a, stack_spec(a))
-                          for a in (np.stack([b[1][i] for b in batches])
-                                    for i in range(len(batches[0][1])))]
-                else:
-                    xs = np.stack([b[0] for b in batches])
-                    net.last_batch_size = int(xs.shape[1])
-                    xs = self._stage(xs, stack_spec(xs))
-                    ys = np.stack([b[1] for b in batches])
-                    ys = self._stage(ys, stack_spec(ys))
+        def dispatch(xs, ys, n):
+            if not is_graph:
+                net.last_batch_size = int(xs.shape[1])
             with _t_dispatch.time():
                 (net.params_list, net.state_list, net.updater_state,
                  losses) = \
@@ -526,23 +506,64 @@ class ParallelWrapper:
                                      net.updater_state, xs, ys,
                                      net._next_rng(),
                                      jnp.int32(net.iteration))
-            _compile_tracker().note_step(len(batches))
-            psum_bytes.inc(param_bytes * len(batches))
+            _compile_tracker().note_step(n)
+            psum_bytes.inc(param_bytes * n)
             with _t_listeners.time():
-                for i in range(len(batches)):
+                for i in range(n):
                     net.iteration += 1
                     net.score_value = (lambda ls=losses, j=i: ls[j])
                     for listener in net.listeners:
                         listener.iteration_done(net, net.iteration)
 
+        def stage(kind_item):
+            # producer thread: the sharded version of the single-chip stage —
+            # stack + non-blocking device_put laid out per _batch_spec (or
+            # per-process shards via make_array_from_callback), so the
+            # sharded (K, B, ...) group is in flight while the previous
+            # dispatch executes. Singles fall through to the host fallback.
+            kind, item = kind_item
+            if kind != "group":
+                return kind_item
+            if len(item) == 1:
+                x, y = item[0]
+                if is_graph:
+                    bs = int(np.shape(x[0])[0]) if x else 0
+                    x = [self._stage(a, self._batch_spec(a)) for a in x]
+                    y = [self._stage(a, self._batch_spec(a)) for a in y]
+                else:
+                    bs = int(np.shape(x)[0])
+                    x = self._stage(x, self._batch_spec(x))
+                    y = self._stage(y, self._batch_spec(y))
+                return "staged1", (x, y, bs)
+            if is_graph:
+                xs = [self._stage(a, stack_spec(a))
+                      for a in (np.stack([b[0][i] for b in item])
+                                for i in range(len(item[0][0])))]
+                ys = [self._stage(a, stack_spec(a))
+                      for a in (np.stack([b[1][i] for b in item])
+                                for i in range(len(item[0][1])))]
+            else:
+                xs = np.stack([b[0] for b in item])
+                xs = self._stage(xs, stack_spec(xs))
+                ys = np.stack([b[1] for b in item])
+                ys = self._stage(ys, stack_spec(ys))
+            return "stagedK", (xs, ys, len(item))
+
+        from deeplearning4j_tpu.datasets.prefetch import DevicePrefetcher
+
         for _ in range(epochs):
             if hasattr(iterator, "reset"):
                 iterator.reset()
-            for kind, item in k_step_groups(iterator, k, to_batch):
+            pf = DevicePrefetcher(k_step_groups(iterator, k, to_batch), stage,
+                                  depth=self.prefetch, path="wrapper_sync",
+                                  wait_series=_t_staging)
+            for kind, item in pf:
                 if kind == "single":
                     fallback(item)
+                elif kind == "staged1":
+                    dispatch_one(*item)
                 else:
-                    dispatch(item)
+                    dispatch(*item)
 
     # --------------------------------------------------- local SGD (freq=N>1)
     def _make_local_sgd_fns(self):
@@ -630,22 +651,31 @@ class ParallelWrapper:
         avg_bytes = _collective_bytes.labels(op="parameter_average",
                                              site="wrapper_local_sgd")
         param_bytes = _tree_nbytes(net.params_list)
+
+        def stage(ds):
+            # producer thread: sharded non-blocking transfer of the next
+            # batch while the current local step runs
+            if is_graph:
+                xs, ys, _, _ = _coerce_graph_batch(ds)
+                x = [jax.device_put(a, batch_sh) for a in xs]
+                y = [jax.device_put(a, batch_sh) for a in ys]
+                return x, y, 0
+            bs = int(np.shape(ds.features)[0])
+            return (jax.device_put(ds.features, batch_sh),
+                    jax.device_put(ds.labels, batch_sh), bs)
+
+        from deeplearning4j_tpu.datasets.prefetch import DevicePrefetcher
+
         since_avg = 0
         for _ in range(epochs):
             if hasattr(iterator, "reset"):
                 iterator.reset()
-            for ds in iterator:
-                with _t_staging.time():
-                    if is_graph:
-                        xs, ys, _, _ = _coerce_graph_batch(ds)
-                        x = [jax.device_put(jnp.asarray(a), batch_sh)
-                             for a in xs]
-                        y = [jax.device_put(jnp.asarray(a), batch_sh)
-                             for a in ys]
-                    else:
-                        net.last_batch_size = int(np.shape(ds.features)[0])
-                        x = jax.device_put(jnp.asarray(ds.features), batch_sh)
-                        y = jax.device_put(jnp.asarray(ds.labels), batch_sh)
+            pf = DevicePrefetcher(iterator, stage, depth=self.prefetch,
+                                  path="wrapper_local_sgd",
+                                  wait_series=_t_staging)
+            for x, y, bs in pf:
+                if not is_graph:
+                    net.last_batch_size = bs
                 with _t_dispatch.time():
                     params, states, upd, loss = self._local_step(
                         params, states, upd, x, y, net._next_rng(),

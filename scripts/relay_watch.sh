@@ -53,7 +53,9 @@ while true; do
     if probe; then
         echo "HEALTHY $(date -u +%FT%TZ) — capturing full grid" >> "$LOG"
         before=$(wc -l < scripts/bench_log.jsonl)
-        bash scripts/bench_capture.sh full 2>> scripts/capture_r5.log
+        # DL4J_FROM_WATCHER stops bench_capture.sh re-arming a second watcher
+        DL4J_FROM_WATCHER=1 bash scripts/bench_capture.sh full \
+            2>> scripts/capture_r5.log
         ok=$(healthy_rows_since "$before")
         if [ "${ok:-0}" -gt 0 ]; then
             mkdir -p scripts/profiles

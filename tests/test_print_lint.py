@@ -1,4 +1,4 @@
-"""Lint: no bare ``print(`` in library training/ops/parallel code.
+"""Lint: no bare ``print(`` in library training/ops/parallel/data code.
 
 Library code must report through logging or the listener pipeline so output
 is routable and rate-limitable (and so bench.py's one-JSON-line stdout
@@ -11,7 +11,7 @@ import token
 import tokenize
 
 PKG = pathlib.Path(__file__).resolve().parents[1] / "deeplearning4j_tpu"
-LINTED_DIRS = ("nn", "ops", "parallel")
+LINTED_DIRS = ("nn", "ops", "parallel", "datasets", "utils")
 
 
 def _bare_print_calls(path: pathlib.Path):
