@@ -36,6 +36,10 @@ import threading
 import time
 from typing import Callable, Iterable, Optional
 
+from deeplearning4j_tpu.observability.names import (
+    PREFETCH_BYTES_TOTAL, PREFETCH_DEPTH, PREFETCH_OVERLAP_RATIO,
+    PREFETCH_STAGING_SECONDS_TOTAL, PREFETCH_WAIT_SECONDS_TOTAL,
+)
 from deeplearning4j_tpu.observability.metrics import (
     global_registry as _obs_registry, tree_nbytes as _tree_nbytes,
 )
@@ -43,21 +47,21 @@ from deeplearning4j_tpu.observability.metrics import (
 # families resolved once at import; one series per `path` label (which fit
 # loop is prefetching). Budget pinned by test_telemetry_overhead_budget.
 _depth_gauge = _obs_registry().gauge(
-    "dl4j_prefetch_depth",
+    PREFETCH_DEPTH,
     "staged items currently queued ahead of the dispatch loop, by fit path")
 _bytes_total = _obs_registry().counter(
-    "dl4j_prefetch_bytes_total",
+    PREFETCH_BYTES_TOTAL,
     "bytes of staged device arrays handed to the prefetch queue, by fit path")
 _staging_total = _obs_registry().counter(
-    "dl4j_prefetch_staging_seconds_total",
+    PREFETCH_STAGING_SECONDS_TOTAL,
     "producer-thread seconds spent pulling + staging items (the work hidden "
     "behind dispatch when overlap works), by fit path")
 _wait_total = _obs_registry().counter(
-    "dl4j_prefetch_wait_seconds_total",
+    PREFETCH_WAIT_SECONDS_TOTAL,
     "consumer seconds blocked waiting for a staged item (staging NOT hidden "
     "behind dispatch), by fit path")
 _overlap_gauge = _obs_registry().gauge(
-    "dl4j_prefetch_overlap_ratio",
+    PREFETCH_OVERLAP_RATIO,
     "1 - wait/staging over this prefetcher's lifetime: fraction of staging "
     "time hidden behind dispatch (1.0 = fully overlapped)")
 

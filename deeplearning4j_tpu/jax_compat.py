@@ -69,6 +69,7 @@ def in_checked_shard_map(x) -> bool:
         try:
             if bool(getattr(typeof(x), "vma", None)):
                 return True
+        # lint: swallowed-exception-ok (typeof/vma probe across JAX versions; absence means not varying)
         except Exception:
             pass
     return _SHARD_MAP_GUARD.get() is True

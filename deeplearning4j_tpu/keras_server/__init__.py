@@ -75,9 +75,11 @@ class DeepLearning4jEntryPoint:
             raise ValueError("feature/label batch counts differ")
         for _ in range(int(nb_epoch)):
             for x, y in zip(xs, ys):
+                # lint: host-sync-in-hot-loop-ok (staging HDF5 host batches before fit, not a device read)
                 net.fit(np.asarray(x, np.float32), np.asarray(y, np.float32))
         self._models[model_file_path] = net
         return {"batches": len(xs), "epochs": int(nb_epoch),
+                # lint: host-sync-in-hot-loop-ok (trusted LazyScore sync, once per RPC after fit)
                 "score": float(net.score_value)}
 
     def evaluate(self, model_file_path: str, features_directory: str,

@@ -1,0 +1,48 @@
+"""graftlint: JAX/TPU-aware static analysis for the framework's own code.
+
+The rules encode hot-path invariants the profiles keep re-teaching: no host
+syncs inside fit loops, no donated-buffer reuse, no recompile-triggering
+patterns inside jit seams, no global RNG in library code, one central module
+for telemetry metric names, no bare prints past bench.py's stdout contract,
+no silently-swallowed exceptions.
+
+    python -m deeplearning4j_tpu.lint deeplearning4j_tpu          # human
+    python -m deeplearning4j_tpu.lint deeplearning4j_tpu --json   # for gates
+
+Suppress a deliberate finding inline, reason required::
+
+    x = np.asarray(batch)  # lint: host-sync-in-hot-loop-ok (host ndarray in)
+
+See docs/GUIDE.md "Static analysis" for the rule catalog and how to add one.
+"""
+from __future__ import annotations
+
+import pathlib
+from typing import Iterable, Optional, Sequence
+
+from .engine import (BAD_SUPPRESSION, FileContext, LintResult, Rule,
+                     Suppression, Violation, run)
+from .rules import REGISTRY, default_rules, rule_names
+
+__all__ = [
+    "BAD_SUPPRESSION", "FileContext", "LintResult", "Rule", "Suppression",
+    "Violation", "REGISTRY", "default_rules", "rule_names", "run",
+    "run_paths",
+]
+
+
+def run_paths(paths: Sequence, rule_subset: Optional[Iterable[str]] = None
+              ) -> LintResult:
+    """Lint ``paths`` (files or package dirs) with the full registry, or
+    with ``rule_subset`` names. Unknown names in the subset raise — a gate
+    script must not silently run fewer checks than it was asked for."""
+    if rule_subset is None:
+        rules = default_rules()
+    else:
+        unknown = [n for n in rule_subset if n not in REGISTRY]
+        if unknown:
+            raise ValueError(
+                f"unknown rule(s) {unknown}; known: {rule_names()}")
+        rules = [REGISTRY[n]() for n in rule_subset]
+    return run([pathlib.Path(p) for p in paths], rules,
+               known_rule_names=rule_names())

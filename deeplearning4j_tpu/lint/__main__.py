@@ -1,0 +1,76 @@
+"""graftlint CLI: ``python -m deeplearning4j_tpu.lint [paths] [options]``.
+
+Exit status: 0 clean, 1 unsuppressed violations (or parse errors), 2 usage
+error. ``--json`` emits one machine-readable object (the lint_gate.sh /
+baseline format); the default human format is one ``path:line: [rule]``
+row per finding.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+from typing import List, Optional
+
+from . import REGISTRY, rule_names, run_paths
+
+
+def _build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m deeplearning4j_tpu.lint",
+        description="graftlint: JAX/TPU-aware static analysis "
+                    "(rule catalog: --list-rules)")
+    p.add_argument("paths", nargs="*",
+                   help="files or package dirs to lint (default: the "
+                        "deeplearning4j_tpu package this module lives in)")
+    p.add_argument("--json", action="store_true",
+                   help="emit one JSON object instead of human lines")
+    p.add_argument("--rules",
+                   help="comma-separated rule subset (default: all)")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print the rule catalog and exit")
+    p.add_argument("--show-suppressed", action="store_true",
+                   help="also print suppressed findings (human mode; JSON "
+                        "always includes them)")
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = _build_parser().parse_args(argv)
+
+    if args.list_rules:
+        for name in rule_names():
+            print(f"{name:24s} {REGISTRY[name].description}")
+        return 0
+
+    paths = args.paths or [str(pathlib.Path(__file__).resolve().parents[1])]
+    subset = None
+    if args.rules:
+        subset = [r.strip() for r in args.rules.split(",") if r.strip()]
+    try:
+        result = run_paths(paths, subset)
+    except ValueError as e:
+        print(str(e), file=sys.stderr)
+        return 2
+
+    if args.json:
+        print(json.dumps(result.to_json(), indent=2, sort_keys=True))
+    else:
+        for err in result.errors:
+            print(f"ERROR {err}")
+        for v in result.violations:
+            print(v.render())
+            if v.snippet:
+                print(f"    {v.snippet}")
+        if args.show_suppressed:
+            for v in result.suppressed:
+                print(v.render())
+        n, s = len(result.violations), len(result.suppressed)
+        print(f"graftlint: {result.files_scanned} files, {n} violation(s), "
+              f"{s} suppressed, {len(result.errors)} error(s)")
+    return 0 if result.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

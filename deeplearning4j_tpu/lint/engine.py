@@ -1,0 +1,344 @@
+"""graftlint core: file contexts, suppression parsing, rule base, runner.
+
+The framework's hot-path invariants (no host syncs inside fit loops, no
+donated-buffer reuse, no recompile-triggering captures inside jit seams, one
+stdout contract for bench.py) were enforced by convention and rediscovered in
+profiles when broken. graftlint machine-checks them: rules are small
+AST/tokenize passes over library code, wired into the test suite and a CLI
+(``python -m deeplearning4j_tpu.lint``).
+
+Design choices worth stating:
+
+* **Static only.** Rules never import the code under analysis — linting a
+  broken tree must not execute it (and must work before jax is importable on
+  a given host). Everything is ``ast`` + ``tokenize``.
+* **Suppressions are loud.** ``# lint: <rule>-ok (reason)`` on the offending
+  line (or a standalone comment on the line above). The reason is mandatory:
+  a suppression without one is itself a violation (``bad-suppression``), as
+  is a suppression naming an unknown rule — typos must not silently disable
+  a check. Suppressed findings stay in the report (flagged), so the gate
+  script can show when a diff adds new suppressions.
+* **Per-rule path scoping.** A rule owns glob excludes (e.g. ``bare-print``
+  skips the CLI entry points, whose stdout IS the product). Scoping is for
+  whole files that are out of a rule's jurisdiction; single deliberate lines
+  use suppressions, keeping the decision next to the code it covers.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+import fnmatch
+import io
+import pathlib
+import re
+import token
+import tokenize
+from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Tuple
+
+#: the marker that introduces suppressions inside a comment
+_SUPPRESS_INTRO = re.compile(r"#\s*lint:\s*(?P<body>.*)$")
+#: one suppression: "<rule>-ok" optionally followed by "(reason)"
+_SUPPRESS_MARKER = re.compile(
+    r"(?P<rule>[a-z][a-z0-9]*(?:-[a-z0-9]+)*)-ok(?:\s*\((?P<reason>[^)]*)\))?")
+
+#: rule id reserved for malformed/unknown suppressions (engine-level)
+BAD_SUPPRESSION = "bad-suppression"
+
+
+@dataclasses.dataclass(frozen=True)
+class Violation:
+    """One finding. ``path`` is scan-root-relative posix (stable across
+    machines, so baselines diff cleanly)."""
+
+    rule: str
+    path: str
+    line: int
+    message: str
+    snippet: str = ""
+    suppressed: bool = False
+    reason: str = ""
+
+    def key(self) -> Tuple[str, int, str]:
+        return (self.path, self.line, self.rule)
+
+    def to_json(self) -> dict:
+        d = {"rule": self.rule, "path": self.path, "line": self.line,
+             "message": self.message}
+        if self.snippet:
+            d["snippet"] = self.snippet
+        if self.suppressed:
+            d["suppressed"] = True
+            d["reason"] = self.reason
+        return d
+
+    def render(self) -> str:
+        tag = " (suppressed: %s)" % self.reason if self.suppressed else ""
+        loc = f"{self.path}:{self.line}"
+        return f"{loc}: [{self.rule}] {self.message}{tag}"
+
+
+@dataclasses.dataclass
+class Suppression:
+    rule: str
+    reason: str
+    line: int          # source line the marker sits on
+    applies_to: int    # line the suppression covers
+
+
+class FileContext:
+    """Lazily-parsed view of one source file shared by every rule: raw text,
+    token stream, AST, and the parsed suppression table."""
+
+    def __init__(self, path: pathlib.Path, rel: str):
+        self.path = path
+        self.rel = rel
+        self.text = path.read_text()
+        self.lines = self.text.splitlines()
+        self._tokens: Optional[List[tokenize.TokenInfo]] = None
+        self._tree: Optional[ast.Module] = None
+        self._tree_error: Optional[str] = None
+        #: applies_to line -> {rule -> Suppression}
+        self.suppressions: Dict[int, Dict[str, Suppression]] = {}
+        #: suppressions with a missing reason (reported as bad-suppression)
+        self.malformed: List[Suppression] = []
+        self._parse_suppressions()
+
+    # ------------------------------------------------------------ lazy parses
+    @property
+    def tokens(self) -> List[tokenize.TokenInfo]:
+        if self._tokens is None:
+            self._tokens = list(tokenize.generate_tokens(
+                io.StringIO(self.text).readline))
+        return self._tokens
+
+    @property
+    def tree(self) -> Optional[ast.Module]:
+        if self._tree is None and self._tree_error is None:
+            try:
+                self._tree = ast.parse(self.text)
+            except SyntaxError as e:  # surfaced by the runner, not swallowed
+                self._tree_error = f"{self.rel}:{e.lineno}: {e.msg}"
+        return self._tree
+
+    @property
+    def tree_error(self) -> Optional[str]:
+        self.tree  # noqa: B018 - force the parse attempt
+        return self._tree_error
+
+    def line_at(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1].strip()
+        return ""
+
+    # ---------------------------------------------------------- suppressions
+    def _parse_suppressions(self) -> None:
+        try:
+            toks = self.tokens
+        except (tokenize.TokenError, SyntaxError, IndentationError):
+            return
+        for i, t in enumerate(toks):
+            if t.type != token.COMMENT:
+                continue
+            m = _SUPPRESS_INTRO.search(t.string)
+            if m is None:
+                continue
+            standalone = t.line.lstrip().startswith("#")
+            applies_to = t.start[0]
+            if standalone:
+                # standalone comment: covers the next code line (multi-line
+                # statements get annotated above their first line)
+                nxt = next((n for n in toks[i + 1:]
+                            if n.type not in (token.NL, token.NEWLINE,
+                                              token.COMMENT, token.INDENT,
+                                              token.DEDENT)), None)
+                if nxt is not None:
+                    applies_to = nxt.start[0]
+            body = m.group("body")
+            found_any = False
+            for sm in _SUPPRESS_MARKER.finditer(body):
+                found_any = True
+                reason = (sm.group("reason") or "").strip()
+                sup = Suppression(sm.group("rule"), reason, t.start[0],
+                                  applies_to)
+                if not reason:
+                    self.malformed.append(sup)
+                    continue
+                self.suppressions.setdefault(applies_to, {})[sup.rule] = sup
+            if not found_any:
+                # the intro marker with nothing parseable after it — flag it
+                # rather than silently ignoring an intended suppression
+                self.malformed.append(Suppression("", "", t.start[0],
+                                                  applies_to))
+
+    def suppression_for(self, rule: str, line: int) -> Optional[Suppression]:
+        return self.suppressions.get(line, {}).get(rule)
+
+
+class Rule:
+    """Base rule: subclasses set ``name``/``description``, optionally
+    ``exclude`` (fnmatch globs tested against the scan-relative posix path
+    AND the absolute posix path), and implement ``check``."""
+
+    name: str = ""
+    description: str = ""
+    exclude: Sequence[str] = ()
+
+    def applies_to(self, ctx: FileContext) -> bool:
+        full = self.exclude
+        rel = ctx.rel
+        ab = ctx.path.as_posix()
+        return not any(fnmatch.fnmatch(rel, g) or fnmatch.fnmatch(ab, g)
+                       for g in full)
+
+    def prepare(self, ctxs: Sequence[FileContext]) -> None:
+        """Called once per run with every file in scope, before ``check``.
+        Cross-file rules (metric-name-drift reads the names module) hook in
+        here; the default is stateless."""
+
+    def check(self, ctx: FileContext) -> Iterator[Violation]:
+        raise NotImplementedError
+
+    def violation(self, ctx: FileContext, line: int, message: str) -> Violation:
+        return Violation(self.name, ctx.rel, line, message,
+                         snippet=ctx.line_at(line))
+
+
+@dataclasses.dataclass
+class LintResult:
+    violations: List[Violation]       # unsuppressed — these fail the build
+    suppressed: List[Violation]       # found but covered by a reasoned marker
+    files_scanned: int
+    errors: List[str]                 # syntax/read errors (also build-failing)
+
+    @property
+    def ok(self) -> bool:
+        return not self.violations and not self.errors
+
+    def to_json(self) -> dict:
+        counts: Dict[str, int] = {}
+        for v in self.violations:
+            counts[v.rule] = counts.get(v.rule, 0) + 1
+        return {
+            "ok": self.ok,
+            "files_scanned": self.files_scanned,
+            "counts": counts,
+            "violations": [v.to_json() for v in self.violations],
+            "suppressed": [v.to_json() for v in self.suppressed],
+            "errors": list(self.errors),
+        }
+
+
+def iter_py_files(paths: Sequence[pathlib.Path]) -> List[Tuple[pathlib.Path, str]]:
+    """Expand files/dirs into sorted (path, scan-relative posix) pairs.
+    Relative paths are taken against the argument's parent so a package dir
+    argument yields ``pkgname/sub/mod.py`` — the baseline-stable form."""
+    out: Dict[pathlib.Path, str] = {}
+    for p in paths:
+        p = pathlib.Path(p)
+        if p.is_dir():
+            base = p.resolve().parent
+            for f in sorted(p.resolve().rglob("*.py")):
+                out[f] = f.relative_to(base).as_posix()
+        elif p.suffix == ".py":
+            out[p.resolve()] = p.name
+    return sorted(out.items(), key=lambda kv: kv[1])
+
+
+def run(paths: Sequence[pathlib.Path], rules: Sequence[Rule],
+        known_rule_names: Optional[Iterable[str]] = None) -> LintResult:
+    """Run ``rules`` over every .py under ``paths``; resolve suppressions.
+
+    ``known_rule_names``: full registry (suppressions may name a rule that
+    exists but isn't selected this run — that is not a typo)."""
+    known = set(known_rule_names or ()) | {r.name for r in rules}
+    files = iter_py_files(paths)
+    ctxs: List[FileContext] = []
+    errors: List[str] = []
+    for path, rel in files:
+        try:
+            ctxs.append(FileContext(path, rel))
+        except (OSError, UnicodeDecodeError, tokenize.TokenError) as e:
+            errors.append(f"{rel}: unreadable: {e}")
+
+    for rule in rules:
+        rule.prepare(ctxs)
+
+    open_v: List[Violation] = []
+    suppressed: List[Violation] = []
+    for ctx in ctxs:
+        seen: set = set()
+        for rule in rules:
+            if not rule.applies_to(ctx):
+                continue
+            try:
+                found = list(rule.check(ctx))
+            except (SyntaxError, tokenize.TokenError, IndentationError):
+                if ctx.tree_error and ctx.tree_error not in errors:
+                    errors.append(ctx.tree_error)
+                continue
+            for v in found:
+                if v.key() in seen:
+                    continue
+                seen.add(v.key())
+                sup = ctx.suppression_for(v.rule, v.line)
+                if sup is not None:
+                    suppressed.append(dataclasses.replace(
+                        v, suppressed=True, reason=sup.reason))
+                else:
+                    open_v.append(v)
+        if ctx.tree_error and ctx.tree_error not in errors:
+            errors.append(ctx.tree_error)
+        # engine-level: malformed suppressions + unknown rule names
+        for sup in ctx.malformed:
+            what = (f"suppression {sup.rule!r}-ok is missing its required "
+                    "(reason)" if sup.rule else
+                    "'# lint:' comment with no parseable '<rule>-ok' marker")
+            open_v.append(Violation(BAD_SUPPRESSION, ctx.rel, sup.line, what,
+                                    snippet=ctx.line_at(sup.line)))
+        for by_rule in ctx.suppressions.values():
+            for sup in by_rule.values():
+                if sup.rule not in known and sup.rule != BAD_SUPPRESSION:
+                    open_v.append(Violation(
+                        BAD_SUPPRESSION, ctx.rel, sup.line,
+                        f"suppression names unknown rule {sup.rule!r} "
+                        "(typo? see --list-rules)",
+                        snippet=ctx.line_at(sup.line)))
+
+    open_v.sort(key=lambda v: v.key())
+    suppressed.sort(key=lambda v: v.key())
+    return LintResult(open_v, suppressed, len(ctxs), errors)
+
+
+# --------------------------------------------------------------- AST helpers
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """'np.random.seed' for a Name/Attribute chain; None for anything else
+    (calls, subscripts — chains through those are not static receivers)."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = dotted_name(node.value)
+        return f"{base}.{node.attr}" if base else None
+    return None
+
+
+def is_literal(node: ast.AST) -> bool:
+    """True for pure Python literals (including nested list/tuple/dict of
+    literals) — the payloads jnp.array() re-materializes on every trace."""
+    if isinstance(node, ast.Constant):
+        return True
+    if isinstance(node, (ast.List, ast.Tuple, ast.Set)):
+        return all(is_literal(e) for e in node.elts)
+    if isinstance(node, ast.Dict):
+        return all(k is not None and is_literal(k) and is_literal(v)
+                   for k, v in zip(node.keys, node.values))
+    if isinstance(node, ast.UnaryOp) and isinstance(node.op,
+                                                    (ast.USub, ast.UAdd)):
+        return is_literal(node.operand)
+    return False
+
+
+def walk_functions(tree: ast.Module) -> Iterator[ast.AST]:
+    """Every FunctionDef/AsyncFunctionDef in the module, any nesting."""
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node

@@ -146,6 +146,7 @@ def _load() -> ctypes.CDLL:
     sig("H5Tclose", herr_t, [hid_t])
     try:
         sig("H5free_memory", herr_t, [ctypes.c_void_p])
+    # lint: swallowed-exception-ok (symbol optional in older libhdf5; callers guard on hasattr)
     except AttributeError:
         pass
 
@@ -229,6 +230,7 @@ class H5File:
     def __del__(self):
         try:
             self.close()
+        # lint: swallowed-exception-ok (destructor must not raise during interpreter teardown)
         except Exception:
             pass
 

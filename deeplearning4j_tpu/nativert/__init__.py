@@ -302,6 +302,7 @@ class AsyncNativeLoader:
     def __del__(self):
         try:
             self.close()
+        # lint: swallowed-exception-ok (destructor must not raise during interpreter teardown)
         except Exception:
             pass
 

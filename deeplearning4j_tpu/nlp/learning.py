@@ -146,7 +146,7 @@ def make_train_step(use_hs: bool, negative: int, chunk: int = 64,
     def step(syn0, syn1, syn1neg, cum_table, batch: PairBatch, lr, key):
         B = batch.ctx.shape[0]
         S = min(chunk, B)
-        if B % S != 0:  # static shapes — B is the fixed accumulator size
+        if B % S != 0:  # lint: recompile-hazard-ok (trace-time chunk sizing; B is the fixed accumulator size, static under jit)
             S = B
         C = B // S
         chunked = jax.tree_util.tree_map(

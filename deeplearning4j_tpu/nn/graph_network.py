@@ -623,6 +623,7 @@ class ComputationGraph(LazyScore):
             xs, ys, fm, lm = _coerce_graph_batch(ds)
             if fm is not None or lm is not None:
                 return None  # masked -> per-batch fallback
+            # lint: host-sync-in-hot-loop-ok (producer-thread host staging of iterator output, not a device sync)
             return ([np.asarray(x) for x in xs], [np.asarray(y) for y in ys])
 
         def stage(kind_item):

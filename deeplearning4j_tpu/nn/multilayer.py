@@ -29,6 +29,7 @@ from deeplearning4j_tpu import common
 from deeplearning4j_tpu.observability.compile_tracker import (
     global_tracker as _compile_tracker,
 )
+from deeplearning4j_tpu.observability.names import FIT_PHASE_SECONDS
 from deeplearning4j_tpu.observability.metrics import (
     global_registry as _obs_registry,
 )
@@ -47,7 +48,7 @@ Array = jax.Array
 # perf_counter reads and one locked float add per phase; budget pinned by
 # tests/test_bench_contract.py::test_telemetry_overhead_budget)
 _phase_hist = _obs_registry().histogram(
-    "dl4j_fit_phase_seconds",
+    FIT_PHASE_SECONDS,
     "host wall seconds per fit-loop phase (staging: host cast+transfer "
     "submit, or with device prefetch the visible wait for the staged batch; "
     "dispatch: jitted-call submit; listeners: callback overhead)")
@@ -643,6 +644,7 @@ class MultiLayerNetwork(LazyScore):
         def to_batch(ds):
             if ds.features_mask is not None or ds.labels_mask is not None:
                 return None  # masked -> per-batch fallback
+            # lint: host-sync-in-hot-loop-ok (producer-thread host staging of iterator output, not a device sync)
             return np.asarray(ds.features), np.asarray(ds.labels)
 
         def stage(kind_item):

@@ -9,6 +9,7 @@ server's ``/metrics`` route and as JSONL snapshots via ``--telemetry-out``.
     from deeplearning4j_tpu.observability import (
         global_registry, global_tracker, span, TelemetryListener)
 """
+from . import names
 from .metrics import (MetricsRegistry, global_registry, DEFAULT_BUCKETS,
                       tree_nbytes)
 from .compile_tracker import CompileTracker, global_tracker
@@ -18,6 +19,6 @@ from .listener import TelemetryListener, record_hbm_gauges
 __all__ = [
     "MetricsRegistry", "global_registry", "DEFAULT_BUCKETS", "tree_nbytes",
     "CompileTracker", "global_tracker",
-    "span",
+    "span", "names",
     "TelemetryListener", "record_hbm_gauges",
 ]

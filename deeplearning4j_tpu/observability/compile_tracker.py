@@ -33,6 +33,8 @@ from typing import Any, Callable, Dict, Optional, Tuple
 import numpy as np
 
 from .metrics import global_registry
+from .names import (JIT_BACKEND_COMPILE_SECONDS, JIT_COMPILE_SECONDS,
+                    JIT_COMPILE_TOTAL, RECOMPILE_STORM_WARNINGS_TOTAL)
 
 log = logging.getLogger(__name__)
 
@@ -102,13 +104,13 @@ class CompileTracker:
     def _metrics(self):
         reg = self.registry
         return (
-            reg.counter("dl4j_jit_compile_total",
+            reg.counter(JIT_COMPILE_TOTAL,
                         "jit/pjit compiles recorded at framework seams"),
-            reg.histogram("dl4j_jit_compile_seconds",
+            reg.histogram(JIT_COMPILE_SECONDS,
                           "wall time of first-call trace+lower+compile"),
-            reg.histogram("dl4j_jit_backend_compile_seconds",
+            reg.histogram(JIT_BACKEND_COMPILE_SECONDS,
                           "backend compile time from jax.monitoring events"),
-            reg.counter("dl4j_recompile_storm_warnings_total",
+            reg.counter(RECOMPILE_STORM_WARNINGS_TOTAL,
                         "rate-limited retrace-storm warnings emitted"),
         )
 
@@ -142,7 +144,7 @@ class CompileTracker:
                 backend_hist.labels(fn=name).observe(duration)
 
             jmon.register_event_duration_secs_listener(_on_duration)
-        except Exception:  # pragma: no cover - monitoring API moved/absent
+        except Exception:  # pragma: no cover - monitoring API moved/absent  # lint: swallowed-exception-ok (tracker degrades to wall timing only)
             pass
 
     # ------------------------------------------------------------ tracking

@@ -20,16 +20,19 @@ import time
 from typing import Optional
 
 from .metrics import global_registry
+from .names import (DEVICE_HBM_BYTES, DEVICE_HBM_PEAK_BYTES,
+                    STEP_DEVICE_SYNC_SECONDS, STEP_HOST_SECONDS,
+                    TRAIN_ITERATION, TRAIN_SCORE)
 
 
 def record_hbm_gauges(registry=None) -> None:
     """Set ``dl4j_device_hbm_bytes{device=...}`` for every local device,
     None-safe (CPU backends report no memory_stats -> 0.0)."""
     reg = registry if registry is not None else global_registry()
-    gauge = reg.gauge("dl4j_device_hbm_bytes",
+    gauge = reg.gauge(DEVICE_HBM_BYTES,
                       "bytes in use per device (0 when the backend "
                       "reports no memory_stats, e.g. CPU)")
-    peak = reg.gauge("dl4j_device_hbm_peak_bytes",
+    peak = reg.gauge(DEVICE_HBM_PEAK_BYTES,
                      "peak bytes in use per device (0 when unreported)")
     try:
         import jax
@@ -81,18 +84,18 @@ class TelemetryListener:
         self._session_id = f"telemetry_{int(time.time() * 1000)}"
         reg = self.registry
         self._step_hist = reg.histogram(
-            "dl4j_step_host_seconds",
+            STEP_HOST_SECONDS,
             "host wall time between consecutive iterations").labels(
                 worker=worker_id)
         self._sync_hist = reg.histogram(
-            "dl4j_step_device_sync_seconds",
+            STEP_DEVICE_SYNC_SECONDS,
             "time to materialize float(loss) at the trusted sync point"
         ).labels(worker=worker_id)
         self._score_gauge = reg.gauge(
-            "dl4j_train_score", "last synced training score").labels(
+            TRAIN_SCORE, "last synced training score").labels(
                 worker=worker_id)
         self._iter_gauge = reg.gauge(
-            "dl4j_train_iteration", "last completed iteration").labels(
+            TRAIN_ITERATION, "last completed iteration").labels(
                 worker=worker_id)
 
     @property
@@ -142,7 +145,7 @@ class TelemetryListener:
         if score is not None:
             r.score = score
         snap = self.registry.snapshot()
-        hbm = snap.get("dl4j_device_hbm_bytes", {}).get("series", [])
+        hbm = snap.get(DEVICE_HBM_BYTES, {}).get("series", [])
         if hbm:
             r.device_mem_bytes = int(max(s["value"] for s in hbm))
         self.router.put_update(r)

@@ -1,0 +1,55 @@
+"""THE registry of telemetry metric names — the /metrics stability contract.
+
+Metric names are external API: Prometheus scrapers alert on them, bench.py's
+log reinterpretation greps them, dashboards chart them. Every name therefore
+lives here, once, as a ``dl4j_``-prefixed constant; registry call sites
+import the constant instead of repeating the string. The
+``metric-name-drift`` lint rule enforces both halves (prefix + central
+registration), so a rename is one reviewable diff line here and drift
+between two subsystems claiming the same string is impossible.
+
+Naming follows Prometheus conventions: ``_total`` for counters, ``_seconds``
+/ ``_bytes`` for unit-carrying series, no label names in the metric name.
+"""
+from __future__ import annotations
+
+# --- spans (observability/spans.py) ----------------------------------------
+SPAN_SECONDS = "dl4j_span_seconds"
+
+# --- compile tracking (observability/compile_tracker.py) -------------------
+JIT_COMPILE_TOTAL = "dl4j_jit_compile_total"
+JIT_COMPILE_SECONDS = "dl4j_jit_compile_seconds"
+JIT_BACKEND_COMPILE_SECONDS = "dl4j_jit_backend_compile_seconds"
+RECOMPILE_STORM_WARNINGS_TOTAL = "dl4j_recompile_storm_warnings_total"
+
+# --- per-iteration telemetry (observability/listener.py) -------------------
+DEVICE_HBM_BYTES = "dl4j_device_hbm_bytes"
+DEVICE_HBM_PEAK_BYTES = "dl4j_device_hbm_peak_bytes"
+STEP_HOST_SECONDS = "dl4j_step_host_seconds"
+STEP_DEVICE_SYNC_SECONDS = "dl4j_step_device_sync_seconds"
+TRAIN_SCORE = "dl4j_train_score"
+TRAIN_ITERATION = "dl4j_train_iteration"
+
+# --- fit-loop phase attribution (nn/multilayer.py, parallel/wrapper.py) ----
+FIT_PHASE_SECONDS = "dl4j_fit_phase_seconds"
+
+# --- collective traffic (parallel/{wrapper,training_master,moe,ring_attention}.py)
+COLLECTIVE_BYTES_TOTAL = "dl4j_collective_bytes_total"
+COLLECTIVE_BYTES_PER_STEP = "dl4j_collective_bytes_per_step"
+
+# --- kernel dispatch (ops/pallas_kernels.py) -------------------------------
+PALLAS_DISPATCH_TOTAL = "dl4j_pallas_dispatch_total"
+
+# --- input pipeline (datasets/prefetch.py) ---------------------------------
+PREFETCH_DEPTH = "dl4j_prefetch_depth"
+PREFETCH_BYTES_TOTAL = "dl4j_prefetch_bytes_total"
+PREFETCH_STAGING_SECONDS_TOTAL = "dl4j_prefetch_staging_seconds_total"
+PREFETCH_WAIT_SECONDS_TOTAL = "dl4j_prefetch_wait_seconds_total"
+PREFETCH_OVERLAP_RATIO = "dl4j_prefetch_overlap_ratio"
+
+#: every registered name, sorted by constant name; the lint rule parses
+#: this module statically, this tuple is for runtime consumers (tests,
+#: /metrics docs)
+ALL_METRIC_NAMES = tuple(
+    v for k, v in sorted(globals().items())
+    if not k.startswith("_") and isinstance(v, str) and k.isupper())

@@ -20,6 +20,7 @@ import time
 from typing import Optional
 
 from .metrics import global_registry
+from .names import SPAN_SECONDS
 
 
 @contextlib.contextmanager
@@ -31,7 +32,7 @@ def span(name: str, metric_name: Optional[str] = None, registry=None):
     per-index names like ``epoch/3`` into a bounded series like ``epoch``).
     """
     reg = registry if registry is not None else global_registry()
-    hist = reg.histogram("dl4j_span_seconds",
+    hist = reg.histogram(SPAN_SECONDS,
                          "wall seconds of user/framework span() phases")
     series = hist.labels(name=metric_name or name)
     try:

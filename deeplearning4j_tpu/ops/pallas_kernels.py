@@ -236,12 +236,15 @@ _MIN_SEQ = int(os.environ.get("DL4J_FLASH_MIN_SEQ", "1024"))
 #: is baked into the compiled program), so each increment is one compiled
 #: program embedding the pallas-vs-XLA choice — retraces show up as extra
 #: counts, which is exactly what an engagement dashboard wants to see
+from deeplearning4j_tpu.observability.names import (  # noqa: E402
+    PALLAS_DISPATCH_TOTAL,
+)
 from deeplearning4j_tpu.observability.metrics import (  # noqa: E402
     global_registry as _obs_registry,
 )
 
 _pallas_dispatch = _obs_registry().counter(
-    "dl4j_pallas_dispatch_total",
+    PALLAS_DISPATCH_TOTAL,
     "pallas-vs-XLA dispatch decisions at kernel call sites, counted per "
     "trace, by kernel and whether the pallas path engaged")
 

@@ -48,6 +48,7 @@ class ScoreIterationListener(IterationListener):
             log.info("Score at iteration %d is %s", iteration,
                      model.score_value)
             if self.echo:
+                # lint: bare-print-ok (echo=True is an explicit user opt-in to console output)
                 print(f"Score at iteration {iteration} is "
                       f"{model.score_value}")
 
@@ -298,7 +299,8 @@ class CheckpointListener(IterationListener):
             try:
                 os.remove(old)
             except OSError:
-                pass
+                log.debug("could not remove rotated checkpoint %s", old,
+                          exc_info=True)
         return path
 
     def iteration_done(self, model, iteration: int) -> None:

@@ -19,6 +19,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from deeplearning4j_tpu.jax_compat import shard_map
+from deeplearning4j_tpu.observability.names import COLLECTIVE_BYTES_PER_STEP
 from deeplearning4j_tpu.observability.metrics import (
     global_registry as _obs_registry,
 )
@@ -26,7 +27,7 @@ from deeplearning4j_tpu.observability.metrics import (
 # trace-time traffic gauge (see parallel/ring_attention.py: the local bodies
 # run inside jit traces, so traffic is sized from static shapes per trace)
 _collective_per_step = _obs_registry().gauge(
-    "dl4j_collective_bytes_per_step",
+    COLLECTIVE_BYTES_PER_STEP,
     "bytes one executed step moves through a traced collective, from "
     "static shapes at trace time, by op and site")
 

@@ -140,4 +140,5 @@ class ParameterServerParallelWrapper:
             t.join()
         model.params_list = jax.tree_util.tree_map(jax.numpy.asarray,
                                                    server.pull())
+        # lint: host-sync-in-hot-loop-ok (one trusted LazyScore sync after the workers join)
         model.score_value = float(model.score_value)

@@ -182,7 +182,9 @@ class PipelineTrainer:
                 # same observable prefix as the synchronous loop.
                 raise ValueError("PipelineTrainer does not support "
                                  "masked batches; use net.fit()")
+            # lint: host-sync-in-hot-loop-ok (producer-thread staging; device_put is non-blocking)
             x = jax.device_put(np.asarray(ds.features))
+            # lint: host-sync-in-hot-loop-ok (producer-thread staging; device_put is non-blocking)
             y = jax.device_put(np.asarray(ds.labels))
             return x, y
 

@@ -23,6 +23,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from deeplearning4j_tpu.jax_compat import pcast, shard_map
+from deeplearning4j_tpu.observability.names import COLLECTIVE_BYTES_PER_STEP
 from deeplearning4j_tpu.observability.metrics import (
     global_registry as _obs_registry, tree_nbytes as _tree_nbytes,
 )
@@ -34,7 +35,7 @@ _NEG = -1e30
 # so a per-execution counter is impossible — instead each (re)trace sizes
 # the collective from the static operand shapes and records a per-step gauge
 _collective_per_step = _obs_registry().gauge(
-    "dl4j_collective_bytes_per_step",
+    COLLECTIVE_BYTES_PER_STEP,
     "bytes one executed step moves through a traced collective, from "
     "static shapes at trace time, by op and site")
 
@@ -176,7 +177,7 @@ def ulysses_attention_sharded(q: Array, k: Array, v: Array, mesh: Mesh,
     """Trace-safe Ulysses attention (see ring_attention_sharded): the
     in-jit dispatch target for sequence-parallel attention layers."""
     n = mesh.shape[axis_name]
-    if q.shape[2] % n != 0:
+    if q.shape[2] % n != 0:  # lint: recompile-hazard-ok (trace-time config validation; head count is static under jit)
         raise ValueError(f"num heads {q.shape[2]} not divisible by axis size {n}")
     # four all-to-alls (q/k/v gather + output scatter), each moving one
     # q-sized global array across the axis

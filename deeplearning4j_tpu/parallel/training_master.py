@@ -32,6 +32,7 @@ from deeplearning4j_tpu import common
 from deeplearning4j_tpu.observability.compile_tracker import (
     global_tracker as _compile_tracker,
 )
+from deeplearning4j_tpu.observability.names import COLLECTIVE_BYTES_TOTAL
 from deeplearning4j_tpu.observability.metrics import (
     global_registry as _obs_registry, tree_nbytes as _tree_nbytes,
 )
@@ -259,7 +260,7 @@ class ParameterAveragingTrainingMaster(TrainingMaster):
             data_iterator.reset()
         # each averaging round psum-means ~per-replica param bytes
         avg_bytes = _obs_registry().counter(
-            "dl4j_collective_bytes_total",
+            COLLECTIVE_BYTES_TOTAL,
             "bytes moved by host-dispatched collectives, by op and site"
         ).labels(op="parameter_average", site="training_master")
         param_bytes = _tree_nbytes(model.params_list)
@@ -285,8 +286,10 @@ class ParameterAveragingTrainingMaster(TrainingMaster):
             # built and put in flight (non-blocking sharded device_put)
             # while the current split's shard_map local steps execute
             t0 = time.time()
+            # lint: host-sync-in-hot-loop-ok (producer-thread host stacking of iterator output)
             xs = np.stack([np.stack([np.asarray(ds.features) for ds in row])
                            for row in split_batches])
+            # lint: host-sync-in-hot-loop-ok (producer-thread host stacking of iterator output)
             ys = np.stack([np.stack([np.asarray(ds.labels) for ds in row])
                            for row in split_batches])
             xs = jax.device_put(xs, sharding)
@@ -307,7 +310,7 @@ class ParameterAveragingTrainingMaster(TrainingMaster):
                 # stats want the realized loss; this is the only host sync
                 # in the split and only happens when stats are collected
                 self.stats.add("WorkerFit", t1, time.time() - t1,
-                               loss=float(loss))
+                               loss=float(loss))  # lint: host-sync-in-hot-loop-ok (stats-only sync, gated on self.stats)
             _compile_tracker().note_step(f)
             t2 = time.time()
             params, states, upd = average(params, states, upd)
@@ -326,6 +329,7 @@ class ParameterAveragingTrainingMaster(TrainingMaster):
             run_split(xs, ys)
 
         t3 = time.time()
+        # lint: host-sync-in-hot-loop-ok (final param pull-back after the fit loop ends)
         unstack = functools.partial(jax.tree_util.tree_map, lambda a: np.asarray(a[0]))
         model.params_list = jax.tree_util.tree_map(jnp.asarray, unstack(params))
         model.state_list = jax.tree_util.tree_map(jnp.asarray, unstack(states))

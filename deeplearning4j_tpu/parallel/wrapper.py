@@ -37,6 +37,9 @@ from deeplearning4j_tpu.datasets.iterators import AsyncDataSetIterator
 from deeplearning4j_tpu.observability.compile_tracker import (
     global_tracker as _compile_tracker,
 )
+from deeplearning4j_tpu.observability.names import (
+    COLLECTIVE_BYTES_TOTAL, FIT_PHASE_SECONDS,
+)
 from deeplearning4j_tpu.observability.metrics import (
     global_registry as _obs_registry, tree_nbytes as _tree_nbytes,
 )
@@ -47,7 +50,7 @@ from deeplearning4j_tpu.parallel.mesh import data_parallel_mesh
 # gradient psum moves ~param bytes per step; traced collectives inside
 # ring/ulysses/moe report trace-time per-step gauges instead)
 _phase_hist = _obs_registry().histogram(
-    "dl4j_fit_phase_seconds",
+    FIT_PHASE_SECONDS,
     "host wall seconds per fit-loop phase (staging: host cast+transfer "
     "submit, or with device prefetch the visible wait for the staged batch; "
     "dispatch: jitted-call submit; listeners: callback overhead)")
@@ -55,7 +58,7 @@ _t_staging = _phase_hist.labels(phase="staging")
 _t_dispatch = _phase_hist.labels(phase="dispatch")
 _t_listeners = _phase_hist.labels(phase="listeners")
 _collective_bytes = _obs_registry().counter(
-    "dl4j_collective_bytes_total",
+    COLLECTIVE_BYTES_TOTAL,
     "bytes moved by host-dispatched collectives, by op and site")
 
 
@@ -457,10 +460,11 @@ class ParallelWrapper:
                 xs, ys, fm, lm = _coerce_graph_batch(ds)
                 if fm is not None or lm is not None:
                     return None
-                return ([np.asarray(a) for a in xs],
-                        [np.asarray(a) for a in ys])
+                return ([np.asarray(a) for a in xs],  # lint: host-sync-in-hot-loop-ok (host staging in to_batch)
+                        [np.asarray(a) for a in ys])  # lint: host-sync-in-hot-loop-ok (host staging in to_batch)
             if ds.features_mask is not None or ds.labels_mask is not None:
                 return None
+            # lint: host-sync-in-hot-loop-ok (host staging of iterator output, not a device sync)
             return np.asarray(ds.features), np.asarray(ds.labels)
 
         def fallback(ds):

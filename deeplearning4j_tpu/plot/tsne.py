@@ -164,7 +164,7 @@ class BarnesHutTsne:
         return BarnesHutTsne.Builder()
 
     def fit(self, x) -> np.ndarray:
-        x = np.asarray(x, np.float64)
+        x = np.asarray(x, np.float64)  # lint: host-sync-in-hot-loop-ok (pure NumPy host algorithm; no device loop)
         n = x.shape[0]
         if self.theta <= 0 or n < 64:
             self.embedding = Tsne(

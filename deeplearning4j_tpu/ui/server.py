@@ -10,6 +10,7 @@ the environment has zero egress), and the remote-receiver POST route.
 from __future__ import annotations
 
 import json
+import logging
 import re
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -18,6 +19,8 @@ from urllib.parse import parse_qs, urlparse
 
 from deeplearning4j_tpu.ui.stats import StatsReport
 from deeplearning4j_tpu.ui.storage import StatsStorage
+
+log = logging.getLogger(__name__)
 
 _STYLE = """<style>
 body { font-family: sans-serif; margin: 20px; background: #fafafa; }
@@ -460,7 +463,9 @@ class UIServer:
                         try:
                             out.append(StatsReport.decode(blob))
                         except ValueError:
-                            pass
+                            log.debug("skipping undecodable stats blob for "
+                                      "session %s worker %s", sid, wid,
+                                      exc_info=True)
         out.sort(key=lambda r: (r.timestamp, r.iteration))
         return out
 

@@ -4,8 +4,19 @@
 # PALLAS_AXON_POOL_IPS is cleared so the axon TPU-relay sitecustomize doesn't dial
 # the tunnel for CPU-only test runs (it can hang interpreter startup); tests never
 # need the real chip. bench.py, by contrast, runs under the default env to use it.
+#
+#   ./runtests.sh [pytest args]   # the suite
+#   ./runtests.sh lint [args]     # graftlint over the package (see docs/GUIDE.md)
 set -e
 cd "$(dirname "$0")"
+
+if [ "${1-}" = "lint" ]; then
+  shift
+  PALLAS_AXON_POOL_IPS= \
+  JAX_PLATFORMS=cpu \
+  exec python -m deeplearning4j_tpu.lint "$@"
+fi
+
 PALLAS_AXON_POOL_IPS= \
 JAX_PLATFORMS=cpu \
 XLA_FLAGS="--xla_force_host_platform_device_count=8" \

@@ -1,0 +1,521 @@
+"""graftlint engine + rule-catalog tests.
+
+Each rule gets a true-positive fixture, a negative fixture, and a
+suppressed fixture; the suppression grammar itself (reason required,
+unknown rule names rejected) and the CLI contract (JSON shape, exit
+codes) are covered below. The final test runs the full registry over the
+real package tree — the gate the repo ships under: zero unsuppressed
+violations, every suppression carrying a reason.
+"""
+import json
+import pathlib
+import textwrap
+
+import pytest
+
+import deeplearning4j_tpu.lint as lint
+from deeplearning4j_tpu.lint import BAD_SUPPRESSION, REGISTRY, rule_names
+from deeplearning4j_tpu.lint.__main__ import main as lint_main
+
+PKG = pathlib.Path(lint.__file__).resolve().parents[1]
+
+
+def lint_src(tmp_path, source, name="fixture.py", rules=None):
+    f = tmp_path / name
+    f.write_text(textwrap.dedent(source))
+    return lint.run_paths([f], rules)
+
+
+def rules_of(result):
+    return [v.rule for v in result.violations]
+
+
+# ---------------------------------------------------------------- bare-print
+def test_bare_print_positive(tmp_path):
+    res = lint_src(tmp_path, """\
+        def report(x):
+            print("loss:", x)
+        """, rules=["bare-print"])
+    assert rules_of(res) == ["bare-print"]
+    assert res.violations[0].line == 2
+
+
+def test_bare_print_negative(tmp_path):
+    res = lint_src(tmp_path, '''\
+        import logging
+        log = logging.getLogger(__name__)
+
+        def report(x, sink):
+            """print() in a docstring is not a call."""
+            log.info("loss: %s", x)
+            sink.print(x)        # attribute access
+            return dict(print=x)  # keyword argument
+        ''', rules=["bare-print"])
+    assert res.violations == []
+
+
+def test_bare_print_suppressed(tmp_path):
+    res = lint_src(tmp_path, """\
+        def banner():
+            print("=" * 40)  # lint: bare-print-ok (interactive demo output)
+        """, rules=["bare-print"])
+    assert res.violations == []
+    assert [v.rule for v in res.suppressed] == ["bare-print"]
+    assert res.suppressed[0].reason == "interactive demo output"
+
+
+# ------------------------------------------------------ host-sync-in-hot-loop
+def test_host_sync_positive(tmp_path):
+    res = lint_src(tmp_path, """\
+        import numpy as np
+
+        def train_step(model, batch):
+            loss = model.loss(batch)
+            host = np.asarray(loss)
+            loss.block_until_ready()
+            scalar = loss.item()
+            return float(loss), scalar, host
+        """, rules=["host-sync-in-hot-loop"])
+    assert rules_of(res) == ["host-sync-in-hot-loop"] * 4
+
+
+def test_host_sync_negative(tmp_path):
+    res = lint_src(tmp_path, """\
+        import numpy as np
+
+        def summarize(model, batch):
+            # not a hot-path name: syncs here are allowed
+            return float(model.loss(batch))
+
+        def train_step(model, batch):
+            scale = float(0.5)  # literal float() is not a device sync
+            return model.loss(batch) * scale
+        """, rules=["host-sync-in-hot-loop"])
+    assert res.violations == []
+
+
+def test_host_sync_nested_def_inherits_hotness(tmp_path):
+    res = lint_src(tmp_path, """\
+        def fit(model, it):
+            def stage(ds):
+                return ds.features.item()
+            for ds in it:
+                model.step(stage(ds))
+        """, rules=["host-sync-in-hot-loop"])
+    assert rules_of(res) == ["host-sync-in-hot-loop"]
+
+
+def test_host_sync_suppressed(tmp_path):
+    res = lint_src(tmp_path, """\
+        import numpy as np
+
+        def fit(model, it):
+            for ds in it:
+                x = np.asarray(ds.features)  # lint: host-sync-in-hot-loop-ok (host staging of iterator output)
+                model.step(x)
+        """, rules=["host-sync-in-hot-loop"])
+    assert res.violations == []
+    assert [v.rule for v in res.suppressed] == ["host-sync-in-hot-loop"]
+
+
+# ----------------------------------------------------------- recompile-hazard
+def test_recompile_hazard_positive(tmp_path):
+    res = lint_src(tmp_path, """\
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def f(x, opts={}):
+            bias = jnp.array([1.0, 2.0])
+            if x.shape[0] > 2:
+                return x + bias
+            return x
+        """, rules=["recompile-hazard"])
+    msgs = [v.message for v in res.violations]
+    assert rules_of(res) == ["recompile-hazard"] * 3
+    assert any("mutable default" in m for m in msgs)
+    assert any("Python literal" in m for m in msgs)
+    assert any("trace-time shape" in m for m in msgs)
+
+
+def test_recompile_hazard_shape_taint_flows_through_locals(tmp_path):
+    res = lint_src(tmp_path, """\
+        import jax
+
+        @jax.jit
+        def f(x):
+            n = x.shape[0]
+            half = n // 2
+            if half > 4:
+                return x[:half]
+            return x
+        """, rules=["recompile-hazard"])
+    assert rules_of(res) == ["recompile-hazard"]
+
+
+def test_recompile_hazard_negative(tmp_path):
+    res = lint_src(tmp_path, """\
+        import jax
+        import jax.numpy as jnp
+
+        _BIAS = jnp.array([1.0, 2.0])  # module scope: traced once
+
+        @jax.jit
+        def f(x, y):
+            return x + _BIAS + jnp.asarray(y)  # non-literal arg is fine
+
+        def host_branching(x):
+            # not traced: shape branching on the host is normal code
+            if x.shape[0] > 2:
+                return x[:2]
+            return x
+        """, rules=["recompile-hazard"])
+    assert res.violations == []
+
+
+def test_recompile_hazard_naming_convention_and_method_exemption(tmp_path):
+    res = lint_src(tmp_path, """\
+        import jax.numpy as jnp
+
+        def make_fns():
+            def local_step(x):  # factory-built trace body: eligible
+                return x + jnp.array([1.0])
+            return local_step
+
+        class Net:
+            def rnn_time_step(self, x):  # host API method: exempt
+                return x + jnp.array([1.0])
+        """, rules=["recompile-hazard"])
+    assert rules_of(res) == ["recompile-hazard"]
+    assert "local_step" in res.violations[0].message
+
+
+def test_recompile_hazard_suppressed(tmp_path):
+    res = lint_src(tmp_path, """\
+        import jax
+
+        @jax.jit
+        def f(x):
+            if x.shape[0] % 8 != 0:  # lint: recompile-hazard-ok (static pad guard; batch is fixed)
+                raise ValueError("unpadded batch")
+            return x
+        """, rules=["recompile-hazard"])
+    assert res.violations == []
+    assert [v.rule for v in res.suppressed] == ["recompile-hazard"]
+
+
+# ------------------------------------------------------------- donation-alias
+def test_donation_alias_positive(tmp_path):
+    res = lint_src(tmp_path, """\
+        import functools
+        import jax
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def step(params, x):
+            return params + x
+
+        def fit(params, xs):
+            for x in xs:
+                out = step(params, x)
+            return params + out
+        """, rules=["donation-alias"])
+    assert rules_of(res) == ["donation-alias"]
+    assert "'params'" in res.violations[0].message
+
+
+def test_donation_alias_rebind_idiom_negative(tmp_path):
+    res = lint_src(tmp_path, """\
+        import jax
+
+        def _step(params, x):
+            return params + x
+
+        step = jax.jit(_step, donate_argnums=(0,))
+
+        def fit(params, xs):
+            for x in xs:
+                params = step(params, x)  # safe: rebound from the result
+            return params
+        """, rules=["donation-alias"])
+    assert res.violations == []
+
+
+def test_donation_alias_suppressed(tmp_path):
+    res = lint_src(tmp_path, """\
+        import functools
+        import jax
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def step(params, x):
+            return params + x
+
+        def fit(params, xs):
+            out = step(params, xs)
+            return params  # lint: donation-alias-ok (CPU-only test helper; no donation on CPU)
+        """, rules=["donation-alias"])
+    assert res.violations == []
+    assert [v.rule for v in res.suppressed] == ["donation-alias"]
+
+
+# --------------------------------------------------------------- unseeded-rng
+def test_unseeded_rng_positive(tmp_path):
+    res = lint_src(tmp_path, """\
+        import random
+        import numpy as np
+        from random import shuffle
+
+        def init(n):
+            w = np.random.rand(n)          # global numpy RNG
+            g = np.random.default_rng()    # OS-entropy, unseeded
+            random.random()                # stdlib global RNG
+            shuffle(w)                     # from-import of stdlib random
+            return w, g
+        """, rules=["unseeded-rng"])
+    assert rules_of(res) == ["unseeded-rng"] * 4
+
+
+def test_unseeded_rng_negative(tmp_path):
+    res = lint_src(tmp_path, """\
+        import random
+        import numpy as np
+        import jax
+
+        def init(n, seed):
+            rng = np.random.default_rng(seed)
+            local = random.Random(seed)
+            key = jax.random.PRNGKey(seed)
+            return rng.normal(size=n), local.random(), \\
+                jax.random.normal(key, (n,))
+        """, rules=["unseeded-rng"])
+    assert res.violations == []
+
+
+def test_unseeded_rng_suppressed(tmp_path):
+    res = lint_src(tmp_path, """\
+        import numpy as np
+
+        def jitter():
+            return np.random.rand()  # lint: unseeded-rng-ok (backoff jitter; determinism not wanted)
+        """, rules=["unseeded-rng"])
+    assert res.violations == []
+    assert [v.rule for v in res.suppressed] == ["unseeded-rng"]
+
+
+# ---------------------------------------------------------- metric-name-drift
+def _metric_fixture(tmp_path, client_src):
+    pkg = tmp_path / "pkg"
+    (pkg / "observability").mkdir(parents=True)
+    (pkg / "observability" / "names.py").write_text(
+        'GOOD_TOTAL = "dl4j_good_total"\n')
+    (pkg / "client.py").write_text(textwrap.dedent(client_src))
+    return lint.run_paths([pkg], ["metric-name-drift"])
+
+
+def test_metric_drift_hardcoded_literal_positive(tmp_path):
+    res = _metric_fixture(tmp_path, """\
+        def wire(reg):
+            reg.counter("dl4j_adhoc_total").inc()
+        """)
+    assert rules_of(res) == ["metric-name-drift"]
+    assert "hardcoded metric name" in res.violations[0].message
+
+
+def test_metric_drift_stale_import_positive(tmp_path):
+    res = _metric_fixture(tmp_path, """\
+        from pkg.observability.names import MISSING_TOTAL
+
+        def wire(reg):
+            reg.gauge(MISSING_TOTAL).set(1)
+        """)
+    assert rules_of(res) == ["metric-name-drift"]
+    assert "not defined there" in res.violations[0].message
+
+
+def test_metric_drift_unprefixed_name_in_names_module(tmp_path):
+    pkg = tmp_path / "pkg"
+    (pkg / "observability").mkdir(parents=True)
+    (pkg / "observability" / "names.py").write_text(
+        'BAD = "plain_name_total"\n')
+    res = lint.run_paths([pkg], ["metric-name-drift"])
+    assert rules_of(res) == ["metric-name-drift"]
+    assert "lacks the dl4j_ namespace prefix" in res.violations[0].message
+
+
+def test_metric_drift_negative(tmp_path):
+    res = _metric_fixture(tmp_path, """\
+        import numpy as np
+        from pkg.observability.names import GOOD_TOTAL
+
+        def wire(reg, data):
+            reg.counter(GOOD_TOTAL).inc()      # the central-constant idiom
+            np.histogram(data, 10)             # not a metrics registry
+        """)
+    assert res.violations == []
+
+
+def test_metric_drift_suppressed(tmp_path):
+    res = _metric_fixture(tmp_path, """\
+        def wire(reg):
+            reg.counter("dl4j_scratch_total")  # lint: metric-name-drift-ok (throwaway bench-local series)
+        """)
+    assert res.violations == []
+    assert [v.rule for v in res.suppressed] == ["metric-name-drift"]
+
+
+# -------------------------------------------------------- swallowed-exception
+def test_swallowed_exception_positive(tmp_path):
+    res = lint_src(tmp_path, """\
+        def load(path):
+            try:
+                return open(path).read()
+            except:
+                pass
+
+        def probe(obj):
+            try:
+                obj.close()
+            except ValueError:
+                pass
+        """, rules=["swallowed-exception"])
+    assert rules_of(res) == ["swallowed-exception"] * 2
+    assert "bare `except:`" in res.violations[0].message
+
+
+def test_swallowed_exception_negative(tmp_path):
+    res = lint_src(tmp_path, """\
+        import logging
+        log = logging.getLogger(__name__)
+
+        def load(path):
+            try:
+                return open(path).read()
+            except OSError:
+                log.debug("unreadable %s", path, exc_info=True)
+                return None
+
+        def strict(obj):
+            try:
+                obj.close()
+            except ValueError:
+                raise
+        """, rules=["swallowed-exception"])
+    assert res.violations == []
+
+
+def test_swallowed_exception_suppressed(tmp_path):
+    res = lint_src(tmp_path, """\
+        class H:
+            def __del__(self):
+                try:
+                    self.close()
+                # lint: swallowed-exception-ok (destructor must not raise)
+                except Exception:
+                    pass
+        """, rules=["swallowed-exception"])
+    assert res.violations == []
+    assert [v.rule for v in res.suppressed] == ["swallowed-exception"]
+
+
+# ------------------------------------------------------- suppression grammar
+def test_suppression_without_reason_rejected(tmp_path):
+    res = lint_src(tmp_path, """\
+        def report(x):
+            print(x)  # lint: bare-print-ok
+        """, rules=["bare-print"])
+    found = sorted(rules_of(res))
+    # the reasonless marker does NOT suppress, and is itself a violation
+    assert found == [BAD_SUPPRESSION, "bare-print"]
+    assert res.suppressed == []
+
+
+def test_suppression_of_unknown_rule_rejected(tmp_path):
+    res = lint_src(tmp_path, """\
+        x = 1  # lint: no-such-rule-ok (typo fixture)
+        """, rules=["bare-print"])
+    assert rules_of(res) == [BAD_SUPPRESSION]
+    assert "unknown rule" in res.violations[0].message
+
+
+def test_suppressed_findings_stay_in_report_with_reason(tmp_path):
+    res = lint_src(tmp_path, """\
+        def report(x):
+            print(x)  # lint: bare-print-ok (fixture)
+        """, rules=["bare-print"])
+    j = res.to_json()
+    assert j["ok"] is True
+    assert j["violations"] == []
+    assert j["suppressed"][0]["rule"] == "bare-print"
+    assert j["suppressed"][0]["reason"] == "fixture"
+
+
+def test_standalone_marker_applies_to_next_code_line(tmp_path):
+    res = lint_src(tmp_path, """\
+        def report(x):
+            # lint: bare-print-ok (covers the next line only)
+            print(x)
+            print(x)
+        """, rules=["bare-print"])
+    assert rules_of(res) == ["bare-print"]
+    assert res.violations[0].line == 4
+    assert [v.line for v in res.suppressed] == [3]
+
+
+def test_unknown_rule_subset_raises():
+    with pytest.raises(ValueError, match="unknown rule"):
+        lint.run_paths([PKG], ["bare-print", "not-a-rule"])
+
+
+def test_syntax_error_is_reported_not_crash(tmp_path):
+    res = lint_src(tmp_path, "def broken(:\n    pass\n")
+    assert not res.ok
+    assert res.violations == []
+    assert len(res.errors) == 1
+
+
+# -------------------------------------------------------------- CLI contract
+def test_cli_registry_lists_all_rules(capsys):
+    assert set(rule_names()) == set(REGISTRY) and len(REGISTRY) >= 6
+    assert lint_main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for name in rule_names():
+        assert name in out
+
+
+def test_cli_json_and_exit_codes(tmp_path, capsys):
+    bad = tmp_path / "bad.py"
+    bad.write_text("print('x')\n")
+    assert lint_main([str(bad), "--json"]) == 1
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["ok"] is False
+    assert payload["counts"] == {"bare-print": 1}
+    assert payload["violations"][0]["path"] == "bad.py"
+
+    clean = tmp_path / "clean.py"
+    clean.write_text("x = 1\n")
+    assert lint_main([str(clean), "--json"]) == 0
+    assert json.loads(capsys.readouterr().out)["ok"] is True
+
+    assert lint_main([str(clean), "--rules", "bogus"]) == 2
+
+
+def test_cli_human_output_shape(tmp_path, capsys):
+    bad = tmp_path / "bad.py"
+    bad.write_text("print('x')\n")
+    assert lint_main([str(bad)]) == 1
+    out = capsys.readouterr().out
+    assert "bad.py:1: [bare-print]" in out
+    assert "1 violation(s)" in out
+
+
+# ------------------------------------------------------- the real package
+def test_package_is_lint_clean():
+    """The gate the repo ships under: the full registry over the real tree
+    finds zero unsuppressed violations, zero parse errors, and every
+    suppression carries its reason."""
+    res = lint.run_paths([PKG])
+    assert res.errors == []
+    assert res.violations == [], "\n".join(
+        v.render() for v in res.violations)
+    assert res.files_scanned > 100
+    for v in res.suppressed:
+        assert v.reason, f"reasonless suppression survived: {v.render()}"

@@ -1,42 +1,24 @@
-"""Lint: no bare ``print(`` in library training/ops/parallel/data code.
+"""Lint: no bare ``print(`` anywhere in the library package.
 
 Library code must report through logging or the listener pipeline so output
 is routable and rate-limitable (and so bench.py's one-JSON-line stdout
-contract can't be broken by a stray debug print). Tokenize-based so strings,
-comments, and docstrings mentioning print don't false-positive.
+contract can't be broken by a stray debug print). The check itself lives in
+graftlint's ``bare-print`` rule (deeplearning4j_tpu/lint) — tokenize-based,
+CLI entry points scoped out, deliberate prints suppressed inline with a
+reason; this test pins the whole-package run of that one rule.
 """
-import io
 import pathlib
-import token
-import tokenize
 
-PKG = pathlib.Path(__file__).resolve().parents[1] / "deeplearning4j_tpu"
-LINTED_DIRS = ("nn", "ops", "parallel", "datasets", "utils")
+import deeplearning4j_tpu.lint as lint
 
-
-def _bare_print_calls(path: pathlib.Path):
-    """Yield (line, text) for each NAME ``print`` followed by ``(``."""
-    toks = list(tokenize.generate_tokens(
-        io.StringIO(path.read_text()).readline))
-    for i, t in enumerate(toks):
-        if t.type == token.NAME and t.string == "print":
-            # skip attribute access (x.print) and keyword-arg (print=...)
-            if i and toks[i - 1].type == token.OP and toks[i - 1].string == ".":
-                continue
-            nxt = next((n for n in toks[i + 1:]
-                        if n.type not in (token.NL, token.NEWLINE,
-                                          token.COMMENT)), None)
-            if nxt is not None and nxt.type == token.OP and nxt.string == "(":
-                yield t.start[0], t.line.strip()
+PKG = pathlib.Path(lint.__file__).resolve().parents[1]
 
 
 def test_no_bare_print_in_library_code():
-    offenders = []
-    for d in LINTED_DIRS:
-        for path in sorted((PKG / d).rglob("*.py")):
-            for line_no, text in _bare_print_calls(path):
-                offenders.append(
-                    f"{path.relative_to(PKG.parent)}:{line_no}: {text}")
+    res = lint.run_paths([PKG], ["bare-print"])
+    offenders = [f"{v.path}:{v.line}: {v.snippet}".rstrip()
+                 for v in res.violations]
     assert not offenders, (
         "bare print() in library code (use logging or a listener):\n"
         + "\n".join(offenders))
+    assert res.errors == []
