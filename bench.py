@@ -771,12 +771,24 @@ def main() -> None:
                     help="append a metrics-registry snapshot (JSONL) to this "
                          "file beside the headline JSON; measurement-only — "
                          "ignored for bench_log config matching")
+    ap.add_argument("--flight-recorder-dir", default=None, metavar="DIR",
+                    help="arm the flight recorder: bundles (crash, signal, "
+                         "device-unreachable) are written under DIR instead "
+                         "of next to scripts/bench_log.jsonl")
     ap.add_argument("--child", action="store_true", help=argparse.SUPPRESS)
     # worst case must finish inside the harness's own command timeout
     # (round-1 artifacts show it kills at ~600s): 2 x 240s + 5s backoff < 500s
     ap.add_argument("--attempts", type=int, default=2)
     ap.add_argument("--attempt-timeout", type=float, default=240.0)
     args = ap.parse_args()
+
+    if args.flight_recorder_dir:
+        from deeplearning4j_tpu.observability import (
+            global_recorder, install_signal_handlers,
+        )
+        global_recorder().set_dump_dir(args.flight_recorder_dir)
+        if args.child:
+            install_signal_handlers()
 
     if args.child:
         _child_main(args)
@@ -803,9 +815,13 @@ def main() -> None:
             s = s.decode("utf-8", errors="replace")
         return (s or "")[-600:]
 
+    from deeplearning4j_tpu.observability import global_recorder
+
     last_err = ""
     last_was_timeout = False
+    retry_timeline = []
     for attempt in range(args.attempts):
+        t_attempt = time.time()
         try:
             proc = subprocess.run(cmd, capture_output=True, text=True,
                                   timeout=args.attempt_timeout)
@@ -823,6 +839,14 @@ def main() -> None:
                 last_err = (f"attempt {attempt + 1}: timed out after "
                             f"{args.attempt_timeout}s; stderr tail: "
                             + _tail(e.stderr))
+        retry_timeline.append({
+            "attempt": attempt + 1, "started": t_attempt,
+            "elapsed_s": time.time() - t_attempt,
+            "outcome": ("ok" if rec is not None
+                        else "timeout" if last_was_timeout else "crash"),
+            "error": None if rec is not None else last_err,
+        })
+        global_recorder().record("bench_attempt", **retry_timeline[-1])
         if rec is not None:
             rec["detail"] = dict(rec.get("detail", {}), attempt=attempt + 1)
             print(json.dumps(rec), flush=True)
@@ -860,6 +884,17 @@ def main() -> None:
                            "and no prior on-chip capture of this config in "
                            "scripts/bench_log.jsonl; BASELINE.md's measured "
                            "tables hold the last recorded numbers")
+        # self-diagnosing outage artifact: a flight-recorder bundle (env,
+        # retry timeline, the record we emitted, the prior healthy number)
+        # next to the bench log — or under --flight-recorder-dir if armed
+        bundle_dir = args.flight_recorder_dir or os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "scripts")
+        bundle = global_recorder().dump(
+            dir=bundle_dir, reason="device-unreachable",
+            extra={"retry_timeline": retry_timeline, "last_healthy": prior,
+                   "record": rec})
+        if bundle:
+            rec["flight_bundle"] = bundle
     print(json.dumps(rec), flush=True)
     if not last_was_timeout:
         sys.exit(1)

@@ -38,6 +38,14 @@ def _cmd_parallel_train(args) -> int:
     )
 
     net = guess_model(args.model)
+    if args.flight_recorder_dir:
+        from deeplearning4j_tpu.observability import (
+            global_recorder, install_signal_handlers,
+        )
+        global_recorder().set_dump_dir(args.flight_recorder_dir)
+        install_signal_handlers()
+        print(f"flight recorder armed: bundles -> {args.flight_recorder_dir} "
+              "(SIGTERM/SIGUSR1 dump)")
     if args.dataset == "mnist":
         from deeplearning4j_tpu.datasets.mnist import MnistDataSetIterator
         it = MnistDataSetIterator(args.batch, train=True,
@@ -148,6 +156,10 @@ def build_parser() -> argparse.ArgumentParser:
                     help="GPipe pipeline over the model's homogeneous "
                          "block stack (stages = --workers or all devices)")
     tr.add_argument("--microbatches", type=int, default=4)
+    tr.add_argument("--flight-recorder-dir", default=None, metavar="DIR",
+                    help="arm the flight recorder: crash/signal/health-alarm "
+                         "bundles are written under DIR; SIGTERM and SIGUSR1 "
+                         "dump handlers are installed")
     tr.add_argument("--telemetry-out", default=None, metavar="PATH",
                     help="append a metrics-registry snapshot (JSONL, incl. "
                          "compile events) to PATH after training")

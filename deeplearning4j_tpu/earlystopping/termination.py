@@ -5,7 +5,6 @@ Reference: earlystopping/termination/*.java — epoch conditions receive
 """
 from __future__ import annotations
 
-import math
 import time
 
 
@@ -119,10 +118,13 @@ class MaxScoreIterationTerminationCondition(IterationTerminationCondition):
 
 class InvalidScoreIterationTerminationCondition(IterationTerminationCondition):
     """Stop on NaN/Inf score (reference InvalidScoreIterationTerminationCondition.java
-    — the reference's only failure-detection mechanism, SURVEY.md §5)."""
+    — the reference's only failure-detection mechanism, SURVEY.md §5). The
+    predicate is shared with the training-health monitor so early stopping
+    and NanAlertListener agree on what "invalid" means."""
 
     def terminate(self, score: float) -> bool:
-        return math.isnan(score) or math.isinf(score)
+        from deeplearning4j_tpu.observability.health import is_invalid_score
+        return is_invalid_score(score)
 
     def __repr__(self):
         return "InvalidScoreIterationTerminationCondition()"

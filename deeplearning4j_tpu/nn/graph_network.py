@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import dataclasses
 import math
+import time
 from typing import Optional
 
 import jax
@@ -27,6 +28,11 @@ from deeplearning4j_tpu.nn.multilayer import (
 from deeplearning4j_tpu.observability.compile_tracker import (
     global_tracker as _compile_tracker,
 )
+from deeplearning4j_tpu.observability.flight_recorder import (
+    dump_on_unhandled as _dump_on_unhandled,
+    global_recorder as _flight_recorder,
+)
+from deeplearning4j_tpu.observability.watchdog import beat as _wd_beat
 from deeplearning4j_tpu.nn.updaters import (
     effective_lr, grads_to_param_dtype, normalize_gradients, updater_init,
     updater_step_with_param,
@@ -195,7 +201,10 @@ def _apply_graph_updates(conf, params, grads, upd_state, iteration):
     return new_params, new_upd
 
 
-def make_graph_train_step(conf: ComputationGraphConfiguration):
+def make_graph_train_step(conf: ComputationGraphConfiguration, *,
+                          health: bool = False):
+    """``health=True`` appends the health monitor's packed summary vector to
+    the return tuple (see make_train_step in multilayer.py)."""
     def train_step(params, states, upd_state, inputs, labels, rng, iteration,
                    fmasks=None, lmasks=None):
         (loss, new_states), grads = jax.value_and_grad(
@@ -203,6 +212,11 @@ def make_graph_train_step(conf: ComputationGraphConfiguration):
             has_aux=True)(params)
         new_params, new_upd = _apply_graph_updates(conf, params, grads,
                                                    upd_state, iteration)
+        if health:
+            from deeplearning4j_tpu.observability.health import health_terms
+
+            haux = health_terms(grads, params, new_params, loss)
+            return new_params, new_states, new_upd, loss, haux
         return new_params, new_states, new_upd, loss
 
     # a config-declared dtype policy is baked in at trace time (GlobalConf.dtype)
@@ -314,13 +328,15 @@ def make_graph_tbptt_step(conf: ComputationGraphConfiguration):
     return common.wrap_with_policy(tbptt_step, conf.global_conf.dtype)
 
 
-def make_graph_multistep_train_step(conf: ComputationGraphConfiguration):
+def make_graph_multistep_train_step(conf: ComputationGraphConfiguration, *,
+                                    health: bool = False):
     """K fused graph train steps per host dispatch via `lax.scan`.
 
     ``inputs_stack``/``labels_stack`` are lists of ``(K, B, ...)`` arrays (one
     per graph input/output). See make_multistep_train_step in multilayer.py
-    for the rationale (dispatch amortization on TPU)."""
-    step = make_graph_train_step(conf)
+    for the rationale (dispatch amortization on TPU) and the ``health``
+    variant's stacked ``(K, 4)`` summary output."""
+    step = make_graph_train_step(conf, health=health)
 
     def multi_step(params, states, upd_state, inputs_stack, labels_stack,
                    rng, iteration0):
@@ -328,13 +344,19 @@ def make_graph_multistep_train_step(conf: ComputationGraphConfiguration):
             p, s, u, it = carry
             xs, ys = batch
             key = jax.random.fold_in(rng, it)
+            if health:
+                p, s, u, loss, haux = step(p, s, u, xs, ys, key, it)
+                return (p, s, u, it + 1), (loss, haux)
             p, s, u, loss = step(p, s, u, xs, ys, key, it)
             return (p, s, u, it + 1), loss
 
-        (p, s, u, _), losses = jax.lax.scan(
+        (p, s, u, _), out = jax.lax.scan(
             body, (params, states, upd_state, iteration0),
             (list(inputs_stack), list(labels_stack)))
-        return p, s, u, losses
+        if health:
+            losses, hauxs = out
+            return p, s, u, losses, hauxs
+        return p, s, u, out
 
     return multi_step
 
@@ -407,6 +429,8 @@ def make_graph_pretrain_step(conf: ComputationGraphConfiguration, name: str):
 
 class ComputationGraph(LazyScore):
     """Stateful shell (reference nn/graph/ComputationGraph.java)."""
+
+    _multistep_builder = staticmethod(make_graph_multistep_train_step)
 
     def __init__(self, conf: ComputationGraphConfiguration):
         self.conf = conf
@@ -511,6 +535,7 @@ class ComputationGraph(LazyScore):
         self._rng, sub = jax.random.split(self._rng)
         return sub
 
+    @_dump_on_unhandled("ComputationGraph.fit")
     def fit(self, data, labels=None, *, epochs: int = 1) -> None:
         """Fit on a MultiDataSet, DataSet, iterator, or (inputs, labels) lists
         (reference fit:670/747)."""
@@ -550,26 +575,19 @@ class ComputationGraph(LazyScore):
             xd = [jnp.asarray(_stage_host(a, self.stage_dtype)) for a in xs]
             yd = [jnp.asarray(a) for a in ys]
         self.last_batch_size = int(xd[0].shape[0]) if xd and xd[0].ndim else 0
-        multi = self._jit("multistep",
-                          make_graph_multistep_train_step(self.conf),
-                          donate=(0, 1, 2))
         remaining = epochs
         while remaining > 0:
             k = min(self.dispatch_ksteps, remaining)
             xk = [jnp.broadcast_to(a[None], (k,) + a.shape) for a in xd]
             yk = [jnp.broadcast_to(a[None], (k,) + a.shape) for a in yd]
-            with _t_dispatch.time():
-                (self.params_list, self.state_list, self.updater_state,
-                 losses) = multi(self.params_list, self.state_list,
-                                 self.updater_state, xk, yk, self._next_rng(),
-                                 jnp.int32(self.iteration))
-            _compile_tracker().note_step(k)
+            losses = self._run_multistep(xk, yk, k)
             with _t_listeners.time():
                 for i in range(k):
                     self.iteration += 1
                     self.score_value = (lambda ls=losses, j=i: ls[j])
                     for listener in self.listeners:
                         listener.iteration_done(self, self.iteration)
+            _wd_beat(self.iteration)
             remaining -= k
 
     #: train steps fused per host dispatch in fit_iterator (see
@@ -584,6 +602,7 @@ class ComputationGraph(LazyScore):
     #: MultiLayerNetwork.prefetch_depth); 0 = synchronous staging
     prefetch_depth: int = 2
 
+    @_dump_on_unhandled("ComputationGraph.fit_iterator")
     def fit_iterator(self, iterator, epochs: int = 1,
                      ksteps: Optional[int] = None) -> None:
         """Iterator fit with K-step fused dispatch (TPU fast path — see
@@ -678,21 +697,14 @@ class ComputationGraph(LazyScore):
         # what the in-flight step consumes (see
         # MultiLayerNetwork._dispatch_staged)
         self.last_batch_size = int(xs[0].shape[1]) if xs else 0
-        multi = self._jit("multistep",
-                          make_graph_multistep_train_step(self.conf),
-                          donate=(0, 1, 2))
-        with _t_dispatch.time():
-            (self.params_list, self.state_list, self.updater_state,
-             losses) = multi(
-                self.params_list, self.state_list, self.updater_state, xs, ys,
-                self._next_rng(), jnp.int32(self.iteration))
-        _compile_tracker().note_step(n)
+        losses = self._run_multistep(xs, ys, n)
         with _t_listeners.time():
             for i in range(n):
                 self.iteration += 1
                 self.score_value = (lambda ls=losses, j=i: ls[j])
                 for listener in self.listeners:
                     listener.iteration_done(self, self.iteration)
+        _wd_beat(self.iteration)
 
     #: Solver facade instance when optimization_algo != SGD (built lazily)
     _solver = None
@@ -725,19 +737,36 @@ class ComputationGraph(LazyScore):
             fmasks = [jnp.asarray(m) for m in fmasks] if fmasks else None
             lmasks = [jnp.asarray(m) for m in lmasks] if lmasks else None
         self.last_batch_size = int(xs[0].shape[0]) if xs and xs[0].ndim else 0
-        step = self._jit("train_step", make_graph_train_step(self.conf))
         for _ in range(max(1, self.conf.global_conf.iterations)):
-            with _t_dispatch.time():
+            hm = self.health_monitor
+            use_health = hm is not None and hm.due(self.iteration)
+            name = "train_step_health" if use_health else "train_step"
+            step = self._jit(name, make_graph_train_step(self.conf,
+                                                         health=use_health))
+            t0 = time.perf_counter()
+            out = step(self.params_list, self.state_list,
+                       self.updater_state, xs, ys, self._next_rng(),
+                       jnp.int32(self.iteration), fmasks, lmasks)
+            dt = time.perf_counter() - t0
+            _t_dispatch.observe(dt)
+            if use_health:
                 (self.params_list, self.state_list, self.updater_state,
-                 loss) = step(self.params_list, self.state_list,
-                              self.updater_state, xs, ys, self._next_rng(),
-                              jnp.int32(self.iteration), fmasks, lmasks)
-            _compile_tracker().note_step()
+                 loss, haux) = out
+                hm.offer(haux, self.iteration)
+            else:
+                (self.params_list, self.state_list, self.updater_state,
+                 loss) = out
+            wrap_name = f"{type(self).__name__}.{name}"
+            _compile_tracker().note_step(fn=wrap_name)
+            _flight_recorder().record(
+                "step", path=wrap_name, it=self.iteration,
+                batch=self.last_batch_size, dispatch_s=dt)
             self.score_value = loss  # device scalar; synced lazily (LazyScore)
             self.iteration += 1
             with _t_listeners.time():
                 for listener in self.listeners:
                     listener.iteration_done(self, self.iteration)
+            _wd_beat(self.iteration)
 
     # ------------------------------------------------------------------ pretrain
     def pretrain(self, iterator) -> None:
@@ -858,10 +887,15 @@ class ComputationGraph(LazyScore):
              loss) = step(self.params_list, self.state_list,
                           self.updater_state, rnn_state, xc, yc,
                           self._next_rng(), jnp.int32(self.iteration), fm, lm)
+            _compile_tracker().note_step(fn=f"{type(self).__name__}.tbptt_step")
+            _flight_recorder().record(
+                "step", path=f"{type(self).__name__}.tbptt_step",
+                it=self.iteration, batch=self.last_batch_size)
             self.score_value = loss  # synced lazily (LazyScore)
             self.iteration += 1
             for listener in self.listeners:
                 listener.iteration_done(self, self.iteration)
+            _wd_beat(self.iteration)
 
     # ------------------------------------------------------------------ rnn API
     def rnn_time_step(self, *inputs) -> list:

@@ -29,10 +29,15 @@ from deeplearning4j_tpu import common
 from deeplearning4j_tpu.observability.compile_tracker import (
     global_tracker as _compile_tracker,
 )
+from deeplearning4j_tpu.observability.flight_recorder import (
+    dump_on_unhandled as _dump_on_unhandled,
+    global_recorder as _flight_recorder,
+)
 from deeplearning4j_tpu.observability.names import FIT_PHASE_SECONDS
 from deeplearning4j_tpu.observability.metrics import (
     global_registry as _obs_registry,
 )
+from deeplearning4j_tpu.observability.watchdog import beat as _wd_beat
 from deeplearning4j_tpu.nn.conf.multilayer import MultiLayerConfiguration
 from deeplearning4j_tpu.nn.conf.layers.base import PretrainLayer
 from deeplearning4j_tpu.nn.conf.layers.recurrent import LSTM
@@ -162,7 +167,8 @@ def _aux_losses(layers, new_states):
     return total
 
 
-def make_train_step(conf: MultiLayerConfiguration, loss=None):
+def make_train_step(conf: MultiLayerConfiguration, loss=None, *,
+                    health: bool = False):
     """Build the fused train step: grads via autodiff, per-layer normalization + updater.
     Pure: (params, states, upd_states, x, y, rng, iteration, fmask, lmask) ->
     (params', states', upd_states', loss).
@@ -170,7 +176,14 @@ def make_train_step(conf: MultiLayerConfiguration, loss=None):
     ``loss`` optionally replaces the standard ``loss_fn`` with a callable of
     the same signature (params_list, state_list, x, y, rng, fmask, lmask) ->
     (loss, new_state_list) — e.g. PipelineTrainer's pipelined forward — while
-    keeping the updater/clipping/schedule semantics identical."""
+    keeping the updater/clipping/schedule semantics identical.
+
+    ``health=True`` fuses the health monitor's summary (grad/update norms,
+    non-finite count, loss — see ``observability.health.health_terms``) into
+    the step and appends its packed vector to the return tuple. Computed
+    where grads, old params, and new params all coexist as program values,
+    so it stays donation-safe; off-cadence fit dispatches use the plain
+    variant and are byte-identical to unmonitored training."""
     g = conf.global_conf
     if loss is None:
         loss = functools.partial(loss_fn, conf)
@@ -209,13 +222,19 @@ def make_train_step(conf: MultiLayerConfiguration, loss=None):
                 u_new[name] = ustate
             new_params.append(p_new)
             new_upd.append(u_new)
+        if health:
+            from deeplearning4j_tpu.observability.health import health_terms
+
+            haux = health_terms(grads, params_list, new_params, loss_val)
+            return new_params, new_states, new_upd, loss_val, haux
         return new_params, new_states, new_upd, loss_val
 
     # a config-declared dtype policy is baked in at trace time (GlobalConf.dtype)
     return common.wrap_with_policy(train_step, g.dtype)
 
 
-def make_multistep_train_step(conf: MultiLayerConfiguration):
+def make_multistep_train_step(conf: MultiLayerConfiguration, *,
+                              health: bool = False):
     """K fused train steps per host dispatch via `lax.scan`.
 
     Takes a device-resident stack of K minibatches ``xs, ys`` of shape
@@ -227,20 +246,30 @@ def make_multistep_train_step(conf: MultiLayerConfiguration):
     time. Returns the per-step losses as a (K,) array — listeners that only
     fire every N iterations can then read just the scores they need without
     forcing a host sync per step.
+
+    ``health=True`` threads the per-step health vector through the scan and
+    returns it stacked as ``(K, 4)`` after the losses; the dispatcher picks
+    the row for the cadence-due iteration (a lazy device gather, no sync).
     """
-    step = make_train_step(conf)
+    step = make_train_step(conf, health=health)
 
     def multi_step(params_list, state_list, upd_state, xs, ys, rng, iteration0):
         def body(carry, batch):
             p, s, u, it = carry
             x, y = batch
             key = jax.random.fold_in(rng, it)
+            if health:
+                p, s, u, loss, haux = step(p, s, u, x, y, key, it)
+                return (p, s, u, it + 1), (loss, haux)
             p, s, u, loss = step(p, s, u, x, y, key, it)
             return (p, s, u, it + 1), loss
 
-        (p, s, u, _), losses = jax.lax.scan(
+        (p, s, u, _), out = jax.lax.scan(
             body, (params_list, state_list, upd_state, iteration0), (xs, ys))
-        return p, s, u, losses
+        if health:
+            losses, hauxs = out
+            return p, s, u, losses, hauxs
+        return p, s, u, out
 
     return multi_step
 
@@ -276,6 +305,11 @@ class LazyScore:
     #: path on both network types; PerformanceListener reads it to compute
     #: samples/sec (the reference tracks it on the DataSet instead)
     last_batch_size: int = 0
+
+    #: attached ``observability.health.HealthMonitor`` (or None). When set,
+    #: the fit loops dispatch the health variant of the train step whenever
+    #: the monitor's cadence is due; off-cadence dispatches are untouched.
+    health_monitor = None
 
     @property
     def score_value(self) -> float:
@@ -334,9 +368,51 @@ class LazyScore:
                 f"{type(self).__name__}.{name}", jitted, cache_key=key)
         return self._jit_cache[key]
 
+    #: hook: the module-level K-step builder for this network type
+    #: (make_multistep_train_step / make_graph_multistep_train_step) so the
+    #: shared dispatch helper below can build plain and health variants
+    _multistep_builder = None
+
+    def _run_multistep(self, xs, ys, n: int):
+        """Dispatch one K-step fused group (shared by both network types):
+        picks the health variant when the attached monitor's cadence falls
+        inside the group, times the dispatch, records the flight-recorder
+        step event, and advances the step clock with MFU attribution.
+        Returns the (K,) per-step loss stack; params/states/updater are
+        updated in place (donated)."""
+        hm = self.health_monitor
+        due_i = hm.due_index(self.iteration, n) if hm is not None else None
+        name = "multistep" if due_i is None else "multistep_health"
+        multi = self._jit(
+            name, type(self)._multistep_builder(self.conf,
+                                                health=due_i is not None),
+            donate=(0, 1, 2))
+        t0 = time.perf_counter()
+        out = multi(self.params_list, self.state_list, self.updater_state,
+                    xs, ys, self._next_rng(), jnp.int32(self.iteration))
+        dt = time.perf_counter() - t0
+        _t_dispatch.observe(dt)
+        if due_i is None:
+            (self.params_list, self.state_list, self.updater_state,
+             losses) = out
+        else:
+            (self.params_list, self.state_list, self.updater_state,
+             losses, hauxs) = out
+            # lazy device gather of the due step's packed health vector — the
+            # monitor parks it; the host sync happens at poll() time
+            hm.offer(hauxs[due_i], self.iteration + due_i)
+        wrap_name = f"{type(self).__name__}.{name}"
+        _compile_tracker().note_step(n, fn=wrap_name)
+        _flight_recorder().record(
+            "step", path=wrap_name, it=self.iteration, k=n,
+            batch=self.last_batch_size, dispatch_s=dt)
+        return losses
+
 
 class MultiLayerNetwork(LazyScore):
     """Stateful convenience shell over the pure functions above."""
+
+    _multistep_builder = staticmethod(make_multistep_train_step)
 
     def __init__(self, conf: MultiLayerConfiguration):
         self.conf = conf
@@ -517,6 +593,7 @@ class MultiLayerNetwork(LazyScore):
         self._rng, sub = jax.random.split(self._rng)
         return sub
 
+    @_dump_on_unhandled("MultiLayerNetwork.fit")
     def fit(self, x, y=None, *, epochs: int = 1, fmask=None, lmask=None) -> None:
         """Fit on arrays, a DataSet, or a DataSetIterator (reference fit:978).
 
@@ -556,25 +633,19 @@ class MultiLayerNetwork(LazyScore):
             xd = jnp.asarray(_stage_host(x, self.stage_dtype))
             yd = jnp.asarray(y)
         self.last_batch_size = int(np.shape(x)[0]) if np.ndim(x) else 0
-        multi = self._jit("multistep", make_multistep_train_step(self.conf),
-                          donate=(0, 1, 2))
         remaining = epochs
         while remaining > 0:
             k = min(self.dispatch_ksteps, remaining)
             xs = jnp.broadcast_to(xd[None], (k,) + xd.shape)
             ys = jnp.broadcast_to(yd[None], (k,) + yd.shape)
-            with _t_dispatch.time():
-                (self.params_list, self.state_list, self.updater_state,
-                 losses) = multi(self.params_list, self.state_list,
-                                 self.updater_state, xs, ys, self._next_rng(),
-                                 jnp.int32(self.iteration))
-            _compile_tracker().note_step(k)
+            losses = self._run_multistep(xs, ys, k)
             with _t_listeners.time():
                 for i in range(k):
                     self.iteration += 1
                     self.score_value = (lambda ls=losses, j=i: ls[j])
                     for listener in self.listeners:
                         listener.iteration_done(self, self.iteration)
+            _wd_beat(self.iteration)
             remaining -= k
 
     #: train steps fused per host dispatch in fit_iterator (lax.scan); 1
@@ -595,6 +666,7 @@ class MultiLayerNetwork(LazyScore):
     #: either way — tests/test_prefetch.py).
     prefetch_depth: int = 2
 
+    @_dump_on_unhandled("MultiLayerNetwork.fit_iterator")
     def fit_iterator(self, iterator: Iterable, epochs: int = 1,
                      ksteps: Optional[int] = None) -> None:
         """Fit from a DataSetIterator (reference fit(DataSetIterator):978).
@@ -700,20 +772,14 @@ class MultiLayerNetwork(LazyScore):
         device_put on the prefetch thread, so a prefetched group can never
         alias a buffer the in-flight step is consuming."""
         self.last_batch_size = int(xs.shape[1])
-        multi = self._jit("multistep", make_multistep_train_step(self.conf),
-                          donate=(0, 1, 2))
-        with _t_dispatch.time():
-            (self.params_list, self.state_list, self.updater_state,
-             losses) = multi(
-                self.params_list, self.state_list, self.updater_state, xs, ys,
-                self._next_rng(), jnp.int32(self.iteration))
-        _compile_tracker().note_step(n)
+        losses = self._run_multistep(xs, ys, n)
         with _t_listeners.time():
             for i in range(n):
                 self.iteration += 1
                 self.score_value = (lambda ls=losses, j=i: ls[j])
                 for listener in self.listeners:
                     listener.iteration_done(self, self.iteration)
+        _wd_beat(self.iteration)
 
     #: Solver facade instance when optimization_algo != SGD (built lazily)
     _solver = None
@@ -742,19 +808,36 @@ class MultiLayerNetwork(LazyScore):
             fmask = jnp.asarray(fmask) if fmask is not None else None
             lmask = jnp.asarray(lmask) if lmask is not None else None
         self.last_batch_size = int(x.shape[0]) if x.ndim else 0
-        step = self._jit("train_step", make_train_step(self.conf))
         for _ in range(max(1, self.conf.global_conf.iterations)):
-            with _t_dispatch.time():
+            hm = self.health_monitor
+            use_health = hm is not None and hm.due(self.iteration)
+            name = "train_step_health" if use_health else "train_step"
+            step = self._jit(name, make_train_step(self.conf,
+                                                   health=use_health))
+            t0 = time.perf_counter()
+            out = step(self.params_list, self.state_list,
+                       self.updater_state, x, y, self._next_rng(),
+                       jnp.int32(self.iteration), fmask, lmask)
+            dt = time.perf_counter() - t0
+            _t_dispatch.observe(dt)
+            if use_health:
                 (self.params_list, self.state_list, self.updater_state,
-                 loss) = step(self.params_list, self.state_list,
-                              self.updater_state, x, y, self._next_rng(),
-                              jnp.int32(self.iteration), fmask, lmask)
-            _compile_tracker().note_step()
+                 loss, haux) = out
+                hm.offer(haux, self.iteration)
+            else:
+                (self.params_list, self.state_list, self.updater_state,
+                 loss) = out
+            wrap_name = f"{type(self).__name__}.{name}"
+            _compile_tracker().note_step(fn=wrap_name)
+            _flight_recorder().record(
+                "step", path=wrap_name, it=self.iteration,
+                batch=self.last_batch_size, dispatch_s=dt)
             self.score_value = loss  # device scalar; synced lazily (LazyScore)
             self.iteration += 1
             with _t_listeners.time():
                 for listener in self.listeners:
                     listener.iteration_done(self, self.iteration)
+            _wd_beat(self.iteration)
 
     # ------------------------------------------------------------------ TBPTT
     def _fit_tbptt(self, x, y, fmask=None, lmask=None) -> None:
@@ -777,11 +860,15 @@ class MultiLayerNetwork(LazyScore):
              loss) = step(self.params_list, self.state_list, self.updater_state,
                           rnn_state, xc, yc, self._next_rng(),
                           jnp.int32(self.iteration), fm, lm)
-            _compile_tracker().note_step()
+            _compile_tracker().note_step(fn=f"{type(self).__name__}.tbptt_step")
+            _flight_recorder().record(
+                "step", path=f"{type(self).__name__}.tbptt_step",
+                it=self.iteration, batch=self.last_batch_size)
             self.score_value = loss  # device scalar; synced lazily (LazyScore)
             self.iteration += 1
             for listener in self.listeners:
                 listener.iteration_done(self, self.iteration)
+            _wd_beat(self.iteration)
 
     # ------------------------------------------------------------------ pretrain
     def pretrain(self, iterator) -> None:

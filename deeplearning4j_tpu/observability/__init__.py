@@ -15,10 +15,23 @@ from .metrics import (MetricsRegistry, global_registry, DEFAULT_BUCKETS,
 from .compile_tracker import CompileTracker, global_tracker
 from .spans import span
 from .listener import TelemetryListener, record_hbm_gauges
+from .flight_recorder import (FlightRecorder, global_recorder,
+                              dump_on_unhandled, install_signal_handlers,
+                              uninstall_signal_handlers)
+from .health import (HealthMonitor, NanAlertListener, TrainingDivergedError,
+                     is_invalid_score, health_terms)
+from .watchdog import (StepWatchdog, install_watchdog, uninstall_watchdog,
+                       global_watchdog, beat)
 
 __all__ = [
     "MetricsRegistry", "global_registry", "DEFAULT_BUCKETS", "tree_nbytes",
     "CompileTracker", "global_tracker",
     "span", "names",
     "TelemetryListener", "record_hbm_gauges",
+    "FlightRecorder", "global_recorder", "dump_on_unhandled",
+    "install_signal_handlers", "uninstall_signal_handlers",
+    "HealthMonitor", "NanAlertListener", "TrainingDivergedError",
+    "is_invalid_score", "health_terms",
+    "StepWatchdog", "install_watchdog", "uninstall_watchdog",
+    "global_watchdog", "beat",
 ]

@@ -26,7 +26,9 @@ one function within M training steps" regardless of dispatch fusion.
 from __future__ import annotations
 
 import logging
+import os
 import threading
+import time
 from collections import deque
 from typing import Any, Callable, Dict, Optional, Tuple
 
@@ -34,7 +36,8 @@ import numpy as np
 
 from .metrics import global_registry
 from .names import (JIT_BACKEND_COMPILE_SECONDS, JIT_COMPILE_SECONDS,
-                    JIT_COMPILE_TOTAL, RECOMPILE_STORM_WARNINGS_TOTAL)
+                    JIT_COMPILE_TOTAL, RECOMPILE_STORM_WARNINGS_TOTAL,
+                    STEP_MFU)
 
 log = logging.getLogger(__name__)
 
@@ -45,6 +48,21 @@ STORM_THRESHOLD = 3
 STORM_WINDOW_STEPS = 200
 
 _MAX_EVENTS = 1000
+
+#: assumed accelerator peak when nothing is configured and the backend is a
+#: TPU (v4 chip bf16 peak, matching bench.py); on CPU the default is "peak
+#: unknown" and the MFU gauge stays silent
+_DEFAULT_TPU_PEAK_FLOPS = 197e12
+
+
+def _abstractify_for_lowering(x: Any) -> Any:
+    """Array leaves -> ShapeDtypeStruct so a compiled program can be
+    re-lowered for cost analysis without keeping live buffers alive."""
+    import jax
+
+    if hasattr(x, "shape") and hasattr(x, "dtype"):
+        return jax.ShapeDtypeStruct(tuple(x.shape), x.dtype)
+    return x
 
 
 def _abstract(x: Any) -> Any:
@@ -91,6 +109,18 @@ class CompileTracker:
         #: fn name -> step of last storm warning (rate limit)
         self._last_warned: Dict[str, int] = {}
         self.events: deque = deque(maxlen=_MAX_EVENTS)
+        #: fn name -> (jitted fn, abstract args, abstract kwargs) captured at
+        #: first call, so cost analysis can be computed lazily without live
+        #: buffers
+        self._lowerable: Dict[str, Tuple] = {}
+        #: fn name -> cost_analysis dict (None caches "analysis unavailable"
+        #: so a failing lower is attempted once, not every step)
+        self._cost: Dict[str, Optional[dict]] = {}
+        #: fn name -> perf_counter of the previous note_step(fn=...) — the
+        #: rolling-MFU time base
+        self._mfu_last: Dict[str, float] = {}
+        self._backend_peak: Optional[float] = None
+        self._backend_peak_resolved = False
         # thread-local stack of active tracked calls, so jax.monitoring
         # compile-duration events can be attributed to the right function
         self._active = threading.local()
@@ -115,15 +145,90 @@ class CompileTracker:
         )
 
     # ------------------------------------------------------------ stepping
-    def note_step(self, n: int = 1) -> None:
+    def note_step(self, n: int = 1, fn: Optional[str] = None) -> None:
         """Advance the training-step clock (fit loops call this; a K-step
-        fused dispatch advances by K)."""
+        fused dispatch advances by K). When ``fn`` names the wrapped program
+        that just dispatched, a rolling MFU sample is also recorded — see
+        ``_note_mfu``."""
         with self._lock:
             self._step += n
+        if fn is not None:
+            self._note_mfu(fn, n)
 
     @property
     def step(self) -> int:
         return self._step
+
+    # ----------------------------------------------------------------- mfu
+    def peak_flops(self) -> Optional[float]:
+        """Accelerator peak FLOP/s for MFU: ``DL4J_PEAK_FLOPS`` (or bench's
+        ``BENCH_PEAK_FLOPS``) if set, else a TPU default when the backend is
+        a TPU, else None — on CPU the MFU gauge deliberately stays silent
+        rather than report a meaningless ratio."""
+        env = os.environ.get("DL4J_PEAK_FLOPS") \
+            or os.environ.get("BENCH_PEAK_FLOPS")
+        if env:
+            try:
+                return float(env)
+            except ValueError:
+                log.warning("unparseable peak-FLOPS override %r", env)
+        if not self._backend_peak_resolved:
+            self._backend_peak_resolved = True
+            try:
+                import jax
+
+                if jax.default_backend() == "tpu":
+                    self._backend_peak = _DEFAULT_TPU_PEAK_FLOPS
+            except Exception:  # pragma: no cover - no backend available  # lint: swallowed-exception-ok (MFU stays disabled when the backend is unknown)
+                pass
+        return self._backend_peak
+
+    def flops_for(self, name: str) -> Optional[float]:
+        """FLOPs of ONE training step of the wrapped program ``name``, from
+        XLA's ``cost_analysis()`` on the signature captured at first call.
+        Computed lazily once per (re)compile and cached; XLA counts a scan
+        body once regardless of trip count (pinned by test), so the value is
+        per-step even for the K-step fused programs. Returns None when no
+        analysis is available (never retried until the next compile)."""
+        with self._lock:
+            if name in self._cost:
+                cost = self._cost[name]
+                return None if cost is None else cost.get("flops")
+            lowerable = self._lowerable.get(name)
+        cost = None
+        if lowerable is not None:
+            fn, aargs, akwargs = lowerable
+            try:
+                analysis = fn.lower(*aargs, **akwargs).compile() \
+                    .cost_analysis()
+                if isinstance(analysis, (list, tuple)):
+                    analysis = analysis[0] if analysis else None
+                if analysis is not None:
+                    cost = {str(k): v for k, v in dict(analysis).items()
+                            if isinstance(v, (int, float))}
+            except Exception as e:  # non-jit wrappee, API drift: MFU off
+                log.debug("cost analysis unavailable for %s: %r", name, e)
+        with self._lock:
+            self._cost[name] = cost
+        return None if cost is None else cost.get("flops")
+
+    def _note_mfu(self, fn_name: str, n: int) -> None:
+        now = time.perf_counter()
+        last = self._mfu_last.get(fn_name)
+        self._mfu_last[fn_name] = now
+        if last is None:
+            return
+        elapsed = now - last
+        peak = self.peak_flops()
+        if elapsed <= 0 or not peak:
+            return
+        flops = self.flops_for(fn_name)
+        if not flops:
+            return
+        mfu = min(1.0, (flops * n) / (elapsed * peak))
+        self.registry.gauge(
+            STEP_MFU, "rolling model FLOP utilization per dispatched "
+            "program").labels(fn=fn_name).set(mfu)
 
     # -------------------------------------------------- monitoring bridge
     def _ensure_monitoring(self) -> None:
@@ -179,6 +284,12 @@ class CompileTracker:
                           or step - warned > self.storm_window_steps))
             if storm:
                 self._last_warned[name] = step
+        try:
+            from .flight_recorder import global_recorder
+
+            global_recorder().record("compile", **event)
+        except Exception:  # pragma: no cover - recorder import cycle guard  # lint: swallowed-exception-ok (recorder forwarding is best-effort)
+            pass
         if storm:
             storm_total.labels(fn=name).inc()
             log.warning(
@@ -225,6 +336,7 @@ class CompileTracker:
             wall = _time.perf_counter() - t0
             if sig is not None:
                 seen[sig] = True
+            tracker._capture_lowerable(name, fn, args, kwargs)
             tracker.record_compile(name, cache_key=cache_key, wall_s=wall,
                                    shapes=None if sig is None else sig[0])
             return out
@@ -233,10 +345,33 @@ class CompileTracker:
         tracked.__name__ = getattr(fn, "__name__", name)
         return tracked
 
+    def _capture_lowerable(self, name: str, fn: Callable, args: tuple,
+                           kwargs: dict) -> None:
+        """Remember the abstract signature of a freshly-compiled program so
+        ``flops_for`` can re-lower it later; invalidates any cached cost
+        analysis for the name (shapes may have changed)."""
+        try:
+            import jax
+
+            aargs, akwargs = jax.tree_util.tree_map(
+                _abstractify_for_lowering, (args, kwargs))
+        except Exception:  # unflattenable args: cost analysis just stays off  # lint: swallowed-exception-ok (MFU degrades to unavailable for this program)
+            return
+        with self._lock:
+            self._lowerable[name] = (fn, aargs, akwargs)
+            self._cost.pop(name, None)
+
     # ------------------------------------------------------------ export
     def snapshot_events(self) -> list:
         with self._lock:
             return list(self.events)
+
+    def snapshot_cost_analyses(self) -> dict:
+        """Cached per-program cost analyses (no new lowering/compiling —
+        safe to call from a crash dump)."""
+        with self._lock:
+            return {name: (dict(cost) if cost else None)
+                    for name, cost in self._cost.items()}
 
 
 _GLOBAL = CompileTracker()

@@ -1,0 +1,318 @@
+"""Training-health monitor: device-side NaN/divergence detection.
+
+The reference stack diagnoses bad training with host-side listener idioms —
+``NanScoreWatcher`` reads the score every iteration,
+``InvalidScoreIterationTerminationCondition`` isnan/isinf-checks it — which
+translate to a forced device sync per step under lazy dispatch. This module
+keeps the judgment on the device: the step builders in ``nn/`` fuse a small
+health summary (global grad norm, global param-update norm, non-finite grad
+leaf count, loss) into the training step itself when a monitor is attached
+and the cadence is due, so off-cadence steps are byte-identical to the
+unmonitored program and the only host sync happens when a result is polled.
+
+Flow per cadence-due step::
+
+    train_step(..., health=True)  ->  (..., health_aux)   # on device
+    monitor.offer(health_aux, it)                         # pack, no sync
+    listener polls next iteration  ->  one np.asarray     # the only sync
+        -> gauges, loss-EMA divergence heuristic, alarm -> recorder dump
+
+``is_invalid_score`` is the single shared definition of "invalid" used by
+the alarm path and by early stopping's
+``InvalidScoreIterationTerminationCondition``.
+"""
+from __future__ import annotations
+
+import logging
+import math
+import threading
+from typing import Any, Dict, Optional
+
+from .metrics import global_registry
+from .names import (HEALTH_ALARMS_TOTAL, HEALTH_CHECKS_TOTAL,
+                    HEALTH_GRAD_NORM, HEALTH_LOSS_EMA,
+                    HEALTH_NONFINITE_GRADS, HEALTH_UPDATE_NORM)
+
+log = logging.getLogger(__name__)
+
+#: how often (in training steps) the fused health summary runs by default —
+#: high enough that the extra reduce is noise, low enough that a NaN is
+#: caught within a couple of seconds of wall time
+DEFAULT_CADENCE = 50
+
+#: packed-vector layout produced by ``health_terms`` / consumed by ``_resolve``
+_PACK_FIELDS = ("grad_norm", "update_norm", "nonfinite_grads", "loss")
+
+
+class TrainingDivergedError(RuntimeError):
+    """Raised by ``NanAlertListener(raise_on_alarm=True)`` when the health
+    monitor reports a non-finite or diverged training step."""
+
+
+def is_invalid_score(score: Any) -> bool:
+    """THE shared predicate for "this score means training is broken":
+    None, NaN, or +/-inf. Early stopping and the NaN alarm both route
+    through here so they can never disagree."""
+    if score is None:
+        return True
+    try:
+        value = float(score)
+    except (TypeError, ValueError):
+        return True
+    return math.isnan(value) or math.isinf(value)
+
+
+def health_terms(grads, params, new_params, loss):
+    """Pure-jnp health summary, traced INSIDE the training step.
+
+    Runs where grads, pre-update params, and post-update params all still
+    exist as program values, so it composes with buffer donation (nothing is
+    held across the step boundary) and costs one fused reduce. Returns a
+    single packed f32 vector ordered as ``_PACK_FIELDS``.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    f32 = jnp.float32
+    grad_sq = f32(0.0)
+    nonfinite = f32(0.0)
+    for g in jax.tree_util.tree_leaves(grads):
+        gf = g.astype(f32)
+        grad_sq = grad_sq + jnp.sum(gf * gf)
+        nonfinite = nonfinite + jnp.sum(~jnp.isfinite(gf)).astype(f32)
+    upd_sq = f32(0.0)
+    for p, q in zip(jax.tree_util.tree_leaves(params),
+                    jax.tree_util.tree_leaves(new_params)):
+        d = q.astype(f32) - p.astype(f32)
+        upd_sq = upd_sq + jnp.sum(d * d)
+    return jnp.stack([jnp.sqrt(grad_sq), jnp.sqrt(upd_sq), nonfinite,
+                      jnp.asarray(loss, f32)])
+
+
+class HealthMonitor:
+    """Cadenced device-side health checks with a host-side alarm.
+
+    Attach to a network with ``monitor.attach(net)`` (or assign
+    ``net.health_monitor``); the fit loops then dispatch the health variant
+    of the training step whenever ``due()``/``due_range()`` says a multiple
+    of ``cadence`` falls in the dispatched range. Results arrive via
+    ``offer()`` (device array, no sync) and are materialized by ``poll()``
+    — one host transfer per cadence window, normally issued by
+    ``NanAlertListener`` an iteration later, when the step has long
+    completed.
+    """
+
+    def __init__(self, cadence: int = DEFAULT_CADENCE, *,
+                 ema_alpha: float = 0.98, divergence_factor: float = 25.0,
+                 min_ema_samples: int = 5, dump_on_alarm: bool = True,
+                 recorder=None, registry=None):
+        self.cadence = int(cadence)
+        self.ema_alpha = float(ema_alpha)
+        self.divergence_factor = float(divergence_factor)
+        self.min_ema_samples = int(min_ema_samples)
+        self.dump_on_alarm = dump_on_alarm
+        self._recorder = recorder
+        self._registry = registry
+        self._lock = threading.Lock()
+        self._pending = None  # (packed device vector, iteration)
+        self._dumped = False
+        self.loss_ema: Optional[float] = None
+        self._ema_samples = 0
+        self.checks = 0
+        self.alarms = 0
+        self.alarm: Optional[Dict[str, Any]] = None  # last alarm, sticky
+        self.last: Optional[Dict[str, Any]] = None   # last resolved summary
+
+    # ------------------------------------------------------------- wiring
+    def attach(self, net):
+        """Set this monitor as ``net.health_monitor``; returns the monitor
+        (``hm = HealthMonitor(...).attach(net)``)."""
+        net.health_monitor = self
+        return self
+
+    @property
+    def registry(self):
+        return self._registry if self._registry is not None \
+            else global_registry()
+
+    def _recorder_or_global(self):
+        if self._recorder is not None:
+            return self._recorder
+        from .flight_recorder import global_recorder
+
+        return global_recorder()
+
+    # ------------------------------------------------------------ cadence
+    def due(self, iteration: int) -> bool:
+        """True when the step at ``iteration`` should carry the health
+        summary."""
+        return self.cadence > 0 and iteration % self.cadence == 0
+
+    def due_range(self, start: int, n: int) -> bool:
+        """True when any iteration in ``[start, start + n)`` is due — the
+        K-step fused dispatchers use this to pick the health variant of the
+        multistep program for the whole group."""
+        return self.due_index(start, n) is not None
+
+    def due_index(self, start: int, n: int) -> Optional[int]:
+        """Offset within ``[start, start + n)`` of the first due iteration,
+        or None — the dispatcher uses it to pick which row of the stacked
+        ``(K, 4)`` health output to offer."""
+        if self.cadence <= 0 or n <= 0:
+            return None
+        first_due = ((start + self.cadence - 1) // self.cadence) * self.cadence
+        return first_due - start if first_due < start + n else None
+
+    # ------------------------------------------------------------ results
+    def offer(self, packed, iteration: int) -> None:
+        """Accept the packed device vector from a completed health step.
+        No host sync here: the array is parked until ``poll()``. If an
+        earlier offer was never polled (no listener attached), it is
+        resolved now — by this point its step has long finished, so the
+        transfer is a copy, not a wait."""
+        with self._lock:
+            prev, self._pending = self._pending, (packed, int(iteration))
+        if prev is not None:
+            self._resolve(*prev)
+
+    def poll(self) -> Optional[Dict[str, Any]]:
+        """Materialize the pending health summary, if any; returns the alarm
+        dict when this summary tripped the alarm, else None. The single
+        host sync of the health path lives here, outside the fit loops'
+        hot dispatch names."""
+        with self._lock:
+            pending, self._pending = self._pending, None
+        if pending is None:
+            return None
+        return self._resolve(*pending)
+
+    def _resolve(self, packed, iteration: int) -> Optional[Dict[str, Any]]:
+        values = np_asarray(packed)
+        summary = {k: float(v) for k, v in zip(_PACK_FIELDS, values)}
+        summary["iteration"] = iteration
+        reg = self.registry
+        reg.gauge(HEALTH_GRAD_NORM,
+                  "global grad L2 norm at the last health check").set(
+                      summary["grad_norm"])
+        reg.gauge(HEALTH_UPDATE_NORM,
+                  "global param-update L2 norm at the last health check").set(
+                      summary["update_norm"])
+        reg.gauge(HEALTH_NONFINITE_GRADS,
+                  "non-finite grad elements at the last health check").set(
+                      summary["nonfinite_grads"])
+        reg.counter(HEALTH_CHECKS_TOTAL,
+                    "health summaries resolved on the host").inc()
+        self.checks += 1
+        loss = summary["loss"]
+        why = None
+        if summary["nonfinite_grads"] > 0:
+            why = "nonfinite-grads"
+        elif is_invalid_score(loss):
+            why = "invalid-loss"
+        elif not (math.isfinite(summary["grad_norm"])
+                  and math.isfinite(summary["update_norm"])):
+            why = "nonfinite-norms"
+        else:
+            if (self.loss_ema is not None
+                    and self._ema_samples >= self.min_ema_samples
+                    and loss > self.divergence_factor
+                    * max(abs(self.loss_ema), 1e-8)):
+                why = "loss-divergence"
+            a = self.ema_alpha
+            self.loss_ema = loss if self.loss_ema is None \
+                else a * self.loss_ema + (1.0 - a) * loss
+            self._ema_samples += 1
+            reg.gauge(HEALTH_LOSS_EMA,
+                      "EMA of the training loss at health checks").set(
+                          self.loss_ema)
+        self.last = summary
+        if why is None:
+            return None
+        return self._raise_alarm(why, summary)
+
+    def _raise_alarm(self, why: str, summary: Dict[str, Any]):
+        alarm = dict(summary, why=why, ema=self.loss_ema)
+        self.alarm = alarm
+        self.alarms += 1
+        self.registry.counter(
+            HEALTH_ALARMS_TOTAL,
+            "health alarms (non-finite or diverged training)").labels(
+                why=why).inc()
+        rec = self._recorder_or_global()
+        rec.record("health_alarm", **alarm)
+        log.error("health alarm at iteration %d: %s (loss=%g grad_norm=%g "
+                  "update_norm=%g nonfinite_grads=%g ema=%s)",
+                  summary["iteration"], why, summary["loss"],
+                  summary["grad_norm"], summary["update_norm"],
+                  summary["nonfinite_grads"], self.loss_ema)
+        if self.dump_on_alarm and not self._dumped:
+            if rec.dump(reason=f"health-alarm-{why}") is not None:
+                self._dumped = True
+        return alarm
+
+
+def np_asarray(x):
+    """Device -> host materialization for resolved health vectors, isolated
+    here so the fit-path modules stay free of sync-looking calls."""
+    import numpy as np
+
+    return np.asarray(x, dtype=np.float64)
+
+
+class NanAlertListener:
+    """Listener that polls the attached ``HealthMonitor`` and turns alarms
+    into action: record + flight-recorder dump (done by the monitor) and,
+    with ``raise_on_alarm=True``, a ``TrainingDivergedError`` that stops the
+    fit. Without a monitor it degrades to the reference ``NanScoreWatcher``
+    idiom — checking ``score_value`` every ``check_every`` iterations, which
+    costs a host sync at that cadence."""
+
+    def __init__(self, monitor: Optional[HealthMonitor] = None, *,
+                 check_every: int = 1, raise_on_alarm: bool = False,
+                 recorder=None):
+        self.monitor = monitor
+        self.check_every = max(1, int(check_every))
+        self.raise_on_alarm = raise_on_alarm
+        self._recorder = recorder
+        self._score_alarmed = False
+        self._seen_alarm = None
+
+    def _recorder_or_global(self):
+        if self._recorder is not None:
+            return self._recorder
+        from .flight_recorder import global_recorder
+
+        return global_recorder()
+
+    def iteration_done(self, model, iteration: int) -> None:
+        hm = self.monitor or getattr(model, "health_monitor", None)
+        if hm is not None:
+            hm.poll()
+            # the sticky alarm also covers summaries resolved by offer()'s
+            # backlog path, which poll() never returned to us
+            alarm = hm.alarm
+            if (alarm is not None and alarm is not self._seen_alarm
+                    and self.raise_on_alarm):
+                self._seen_alarm = alarm
+                raise TrainingDivergedError(
+                    f"training health alarm at iteration "
+                    f"{alarm['iteration']}: {alarm['why']} "
+                    f"(loss={alarm['loss']!r})")
+            return
+        if iteration % self.check_every != 0:
+            return
+        score = model.score_value  # forces the sync, as the reference did
+        if not is_invalid_score(score) or self._score_alarmed:
+            return
+        self._score_alarmed = True
+        reg = global_registry()
+        reg.counter(HEALTH_ALARMS_TOTAL,
+                    "health alarms (non-finite or diverged training)").labels(
+                        why="invalid-score").inc()
+        rec = self._recorder_or_global()
+        rec.record("health_alarm", why="invalid-score", iteration=iteration,
+                   loss=None if score is None else float(score))
+        rec.dump(reason="health-alarm-invalid-score")
+        if self.raise_on_alarm:
+            raise TrainingDivergedError(
+                f"invalid score {score!r} at iteration {iteration}")

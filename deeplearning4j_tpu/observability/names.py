@@ -40,6 +40,21 @@ COLLECTIVE_BYTES_PER_STEP = "dl4j_collective_bytes_per_step"
 # --- kernel dispatch (ops/pallas_kernels.py) -------------------------------
 PALLAS_DISPATCH_TOTAL = "dl4j_pallas_dispatch_total"
 
+# --- training health (observability/health.py) -----------------------------
+HEALTH_GRAD_NORM = "dl4j_health_grad_norm"
+HEALTH_UPDATE_NORM = "dl4j_health_update_norm"
+HEALTH_NONFINITE_GRADS = "dl4j_health_nonfinite_grads"
+HEALTH_LOSS_EMA = "dl4j_health_loss_ema"
+HEALTH_CHECKS_TOTAL = "dl4j_health_checks_total"
+HEALTH_ALARMS_TOTAL = "dl4j_health_alarms_total"
+
+# --- flight recorder + watchdog (observability/{flight_recorder,watchdog}.py)
+FLIGHT_DUMPS_TOTAL = "dl4j_flight_dumps_total"
+WATCHDOG_STALLS_TOTAL = "dl4j_watchdog_stalls_total"
+
+# --- model FLOP utilization (observability/compile_tracker.py) --------------
+STEP_MFU = "dl4j_step_mfu"
+
 # --- input pipeline (datasets/prefetch.py) ---------------------------------
 PREFETCH_DEPTH = "dl4j_prefetch_depth"
 PREFETCH_BYTES_TOTAL = "dl4j_prefetch_bytes_total"

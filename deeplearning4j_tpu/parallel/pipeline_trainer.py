@@ -15,6 +15,7 @@ is step-for-step equivalent to single-device training on the same batches
 """
 from __future__ import annotations
 
+import time as _time
 from typing import Optional
 
 import jax
@@ -25,6 +26,11 @@ from jax.sharding import Mesh
 from deeplearning4j_tpu.observability.compile_tracker import (
     global_tracker as _compile_tracker,
 )
+from deeplearning4j_tpu.observability.flight_recorder import (
+    dump_on_unhandled as _dump_on_unhandled,
+    global_recorder as _flight_recorder,
+)
+from deeplearning4j_tpu.observability.watchdog import beat as _wd_beat
 from deeplearning4j_tpu.parallel.mesh import build_mesh
 from deeplearning4j_tpu.parallel.pipeline import PipelineParallel
 from deeplearning4j_tpu.parallel.wrapper import (
@@ -163,6 +169,7 @@ class PipelineTrainer:
     #: MultiLayerNetwork.prefetch_depth); 0 = synchronous staging
     prefetch_depth: int = 2
 
+    @_dump_on_unhandled("PipelineTrainer.fit")
     def fit(self, iterator, epochs: int = 1) -> None:
         """Reference ParallelWrapper.fit(DataSetIterator):322 shape: every
         batch runs one pipelined train step; listeners fire per iteration.
@@ -197,15 +204,22 @@ class PipelineTrainer:
                                   path="pipeline", wait_series=_t_staging)
             for x, y in pf:
                 net.last_batch_size = int(x.shape[0]) if x.ndim else 0
-                with _t_dispatch.time():
-                    (net.params_list, net.state_list, net.updater_state,
-                     loss) = self._step(net.params_list, net.state_list,
-                                        net.updater_state, x, y,
-                                        net._next_rng(),
-                                        jnp.int32(net.iteration))
-                _compile_tracker().note_step()
+                t0 = _time.perf_counter()
+                (net.params_list, net.state_list, net.updater_state,
+                 loss) = self._step(net.params_list, net.state_list,
+                                    net.updater_state, x, y,
+                                    net._next_rng(),
+                                    jnp.int32(net.iteration))
+                dt = _time.perf_counter() - t0
+                _t_dispatch.observe(dt)
+                _compile_tracker().note_step(fn="PipelineTrainer.train_step")
+                _flight_recorder().record(
+                    "step", path="PipelineTrainer.train_step",
+                    it=net.iteration, batch=net.last_batch_size,
+                    dispatch_s=dt)
                 net.score_value = loss
                 net.iteration += 1
                 with _t_listeners.time():
                     for listener in net.listeners:
                         listener.iteration_done(net, net.iteration)
+                _wd_beat(net.iteration)

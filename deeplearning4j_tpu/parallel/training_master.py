@@ -32,6 +32,11 @@ from deeplearning4j_tpu import common
 from deeplearning4j_tpu.observability.compile_tracker import (
     global_tracker as _compile_tracker,
 )
+from deeplearning4j_tpu.observability.flight_recorder import (
+    dump_on_unhandled as _dump_on_unhandled,
+    global_recorder as _flight_recorder,
+)
+from deeplearning4j_tpu.observability.watchdog import beat as _wd_beat
 from deeplearning4j_tpu.observability.names import COLLECTIVE_BYTES_TOTAL
 from deeplearning4j_tpu.observability.metrics import (
     global_registry as _obs_registry, tree_nbytes as _tree_nbytes,
@@ -235,6 +240,7 @@ class ParameterAveragingTrainingMaster(TrainingMaster):
         return fns
 
     # ------------------------------------------------------------------ training
+    @_dump_on_unhandled("TrainingMaster.execute_training")
     def execute_training(self, model, data_iterator) -> None:
         """One pass over the iterator (reference executeTraining:344). Minibatches
         are grouped into splits of num_workers*averaging_frequency; each worker
@@ -311,15 +317,22 @@ class ParameterAveragingTrainingMaster(TrainingMaster):
                 # in the split and only happens when stats are collected
                 self.stats.add("WorkerFit", t1, time.time() - t1,
                                loss=float(loss))  # lint: host-sync-in-hot-loop-ok (stats-only sync, gated on self.stats)
-            _compile_tracker().note_step(f)
+            _compile_tracker().note_step(f, fn="TrainingMaster.local_steps")
+            _flight_recorder().record(
+                "step", path="TrainingMaster.local_steps",
+                it=model.iteration, k=f, dispatch_s=time.time() - t1)
             t2 = time.time()
             params, states, upd = average(params, states, upd)
             avg_bytes.inc(param_bytes)
+            _flight_recorder().record(
+                "step", path="TrainingMaster.average", it=model.iteration,
+                collective_bytes=param_bytes, dispatch_s=time.time() - t2)
             if self.stats:
                 self.stats.add("AverageParameters", t2, time.time() - t2)
             model.score_value = loss
             for listener in model.listeners:
                 listener.iteration_done(model, model.iteration)
+            _wd_beat(model.iteration)
 
         from deeplearning4j_tpu.datasets.prefetch import DevicePrefetcher
 

@@ -25,6 +25,7 @@ collectives ride DCN.
 from __future__ import annotations
 
 import functools
+import time as _time
 from typing import Optional
 
 import jax
@@ -37,6 +38,11 @@ from deeplearning4j_tpu.datasets.iterators import AsyncDataSetIterator
 from deeplearning4j_tpu.observability.compile_tracker import (
     global_tracker as _compile_tracker,
 )
+from deeplearning4j_tpu.observability.flight_recorder import (
+    dump_on_unhandled as _dump_on_unhandled,
+    global_recorder as _flight_recorder,
+)
+from deeplearning4j_tpu.observability.watchdog import beat as _wd_beat
 from deeplearning4j_tpu.observability.names import (
     COLLECTIVE_BYTES_TOTAL, FIT_PHASE_SECONDS,
 )
@@ -337,6 +343,7 @@ class ParallelWrapper:
                                     "shard_parameters()")
 
     # ------------------------------------------------------------------ public API
+    @_dump_on_unhandled("ParallelWrapper.fit")
     def fit(self, iterator, epochs: int = 1) -> None:
         """Reference fit(DataSetIterator):322. Batches are sharded over the mesh;
         each global batch must be divisible by the number of workers."""
@@ -483,18 +490,25 @@ class ParallelWrapper:
         def dispatch_one(x, y, batch_size):
             if not is_graph:
                 net.last_batch_size = batch_size
-            with _t_dispatch.time():
-                (net.params_list, net.state_list, net.updater_state, loss) = \
-                    self._sync_step(net.params_list, net.state_list,
-                                    net.updater_state, x, y, net._next_rng(),
-                                    jnp.int32(net.iteration))
-            _compile_tracker().note_step()
+            t0 = _time.perf_counter()
+            (net.params_list, net.state_list, net.updater_state, loss) = \
+                self._sync_step(net.params_list, net.state_list,
+                                net.updater_state, x, y, net._next_rng(),
+                                jnp.int32(net.iteration))
+            dt = _time.perf_counter() - t0
+            _t_dispatch.observe(dt)
+            _compile_tracker().note_step(fn="ParallelWrapper.sync_step")
             psum_bytes.inc(param_bytes)
+            _flight_recorder().record(
+                "step", path="ParallelWrapper.sync_step", it=net.iteration,
+                batch=batch_size, dispatch_s=dt,
+                collective_bytes=param_bytes)
             net.score_value = loss  # synced lazily (LazyScore)
             net.iteration += 1
             with _t_listeners.time():
                 for listener in net.listeners:
                     listener.iteration_done(net, net.iteration)
+            _wd_beat(net.iteration)
 
         def stack_spec(arr):
             # stacked (K, B, ...) batches: batch spec shifted one axis right
@@ -503,21 +517,28 @@ class ParallelWrapper:
         def dispatch(xs, ys, n):
             if not is_graph:
                 net.last_batch_size = int(xs.shape[1])
-            with _t_dispatch.time():
-                (net.params_list, net.state_list, net.updater_state,
-                 losses) = \
-                    self._sync_multi(net.params_list, net.state_list,
-                                     net.updater_state, xs, ys,
-                                     net._next_rng(),
-                                     jnp.int32(net.iteration))
-            _compile_tracker().note_step(n)
+            t0 = _time.perf_counter()
+            (net.params_list, net.state_list, net.updater_state,
+             losses) = \
+                self._sync_multi(net.params_list, net.state_list,
+                                 net.updater_state, xs, ys,
+                                 net._next_rng(),
+                                 jnp.int32(net.iteration))
+            dt = _time.perf_counter() - t0
+            _t_dispatch.observe(dt)
+            _compile_tracker().note_step(n, fn="ParallelWrapper.sync_multistep")
             psum_bytes.inc(param_bytes * n)
+            _flight_recorder().record(
+                "step", path="ParallelWrapper.sync_multistep",
+                it=net.iteration, k=n, batch=net.last_batch_size,
+                dispatch_s=dt, collective_bytes=param_bytes * n)
             with _t_listeners.time():
                 for i in range(n):
                     net.iteration += 1
                     net.score_value = (lambda ls=losses, j=i: ls[j])
                     for listener in net.listeners:
                         listener.iteration_done(net, net.iteration)
+            _wd_beat(net.iteration)
 
         def stage(kind_item):
             # producer thread: the sharded version of the single-chip stage —
@@ -680,11 +701,16 @@ class ParallelWrapper:
             for x, y, bs in pf:
                 if not is_graph:
                     net.last_batch_size = bs
-                with _t_dispatch.time():
-                    params, states, upd, loss = self._local_step(
-                        params, states, upd, x, y, net._next_rng(),
-                        jnp.int32(net.iteration))
-                _compile_tracker().note_step()
+                t0 = _time.perf_counter()
+                params, states, upd, loss = self._local_step(
+                    params, states, upd, x, y, net._next_rng(),
+                    jnp.int32(net.iteration))
+                dt = _time.perf_counter() - t0
+                _t_dispatch.observe(dt)
+                _compile_tracker().note_step(fn="ParallelWrapper.local_step")
+                _flight_recorder().record(
+                    "step", path="ParallelWrapper.local_step",
+                    it=net.iteration, batch=bs, dispatch_s=dt)
                 net.score_value = loss  # synced lazily (LazyScore)
                 net.iteration += 1
                 since_avg += 1
@@ -695,6 +721,7 @@ class ParallelWrapper:
                 with _t_listeners.time():
                     for listener in net.listeners:
                         listener.iteration_done(net, net.iteration)
+                _wd_beat(net.iteration)
         # final sync + unstack back into the model
         params, upd, states = self._avg_fn(params, upd, states)
         unstack = functools.partial(jax.tree_util.tree_map, lambda a: a[0])

@@ -358,6 +358,10 @@ class _Handler(BaseHTTPRequestHandler):
                        "text/plain; version=0.0.4; charset=utf-8")
         elif path == "/train/telemetry/data":
             self._json(self.ui.telemetry_data())
+        elif path == "/train/health":
+            self._json(self.ui.health_data())
+        elif path == "/train/health/bundles":
+            self._json(self.ui.health_bundles())
         elif path == "/train/histograms/data":
             # HistogramModule equivalent: latest param/gradient/update
             # histograms per variable
@@ -534,6 +538,36 @@ class UIServer:
         return {"metrics": global_registry().snapshot(),
                 "compile_events": global_tracker().snapshot_events(),
                 "step": global_tracker().step}
+
+    def health_data(self) -> dict:
+        """Training-health snapshot for ``/train/health``: the dl4j_health_*
+        / watchdog / MFU gauge families plus flight-recorder state, so one
+        request answers "is this run diverging, stalled, or dumping"."""
+        from deeplearning4j_tpu.observability import (global_recorder,
+                                                      global_registry)
+        from deeplearning4j_tpu.observability.watchdog import global_watchdog
+
+        prefixes = ("dl4j_health_", "dl4j_watchdog_", "dl4j_flight_",
+                    "dl4j_step_mfu")
+        metrics = {name: fam
+                   for name, fam in global_registry().snapshot().items()
+                   if name.startswith(prefixes)}
+        rec = global_recorder()
+        wd = global_watchdog()
+        return {
+            "metrics": metrics,
+            "recorder": {"enabled": rec.enabled, "events": len(rec),
+                         "dropped": rec.dropped, "capacity": rec.capacity},
+            "watchdog": None if wd is None else {
+                "threshold_s": wd.threshold_s, "stalls": wd.stalls},
+        }
+
+    def health_bundles(self) -> dict:
+        """Flight-recorder bundle manifests (newest first) for
+        ``/train/health/bundles``."""
+        from deeplearning4j_tpu.observability import global_recorder
+
+        return {"bundles": global_recorder().list_bundles()}
 
     def histogram_data(self, session: Optional[str] = None) -> dict:
         """Latest histograms per variable (reference HistogramModule)."""

@@ -7,6 +7,8 @@
 #
 #   ./runtests.sh [pytest args]   # the suite
 #   ./runtests.sh lint [args]     # graftlint over the package (see docs/GUIDE.md)
+#   ./runtests.sh health [args]   # failure-diagnostics suite: flight recorder,
+#                                 # health monitor, watchdog, overhead budget
 set -e
 cd "$(dirname "$0")"
 
@@ -15,6 +17,15 @@ if [ "${1-}" = "lint" ]; then
   PALLAS_AXON_POOL_IPS= \
   JAX_PLATFORMS=cpu \
   exec python -m deeplearning4j_tpu.lint "$@"
+fi
+
+if [ "${1-}" = "health" ]; then
+  shift
+  PALLAS_AXON_POOL_IPS= \
+  JAX_PLATFORMS=cpu \
+  XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+  exec python -m pytest tests/test_flight_recorder.py \
+    tests/test_bench_contract.py::test_telemetry_overhead_budget -q "$@"
 fi
 
 PALLAS_AXON_POOL_IPS= \

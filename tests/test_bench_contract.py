@@ -170,14 +170,15 @@ def test_bench_reduction_dtype_flag_end_to_end(tmp_path):
 
 
 def test_telemetry_overhead_budget():
-    """Telemetry (including the prefetch families) must cost <=2% of a
-    LeNet fit step. Budget-style rather than a wall-clock A/B (which flakes
-    on shared CI hosts): measure the real per-step time of the instrumented
-    loop — driven through fit_iterator with device prefetch ON so the
-    prefetch metrics are in the measured window — microbenchmark the
-    registry primitives it calls, bound the ops issued per step from
-    registry deltas, and require ops_per_step * per_op_cost <= 2% of the
-    step time."""
+    """Telemetry (including the prefetch families AND the training-health
+    monitor at its check cadence) must cost <=2% of a LeNet fit step.
+    Budget-style rather than a wall-clock A/B (which flakes on shared CI
+    hosts): measure the real per-step time of the instrumented loop —
+    driven through fit_iterator with device prefetch ON and a HealthMonitor
+    + NanAlertListener attached so the health metrics are in the measured
+    window — microbenchmark the registry primitives it calls, bound the
+    ops issued per step from registry deltas, and require
+    ops_per_step * per_op_cost <= 2% of the step time."""
     import time
 
     from deeplearning4j_tpu.datasets.dataset import DataSet
@@ -185,7 +186,8 @@ def test_telemetry_overhead_budget():
     from deeplearning4j_tpu.models.lenet import lenet_mnist
     from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
     from deeplearning4j_tpu.observability import (
-        MetricsRegistry, TelemetryListener, global_registry,
+        HealthMonitor, MetricsRegistry, NanAlertListener, TelemetryListener,
+        global_registry,
     )
 
     rng = np.random.default_rng(0)
@@ -193,12 +195,16 @@ def test_telemetry_overhead_budget():
     y = np.zeros((8, 10), np.float32)
     y[np.arange(8), rng.integers(0, 10, 8)] = 1
     ksteps = 2
+    health_cadence = 4
     net = MultiLayerNetwork(lenet_mnist()).init()
     net.dispatch_ksteps = ksteps
+    HealthMonitor(cadence=health_cadence, dump_on_alarm=False).attach(net)
     net.set_listeners(TelemetryListener(sync_every=1, hbm_every=1,
-                                        worker_id="overhead_budget"))
-    # warmup: compile the fused step outside the measured window
-    net.fit_iterator(ListDataSetIterator([DataSet(x, y)] * ksteps))
+                                        worker_id="overhead_budget"),
+                      NanAlertListener())
+    # warmup: compile the fused step (both health variants) outside the
+    # measured window
+    net.fit_iterator(ListDataSetIterator([DataSet(x, y)] * 2 * ksteps))
 
     def _mutation_count(reg):
         # counter value == #incs (unit increments in the fit path),
@@ -206,12 +212,16 @@ def test_telemetry_overhead_budget():
         # set per step (upper bound: they are set at most once a step).
         # Quantity counters (*_bytes_total / *_seconds_total) increment by
         # measured amounts, not by 1 — their value is NOT an op count, so
-        # they are excluded here and charged explicitly below.
+        # they are excluded here and charged explicitly below. The health
+        # gauges hold arbitrary floats (norms, EMA) rather than op counts,
+        # so they too are excluded and charged explicitly per cadence.
         total = 0.0
         for name, fam in reg.snapshot().items():
             if name.endswith(("_bytes_total", "_seconds_total")):
                 continue
             for s in fam["series"]:
+                if fam["type"] == "gauge" and name.startswith("dl4j_health_"):
+                    continue
                 total += s["count"] if "count" in s else max(s["value"], 1.0)
         return total
 
@@ -232,6 +242,11 @@ def test_telemetry_overhead_budget():
     # wait.inc + depth.set + overlap.set = 6 (the wait_series observe is a
     # histogram count, already in the delta).
     ops_per_step += 6 / ksteps
+    # health gauges excluded above, charged per CHECK: grad/update/nonfinite
+    # norm sets + loss-EMA set = 4 (the checks counter inc is a unit counter,
+    # already in the delta). The fused K-group path checks at most once per
+    # group, so the effective cadence is max(cadence, ksteps).
+    ops_per_step += 4 / max(health_cadence, ksteps)
     assert ops_per_step > 0  # the loop really is instrumented
 
     probe = MetricsRegistry()
