@@ -66,12 +66,17 @@ def _xla_flops(jit_fn, *args) -> float:
 
 
 def _measure_multistep(conf, xs, ys, iters: int, warmup: int,
-                       graph: bool = False) -> dict:
+                       graph: bool = False, track_fn: str = None) -> dict:
     """Steady-state throughput of K-step scanned training on stacked batches.
 
     xs/ys: (K, B, ...) stacks (lists of stacks for graph nets). Each timed
     "iter" is ONE host dispatch running K fused train steps on device. The
     donated-params chain means the final float(loss) waits on every step.
+
+    ``track_fn`` names the program in the CompileTracker so the rolling
+    ``dl4j_step_mfu{fn=track_fn}`` gauge populates during the run — the
+    per-variant MFU channel for A/B twins (note_step after each timed
+    dispatch advances by K, matching the fit loops).
     """
     import jax
     import jax.numpy as jnp
@@ -88,6 +93,12 @@ def _measure_multistep(conf, xs, ys, iters: int, warmup: int,
         multi = make_multistep_train_step(conf)
 
     jit_multi = jax.jit(multi, donate_argnums=(0, 1, 2))
+    tracker = None
+    dispatch = jit_multi
+    if track_fn:
+        from deeplearning4j_tpu.observability import global_tracker
+        tracker = global_tracker()
+        dispatch = tracker.wrap(track_fn, jit_multi)
     key = jax.random.PRNGKey(0)
     params, states, upd = net.params_list, net.state_list, net.updater_state
 
@@ -100,16 +111,18 @@ def _measure_multistep(conf, xs, ys, iters: int, warmup: int,
                                              xs, ys, key, jnp.int32(0))
 
     for i in range(warmup):
-        params, states, upd, loss = jit_multi(params, states, upd, xs, ys,
-                                              key, jnp.int32(i * ksteps))
+        params, states, upd, loss = dispatch(params, states, upd, xs, ys,
+                                             key, jnp.int32(i * ksteps))
     float(loss[-1])  # hard sync: host read (block_until_ready alone is
     #                  unreliable through the axon relay's async dispatch)
 
     t0 = time.perf_counter()
     for i in range(iters):
-        params, states, upd, loss = jit_multi(
+        params, states, upd, loss = dispatch(
             params, states, upd, xs, ys, key,
             jnp.int32((warmup + i) * ksteps))
+        if tracker is not None:
+            tracker.note_step(ksteps, fn=track_fn)
     # the donated-params chain makes this final host read wait on every step
     float(loss[-1])
     dt = time.perf_counter() - t0
@@ -203,25 +216,77 @@ def bench_vgg16(batch: int, iters: int, ksteps: int, warmup: int = 2) -> dict:
 
 def bench_char_rnn(batch: int, iters: int, ksteps: int, warmup: int = 2,
                    vocab: int = 64, seq: int = 50,
-                   hidden: int = 200) -> dict:
+                   hidden: int = 200, lstm_impl: str = "auto") -> dict:
     """GravesLSTM char-RNN (BASELINE config 3): TBPTT-length sequences.
 
     ``hidden`` >= 1024 is the grid's worst-number config (0.5%% MFU at the
-    default 200) — the [F, 4H] fused-gate weight layout in recurrent.py is
-    what this row measures at MXU-filling widths (VERDICT #7)."""
+    default 200) — the row the recurrent engine (ops/lstm.py) exists to move.
+
+    Three-way A/B twin (the word2vec dense/scatter pattern): every record
+    carries the scan-oracle and fused-scan timings, plus the Pallas
+    persistent-cell timing when the dispatch gate would engage it on this
+    backend (None fields on CPU, where the kernel never runs). The headline
+    ``samples_per_sec`` is whichever variant ``lstm_impl`` selects — "auto"
+    resolves through the production gate, so the headline IS the shipping
+    default. Each variant is measured under its own CompileTracker program
+    name (``char_rnn[<impl>]``), so per-variant MFU flows through the rolling
+    ``dl4j_step_mfu{fn}`` gauge."""
     import jax.numpy as jnp
 
     from deeplearning4j_tpu.models.char_rnn import char_rnn_lstm
+    from deeplearning4j_tpu.ops import lstm as lstm_engine
 
-    conf = char_rnn_lstm(vocab_size=vocab, hidden=hidden, tbptt_length=seq)
-    conf.backprop_type = "Standard"  # one jitted step over the tbptt window
     rng = np.random.default_rng(0)
     ids = rng.integers(0, vocab, (batch, seq))
     x = jnp.asarray(np.eye(vocab, dtype=np.float32)[ids])
-    r = _measure_multistep(conf, _stack(x, ksteps), _stack(x, ksteps),
-                           iters, warmup)
+
+    def measure(impl: str) -> dict:
+        # the gate reads DL4J_LSTM_IMPL at trace time; a fresh conf per
+        # variant keeps each measurement's trace (and donated buffers) its own
+        saved = os.environ.get(lstm_engine.IMPL_ENV)
+        os.environ[lstm_engine.IMPL_ENV] = impl
+        try:
+            conf = char_rnn_lstm(vocab_size=vocab, hidden=hidden,
+                                 tbptt_length=seq)
+            conf.backprop_type = "Standard"  # one jitted step over the window
+            return _measure_multistep(conf, _stack(x, ksteps),
+                                      _stack(x, ksteps), iters, warmup,
+                                      track_fn=f"char_rnn[{impl}]")
+        finally:
+            if saved is None:
+                os.environ.pop(lstm_engine.IMPL_ENV, None)
+            else:
+                os.environ[lstm_engine.IMPL_ENV] = saved
+
+    results = {"scan": measure("scan"), "fused": measure("fused")}
+    pallas_engages = lstm_engine.resolve_impl(
+        hidden, seq, batch, vocab, impl="pallas")[0] == "pallas"
+    if pallas_engages:
+        results["pallas"] = measure("pallas")
+
+    headline = lstm_impl
+    if headline == "auto":
+        headline = lstm_engine.resolve_impl(hidden, seq, batch, vocab,
+                                            impl="auto")[0]
+    if headline not in results:  # e.g. forced pallas on CPU -> fused fallback
+        headline = "fused"
+    r = dict(results[headline])
     r["chars_per_sec"] = r["samples_per_sec"] * seq
     r["hidden"] = hidden
+    r["lstm_impl"] = lstm_impl
+    r["lstm_impl_selected"] = headline
+    base = results["scan"]["samples_per_sec"]
+    r["scan_samples_per_sec"] = round(base, 1)
+    r["fused_samples_per_sec"] = round(results["fused"]["samples_per_sec"], 1)
+    r["fused_speedup"] = round(results["fused"]["samples_per_sec"] / base, 3)
+    if pallas_engages:
+        r["pallas_samples_per_sec"] = round(
+            results["pallas"]["samples_per_sec"], 1)
+        r["pallas_speedup"] = round(
+            results["pallas"]["samples_per_sec"] / base, 3)
+    else:
+        r["pallas_samples_per_sec"] = None
+        r["pallas_speedup"] = None
     return r
 
 
@@ -690,6 +755,8 @@ def _child_main(args) -> None:
     kwargs = {}
     if args.hidden and args.model == "char_rnn":
         kwargs["hidden"] = args.hidden
+    if args.lstm_impl and args.model == "char_rnn":
+        kwargs["lstm_impl"] = args.lstm_impl
     r = _bench_fns()[args.model](args.batch or db, args.iters or di,
                                  args.ksteps or dk, **kwargs)
 
@@ -746,6 +813,14 @@ def main() -> None:
                          ">=1024 is the MFU-floor grid row")
     ap.add_argument("--ksteps", type=int, default=None,
                     help="train steps fused per host dispatch")
+    ap.add_argument("--lstm-impl", default=None,
+                    choices=("auto", "scan", "fused", "pallas"),
+                    help="char_rnn recurrent-engine headline variant "
+                         "(config-distinct). Every record also carries the "
+                         "three-way A/B fields (scan/fused/pallas "
+                         "samples_per_sec + *_speedup); this picks which "
+                         "one is the headline. Default: auto (the "
+                         "production DL4J_LSTM_IMPL gate)")
     dt = ap.add_mutually_exclusive_group()
     dt.add_argument("--f32", action="store_true",
                     help="float32 compute")
@@ -908,6 +983,10 @@ _DTYPE_DEFAULT_CHANGE_TS = "2026-07-31T04:35:00Z"
 #: logged before this instant ran classic at-least-f32 statistics
 _RDTYPE_DEFAULT_CHANGE_TS = "2026-08-05T00:00:00Z"
 
+#: when the recurrent engine landed (round 6) — bare char_rnn rows logged
+#: before this instant measured the old scan path, not today's fused default
+_LSTM_IMPL_DEFAULT_CHANGE_TS = "2026-08-05T12:00:00Z"
+
 
 def _config_key(args_str: str, ts: str = None) -> dict:
     """The fields that make two bench invocations the SAME config: model,
@@ -940,10 +1019,18 @@ def _config_key(args_str: str, ts: str = None) -> dict:
         # pre-round-6 rows predate the reduction-precision subsystem: they
         # all ran at-least-f32 statistics regardless of dtype mode
         rdtype = "f32"
+    lstm_impl = None
+    if model == "char_rnn":
+        lstm_impl = val("--lstm-impl") or "auto"
+        if ts is not None and ts < _LSTM_IMPL_DEFAULT_CHANGE_TS \
+                and "--lstm-impl" not in toks:
+            # pre-engine rows measured the reference scan path; an outage
+            # must not serve an old scan number for today's fused/auto row
+            lstm_impl = "scan"
     return {"model": model, "batch": val("--batch"),
             "ksteps": val("--ksteps"), "dtype": mode, "rdtype": rdtype,
             "seq": val("--seq"), "vocab": val("--vocab"),
-            "hidden": val("--hidden")}
+            "hidden": val("--hidden"), "lstm_impl": lstm_impl}
 
 
 def _last_healthy_from_log(args_str: str, path: str = None):

@@ -1,7 +1,11 @@
 """GravesLSTM char-RNN configuration — BASELINE.json config-3 benchmark.
 
 Matches the reference's canonical character-modelling example (2x GravesLSTM 200 +
-RnnOutputLayer, TBPTT 50) built on this framework's XLA-scan LSTM.
+RnnOutputLayer, TBPTT 50). The recurrence runs through the three-variant engine
+in ``ops/lstm.py`` (fused scan by default; ``DL4J_LSTM_IMPL``/auto thresholds
+can engage the Pallas persistent cell at MXU-filling widths — the tanh/sigmoid
+GravesLSTM cell here satisfies the kernel's hard constraints, so this model is
+the engine's bench vehicle via ``bench.py --model char_rnn --lstm-impl``).
 """
 from __future__ import annotations
 

@@ -3,10 +3,12 @@ RnnOutputLayer.
 
 Reference: nn/layers/recurrent/LSTMHelpers.java (activateHelper:58,
 backpropGradientHelper:248 — hand-written BPTT) and GravesLSTM/GravesBidirectionalLSTM
-configs. TPU-native: the time recursion is a ``lax.scan`` whose body is one fused
-[B, n_in+H] x [n_in+H, 4H] matmul on the MXU; backprop-through-time is autodiff through
-the scan (XLA generates the reverse scan) — this *is* the accelerated LSTM path the
-cuDNN-helper seam would otherwise provide (SURVEY.md §2.3 note).
+configs. TPU-native: the time recursion runs through the three-variant recurrent
+engine in ``ops/lstm.py`` (fused scan / Pallas persistent cell / reference scan,
+selected by ``DL4J_LSTM_IMPL`` + calibrated thresholds at trace time);
+backprop-through-time is autodiff through the scan body or the kernel's custom
+VJP — this *is* the accelerated LSTM path the cuDNN-helper seam
+(CudnnLSTMHelper) would otherwise provide (SURVEY.md §2.3 note).
 
 Layout: [batch, time, features] (reference uses [batch, features, time]).
 Param names: "W" [n_in,4H] input weights, "RW" [H,4H] recurrent, "b" [4H],
@@ -17,70 +19,21 @@ State pytree carries the streaming-inference hidden state for rnn_time_step
 from __future__ import annotations
 
 import dataclasses
-from typing import Optional
 
 import jax
 import jax.numpy as jnp
-from jax import lax
 
-from deeplearning4j_tpu.common import accum_dtype, get_policy
 from deeplearning4j_tpu.nn.conf.inputs import InputType
 from deeplearning4j_tpu.nn.conf.layers.base import FeedForwardLayer
 from deeplearning4j_tpu.nn.conf.layers.feedforward import _dense
 from deeplearning4j_tpu.nn.conf.serde import register_config
 from deeplearning4j_tpu.ops.losses import get_loss
+from deeplearning4j_tpu.ops.lstm import lstm_sequence
+# back-compat alias: the scan implementation (now the engine's reference
+# oracle) used to live here
+from deeplearning4j_tpu.ops.lstm import lstm_scan as _lstm_scan  # noqa: F401
 
 Array = jax.Array
-
-
-def _lstm_scan(params: dict, x: Array, act, gate_act, h0: Array, c0: Array,
-               peephole: bool, mask: Optional[Array]):
-    """Run the LSTM over time with lax.scan. x: [B,T,F]. Returns (outputs [B,T,H], (h,c))."""
-    pol = get_policy()
-    w = params["W"].astype(pol.compute_dtype)
-    rw = params["RW"].astype(pol.compute_dtype)
-    b = params["b"].astype(pol.compute_dtype)
-    hidden = rw.shape[0]
-
-    # Precompute input contributions for all timesteps in one big MXU matmul:
-    # [B,T,4H]. preferred_element_type routes the dW contraction through the
-    # policy's grad-accum dtype; cast straight back so the scan carry dtype
-    # below never changes.
-    xw = jnp.einsum("btf,fg->btg", x.astype(pol.compute_dtype), w,
-                    preferred_element_type=accum_dtype(pol.compute_dtype)
-                    ).astype(pol.compute_dtype) + b
-
-    def step(carry, inputs):
-        h, c = carry
-        xw_t, m_t = inputs
-        z = xw_t + jnp.matmul(h.astype(pol.compute_dtype), rw)
-        zi, zf, zg, zo = jnp.split(z.astype(pol.output_dtype), 4, axis=-1)
-        if peephole:
-            # cast peephole params to the gate dtype: a silent bf16*f32
-            # promotion here would flip the scan carry dtype mid-trace
-            zi = zi + c * params["pI"].astype(zi.dtype)
-            zf = zf + c * params["pF"].astype(zf.dtype)
-        i = gate_act(zi)
-        f = gate_act(zf)
-        g = act(zg)
-        c_new = f * c + i * g
-        if peephole:
-            zo = zo + c_new * params["pO"].astype(zo.dtype)
-        o = gate_act(zo)
-        h_new = o * act(c_new)
-        if m_t is not None:
-            m = m_t[:, None]
-            h_new = jnp.where(m > 0, h_new, h)
-            c_new = jnp.where(m > 0, c_new, c)
-        return (h_new, c_new), h_new
-
-    xw_t = jnp.moveaxis(xw, 1, 0)  # [T,B,4H]
-    mask_t = jnp.moveaxis(mask, 1, 0) if mask is not None else None
-    if mask_t is None:
-        (h, c), ys = lax.scan(lambda cr, xi: step(cr, (xi, None)), (h0, c0), xw_t)
-    else:
-        (h, c), ys = lax.scan(step, (h0, c0), (xw_t, mask_t))
-    return jnp.moveaxis(ys, 0, 1), (h, c)
 
 
 @register_config("LSTM")
@@ -127,17 +80,25 @@ class LSTM(FeedForwardLayer):
         act, gate = self._acts()
         B = x.shape[0]
         zeros = jnp.zeros((B, self.n_out), x.dtype)
-        ys, _ = _lstm_scan(params, x, act, gate, zeros, zeros, self.peephole, mask)
+        ys, _ = lstm_sequence(params, x, act, gate, zeros, zeros,
+                              self.peephole, mask,
+                              act_name=self.activation or "tanh",
+                              gate_name=self.gate_activation)
         return ys, state
 
     def apply_streaming(self, params, state, x, *, mask=None):
         """rnnTimeStep equivalent: carry (h,c) across calls (reference
-        MultiLayerNetwork.rnnTimeStep:2196)."""
+        MultiLayerNetwork.rnnTimeStep:2196). Routed through the same engine
+        as full sequences, so serving single steps take the fused cell and a
+        T-step rnnTimeStep loop reproduces the fused-scan forward bitwise."""
         act, gate = self._acts()
         B = x.shape[0]
         h0 = state.get("h", jnp.zeros((B, self.n_out), x.dtype))
         c0 = state.get("c", jnp.zeros((B, self.n_out), x.dtype))
-        ys, (h, c) = _lstm_scan(params, x, act, gate, h0, c0, self.peephole, mask)
+        ys, (h, c) = lstm_sequence(params, x, act, gate, h0, c0,
+                                   self.peephole, mask,
+                                   act_name=self.activation or "tanh",
+                                   gate_name=self.gate_activation)
         return ys, {"h": h, "c": c}
 
 
@@ -174,10 +135,14 @@ class GravesBidirectionalLSTM(LSTM):
         zeros = jnp.zeros((B, self.n_out), x.dtype)
         fwd_p = {k[1:]: v for k, v in params.items() if k.startswith("F")}
         bwd_p = {k[1:]: v for k, v in params.items() if k.startswith("B")}
-        ys_f, _ = _lstm_scan(fwd_p, x, act, gate, zeros, zeros, self.peephole, mask)
+        names = dict(act_name=self.activation or "tanh",
+                     gate_name=self.gate_activation)
+        ys_f, _ = lstm_sequence(fwd_p, x, act, gate, zeros, zeros,
+                                self.peephole, mask, **names)
         x_rev = jnp.flip(x, axis=1)
         mask_rev = jnp.flip(mask, axis=1) if mask is not None else None
-        ys_b, _ = _lstm_scan(bwd_p, x_rev, act, gate, zeros, zeros, self.peephole, mask_rev)
+        ys_b, _ = lstm_sequence(bwd_p, x_rev, act, gate, zeros, zeros,
+                                self.peephole, mask_rev, **names)
         return ys_f + jnp.flip(ys_b, axis=1), state
 
 

@@ -40,6 +40,10 @@ COLLECTIVE_BYTES_PER_STEP = "dl4j_collective_bytes_per_step"
 # --- kernel dispatch (ops/pallas_kernels.py) -------------------------------
 PALLAS_DISPATCH_TOTAL = "dl4j_pallas_dispatch_total"
 
+# --- recurrent engine (ops/lstm.py) ----------------------------------------
+LSTM_DISPATCH_TOTAL = "dl4j_lstm_dispatch_total"
+LSTM_PALLAS_BLOCK_STEPS = "dl4j_lstm_pallas_block_steps"
+
 # --- training health (observability/health.py) -----------------------------
 HEALTH_GRAD_NORM = "dl4j_health_grad_norm"
 HEALTH_UPDATE_NORM = "dl4j_health_update_norm"

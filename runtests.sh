@@ -9,6 +9,8 @@
 #   ./runtests.sh lint [args]     # graftlint over the package (see docs/GUIDE.md)
 #   ./runtests.sh health [args]   # failure-diagnostics suite: flight recorder,
 #                                 # health monitor, watchdog, overhead budget
+#   ./runtests.sh rnn [args]      # recurrent engine: fused/pallas-vs-scan
+#                                 # equivalence, dispatch gate, layer tests
 set -e
 cd "$(dirname "$0")"
 
@@ -17,6 +19,14 @@ if [ "${1-}" = "lint" ]; then
   PALLAS_AXON_POOL_IPS= \
   JAX_PLATFORMS=cpu \
   exec python -m deeplearning4j_tpu.lint "$@"
+fi
+
+if [ "${1-}" = "rnn" ]; then
+  shift
+  PALLAS_AXON_POOL_IPS= \
+  JAX_PLATFORMS=cpu \
+  XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+  exec python -m pytest tests/test_lstm_fast.py tests/test_layers.py -q "$@"
 fi
 
 if [ "${1-}" = "health" ]; then

@@ -47,13 +47,19 @@ run --model resnet50
 run --model resnet50 --bf16-matmul
 run --model transformer
 run --model transformer --bf16-matmul
+# the MFU-floor row (VERDICT #7, ISSUE 6) in the ALWAYS-RUN set: one record
+# carries the scan/fused/pallas three-way A/B of the recurrent engine at MXU
+# width — capture-first, so the first healthy window prices the new path
+run --model char_rnn --hidden 1024
 if [ "$MODE" = full ]; then
     run --model lenet
     run --model lenet --bf16-act
     run --model char_rnn
     run --model char_rnn --bf16-matmul
-    # the MFU-floor row (VERDICT #7): fused-gate [F,4H] LSTM at MXU width
-    run --model char_rnn --hidden 1024
+    # engine A/B at MXU width with the scan oracle as the headline (the
+    # hidden-1024 headline row above is auto); speedup fields overlap as a
+    # cross-check
+    run --model char_rnn --hidden 1024 --lstm-impl scan
     run --model vgg16
     run --model vgg16 --bf16-matmul
     run --model moe

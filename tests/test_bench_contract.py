@@ -280,3 +280,24 @@ def test_grid_rows_vgg16_and_lstm_hidden():
     a = bench._config_key("--model char_rnn")
     b = bench._config_key("--model char_rnn --hidden 1024")
     assert a != b and b["hidden"] == "1024"
+
+
+def test_config_key_lstm_impl_axis():
+    """--lstm-impl is config-distinct for char_rnn rows (an explicit scan-
+    headline row must not stand in for the auto/fused default), and rows
+    logged before the recurrent engine landed reinterpret as the scan path
+    they actually measured — the same timestamp-guard pattern as the dtype
+    and reduction-dtype default changes."""
+    import bench
+
+    a = bench._config_key("--model char_rnn --hidden 1024")
+    b = bench._config_key("--model char_rnn --hidden 1024 --lstm-impl scan")
+    assert a != b and a["lstm_impl"] == "auto" and b["lstm_impl"] == "scan"
+    # non-recurrent models don't grow a phantom axis
+    assert bench._config_key("--model resnet50")["lstm_impl"] is None
+    # pre-engine bare rows ran the old scan path
+    old = bench._config_key("--model char_rnn",
+                            ts="2026-08-05T11:59:59Z")
+    new = bench._config_key("--model char_rnn",
+                            ts="2026-08-05T12:00:01Z")
+    assert old["lstm_impl"] == "scan" and new["lstm_impl"] == "auto"
