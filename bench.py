@@ -65,6 +65,57 @@ def _xla_flops(jit_fn, *args) -> float:
     return max(0.0, float((cost or {}).get("flops", 0.0)))
 
 
+#: armed by _child_main when --xplane-attribution (or the first-healthy
+#: trigger) asks for a trace: {"trigger": ..., "dispatches": N}. Consumed by
+#: the FIRST _measure_multistep call of the run (for char_rnn's three-way
+#: A/B that is the scan variant), so one bench row pays for one capture.
+_PROFILE_SPEC = None
+
+#: models whose bench path runs through _measure_multistep and can therefore
+#: re-dispatch the already-compiled program under a trace; the others get a
+#: graceful profile_error field instead of a crash
+_PROFILE_CAPABLE = frozenset(
+    {"lenet", "resnet50", "vgg16", "char_rnn", "transformer", "moe"})
+
+
+def _profile_capture(dispatch_once, logdir_hint: str = None) -> dict:
+    """Run the armed trace capture around ``dispatch_once`` (a thunk
+    re-dispatching the compiled program once, ending on a host sync).
+    Returns bench-row fields — xplane_attribution + profile_trace on
+    success, profile_error on ANY failure; never raises (the capture is
+    measurement decoration, the headline number must survive it)."""
+    global _PROFILE_SPEC
+    spec, _PROFILE_SPEC = _PROFILE_SPEC, None
+    if spec is None:
+        return {}
+    fields = {}
+    try:
+        from deeplearning4j_tpu.observability.profiler import \
+            global_trace_session
+        session = global_trace_session()
+        logdir = session.start(spec.get("trigger", "bench"),
+                               logdir=logdir_hint)
+        if logdir is None:
+            return {"profile_error": "trace engine busy or profiler refused"}
+        fields["profile_trace"] = logdir
+        try:
+            for _ in range(max(1, int(spec.get("dispatches", 2)))):
+                dispatch_once()
+        finally:
+            summary = session.stop() or {}
+        if summary.get("error"):
+            fields["profile_error"] = str(summary["error"])
+        else:
+            fields["xplane_attribution"] = {
+                "categories_pct": summary.get("categories_pct", {}),
+                "top_ops": summary.get("top_ops", [])[:5],
+                "total_device_ns": summary.get("total_device_ns", 0),
+            }
+    except Exception as e:  # never let attribution sink the headline row
+        fields["profile_error"] = repr(e)[:300]
+    return fields
+
+
 def _measure_multistep(conf, xs, ys, iters: int, warmup: int,
                        graph: bool = False, track_fn: str = None) -> dict:
     """Steady-state throughput of K-step scanned training on stacked batches.
@@ -129,7 +180,7 @@ def _measure_multistep(conf, xs, ys, iters: int, warmup: int,
 
     n_steps = iters * ksteps
     flops_per_sec = flops_per_dispatch * iters / dt if flops_per_dispatch else 0.0
-    return {
+    r = {
         "samples_per_sec": batch * n_steps / dt,
         "step_time_ms": dt / n_steps * 1000,
         "batch": batch,
@@ -138,6 +189,22 @@ def _measure_multistep(conf, xs, ys, iters: int, warmup: int,
         "tflops_per_sec": round(flops_per_sec / 1e12, 4),
         "mfu": round(flops_per_sec / PEAK_FLOPS, 6),
     }
+    if _PROFILE_SPEC is not None:
+        # attribution capture AFTER the timed loop: re-dispatches the
+        # already-compiled program (zero extra compiles) under a trace, so
+        # the profiled program IS the timed one and the headline number is
+        # untouched by trace overhead
+        state = {"params": params, "states": states, "upd": upd, "i": 0}
+
+        def dispatch_once():
+            state["params"], state["states"], state["upd"], loss = dispatch(
+                state["params"], state["states"], state["upd"], xs, ys, key,
+                jnp.int32((warmup + iters + state["i"]) * ksteps))
+            state["i"] += 1
+            float(loss[-1])  # host sync: the trace must contain device work
+
+        r.update(_profile_capture(dispatch_once))
+    return r
 
 
 def _stack(a, k: int):
@@ -271,6 +338,17 @@ def bench_char_rnn(batch: int, iters: int, ksteps: int, warmup: int = 2,
     if headline not in results:  # e.g. forced pallas on CPU -> fused fallback
         headline = "fused"
     r = dict(results[headline])
+    # an armed attribution capture is consumed by the FIRST variant measured
+    # (scan); hoist its fields so the headline row carries them whichever
+    # variant wins
+    for impl in ("scan", "fused", "pallas"):
+        src = results.get(impl, {})
+        if any(f in src for f in ("xplane_attribution", "profile_error")):
+            for f in ("xplane_attribution", "profile_trace", "profile_error"):
+                if f in src:
+                    r.setdefault(f, src[f])
+            r.setdefault("profile_variant", impl)
+            break
     r["chars_per_sec"] = r["samples_per_sec"] * seq
     r["hidden"] = hidden
     r["lstm_impl"] = lstm_impl
@@ -725,6 +803,7 @@ def _reduction_mode(dtype_mode: str, reduction_dtype: str | None) -> str:
 
 def _child_main(args) -> None:
     """Run one benchmark in-process and print its JSON record."""
+    global _PROFILE_SPEC
     mode = _dtype_mode(args.model, bf16_act=args.bf16_act,
                        bf16_matmul=args.bf16_matmul, f32=args.f32)
     rmode = _reduction_mode(mode, args.reduction_dtype)
@@ -757,8 +836,31 @@ def _child_main(args) -> None:
         kwargs["hidden"] = args.hidden
     if args.lstm_impl and args.model == "char_rnn":
         kwargs["lstm_impl"] = args.lstm_impl
+
+    # arm the attribution capture: explicit --xplane-attribution, or the
+    # first-healthy trigger bench_capture.sh exports (ROADMAP item 1 —
+    # the first healthy relay window after an outage is capture-first)
+    from deeplearning4j_tpu.observability import profiler as _profiler
+    profile_trigger = None
+    if getattr(args, "xplane_attribution", False):
+        profile_trigger = "bench"
+    elif _profiler.first_healthy_due():
+        profile_trigger = "first-healthy"
+    if profile_trigger and args.model in _PROFILE_CAPABLE:
+        _PROFILE_SPEC = {"trigger": profile_trigger}
+
     r = _bench_fns()[args.model](args.batch or db, args.iters or di,
                                  args.ksteps or dk, **kwargs)
+
+    if profile_trigger:
+        if args.model not in _PROFILE_CAPABLE:
+            r["profile_error"] = (
+                f"model '{args.model}' does not run through the multistep "
+                "harness; xplane attribution unsupported")
+        elif r.get("profile_trace") and profile_trigger == "first-healthy":
+            # a capture happened in this healthy window: later grid rows
+            # inside the cool-down skip the trace overhead
+            _profiler.mark_first_healthy()
 
     base = BASELINE_SAMPLES_PER_SEC.get(args.model)
     vs = round(r["samples_per_sec"] / base, 3) if base else None
@@ -846,6 +948,13 @@ def main() -> None:
                     help="append a metrics-registry snapshot (JSONL) to this "
                          "file beside the headline JSON; measurement-only — "
                          "ignored for bench_log config matching")
+    ap.add_argument("--xplane-attribution", action="store_true",
+                    help="after the timed loop, re-dispatch the compiled "
+                         "program under a TraceSession capture and attach "
+                         "the per-op category split (xplane_attribution) to "
+                         "the record — or a profile_error field when capture/"
+                         "parsing fails; measurement-only, ignored for "
+                         "bench_log config matching")
     ap.add_argument("--flight-recorder-dir", default=None, metavar="DIR",
                     help="arm the flight recorder: bundles (crash, signal, "
                          "device-unreachable) are written under DIR instead "
@@ -986,6 +1095,18 @@ _RDTYPE_DEFAULT_CHANGE_TS = "2026-08-05T00:00:00Z"
 #: when the recurrent engine landed (round 6) — bare char_rnn rows logged
 #: before this instant measured the old scan path, not today's fused default
 _LSTM_IMPL_DEFAULT_CHANGE_TS = "2026-08-05T12:00:00Z"
+
+#: when bench rows grew xplane attribution (round 7) — rows logged before
+#: this instant can never carry the fields below. --xplane-attribution is
+#: measurement-only (like --telemetry-out): it must NOT make a config
+#: distinct in bench_log matching, so a prior healthy row without the
+#: fields still stands in for an attribution-armed request during an outage
+_XPLANE_ATTRIBUTION_LANDED_TS = "2026-08-05T16:00:00Z"
+
+#: the exact attribution field names a bench row may carry (the bench-row
+#: contract; pinned by tests/test_bench_contract.py)
+XPLANE_ATTRIBUTION_FIELDS = ("xplane_attribution", "profile_trace",
+                             "profile_error", "profile_variant")
 
 
 def _config_key(args_str: str, ts: str = None) -> dict:

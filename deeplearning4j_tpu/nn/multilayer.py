@@ -37,6 +37,9 @@ from deeplearning4j_tpu.observability.names import FIT_PHASE_SECONDS
 from deeplearning4j_tpu.observability.metrics import (
     global_registry as _obs_registry,
 )
+from deeplearning4j_tpu.observability.profiler import (
+    note_dispatch as _profile_note_dispatch,
+)
 from deeplearning4j_tpu.observability.watchdog import beat as _wd_beat
 from deeplearning4j_tpu.nn.conf.multilayer import MultiLayerConfiguration
 from deeplearning4j_tpu.nn.conf.layers.base import PretrainLayer
@@ -392,6 +395,7 @@ class LazyScore:
                     xs, ys, self._next_rng(), jnp.int32(self.iteration))
         dt = time.perf_counter() - t0
         _t_dispatch.observe(dt)
+        _profile_note_dispatch(dt)
         if due_i is None:
             (self.params_list, self.state_list, self.updater_state,
              losses) = out
@@ -820,6 +824,7 @@ class MultiLayerNetwork(LazyScore):
                        jnp.int32(self.iteration), fmask, lmask)
             dt = time.perf_counter() - t0
             _t_dispatch.observe(dt)
+            _profile_note_dispatch(dt)
             if use_health:
                 (self.params_list, self.state_list, self.updater_state,
                  loss, haux) = out

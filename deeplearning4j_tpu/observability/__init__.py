@@ -22,6 +22,10 @@ from .health import (HealthMonitor, NanAlertListener, TrainingDivergedError,
                      is_invalid_score, health_terms)
 from .watchdog import (StepWatchdog, install_watchdog, uninstall_watchdog,
                        global_watchdog, beat)
+from .profiler import (TraceSession, StepAnomalyWatcher, global_trace_session,
+                       install_anomaly_watcher, uninstall_anomaly_watcher,
+                       note_dispatch, first_healthy_due, mark_first_healthy)
+from . import xplane
 
 __all__ = [
     "MetricsRegistry", "global_registry", "DEFAULT_BUCKETS", "tree_nbytes",
@@ -34,4 +38,7 @@ __all__ = [
     "is_invalid_score", "health_terms",
     "StepWatchdog", "install_watchdog", "uninstall_watchdog",
     "global_watchdog", "beat",
+    "TraceSession", "StepAnomalyWatcher", "global_trace_session",
+    "install_anomaly_watcher", "uninstall_anomaly_watcher", "note_dispatch",
+    "first_healthy_due", "mark_first_healthy", "xplane",
 ]

@@ -56,6 +56,13 @@ HEALTH_ALARMS_TOTAL = "dl4j_health_alarms_total"
 FLIGHT_DUMPS_TOTAL = "dl4j_flight_dumps_total"
 WATCHDOG_STALLS_TOTAL = "dl4j_watchdog_stalls_total"
 
+# --- trace capture + attribution (observability/profiler.py) ----------------
+PROFILE_CAPTURES_TOTAL = "dl4j_profile_captures_total"
+PROFILE_CAPTURE_SECONDS = "dl4j_profile_capture_seconds"
+PROFILE_CATEGORY_SHARE = "dl4j_profile_category_share"
+PROFILE_COLLISIONS_TOTAL = "dl4j_profile_collisions_total"
+PROFILE_ACTIVE = "dl4j_profile_active"
+
 # --- model FLOP utilization (observability/compile_tracker.py) --------------
 STEP_MFU = "dl4j_step_mfu"
 

@@ -3,10 +3,12 @@
 ``ProfilerListener`` already captures XPlane windows, but user-defined
 phases only show up there if the code annotates them — and ad-hoc
 ``jax.profiler.TraceAnnotation`` calls leave no persistent record once the
-trace window closes. A span does double duty: the annotation makes the
-phase visible in xprof/perfetto timelines, and the registry histogram keeps
-an always-on latency distribution a ``/metrics`` scraper can watch between
-(or without) profiler windows.
+trace window closes. A span does triple duty: the annotation makes the
+phase visible in xprof/perfetto timelines, the registry histogram keeps an
+always-on latency distribution a ``/metrics`` scraper can watch between
+(or without) profiler windows, and enter/exit events go into the flight
+recorder's ring so a crash bundle carries the recent span timeline (which
+phase the run died inside, not just that it died).
 
 Span names are hierarchical-by-convention (``"epoch/3/stage"``); the
 registry series is labeled with the name verbatim, so high-cardinality
@@ -19,19 +21,24 @@ import contextlib
 import time
 from typing import Optional
 
+from .flight_recorder import global_recorder
 from .metrics import global_registry
 from .names import SPAN_SECONDS
 
 
 @contextlib.contextmanager
-def span(name: str, metric_name: Optional[str] = None, registry=None):
+def span(name: str, metric_name: Optional[str] = None, registry=None,
+         recorder=None):
     """Annotate a phase in XPlane traces AND record its wall time in the
-    registry histogram ``dl4j_span_seconds{name=...}``.
+    registry histogram ``dl4j_span_seconds{name=...}`` AND leave
+    ``span_enter``/``span_exit`` events in the flight-recorder ring.
 
     ``metric_name`` overrides the histogram label (use it to collapse
     per-index names like ``epoch/3`` into a bounded series like ``epoch``).
     """
     reg = registry if registry is not None else global_registry()
+    # explicit None check: an EMPTY recorder is falsy (__len__ == 0)
+    rec = recorder if recorder is not None else global_recorder()
     hist = reg.histogram(SPAN_SECONDS,
                          "wall seconds of user/framework span() phases")
     series = hist.labels(name=metric_name or name)
@@ -40,9 +47,12 @@ def span(name: str, metric_name: Optional[str] = None, registry=None):
         ann = _prof.TraceAnnotation(name)
     except Exception:  # pragma: no cover - profiler API absent
         ann = contextlib.nullcontext()
+    rec.record("span_enter", name=name)
     t0 = time.perf_counter()
     with ann:
         try:
             yield
         finally:
-            series.observe(time.perf_counter() - t0)
+            dt = time.perf_counter() - t0
+            series.observe(dt)
+            rec.record("span_exit", name=name, dur_s=dt)

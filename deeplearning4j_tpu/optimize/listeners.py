@@ -183,7 +183,14 @@ class ProfilerListener(IterationListener):
     Starts tracing when ``start_iteration`` completes and stops
     ``num_iterations`` later, writing to ``log_dir``. One-shot by default;
     set ``repeat_every`` to re-arm periodically (each window goes to a
-    fresh subdirectory)."""
+    fresh subdirectory).
+
+    Capture goes through the process-global
+    :class:`~deeplearning4j_tpu.observability.profiler.TraceSession` — the
+    profiler is a process singleton, and a listener window overlapping a
+    bench/script/anomaly capture must log-and-skip, never raise from inside
+    the fit loop. Completed windows land in ``self.windows`` with their
+    attribution summaries in ``self.summaries``."""
 
     def __init__(self, log_dir: str, start_iteration: int = 10,
                  num_iterations: int = 5,
@@ -193,22 +200,30 @@ class ProfilerListener(IterationListener):
         self.num_iterations = max(1, num_iterations)
         self.repeat_every = repeat_every
         self.windows: list = []  # directories of completed traces
+        self.summaries: list = []  # attribution dicts, parallel to windows
         self._active_since: Optional[int] = None
+
+    @staticmethod
+    def _session():
+        from deeplearning4j_tpu.observability.profiler import \
+            global_trace_session
+        return global_trace_session()
 
     def _start(self, iteration: int) -> None:
         import os
 
-        import jax
         sub = (os.path.join(self.log_dir, f"iter_{iteration}")
                if self.repeat_every else self.log_dir)
-        os.makedirs(sub, exist_ok=True)
-        jax.profiler.start_trace(sub)
+        # None = the session is owned by another capture (or the profiler
+        # refused): skip this window and retry on a later iteration — the
+        # session already logged the collision
+        if self._session().start("listener", logdir=sub) is None:
+            return
         self._active_since = iteration
         self._dir = sub
 
     def _stop(self) -> None:
-        import jax
-        jax.profiler.stop_trace()
+        self.summaries.append(self._session().stop())
         self.windows.append(self._dir)
         self._active_since = None
         if self.repeat_every:

@@ -362,6 +362,15 @@ class _Handler(BaseHTTPRequestHandler):
             self._json(self.ui.health_data())
         elif path == "/train/health/bundles":
             self._json(self.ui.health_bundles())
+        elif path == "/train/profiles":
+            # persistent trace-capture index (observability/profiler.py)
+            self._json(self.ui.profiles())
+        elif path == "/train/profiles/summary":
+            # per-trace attribution download; ?trace= must equal an indexed
+            # logdir verbatim (the index is the allow-list — no path math on
+            # request input, so no traversal)
+            q = parse_qs(urlparse(self.path).query)
+            self._json(self.ui.profile_summary(q.get("trace", [None])[0]))
         elif path == "/train/histograms/data":
             # HistogramModule equivalent: latest param/gradient/update
             # histograms per variable
@@ -568,6 +577,42 @@ class UIServer:
         from deeplearning4j_tpu.observability import global_recorder
 
         return {"bundles": global_recorder().list_bundles()}
+
+    def profiles(self) -> dict:
+        """Trace-capture index (newest first) for ``/train/profiles`` —
+        the sqlite-backed index survives process death, so this also lists
+        captures from earlier runs under the same profile dir."""
+        from deeplearning4j_tpu.observability.profiler import \
+            global_trace_session
+
+        session = global_trace_session()
+        return {"base_dir": session.base_dir, "active": session.active,
+                "profiles": session.index_entries()}
+
+    def profile_summary(self, trace: Optional[str]) -> dict:
+        """Attribution JSON of one indexed capture for
+        ``/train/profiles/summary?trace=<logdir>``. The requested value must
+        equal an index entry's logdir verbatim; the summary path comes from
+        the index, never from the request."""
+        import os
+
+        from deeplearning4j_tpu.observability.profiler import (
+            ATTRIBUTION_FILE, global_trace_session)
+
+        if not trace:
+            return {"error": "missing ?trace=<logdir>"}
+        for entry in global_trace_session().index_entries():
+            if entry.get("logdir") != trace:
+                continue
+            path = entry.get("summary_path") \
+                or os.path.join(trace, ATTRIBUTION_FILE)
+            try:
+                with open(path) as f:
+                    return json.load(f)
+            except (OSError, ValueError) as e:
+                return {"error": f"unreadable attribution summary: {e!r}",
+                        "entry": entry}
+        return {"error": "trace not in the profile index"}
 
     def histogram_data(self, session: Optional[str] = None) -> dict:
         """Latest histograms per variable (reference HistogramModule)."""

@@ -11,6 +11,9 @@
 #                                 # health monitor, watchdog, overhead budget
 #   ./runtests.sh rnn [args]      # recurrent engine: fused/pallas-vs-scan
 #                                 # equivalence, dispatch gate, layer tests
+#   ./runtests.sh profile [args]  # trace-attribution engine: XPlane parser
+#                                 # golden tests, TraceSession lock, triggers,
+#                                 # e2e CPU capture + bench attribution row
 set -e
 cd "$(dirname "$0")"
 
@@ -27,6 +30,16 @@ if [ "${1-}" = "rnn" ]; then
   JAX_PLATFORMS=cpu \
   XLA_FLAGS="--xla_force_host_platform_device_count=8" \
   exec python -m pytest tests/test_lstm_fast.py tests/test_layers.py -q "$@"
+fi
+
+if [ "${1-}" = "profile" ]; then
+  shift
+  # includes the slow end-to-end bench --xplane-attribution subprocess row
+  PALLAS_AXON_POOL_IPS= \
+  JAX_PLATFORMS=cpu \
+  XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+  exec python -m pytest tests/test_profiler.py \
+    tests/test_bench_contract.py::test_xplane_attribution_contract -q "$@"
 fi
 
 if [ "${1-}" = "health" ]; then

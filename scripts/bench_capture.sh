@@ -7,6 +7,14 @@ cd "$(dirname "$0")/.."
 LOG=scripts/bench_log.jsonl
 MODE=${1:-full}
 
+# Capture-first (ROADMAP item 1): arm the first-healthy profile trigger so
+# the FIRST healthy relay window in this grid carries an XPlane attribution
+# capture (bench.py attaches the category split to that row); the marker
+# file under DL4J_PROFILE_DIR then stops every later row in the cool-down
+# from re-paying the trace overhead.
+export DL4J_PROFILE_TRIGGER=${DL4J_PROFILE_TRIGGER:-first-healthy}
+export DL4J_PROFILE_DIR=${DL4J_PROFILE_DIR:-scripts/profiles}
+
 # Only one capture grid at a time: the armed watcher may probe-and-capture
 # while a manual run is mid-grid; the latecomer exits instead of interleaving
 # half-duplicate rows.

@@ -1,23 +1,23 @@
 """Capture + summarize an XPlane trace of a flagship K-step training program.
 
-The round-3 verdict's top perf item: ResNet-50 runs at 21.4% MFU and nobody
-knows where the other 78% goes. This script answers that the way the
-reference's cuDNN work was guided by nvprof (CudnnConvolutionHelper.java:49):
-run the EXACT program bench.py times (same model builders, same K-step
-make_*_multistep_train_step, same donated buffers), wrap two dispatches in a
-jax.profiler trace, and print the top self-time ops / category split parsed
-from the XPlane artifact.
+Thin CLI over the framework's trace engine: capture goes through the
+process-global ``TraceSession`` (deeplearning4j_tpu/observability/profiler.py
+— single locked owner of ``jax.profiler``), parsing/attribution through the
+stdlib XPlane parser (observability/xplane.py). This script's only jobs are
+(1) the exact-program guarantee — build the SAME (jitted fn, args)
+bench.py times, via ``bench.flagship_setup`` + the same multistep builders
+and donation — and (2) argument plumbing.
 
 Usage (on the TPU host / through the relay):
     python scripts/profile_flagship.py --model resnet50 --batch 128 --ksteps 8
     python scripts/profile_flagship.py --model transformer --bf16-act
 The raw trace stays in --logdir (default scripts/profiles/<model>/) for
-TensorBoard/xprof; the printed summary is self-contained.
+TensorBoard/xprof; the printed summary (also written as attribution.json
+next to the trace) is self-contained.
 """
 from __future__ import annotations
 
 import argparse
-import glob
 import json
 import os
 import sys
@@ -60,6 +60,8 @@ def capture(model: str, batch: int, ksteps: int, logdir: str,
             warmup: int = 2, traced_dispatches: int = 2) -> str:
     import jax
 
+    from deeplearning4j_tpu.observability.profiler import global_trace_session
+
     fn, args = build_program(model, batch, ksteps)
     params, states, upd = args[0], args[1], args[2]
     rest = args[3:]
@@ -69,109 +71,24 @@ def capture(model: str, batch: int, ksteps: int, logdir: str,
     _sync = float(np.asarray(jax.tree_util.tree_leaves(loss)[0]).ravel()[-1])
     print(f"warmup done ({time.time() - t0:.1f}s, loss={_sync:.4f}); tracing...",
           file=sys.stderr)
-    os.makedirs(logdir, exist_ok=True)
-    jax.profiler.start_trace(logdir)
+    session = global_trace_session()
+    if session.start("script", logdir=logdir) is None:
+        raise SystemExit("trace engine busy: another capture owns the "
+                         "process-global profiler")
     for _ in range(traced_dispatches):
         params, states, upd, loss = fn(params, states, upd, *rest)
     float(np.asarray(jax.tree_util.tree_leaves(loss)[0]).ravel()[-1])
-    jax.profiler.stop_trace()
+    session.stop(summarize=False)  # main() prints the summary itself
     return logdir
 
 
 def summarize(logdir: str, top: int = 25) -> dict:
-    """Parse the xplane.pb into a per-op self-time table (device planes)."""
-    paths = sorted(glob.glob(os.path.join(logdir, "**", "*.xplane.pb"),
-                             recursive=True))
-    if not paths:
-        return {"error": f"no xplane.pb under {logdir}"}
-    from jax.profiler import ProfileData
+    """Per-op self-time table of the newest trace under ``logdir`` (the
+    engine's stdlib parser; kept as a function so existing callers and
+    --summarize-only share one path)."""
+    from deeplearning4j_tpu.observability.xplane import summarize as _summ
 
-    xspace = ProfileData.from_file(paths[-1])
-    plane_names = [p.name for p in xspace.planes]
-    out = {"trace": paths[-1], "planes": plane_names}
-    # device planes only ("/device:TPU:0" etc.); fall back to host planes so
-    # the pipeline still summarizes something on CPU-only smoke runs
-    device = [p for p in xspace.planes
-              if any(t in p.name.lower() for t in ("tpu", "gpu", "device"))]
-    planes = device or list(xspace.planes)
-    out["summarized_planes"] = [p.name for p in planes]
-    import re
-
-    def opcode(nm: str) -> str:
-        """The defining HLO opcode of '%name = type opcode(args)'. Bucketing
-        must use THIS, not substring search over the whole HLO string —
-        operand text routinely contains 'transpose'/'reshape', which round
-        4's parser misread as ~38%% 'datamovement' on every model."""
-        m = re.search(r"=\s*(?:\([^=]*?\)\s*|\S+\s+)?([a-z][a-z0-9\-_.]*)\(",
-                      nm)
-        return m.group(1) if m else nm.split(".")[0].lstrip("%")
-
-    op_time: dict = {}
-    total_ns = 0
-    for plane in planes:
-        lines = list(plane.lines)
-        # device planes carry container lines ("XLA Modules", "Steps",
-        # "Framework Name Scope") spanning the same wall time as the per-op
-        # line — summing every line double-counts. Keep exactly the XLA
-        # per-op line when present.
-        op_lines = [l for l in lines
-                    if (l.name or "").strip().lower() in ("xla ops", "ops")]
-        for line in (op_lines or lines):
-            for ev in line.events:
-                nm = ev.name
-                # control-flow wrappers (the K-step scan loop) span their
-                # whole body and would double-count every inner op
-                if opcode(nm) in ("while", "conditional", "call"):
-                    continue
-                dur = int(ev.duration_ns)
-                op_time[nm] = op_time.get(nm, 0) + dur
-                total_ns += dur
-    ranked = sorted(op_time.items(), key=lambda kv: -kv[1])[:top]
-    out["total_device_ns"] = total_ns
-    out["top_ops"] = [
-        {"op": k, "ns": v,
-         "pct": round(100.0 * v / total_ns, 2) if total_ns else 0.0}
-        for k, v in ranked]
-
-    def bucket(nm: str) -> str:
-        op = opcode(nm)
-        # fusions: classify by the name prefix XLA gives them (it encodes
-        # the fused ops: transpose_..., convert_reduce_..., maximum_add_...)
-        label = nm.lstrip("%").split(" ")[0].split(".")[0].lower()
-        if "conv" in op or label.startswith("convolution"):
-            return "conv"
-        if op in ("dot", "custom-call") or "matmul" in label:
-            return "matmul/custom"
-        if any(t in op for t in ("all-reduce", "all-gather", "collective",
-                                 "reduce-scatter", "permute")):
-            return "collective"
-        if op in ("copy", "transpose", "reshape", "bitcast",
-                  "dynamic-slice", "dynamic-update-slice") \
-                or label.startswith(("copy", "transpose", "bitcast")):
-            return "datamovement"
-        if op == "fusion":
-            # TPU traces do not expose fusion bodies; the big kOutput
-            # fusions CONTAIN the convolutions/matmuls plus their
-            # elementwise epilogues, so this bucket is "compute", not
-            # "elementwise overhead"
-            if label.startswith(("convert_reduce", "multiply_reduce",
-                                 "reduce")):
-                return "fusion:reduce"
-            return "fusion:compute"
-        return op
-
-    cats: dict = {}
-    for k, v in op_time.items():
-        cats[bucket(k)] = cats.get(bucket(k), 0) + v
-    ranked_cats = sorted(cats.items(), key=lambda kv: -kv[1])
-    head, tail = ranked_cats[:11], ranked_cats[11:]
-    if tail:  # roll the long tail up so the split still sums to ~100%
-        head.append((f"other({len(tail)} buckets)",
-                     sum(v for _, v in tail)))
-    out["categories_pct"] = {
-        k: round(100.0 * v / total_ns, 2) if total_ns else 0.0
-        for k, v in head}
-    return out
+    return _summ(logdir, top=top)
 
 
 def main() -> None:

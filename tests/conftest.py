@@ -25,6 +25,11 @@ assert jax.default_backend() == "cpu" and len(jax.devices()) == 8, (
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: excluded from the tier-1 gate (-m 'not slow')")
+
+
 @pytest.fixture(scope="session")
 def devices():
     return jax.devices()

@@ -1,0 +1,125 @@
+"""Regenerate tests/golden/xplane_golden.pb — the committed XSpace fixture.
+
+The fixture is a synthetic but wire-format-faithful XSpace protobuf covering
+every classification path the parser has: a device plane ("/device:TPU:0")
+whose "XLA Ops" line holds one op per category (conv, dot, reduce-fusion,
+compute-fusion, collective, datamovement) plus a control-flow `while`
+wrapper the parser must skip and an "XLA Modules" container line it must
+ignore; and a host plane whose "python" line carries PjitFunction spans
+(per-fn share) and a profiler bookkeeping event that must be filtered.
+
+Durations are picked so the category split is exact round percentages
+(conv 40 / matmul 30 / fusion:reduce 20 / fusion:compute 5 / collective 3 /
+datamovement 2 — summing to 100.0), which the parser unit tests assert
+verbatim. Encoding uses observability/xplane.py's own encode_* helpers so
+fixture and parser share one field layout.
+
+Run from the repo root:  python tests/golden/make_xplane_golden.py
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+from deeplearning4j_tpu.observability.xplane import (  # noqa: E402
+    encode_field, encode_message, encode_varint)
+
+_VARINT, _LEN = 0, 2
+
+#: (HLO string, duration_ps) — device "XLA Ops" events. The while wrapper
+#: spans everything and MUST be excluded from totals by the parser.
+DEVICE_OPS = [
+    ("%convolution.42 = f32[128,112,112,64]{3,2,1,0} convolution(%arg0, "
+     "%arg1), window={size=7x7 stride=2x2}", 40_000_000),
+    ("%dot.3 = f32[128,1000]{1,0} dot(%x, %y), "
+     "lhs_contracting_dims={1}", 30_000_000),
+    ("%convert_reduce_fusion.7 = f32[64]{0} fusion(%p0), kind=kInput, "
+     "calls=%fused_computation.7", 20_000_000),
+    ("%multiply_add_fusion.9 = f32[128]{0} fusion(%a, %b), kind=kLoop",
+     5_000_000),
+    ("%all-reduce.1 = f32[256]{0} all-reduce(%x), replica_groups={}",
+     3_000_000),
+    ("%copy.4 = f32[128]{0} copy(%x)", 2_000_000),
+    ("%while.1 = (f32[]) while(%init), condition=%cond, body=%body",
+     99_000_000),
+]
+
+#: host "python" line events: pjit spans feed fn_pct (70/30); the $profiler
+#: bookkeeping event must be filtered from every total
+HOST_EVENTS = [
+    ("PjitFunction(multistep)", 70_000_000),
+    ("PjitFunction(train_step)", 30_000_000),
+    ("$profiler.py:91 start_trace", 4_400_000_000),
+]
+
+
+def _event(metadata_id: int, dur_ps: int) -> bytes:
+    return encode_message(encode_field(1, _VARINT, metadata_id),
+                          encode_field(3, _VARINT, dur_ps))
+
+
+def _metadata_entry(eid: int, name: str) -> bytes:
+    meta = encode_message(encode_field(1, _VARINT, eid),
+                          encode_field(2, _LEN, name.encode()))
+    return encode_message(encode_field(1, _VARINT, eid),
+                          encode_field(2, _LEN, meta))
+
+
+def _line(name: str, events: bytes) -> bytes:
+    return encode_message(encode_field(2, _LEN, name.encode()), events)
+
+
+def _plane(name: str, *parts: bytes) -> bytes:
+    return encode_message(encode_field(2, _LEN, name.encode()), *parts)
+
+
+def build() -> bytes:
+    # device plane: metadata ids 1..N for the ops, one "XLA Ops" line with
+    # an event per op, and an "XLA Modules" container line (same wall span)
+    # the parser must NOT double-count
+    dev_meta = b"".join(
+        encode_field(4, _LEN, _metadata_entry(i + 1, nm))
+        for i, (nm, _) in enumerate(DEVICE_OPS))
+    op_events = b"".join(
+        encode_field(4, _LEN, _event(i + 1, dur))
+        for i, (_, dur) in enumerate(DEVICE_OPS))
+    module_meta = encode_field(
+        4, _LEN, _metadata_entry(100, "SyncTensorsGraph.1234"))
+    module_event = encode_field(4, _LEN, _event(100, 199_000_000))
+    device = _plane(
+        "/device:TPU:0", dev_meta, module_meta,
+        encode_field(3, _LEN, _line("XLA Ops", op_events)),
+        encode_field(3, _LEN, _line("XLA Modules", module_event)))
+
+    host_meta = b"".join(
+        encode_field(4, _LEN, _metadata_entry(i + 1, nm))
+        for i, (nm, _) in enumerate(HOST_EVENTS))
+    host_events = b"".join(
+        encode_field(4, _LEN, _event(i + 1, dur))
+        for i, (_, dur) in enumerate(HOST_EVENTS))
+    host = _plane("/host:CPU", host_meta,
+                  encode_field(3, _LEN, _line("python", host_events)))
+
+    return encode_message(encode_field(1, _LEN, device),
+                          encode_field(1, _LEN, host))
+
+
+def main() -> None:
+    out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "xplane_golden.pb")
+    data = build()
+    with open(out, "wb") as f:
+        f.write(data)
+    print(f"wrote {out} ({len(data)} bytes)")
+    # self-check: parse what we just wrote
+    from deeplearning4j_tpu.observability.xplane import summarize
+    import json
+    print(json.dumps(summarize(out), indent=1))
+    assert encode_varint(0) == b"\x00"  # tiny encoder sanity
+
+
+if __name__ == "__main__":
+    main()

@@ -301,3 +301,32 @@ def test_config_key_lstm_impl_axis():
     new = bench._config_key("--model char_rnn",
                             ts="2026-08-05T12:00:01Z")
     assert old["lstm_impl"] == "scan" and new["lstm_impl"] == "auto"
+
+
+def test_xplane_attribution_contract():
+    """xplane attribution is measurement-only and ts-gated: the flag never
+    makes a config distinct (a prior healthy row stands in during an
+    outage), the landed-ts postdates the lstm-impl gate it stacks on, and
+    the attribution field names bench rows carry are pinned."""
+    import bench
+
+    a = bench._config_key("--model resnet50")
+    b = bench._config_key("--model resnet50 --xplane-attribution")
+    assert a == b  # like --telemetry-out: does not change what is measured
+    # same measurement-only rule on a recurrent row with its impl axis set
+    assert bench._config_key(
+        "--model char_rnn --hidden 1024 --xplane-attribution") == \
+        bench._config_key("--model char_rnn --hidden 1024")
+
+    ts = bench._XPLANE_ATTRIBUTION_LANDED_TS
+    assert ts.endswith("Z") and len(ts) == len("2026-08-05T16:00:00Z")
+    assert ts > bench._LSTM_IMPL_DEFAULT_CHANGE_TS  # ISO-8601 sorts
+
+    assert bench.XPLANE_ATTRIBUTION_FIELDS == (
+        "xplane_attribution", "profile_trace", "profile_error",
+        "profile_variant")
+    # the capture-capable set covers every multistep-harness model; models
+    # outside it must degrade to profile_error, never crash (pinned so a
+    # new model is consciously added or consciously excluded)
+    assert bench._PROFILE_CAPABLE == frozenset(
+        {"lenet", "resnet50", "vgg16", "char_rnn", "transformer", "moe"})
