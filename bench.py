@@ -77,6 +77,10 @@ _PROFILE_SPEC = None
 _PROFILE_CAPABLE = frozenset(
     {"lenet", "resnet50", "vgg16", "char_rnn", "transformer", "moe"})
 
+#: models with a --sharding grid axis: flagship fit paths routed through the
+#: partition-rule engine's compile seam (parallel/partition.py rule sets)
+_SHARDING_CAPABLE = frozenset({"fit_resnet50", "transformer"})
+
 
 def _profile_capture(dispatch_once, logdir_hint: str = None) -> dict:
     """Run the armed trace capture around ``dispatch_once`` (a thunk
@@ -380,9 +384,14 @@ def _bench_lm(model: str, batch: int, iters: int, ksteps: int,
 
 
 def bench_transformer(batch: int, iters: int, ksteps: int,
-                      warmup: int = 2) -> dict:
+                      warmup: int = 2, sharding: str = None) -> dict:
     """Decoder-only transformer LM over the flash-attention kernel
     (geometry fixed by flagship_setup: LM_VOCAB x LM_SEQ)."""
+    if sharding:
+        r = _bench_sharded_fit("transformer", batch, iters, ksteps, sharding,
+                               warmup)
+        r["tokens_per_sec"] = r["samples_per_sec"] * LM_SEQ
+        return r
     return _bench_lm("transformer", batch, iters, ksteps, warmup)
 
 
@@ -662,14 +671,96 @@ def _fit_ab(net, data, warmup_data) -> dict:
     }
 
 
+def _sharded_param_bytes(rule_set: str):
+    """Per-device sharded-param-bytes gauge value for one rule set (set by
+    the compile seam when the wrapper's step compiles)."""
+    from deeplearning4j_tpu.observability import global_registry
+    fam = global_registry().snapshot().get(
+        "dl4j_sharded_param_bytes_per_device", {})
+    for s in fam.get("series", []):
+        if s.get("labels", {}).get("rule_set") == rule_set:
+            return int(s["value"])
+    return None
+
+
+def _bench_sharded_fit(model: str, batch: int, iters: int, ksteps: int,
+                       sharding: str, warmup: int = 1) -> dict:
+    """--sharding axis: the same flagship geometry trained through the
+    partition-rule engine's compile seam (ParallelWrapper.fit on a named
+    mesh) instead of the single-device path. One record per rule set so
+    bench_log.jsonl carries per-mode samples/s AND the per-device param
+    footprint the rule set actually achieved (the zero3 acceptance signal:
+    ~1/N of the replicated bytes)."""
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.datasets.iterators import ListDataSetIterator
+    from deeplearning4j_tpu.parallel.mesh import build_mesh
+    from deeplearning4j_tpu.parallel.wrapper import ParallelWrapper
+
+    n_dev = len(jax.devices())
+    if sharding == "dp_tp":
+        if n_dev < 2 or n_dev % 2:
+            raise ValueError(
+                f"--sharding dp_tp needs an even device count, have {n_dev}")
+        mesh = build_mesh({"data": n_dev // 2, "model": 2})
+    else:
+        mesh = build_mesh({"data": n_dev})
+
+    rng = np.random.default_rng(0)
+    if model == "fit_resnet50":
+        from deeplearning4j_tpu.models.resnet import resnet50
+        from deeplearning4j_tpu.nn.graph_network import ComputationGraph
+        x = rng.normal(size=(batch, 224, 224, 3)).astype(np.float32)
+        y = _onehot_batch(rng, batch, 1000)
+        net = ComputationGraph(resnet50(n_classes=1000, image_size=224)).init()
+    else:  # transformer
+        from deeplearning4j_tpu.models.transformer import transformer_lm
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        ids = rng.integers(0, LM_VOCAB, (batch, LM_SEQ))
+        x = y = np.eye(LM_VOCAB, dtype=np.float32)[ids]
+        net = MultiLayerNetwork(transformer_lm(
+            vocab_size=LM_VOCAB, width=256, n_layers=4, n_heads=4,
+            max_len=LM_SEQ)).init()
+    net.dispatch_ksteps = ksteps
+
+    n_batches = iters * ksteps
+    data = [DataSet(x, y) for _ in range(n_batches)]
+    pw = (ParallelWrapper.builder(net).mesh(mesh).prefetch_buffer(2)
+          .sharding(sharding).build())
+
+    pw.fit(ListDataSetIterator(data[:max(1, warmup) * ksteps]))
+    jax.block_until_ready(net.params_list)  # compile + warm relay
+    t0 = time.perf_counter()
+    pw.fit(ListDataSetIterator(data))
+    jax.block_until_ready(net.params_list)
+    dt = time.perf_counter() - t0
+    return {
+        "samples_per_sec": batch * n_batches / dt,
+        "step_time_ms": dt / n_batches * 1000,
+        "batch": batch, "iters": iters, "ksteps": ksteps,
+        "tflops_per_sec": 0.0, "mfu": 0.0,
+        "api": "ParallelWrapper.fit",
+        "sharding": sharding,
+        "mesh": {k: int(v) for k, v in zip(mesh.axis_names,
+                                           mesh.devices.shape)},
+        "param_bytes_per_device": _sharded_param_bytes(sharding),
+    }
+
+
 def bench_fit_resnet50(batch: int, iters: int, ksteps: int,
-                       warmup: int = 1) -> dict:
+                       warmup: int = 1, sharding: str = None) -> dict:
     """The PRODUCTION fit(DataSetIterator) path on ResNet-50 — not the raw
     multistep kernel. Measures what a user of the documented API gets:
     host-staged numpy batches, K-step grouping + stacking inside
     fit_iterator, lazy score sync (VERDICT round-2 item 2's acceptance bar:
     within ~15% of the raw multistep bench)."""
     import jax.numpy as jnp
+
+    if sharding:
+        return _bench_sharded_fit("fit_resnet50", batch, iters, ksteps,
+                                  sharding, warmup)
 
     from deeplearning4j_tpu.datasets.dataset import DataSet
     from deeplearning4j_tpu.models.resnet import resnet50
@@ -836,6 +927,12 @@ def _child_main(args) -> None:
         kwargs["hidden"] = args.hidden
     if args.lstm_impl and args.model == "char_rnn":
         kwargs["lstm_impl"] = args.lstm_impl
+    if getattr(args, "sharding", None):
+        if args.model not in _SHARDING_CAPABLE:
+            raise SystemExit(
+                f"--sharding supports {sorted(_SHARDING_CAPABLE)}, "
+                f"not '{args.model}'")
+        kwargs["sharding"] = args.sharding
 
     # arm the attribution capture: explicit --xplane-attribution, or the
     # first-healthy trigger bench_capture.sh exports (ROADMAP item 1 —
@@ -944,6 +1041,14 @@ def main() -> None:
                          "preferred_element_type), f32 everywhere else. "
                          "'f32' restores the classic at-least-f32 statistics "
                          "on the bf16-act path")
+    ap.add_argument("--sharding", default=None,
+                    choices=("dp", "dp_tp", "zero3"),
+                    help="train through the partition-rule sharding engine "
+                         "(ParallelWrapper.fit on a named mesh) instead of "
+                         "the single-device path; fit_resnet50/transformer "
+                         "only (config-distinct). The record carries the "
+                         "achieved param_bytes_per_device from "
+                         "dl4j_sharded_param_bytes_per_device")
     ap.add_argument("--telemetry-out", default=None,
                     help="append a metrics-registry snapshot (JSONL) to this "
                          "file beside the headline JSON; measurement-only — "
@@ -1108,6 +1213,11 @@ _XPLANE_ATTRIBUTION_LANDED_TS = "2026-08-05T16:00:00Z"
 XPLANE_ATTRIBUTION_FIELDS = ("xplane_attribution", "profile_trace",
                              "profile_error", "profile_variant")
 
+#: when the --sharding grid axis landed (round 8) — rows logged before this
+#: instant all measured the single-device fit path, so during an outage they
+#: may stand in only for an UNSHARDED request, never for a --sharding row
+_SHARDING_AXIS_LANDED_TS = "2026-08-05T20:00:00Z"
+
 
 def _config_key(args_str: str, ts: str = None) -> dict:
     """The fields that make two bench invocations the SAME config: model,
@@ -1148,10 +1258,18 @@ def _config_key(args_str: str, ts: str = None) -> dict:
             # pre-engine rows measured the reference scan path; an outage
             # must not serve an old scan number for today's fused/auto row
             lstm_impl = "scan"
+    sharding = None
+    if model in _SHARDING_CAPABLE:
+        sharding = val("--sharding")
+        if ts is not None and ts < _SHARDING_AXIS_LANDED_TS:
+            # pre-round-8 rows predate the sharding engine: they all measured
+            # the single-device fit path, whatever flags a later reader asks
+            sharding = None
     return {"model": model, "batch": val("--batch"),
             "ksteps": val("--ksteps"), "dtype": mode, "rdtype": rdtype,
             "seq": val("--seq"), "vocab": val("--vocab"),
-            "hidden": val("--hidden"), "lstm_impl": lstm_impl}
+            "hidden": val("--hidden"), "lstm_impl": lstm_impl,
+            "sharding": sharding}
 
 
 def _last_healthy_from_log(args_str: str, path: str = None):

@@ -726,6 +726,73 @@ class SwallowedException(Rule):
                     "silent")
 
 
+# ---------------------------------------------------------------------------
+@register
+class AdhocSharding(Rule):
+    """No ``NamedSharding(`` / ``PartitionSpec(`` construction outside the
+    partition-rule engine (``parallel/partition.py`` + ``compile_seam.py``).
+
+    Hand-built shardings are how the framework ended up with four parallel
+    fit paths that each wired their own layouts — and where the layout lives
+    determines where it can be fixed. The engine is the one place layout
+    decisions are made (rules -> specs), telemetered
+    (``dl4j_sharding_spec_total``), and compile-tracked; call sites import
+    ``partition.pspec`` for trace-level specs and
+    ``partition.named_sharding``/``tree_shardings``/``device_put`` for
+    placement. Jurisdiction: direct calls to the ``jax.sharding``
+    constructors (by from-import, alias, or dotted attribute). A staging
+    path with a genuine reason to hand-place (datasets/prefetch producer
+    threads) suppresses with that reason spelled out.
+    """
+
+    name = "adhoc-sharding"
+    description = ("NamedSharding/PartitionSpec constructed outside "
+                   "parallel/partition.py + compile_seam.py (use "
+                   "partition.pspec / partition.named_sharding)")
+    exclude = ("*/parallel/partition.py", "*/parallel/compile_seam.py")
+
+    _CTORS = ("NamedSharding", "PartitionSpec")
+    _ORIGIN = "jax.sharding"
+
+    def check(self, ctx: FileContext) -> Iterator[Violation]:
+        tree = ctx.tree
+        if tree is None:
+            return
+        # local names bound to the jax.sharding constructors by from-import
+        # (incl. aliases like `PartitionSpec as P`), and module aliases that
+        # can reach them as attributes (import jax / import jax.sharding)
+        ctor_names: Dict[str, str] = {}
+        mod_aliases: Set[str] = set()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ImportFrom) and node.module == self._ORIGIN:
+                for a in node.names:
+                    if a.name in self._CTORS:
+                        ctor_names[a.asname or a.name] = a.name
+            elif isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.name in ("jax", "jax.sharding"):
+                        mod_aliases.add((a.asname or a.name).split(".")[0])
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            kind = None
+            if isinstance(f, ast.Name) and f.id in ctor_names:
+                kind = ctor_names[f.id]
+            else:
+                d = dotted_name(f)
+                if d and "." in d:
+                    head, leaf = d.split(".", 1)[0], d.rsplit(".", 1)[-1]
+                    if leaf in self._CTORS and head in mod_aliases:
+                        kind = leaf
+            if kind:
+                yield self.violation(
+                    ctx, node.lineno,
+                    f"ad-hoc {kind}() construction — layouts come from the "
+                    "partition-rule engine (partition.pspec / "
+                    "partition.named_sharding / compile_seam.compile_step)")
+
+
 def default_rules() -> List[Rule]:
     """Fresh instances of every registered rule, in registration order."""
     return [cls() for cls in REGISTRY.values()]

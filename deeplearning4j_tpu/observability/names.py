@@ -37,6 +37,10 @@ FIT_PHASE_SECONDS = "dl4j_fit_phase_seconds"
 COLLECTIVE_BYTES_TOTAL = "dl4j_collective_bytes_total"
 COLLECTIVE_BYTES_PER_STEP = "dl4j_collective_bytes_per_step"
 
+# --- sharding engine (parallel/{partition,compile_seam}.py) ----------------
+SHARDING_SPEC_TOTAL = "dl4j_sharding_spec_total"
+SHARDED_PARAM_BYTES_PER_DEVICE = "dl4j_sharded_param_bytes_per_device"
+
 # --- kernel dispatch (ops/pallas_kernels.py) -------------------------------
 PALLAS_DISPATCH_TOTAL = "dl4j_pallas_dispatch_total"
 

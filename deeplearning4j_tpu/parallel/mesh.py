@@ -21,7 +21,7 @@ from typing import Optional, Sequence
 
 import jax
 import numpy as np
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh
 
 
 def init_distributed(coordinator_address: Optional[str] = None,
@@ -100,55 +100,27 @@ def data_parallel_mesh(n: Optional[int] = None,
 
 
 # --------------------------------------------------------------------- shardings
+# Layout DECISIONS live in parallel/partition.py (the rule engine); these are
+# thin delegates kept for API stability. The old per-param Megatron rules
+# (``param_pspec``) are now the engine's ``dp_tp`` rule set.
 def batch_sharding(mesh: Mesh):
     """Shard leading (batch) dim over 'data'."""
-    return NamedSharding(mesh, P("data"))
+    from deeplearning4j_tpu.parallel import partition
+    return partition.named_sharding(mesh, partition.pspec("data"))
 
 
 def replicated(mesh: Mesh):
-    return NamedSharding(mesh, P())
-
-
-def param_pspec(layer, param_name: str, shape: Sequence[int],
-                model_axis: str = "model", axis_size: int = 1) -> P:
-    """Tensor-parallel partition spec for one parameter.
-
-    Rules (Megatron-style column parallelism on dense-like weights): shard the output
-    dim of 2-D weights and conv n_out over 'model'; replicate small vectors, norm
-    params, and anything not divisible by the axis. XLA GSPMD inserts the
-    all-gathers/reduce-scatters that the sharding implies — nothing manual.
-    """
-    def ok(dim):
-        return axis_size > 0 and shape[dim] % axis_size == 0
-
-    if len(shape) == 2 and param_name in ("W", "RW", "FW", "FRW", "BW", "BRW") and ok(1):
-        return P(None, model_axis)
-    if len(shape) == 4 and param_name == "W" and ok(3):  # conv HWIO: shard out chans
-        return P(None, None, None, model_axis)
-    if len(shape) == 1 and param_name in ("b", "Fb", "Bb") and shape[0] >= 8 and ok(0):
-        return P(model_axis)
-    return P()
+    from deeplearning4j_tpu.parallel import partition
+    return partition.named_sharding(mesh)
 
 
 def shard_params_for_tp(params_tree, conf, mesh: Mesh, model_axis: str = "model"):
-    """Apply tensor-parallel shardings to a params pytree (list- or dict-style)."""
-    axis_size = mesh.shape.get(model_axis, 1)
-
-    def spec_tree(layer, params):
-        return {name: NamedSharding(mesh, param_pspec(layer, name, p.shape,
-                                                      model_axis, axis_size))
-                for name, p in params.items()}
-
-    if isinstance(params_tree, list):  # MultiLayerNetwork
-        return [jax.device_put(p, spec_tree(layer, p))
-                if p else p
-                for layer, p in zip(conf.layers, params_tree)]
-    out = {}
-    for name, p in params_tree.items():  # ComputationGraph
-        vertex = conf.vertices[name]
-        layer = getattr(vertex, "layer", None)
-        if layer is not None and p:
-            out[name] = jax.device_put(p, spec_tree(layer, p))
-        else:
-            out[name] = p
-    return out
+    """Apply tensor-parallel shardings to a params pytree (list- or
+    dict-style) via the ``dp_tp`` partition rules — Megatron column/row
+    splits for dense/attention/MoE weights; indivisible or tiny leaves stay
+    replicated. XLA GSPMD inserts the all-gathers/reduce-scatters the
+    shardings imply — nothing manual."""
+    from deeplearning4j_tpu.parallel import partition
+    specs = partition.match_partition_rules(
+        partition.dp_tp_rules(model_axis), params_tree, mesh=mesh, conf=conf)
+    return partition.device_put(params_tree, mesh, specs)

@@ -17,7 +17,10 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh
+from deeplearning4j_tpu.parallel.partition import (
+    pspec as P, named_sharding as _named_sharding,
+)
 from deeplearning4j_tpu.jax_compat import shard_map
 from deeplearning4j_tpu.observability.names import COLLECTIVE_BYTES_PER_STEP
 from deeplearning4j_tpu.observability.metrics import (
@@ -169,12 +172,12 @@ class ExpertParallelMoE:
     def __call__(self, params: dict, x: Array) -> Array:
         """x: [B, T, F] with B divisible by the axis size. Returns [B, T, F]."""
         router = jax.device_put({"Wg": params["Wg"]},
-                                {"Wg": NamedSharding(self.mesh, P())})
+                                {"Wg": _named_sharding(self.mesh, P())})
         experts = jax.device_put(
             {k: params[k] for k in ("W1", "b1", "W2", "b2")},
-            {k: NamedSharding(self.mesh, P(self.axis_name))
+            {k: _named_sharding(self.mesh, P(self.axis_name))
              for k in ("W1", "b1", "W2", "b2")})
-        x = jax.device_put(x, NamedSharding(self.mesh, P(self.axis_name)))
+        x = jax.device_put(x, _named_sharding(self.mesh, P(self.axis_name)))
         y, _ = expert_parallel_ffn(self.layer, {**router, **experts}, x,
                                    self.mesh, self.axis_name,
                                    self.capacity_factor)

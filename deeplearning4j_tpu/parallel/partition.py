@@ -1,0 +1,362 @@
+"""Partition-rule engine: named param trees -> regex rules -> PartitionSpecs.
+
+THE one place sharding layouts come from (ROADMAP open item 3). Every
+parallel fit path used to hand-wire its own ``NamedSharding``s, so tensor
+parallelism and ZeRO-style parameter/optimizer sharding were new code paths
+instead of config choices. Here the GSPMD idiom (match_partition_rules over
+a ``/``-joined named tree, SNIPPETS.md [1]) centralizes it:
+
+  1. ``named_tree_map`` walks any pytree with ``/``-joined path strings;
+     model trees (MultiLayerNetwork ``params_list`` / ComputationGraph
+     params dicts, and the updater state mirroring them) get their top
+     component enriched with the layer class name, so a rule can target
+     ``0.DenseLayer/W`` or ``ff.TransformerBlock/Wqkv`` — and, because
+     optimizer-state leaves extend the same path (``.../W/m``), one rule
+     shards a parameter and its moments alike.
+  2. ``match_partition_rules(rules, tree, ...)`` maps ``(regex, spec)``
+     rules, first match wins, onto a PartitionSpec pytree. Scalars and tiny
+     vectors fall through to replicated; an unmatched non-scalar leaf is a
+     hard ``PartitionRuleError`` (silent replication is how a "sharded" run
+     quietly stops scaling). A matched leaf whose dims don't divide the mesh
+     axis demotes to replicated — the same forgiving behavior the old
+     per-path constructors had.
+  3. Built-in rule sets: ``dp`` (replicate params, shard the batch),
+     ``dp_tp`` (Megatron column/row splits for dense/attention/MoE
+     weights), ``zero3`` (params + optimizer state sharded over the data
+     axis, all-gathered per layer by GSPMD from the sharding constraints).
+
+Rank-polymorphic rule values ``Col``/``Row``/``FirstDivisible`` let one rule
+cover a 2-D dense W, a 4-D conv HWIO W, and a 3-D MoE expert stack: ``Col``
+shards the last (output) dim, ``Row`` the second-to-last (input) dim,
+``FirstDivisible`` the first dim the axis divides (the ZeRO scan).
+
+Specs are layout *hints*: XLA GSPMD inserts the collectives the layout
+implies, so numerics are identical across rule sets — the equivalence tests
+pin that. Construction of raw ``NamedSharding``/``PartitionSpec`` outside
+this module and ``compile_seam.py`` is flagged by the ``adhoc-sharding``
+lint rule; other modules import :data:`pspec` for trace-level specs and use
+:func:`named_sharding`/:func:`tree_shardings`/:func:`device_put` for
+placement.
+"""
+from __future__ import annotations
+
+import re
+from typing import Any, Callable, Iterable, Optional, Sequence, Tuple
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from deeplearning4j_tpu.observability.metrics import (
+    global_registry as _obs_registry, tree_nbytes as _tree_nbytes,
+)
+from deeplearning4j_tpu.observability.names import (
+    SHARDED_PARAM_BYTES_PER_DEVICE, SHARDING_SPEC_TOTAL,
+)
+
+#: the sanctioned spec constructor for trace-level code (shard_map in_specs,
+#: batch specs). A PartitionSpec is device-free data; placement (NamedSharding)
+#: must go through the helpers below so layouts stay greppable in one place.
+pspec = PartitionSpec
+
+#: 1-D leaves below this many elements replicate regardless of rules —
+#: mirrors the old ``param_pspec`` bias floor (shape[0] >= 8): sharding an
+#: 8-float bias buys nothing and costs a collective.
+TINY_VECTOR = 8
+
+
+class PartitionRuleError(ValueError):
+    """A non-scalar leaf matched no rule. Raised, not defaulted: a silently
+    replicated 2 GB embedding is a perf bug that looks like a working run."""
+
+
+# ------------------------------------------------------------- rule values
+class FirstDivisible:
+    """Shard the first dim the mesh axis divides; replicate if none (the
+    ZeRO parameter/optimizer scan — old ``_tree_shardings`` behavior)."""
+
+    def __init__(self, axis: str = "data"):
+        self.axis = axis
+
+    def __repr__(self):
+        return f"FirstDivisible({self.axis!r})"
+
+
+class Col:
+    """Megatron column parallelism: shard the LAST (output) dim. Covers 2-D
+    dense W -> P(None, axis), conv HWIO W -> P(None, None, None, axis),
+    MoE expert stacks [E, F, H] -> P(None, None, axis), 1-D bias -> P(axis)."""
+
+    def __init__(self, axis: str = "model"):
+        self.axis = axis
+
+    def __repr__(self):
+        return f"Col({self.axis!r})"
+
+
+class Row:
+    """Megatron row parallelism: shard the SECOND-TO-LAST (input) dim.
+    1-D leaves replicate (a row-split layer's bias must be replicated)."""
+
+    def __init__(self, axis: str = "model"):
+        self.axis = axis
+
+    def __repr__(self):
+        return f"Row({self.axis!r})"
+
+
+# ---------------------------------------------------------------- tree walk
+def _is_container(x) -> bool:
+    return isinstance(x, (dict, list, tuple)) and not isinstance(x, PartitionSpec)
+
+
+def _path_str(key_path, sep: str) -> str:
+    tu = jax.tree_util
+    parts = []
+    for k in key_path:
+        if isinstance(k, tu.DictKey):
+            parts.append(str(k.key))
+        elif isinstance(k, tu.SequenceKey):
+            parts.append(str(k.idx))
+        elif isinstance(k, tu.GetAttrKey):
+            parts.append(str(k.name))
+        elif isinstance(k, tu.FlattenedIndexKey):
+            parts.append(str(k.key))
+        else:  # future key types: their str() is already path-like
+            parts.append(str(k).strip("[].'\""))
+    return sep.join(parts)
+
+
+def named_tree_map(f: Callable[..., Any], tree, *rest, sep: str = "/",
+                   top_names: Optional[dict] = None, is_leaf=None):
+    """``jax.tree_util.tree_map`` whose function receives the ``sep``-joined
+    path as its first argument: ``f(path, leaf, *rest_leaves)``.
+
+    ``top_names`` optionally rewrites the first path component (used to
+    enrich layer indices / vertex names with layer class names)."""
+    def g(key_path, leaf, *r):
+        path = _path_str(key_path, sep)
+        if top_names:
+            head, _, tail = path.partition(sep)
+            head = top_names.get(head, head)
+            path = head + (sep + tail if tail else "")
+        return f(path, leaf, *r)
+
+    return jax.tree_util.tree_map_with_path(g, tree, *rest, is_leaf=is_leaf)
+
+
+def model_top_names(tree, conf) -> dict:
+    """Map a model tree's top-level components to layer-type-enriched names:
+    list index ``0`` -> ``0.DenseLayer``, vertex ``ff`` -> ``ff.TransformerBlock``.
+    Works for params, grads, and updater state alike — they share structure."""
+    if conf is None:
+        return {}
+    layers = getattr(conf, "layers", None)
+    if isinstance(tree, (list, tuple)) and layers:
+        return {str(i): f"{i}.{type(l).__name__}" for i, l in enumerate(layers)}
+    vertices = getattr(conf, "vertices", None)
+    if isinstance(tree, dict) and vertices:
+        out = {}
+        for name in tree:
+            layer = getattr(vertices.get(name), "layer", None)
+            out[name] = f"{name}.{type(layer).__name__}" if layer is not None \
+                else name
+        return out
+    return {}
+
+
+# ------------------------------------------------------------- rule matching
+def _axis_factor(mesh: Optional[Mesh], axis) -> Optional[int]:
+    """Product of mesh sizes for a spec axis entry (name or tuple of names);
+    None if any name is absent from the mesh."""
+    f = 1
+    for a in (axis if isinstance(axis, tuple) else (axis,)):
+        if mesh is None or a not in mesh.shape:
+            return None
+        f *= mesh.shape[a]
+    return f
+
+
+def _resolve(value, shape: Sequence[int], mesh: Optional[Mesh]) -> PartitionSpec:
+    """Turn a rule value into a concrete spec for ``shape``, demoting to
+    replicated when the mesh axis is absent or doesn't divide the dim."""
+    if isinstance(value, FirstDivisible):
+        f = _axis_factor(mesh, value.axis)
+        if f is not None:
+            for d, n in enumerate(shape):
+                if n % f == 0:
+                    return PartitionSpec(*([None] * d), value.axis)
+        return PartitionSpec()
+    if isinstance(value, Col):
+        f = _axis_factor(mesh, value.axis)
+        if f is not None and shape and shape[-1] % f == 0:
+            return PartitionSpec(*([None] * (len(shape) - 1)), value.axis)
+        return PartitionSpec()
+    if isinstance(value, Row):
+        f = _axis_factor(mesh, value.axis)
+        if f is not None and len(shape) >= 2 and shape[-2] % f == 0:
+            return PartitionSpec(*([None] * (len(shape) - 2)), value.axis, None)
+        return PartitionSpec()
+    if isinstance(value, PartitionSpec):
+        if len(value) > len(shape):
+            return PartitionSpec()
+        for d, ax in enumerate(value):
+            if ax is None:
+                continue
+            f = _axis_factor(mesh, ax)
+            if f is None or shape[d] % f:
+                return PartitionSpec()
+        return value
+    raise TypeError(f"rule value {value!r} is not a PartitionSpec/"
+                    f"Col/Row/FirstDivisible")
+
+
+def match_partition_rules(rules: Iterable[Tuple[str, Any]], tree, *,
+                          mesh: Optional[Mesh] = None, conf=None,
+                          sep: str = "/") -> Any:
+    """Map ``(regex, spec)`` rules onto ``tree`` -> PartitionSpec pytree.
+
+    First match wins (``re.search``, so rules are unanchored — write
+    ``/W(/|$)`` to hit both a param and its optimizer moments ``/W/m``).
+    Scalars / size-1 / tiny 1-D leaves replicate without consulting rules;
+    an unmatched non-scalar leaf raises :class:`PartitionRuleError`.
+    """
+    rules = [(re.compile(pat), val) for pat, val in rules]
+    top = model_top_names(tree, conf)
+
+    def spec_for(path, leaf):
+        shape = tuple(getattr(leaf, "shape", ()) or ())
+        size = 1
+        for n in shape:
+            size *= n
+        if not shape or size <= 1 or (len(shape) == 1 and size < TINY_VECTOR):
+            return PartitionSpec()
+        for pat, val in rules:
+            if pat.search(path):
+                return _resolve(val, shape, mesh)
+        raise PartitionRuleError(
+            f"no partition rule matches leaf {path!r} with shape {shape}; "
+            f"add a rule (or an explicit '.*' -> P() catch-all) — silent "
+            f"replication is not a default")
+
+    return named_tree_map(spec_for, tree, sep=sep, top_names=top)
+
+
+# -------------------------------------------------------------- rule sets
+def dp_rules() -> list:
+    """Pure data parallelism: every parameter replicated; the batch dim of
+    activations is sharded by the caller's batch spec, not by param rules."""
+    return [(r".*", PartitionSpec())]
+
+
+def dp_tp_rules(model_axis: str = "model") -> list:
+    """Megatron-style dp x tp. Column-split the up-projections (fused QKV,
+    MLP/expert W1, dense/conv/LSTM output dims) and their biases; row-split
+    the down-projections (attention Wo, MLP/expert W2) whose biases and the
+    norm/gate params stay replicated. Indivisible dims demote to replicated
+    per-leaf, so a mixed net (e.g. a 3-wide output head) still compiles."""
+    return [
+        (r"/Wqkv(/|$)", Col(model_axis)),            # fused QKV: head split
+        (r"/Wo(/|$)", Row(model_axis)),              # attn out-proj: row
+        (r"/W1(/|$)", Col(model_axis)),              # MLP / expert up: column
+        (r"/W2(/|$)", Row(model_axis)),              # MLP / expert down: row
+        (r"/b1(/|$)", Col(model_axis)),              # bias of the column split
+        (r"/(W|RW|FW|FRW|BW|BRW)(/|$)", Col(model_axis)),  # dense/conv/LSTM
+        (r"/(b|Fb|Bb)(/|$)", Col(model_axis)),       # 1-D biases (TINY floor)
+        (r".*", PartitionSpec()),                    # norms, gates, the rest
+    ]
+
+
+def zero3_rules(data_axis: str = "data") -> list:
+    """ZeRO-3: every parameter and optimizer-state leaf sharded over the
+    data axis on its first divisible dim; GSPMD all-gathers per layer at use
+    sites from the sharding constraints (no manual gather code)."""
+    return [(r".*", FirstDivisible(data_axis))]
+
+
+RULE_SETS = {"dp": dp_rules, "dp_tp": dp_tp_rules, "zero3": zero3_rules}
+
+
+def rules_for(name: str, **kwargs) -> list:
+    try:
+        return RULE_SETS[name](**kwargs)
+    except KeyError:
+        raise ValueError(f"unknown rule set {name!r}; have {sorted(RULE_SETS)}")
+
+
+# ----------------------------------------------------------- placement API
+def named_sharding(mesh: Mesh, spec: Optional[PartitionSpec] = None) -> NamedSharding:
+    """THE NamedSharding constructor — call sites outside the engine use this
+    (keeps every placement greppable; enforced by the adhoc-sharding rule)."""
+    return NamedSharding(mesh, PartitionSpec() if spec is None else spec)
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return named_sharding(mesh, PartitionSpec())
+
+
+def tree_shardings(mesh: Mesh, spec_tree):
+    """PartitionSpec pytree (or prefix) -> NamedSharding pytree (or prefix).
+    ``None`` entries pass through (jit: inherit the committed placement)."""
+    if spec_tree is None:
+        return None
+    return jax.tree_util.tree_map(lambda s: NamedSharding(mesh, s), spec_tree)
+
+
+def device_put(tree, mesh: Mesh, spec_tree):
+    """Place a host/device tree per a spec pytree (or a single prefix spec)."""
+    return jax.device_put(tree, tree_shardings(mesh, spec_tree))
+
+
+# --------------------------------------------------------------- telemetry
+_spec_counter = _obs_registry().counter(
+    SHARDING_SPEC_TOTAL,
+    "partition-rule engine spec decisions, one count per leaf per "
+    "compiled step, by rule set and resolved spec")
+_param_bytes_gauge = _obs_registry().gauge(
+    SHARDED_PARAM_BYTES_PER_DEVICE,
+    "per-device bytes of the parameter tree under the resolved specs — "
+    "zero3 should read ~1/N of the replicated figure")
+
+
+def _spec_label(spec: PartitionSpec) -> str:
+    return "P(" + ",".join(str(a) for a in spec) + ")"
+
+
+def shard_factor(mesh: Mesh, spec: PartitionSpec) -> int:
+    """How many ways the spec splits one array across the mesh."""
+    f = 1
+    for ax in spec:
+        if ax is None:
+            continue
+        f *= _axis_factor(mesh, ax) or 1
+    return f
+
+
+def per_device_bytes(tree, spec_tree, mesh: Mesh) -> int:
+    """Bytes of ``tree`` resident per device under ``spec_tree`` (a spec
+    pytree matching ``tree``, or a single prefix spec for the whole tree)."""
+    if isinstance(spec_tree, PartitionSpec):
+        prefix = spec_tree
+        spec_tree = jax.tree_util.tree_map(lambda _: prefix, tree)
+    total = 0.0
+    leaves = jax.tree_util.tree_leaves(
+        named_tree_map(lambda _p, leaf, spec:
+                       _tree_nbytes(leaf) / shard_factor(mesh, spec),
+                       tree, spec_tree))
+    for b in leaves:
+        total += b
+    return int(total)
+
+
+def record_specs(rule_set: str, *spec_trees) -> None:
+    for tree in spec_trees:
+        for s in jax.tree_util.tree_leaves(tree):
+            if isinstance(s, PartitionSpec):
+                _spec_counter.labels(rule_set=rule_set,
+                                     spec=_spec_label(s)).inc()
+
+
+def record_param_bytes(rule_set: str, tree, spec_tree, mesh: Mesh) -> int:
+    b = per_device_bytes(tree, spec_tree, mesh)
+    _param_bytes_gauge.labels(rule_set=rule_set).set(b)
+    return b

@@ -160,10 +160,14 @@ class PipelineTrainer:
     # ------------------------------------------------------------------- fit
     def _make_step(self):
         from deeplearning4j_tpu.nn.multilayer import make_train_step
-        return _compile_tracker().wrap(
+        from deeplearning4j_tpu.parallel.compile_seam import compile_step
+        # through the seam: plain jit strategy (params replicated; the stage
+        # sharding lives inside PipelineParallel's own shard_map body), with
+        # rule-set-attributed CompileTracker registration
+        return compile_step(
             "PipelineTrainer.train_step",
-            jax.jit(make_train_step(self.net.conf,
-                                    loss=self._pipeline_loss)))
+            make_train_step(self.net.conf, loss=self._pipeline_loss),
+            mesh=self.mesh, rule_set="pipeline", strategy="jit")
 
     #: batches staged + transferred ahead of the dispatch loop (see
     #: MultiLayerNetwork.prefetch_depth); 0 = synchronous staging

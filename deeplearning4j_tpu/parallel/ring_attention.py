@@ -21,7 +21,10 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh
+from deeplearning4j_tpu.parallel.partition import (
+    pspec as P, named_sharding as _named_sharding,
+)
 from deeplearning4j_tpu.jax_compat import pcast, shard_map
 from deeplearning4j_tpu.observability.names import COLLECTIVE_BYTES_PER_STEP
 from deeplearning4j_tpu.observability.metrics import (
@@ -145,7 +148,7 @@ def ring_attention(q: Array, k: Array, v: Array, mesh: Mesh,
     Inputs are (B, T, H, D) with T sharded over ``axis_name`` (global arrays or
     host arrays; sharding is applied here). Returns output sharded the same way.
     """
-    sh = NamedSharding(mesh, P(None, axis_name))
+    sh = _named_sharding(mesh, P(None, axis_name))
     q, k, v = (jax.device_put(x, sh) for x in (q, k, v))
     return ring_attention_sharded(q, k, v, mesh, axis_name, causal)
 
@@ -201,7 +204,7 @@ def ulysses_attention(q: Array, k: Array, v: Array, mesh: Mesh,
                       interpret: bool = False) -> Array:
     """Sequence-parallel attention via head-sharding all-to-all. Requires the
     head count to be divisible by the axis size."""
-    sh = NamedSharding(mesh, P(None, axis_name))
+    sh = _named_sharding(mesh, P(None, axis_name))
     q, k, v = (jax.device_put(x, sh) for x in (q, k, v))
     return ulysses_attention_sharded(q, k, v, mesh, axis_name, causal,
                                      interpret)

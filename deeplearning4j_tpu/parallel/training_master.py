@@ -24,8 +24,7 @@ from typing import List, Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from deeplearning4j_tpu.jax_compat import shard_map
+from jax.sharding import Mesh
 
 from deeplearning4j_tpu import common
 
@@ -42,6 +41,10 @@ from deeplearning4j_tpu.observability.metrics import (
     global_registry as _obs_registry, tree_nbytes as _tree_nbytes,
 )
 from deeplearning4j_tpu.parallel.mesh import data_parallel_mesh
+from deeplearning4j_tpu.parallel.compile_seam import compile_step
+from deeplearning4j_tpu.parallel.partition import (
+    pspec as P, named_sharding as _named_sharding,
+)
 
 
 class TrainingMaster:
@@ -218,10 +221,16 @@ class ParameterAveragingTrainingMaster(TrainingMaster):
             (p, s, u, _), losses = jax.lax.scan(body, (p, s, u, it0), (xs, ys))
             return ex(p), ex(s), ex(u), jax.lax.pmean(jnp.mean(losses), "data")
 
-        local = jax.jit(shard_map(
-            local_steps, mesh=mesh,
+        # compiled through the seam with check_vma=False so flash/LSTM
+        # pallas kernels engage inside the per-replica body (a checked
+        # shard_map downgrades them to XLA math); outputs are replicated by
+        # the body's own pmean, so unchecked is safe
+        local = compile_step(
+            "TrainingMaster.local_steps", local_steps, mesh=mesh,
+            rule_set="dp",
             in_specs=(stacked, stacked, stacked, stacked, stacked, repl, repl),
-            out_specs=(stacked, stacked, stacked, repl)))
+            out_specs=(stacked, stacked, stacked, repl),
+            strategy="shard_map", check_vma=False, cache_key=key)
 
         def average(params, states, upd):
             mean_b = lambda a: jnp.broadcast_to(
@@ -232,10 +241,9 @@ class ParameterAveragingTrainingMaster(TrainingMaster):
                 upd = jax.tree_util.tree_map(mean_b, upd)
             return params, states, upd
 
-        fns = (_compile_tracker().wrap("TrainingMaster.local_steps",
-                                       local, cache_key=key),
-               _compile_tracker().wrap("TrainingMaster.average",
-                                       jax.jit(average), cache_key=key))
+        fns = (local,
+               compile_step("TrainingMaster.average", average, mesh=mesh,
+                            rule_set="dp", strategy="jit", cache_key=key))
         self._local_fns[key] = fns
         return fns
 
@@ -248,7 +256,7 @@ class ParameterAveragingTrainingMaster(TrainingMaster):
         shard_map call, then parameters (+ updater state) are averaged."""
         D, F = self.num_workers, self.averaging_frequency
         local, average = self._fns_for(model)
-        sharding = NamedSharding(self.mesh, P("data"))
+        sharding = _named_sharding(self.mesh, P("data"))
         stack = functools.partial(
             jax.tree_util.tree_map,
             lambda a: jax.device_put(
@@ -387,15 +395,18 @@ class DistributedMultiLayer:
         eff = common.effective_policy_key(conf_dtype)
         if self._eval_fwd is None or self._eval_fwd_policy != eff:
             self._eval_fwd_policy = eff
-            repl = NamedSharding(mesh, P())
-            batch_sh = NamedSharding(mesh, P("data"))
             if isinstance(net, MultiLayerNetwork):
                 fwd_py = lambda p, s, x: net._output_pure(p, s, x, train=False)[0]
             else:
                 fwd_py = lambda p, s, x: net._output_pure(p, s, [x])[0][0]
-            # a conf-declared dtype pins this program like LazyScore._jit does
-            self._eval_fwd = jax.jit(common.wrap_with_policy(fwd_py, conf_dtype),
-                                     in_shardings=(repl, repl, batch_sh))
+            # a conf-declared dtype pins this program like LazyScore._jit
+            # does; the seam adds CompileTracker attribution the old ad-hoc
+            # jit lacked
+            self._eval_fwd = compile_step(
+                "DistributedMultiLayer.eval_fwd",
+                common.wrap_with_policy(fwd_py, conf_dtype), mesh=mesh,
+                rule_set="dp", in_specs=(P(), P(), P("data")),
+                strategy="jit", cache_key=eff)
         fwd = self._eval_fwd
         params, states = net.params_list, net.state_list
         e = Evaluation()

@@ -31,8 +31,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from deeplearning4j_tpu.jax_compat import shard_map
+from jax.sharding import Mesh
 
 from deeplearning4j_tpu.datasets.iterators import AsyncDataSetIterator
 from deeplearning4j_tpu.observability.compile_tracker import (
@@ -50,6 +49,11 @@ from deeplearning4j_tpu.observability.metrics import (
     global_registry as _obs_registry, tree_nbytes as _tree_nbytes,
 )
 from deeplearning4j_tpu.parallel.mesh import data_parallel_mesh
+from deeplearning4j_tpu.parallel.compile_seam import compile_step
+from deeplearning4j_tpu.parallel.partition import (
+    pspec as P, named_sharding as _named_sharding, match_partition_rules,
+    rules_for,
+)
 
 # step-time attribution shares the fit-phase histogram with the single-chip
 # loops; the collective counter sizes DP traffic host-side per dispatch (the
@@ -85,6 +89,7 @@ class ParallelWrapperBuilder:
         self._capacity_factor = 2.0
         self._zero1 = False
         self._fsdp = False
+        self._sharding: Optional[str] = None
 
     def workers(self, n: int) -> "ParallelWrapperBuilder":
         self._workers = n
@@ -149,6 +154,20 @@ class ParallelWrapperBuilder:
         self._zero1 = flag
         return self
 
+    def sharding(self, rule_set: str) -> "ParallelWrapperBuilder":
+        """Pick a partition-rule set by name (parallel/partition.py):
+
+        * ``"dp"`` — replicate params, shard the batch (the default).
+        * ``"dp_tp"`` — Megatron tensor parallelism over the 'model' mesh
+          axis on top of data parallelism (mesh must carry both axes).
+        * ``"zero3"`` — params AND optimizer state sharded over 'data'
+          (equivalent to .shard_parameters() + .shard_optimizer_state()).
+
+        This is the config-choice face of the engine: the mesh shape plus a
+        rule-set name replaces hand-wired sharding code paths."""
+        self._sharding = rule_set
+        return self
+
     def build(self) -> "ParallelWrapper":
         return ParallelWrapper(self._model, workers=self._workers,
                                prefetch=self._prefetch,
@@ -160,7 +179,8 @@ class ParallelWrapperBuilder:
                                expert_parallel_axis=self._expert_axis,
                                capacity_factor=self._capacity_factor,
                                shard_optimizer_state=self._zero1,
-                               shard_parameters=self._fsdp)
+                               shard_parameters=self._fsdp,
+                               sharding=self._sharding)
 
 
 class ParallelWrapper:
@@ -172,7 +192,8 @@ class ParallelWrapper:
                  expert_parallel_axis: Optional[str] = None,
                  capacity_factor: float = 2.0,
                  shard_optimizer_state: bool = False,
-                 shard_parameters: bool = False):
+                 shard_parameters: bool = False,
+                 sharding: Optional[str] = None):
         self.model = model
         self.mesh = mesh or data_parallel_mesh(workers)
         self.n_workers = self.mesh.shape["data"]
@@ -182,6 +203,22 @@ class ParallelWrapper:
         self.capacity_factor = capacity_factor
         self.zero1 = shard_optimizer_state
         self.fsdp = shard_parameters
+        if sharding not in (None, "dp", "dp_tp", "zero3"):
+            raise ValueError(f"unknown sharding rule set {sharding!r}; "
+                             "expected 'dp', 'dp_tp', or 'zero3'")
+        self.rule_set = sharding
+        if sharding == "zero3":
+            # zero3 = the full decomposition: params AND optimizer state
+            # sharded over 'data'; the flags below drive the spec trees
+            self.zero1 = self.fsdp = True
+        if sharding == "dp_tp":
+            if "model" not in self.mesh.shape:
+                raise ValueError("sharding('dp_tp') needs a mesh with a "
+                                 "'model' axis, e.g. build_mesh({'data': 4, "
+                                 "'model': 2})")
+            if averaging_frequency != 1:
+                raise ValueError("sharding('dp_tp') requires "
+                                 "averaging_frequency == 1 (synchronous DP)")
         if (self.zero1 or self.fsdp) and averaging_frequency != 1:
             raise ValueError("shard_optimizer_state/shard_parameters "
                              "(ZeRO/FSDP) require averaging_frequency == 1 "
@@ -290,57 +327,52 @@ class ParallelWrapper:
             return P("data", self.seq_axis)
         return P("data")
 
-    def _tree_shardings(self, state_tree, what: str):
-        """Per-leaf 'data'-axis shardings for a param-shaped pytree — the
-        ONE layout rule behind ZeRO-1 (updater state) and FSDP (params).
+    def _rule_label(self) -> str:
+        """Rule-set name for telemetry + CompileTracker attribution."""
+        if self.rule_set:
+            return self.rule_set
+        if self.fsdp or self.zero1:
+            return "zero3"
+        return "dp"
 
-        Shards the FIRST divisible dim — any split works for storage, but
-        leading-dim splits propagate most cleanly through GSPMD (later dims
-        invited involuntary-remat reshards in practice); leading-dim-ONLY
-        would silently replicate every weight whose fan-in isn't a multiple
-        of n_workers, hence the fallback scan over the remaining dims.
-        Indivisible leaves (small biases) stay replicated; an explicit
-        request that would shard NOTHING raises (same engage-or-fail
-        principle as expert_parallel validation)."""
-        D = self.n_workers
-
-        def leaf(a):
-            for d in range(getattr(a, "ndim", 0)):
-                if a.shape[d] % D == 0 and a.shape[d] > 0:
-                    spec = [None] * a.ndim
-                    spec[d] = "data"
-                    return NamedSharding(self.mesh, P(*spec))
-            return NamedSharding(self.mesh, P())
-
-        tree = jax.tree_util.tree_map(leaf, state_tree)
-        leaves = jax.tree_util.tree_leaves(state_tree)
-        sharded = any(sh.spec != P()
-                      for sh in jax.tree_util.tree_leaves(tree))
-        if leaves and not sharded:
+    def _matched_specs(self, rules, tree, what: str):
+        """Run the partition-rule engine over a param-shaped pytree; an
+        explicit sharding request that would shard NOTHING raises (same
+        engage-or-fail principle as the expert_parallel validation —
+        indivisible leaves demote to replicated per-leaf, but a fully
+        replicated result means the request silently did nothing)."""
+        specs = match_partition_rules(rules, tree, mesh=self.mesh,
+                                      conf=self.model.conf)
+        leaves = jax.tree_util.tree_leaves(tree)
+        if leaves and all(s == P() for s in jax.tree_util.tree_leaves(specs)):
             raise ValueError(
-                f"{what}: no dimension is divisible by the data axis size "
-                f"{D}; nothing would shard")
-        return tree
+                f"{what}: no dimension is divisible by the mesh axis; "
+                f"nothing would shard")
+        return specs
 
-    def _upd_shardings(self, repl):
-        """ZeRO-1: updater state (Adam moments etc.) sharded over 'data' —
-        per-device optimizer memory drops n_workers-fold; GSPMD inserts the
-        gather feeding the parameter update (the reduce-scatter/all-gather
-        decomposition ZeRO-1 prescribes)."""
-        if not self.zero1:
-            return repl
-        return self._tree_shardings(self.model.updater_state,
-                                    "shard_optimizer_state()")
-
-    def _param_shardings(self, repl):
-        """FSDP / ZeRO-3: parameters themselves sharded over 'data' —
-        per-device param memory drops n_workers-fold; GSPMD all-gathers
-        each weight just-in-time for its layer and reduce-scatters its
-        gradient, the standard fully-sharded decomposition."""
-        if not self.fsdp:
-            return repl
-        return self._tree_shardings(self.model.params_list,
-                                    "shard_parameters()")
+    def _spec_trees(self):
+        """(param_specs, upd_specs) from the rule engine — either a P()
+        prefix (replicated) or full spec pytrees. dp_tp applies the Megatron
+        column/row rules to params AND their optimizer moments; fsdp/zero1/
+        zero3 apply the first-divisible-dim ZeRO scan over 'data' (GSPMD
+        all-gathers each weight just-in-time and reduce-scatters its
+        gradient — per-device memory drops n_workers-fold)."""
+        net = self.model
+        if self.rule_set == "dp_tp":
+            rules = rules_for("dp_tp")
+            par = self._matched_specs(rules, net.params_list,
+                                      "sharding('dp_tp')")
+            upd = match_partition_rules(rules, net.updater_state,
+                                        mesh=self.mesh, conf=net.conf)
+            return par, upd
+        par, upd = P(), P()
+        if self.fsdp:
+            par = self._matched_specs(rules_for("zero3"), net.params_list,
+                                      "shard_parameters()")
+        if self.zero1:
+            upd = self._matched_specs(rules_for("zero3"), net.updater_state,
+                                      "shard_optimizer_state()")
+        return par, upd
 
     # ------------------------------------------------------------------ public API
     @_dump_on_unhandled("ParallelWrapper.fit")
@@ -360,7 +392,6 @@ class ParallelWrapper:
 
         net = self.model
         mesh = self.mesh
-        repl = NamedSharding(mesh, P())
         if isinstance(net, MultiLayerNetwork):
             base = make_train_step(net.conf)
         else:
@@ -378,16 +409,14 @@ class ParallelWrapper:
         # policy the weight-grad contractions emit wide (f32) cotangents
         # (preferred_element_type routing in the layers), so the DP reduce
         # itself accumulates wide — no extra plumbing needed here.
-        upd_sh = self._upd_shardings(repl)
-        par_sh = self._param_shardings(repl)
-        return _compile_tracker().wrap(
-            "ParallelWrapper.sync_step",
-            jax.jit(
-                step,
-                in_shardings=(par_sh, repl, upd_sh, None, None, repl, repl),
-                out_shardings=(par_sh, repl, upd_sh, repl),
-            ),
-            cache_key=self._traced_policy)
+        par_sp, upd_sp = self._spec_trees()
+        return compile_step(
+            "ParallelWrapper.sync_step", step, mesh=mesh,
+            rule_set=self._rule_label(),
+            in_specs=(par_sp, P(), upd_sp, None, None, P(), P()),
+            out_specs=(par_sp, P(), upd_sp, P()),
+            strategy="jit", cache_key=self._traced_policy,
+            params=net.params_list, param_specs=par_sp)
 
     def _make_sync_multistep(self):
         """K-step scanned train step with the stacked batch axis sharded over
@@ -399,7 +428,6 @@ class ParallelWrapper:
 
         net = self.model
         mesh = self.mesh
-        repl = NamedSharding(mesh, P())
         if isinstance(net, MultiLayerNetwork):
             base = make_multistep_train_step(net.conf)
         else:
@@ -411,16 +439,13 @@ class ParallelWrapper:
             with self._trace_ctx():
                 return base(params, states, upd, xs, ys, rng, it0)
 
-        upd_sh = self._upd_shardings(repl)
-        par_sh = self._param_shardings(repl)
-        return _compile_tracker().wrap(
-            "ParallelWrapper.sync_multistep",
-            jax.jit(
-                multi,
-                in_shardings=(par_sh, repl, upd_sh, None, None, repl, repl),
-                out_shardings=(par_sh, repl, upd_sh, repl),
-            ),
-            cache_key=self._traced_policy)
+        par_sp, upd_sp = self._spec_trees()
+        return compile_step(
+            "ParallelWrapper.sync_multistep", multi, mesh=mesh,
+            rule_set=self._rule_label(),
+            in_specs=(par_sp, P(), upd_sp, None, None, P(), P()),
+            out_specs=(par_sp, P(), upd_sp, P()),
+            strategy="jit", cache_key=self._traced_policy)
 
     def _stage(self, arr, spec: P):
         """Host batch -> device array laid out for the jit's in_shardings.
@@ -432,7 +457,7 @@ class ParallelWrapper:
         reference's Spark executors each taking their partition of the RDD
         (ParameterAveragingTrainingMaster.executeTraining:344)."""
         arr = np.asarray(arr)
-        sharding = NamedSharding(self.mesh, spec)
+        sharding = _named_sharding(self.mesh, spec)
         if jax.process_count() == 1:
             return jax.device_put(jnp.asarray(arr), sharding)
         return jax.make_array_from_callback(arr.shape, sharding,
@@ -619,14 +644,18 @@ class ParallelWrapper:
             p2, s2, u2, loss = base(p, s, u, x, y, rng_local, it)
             return ex(p2), ex(s2), ex(u2), jax.lax.pmean(loss, "data")
 
-        local = _compile_tracker().wrap(
-            "ParallelWrapper.local_sgd_step",
-            jax.jit(shard_map(
-                local_step, mesh=mesh,
-                in_specs=(stacked, stacked, stacked, stacked, stacked, repl,
-                          repl),
-                out_specs=(stacked, stacked, stacked, repl),
-            )),
+        # check_vma=False through the seam: the vma checker rejects
+        # pallas_call, so a checked body would silently downgrade flash/LSTM
+        # kernels to XLA math inside every local step — the outputs are made
+        # replicated by the body's own pmean, so unchecked is safe (the
+        # ulysses precedent, parallel/ring_attention.py)
+        local = compile_step(
+            "ParallelWrapper.local_sgd_step", local_step, mesh=mesh,
+            rule_set=self._rule_label(),
+            in_specs=(stacked, stacked, stacked, stacked, stacked, repl,
+                      repl),
+            out_specs=(stacked, stacked, stacked, repl),
+            strategy="shard_map", check_vma=False,
             cache_key=self._traced_policy)
 
         def average(params, upd, states):
@@ -648,8 +677,9 @@ class ParallelWrapper:
             states = jax.tree_util.tree_map(mean_bcast, states)
             return avg, upd, states
 
-        avg_fn = _compile_tracker().wrap(
-            "ParallelWrapper.average", jax.jit(average),
+        avg_fn = compile_step(
+            "ParallelWrapper.average", average, mesh=mesh,
+            rule_set=self._rule_label(), strategy="jit",
             cache_key=self._traced_policy)
         return local, avg_fn
 
@@ -662,12 +692,12 @@ class ParallelWrapper:
         stack = functools.partial(
             jax.tree_util.tree_map,
             lambda a: jnp.broadcast_to(a[None], (D,) + a.shape))
-        sharding = NamedSharding(self.mesh, P("data"))
+        sharding = _named_sharding(self.mesh, P("data"))
         params = jax.device_put(stack(net.params_list), sharding) \
             if jax.tree_util.tree_leaves(net.params_list) else net.params_list
         states = stack(net.state_list)
         upd = stack(net.updater_state)
-        batch_sh = NamedSharding(self.mesh, P("data"))
+        batch_sh = _named_sharding(self.mesh, P("data"))
         from deeplearning4j_tpu.nn.graph_network import (
             ComputationGraph, _coerce_graph_batch)
 

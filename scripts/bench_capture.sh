@@ -59,6 +59,11 @@ run --model transformer --bf16-matmul
 # carries the scan/fused/pallas three-way A/B of the recurrent engine at MXU
 # width — capture-first, so the first healthy window prices the new path
 run --model char_rnn --hidden 1024
+# sharding-engine headline rows (ISSUE 8): the flagship fit paths through
+# the partition-rule compile seam — zero3's record must show ~1/N
+# param_bytes_per_device, dp_tp prices the Megatron column/row splits
+run --model fit_resnet50 --sharding zero3
+run --model transformer --sharding dp_tp
 if [ "$MODE" = full ]; then
     run --model lenet
     run --model lenet --bf16-act
@@ -79,6 +84,12 @@ if [ "$MODE" = full ]; then
     run --model attention --seq 16384
     run --model fit_resnet50
     run --model fit_lenet
+    # full sharding grid: dp baselines the seam's overhead vs the bare fit
+    # rows above; the remaining modes complete the per-rule-set comparison
+    run --model fit_resnet50 --sharding dp
+    run --model fit_resnet50 --sharding dp_tp
+    run --model transformer --sharding dp
+    run --model transformer --sharding zero3
     # batch sweep for the flagship at the winning dtype
     run --model resnet50 --batch 64
     run --model resnet50 --batch 256

@@ -303,6 +303,34 @@ def test_config_key_lstm_impl_axis():
     assert old["lstm_impl"] == "scan" and new["lstm_impl"] == "auto"
 
 
+def test_config_key_sharding_axis():
+    """--sharding is config-distinct for the flagship fit models (a dp_tp
+    row must not stand in for the single-device headline), non-capable
+    models don't grow a phantom axis, and rows logged before the sharding
+    engine landed reinterpret as the single-device path they actually
+    measured — the same timestamp-guard pattern as the other axis gates."""
+    import bench
+
+    a = bench._config_key("--model transformer")
+    b = bench._config_key("--model transformer --sharding dp_tp")
+    assert a != b and a["sharding"] is None and b["sharding"] == "dp_tp"
+    assert bench._config_key(
+        "--model fit_resnet50 --sharding zero3")["sharding"] == "zero3"
+    # non-capable models don't grow a phantom axis
+    assert bench._config_key("--model char_rnn")["sharding"] is None
+    assert bench._SHARDING_CAPABLE == frozenset(
+        {"fit_resnet50", "transformer"})
+    # pre-engine rows measured the single-device path, whatever a later
+    # reader asks for
+    old = bench._config_key("--model transformer --sharding dp",
+                            ts="2026-08-05T19:59:59Z")
+    new = bench._config_key("--model transformer --sharding dp",
+                            ts="2026-08-05T20:00:01Z")
+    assert old["sharding"] is None and new["sharding"] == "dp"
+    ts = bench._SHARDING_AXIS_LANDED_TS
+    assert ts.endswith("Z") and ts > bench._XPLANE_ATTRIBUTION_LANDED_TS
+
+
 def test_xplane_attribution_contract():
     """xplane attribution is measurement-only and ts-gated: the flag never
     makes a config distinct (a prior healthy row stands in during an

@@ -416,6 +416,56 @@ def test_swallowed_exception_suppressed(tmp_path):
     assert [v.rule for v in res.suppressed] == ["swallowed-exception"]
 
 
+# ------------------------------------------------------------ adhoc-sharding
+def test_adhoc_sharding_positive(tmp_path):
+    res = lint_src(tmp_path, """\
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        def place(mesh, x):
+            s = NamedSharding(mesh, P("data"))
+            t = jax.sharding.PartitionSpec(None, "model")
+            return s, t
+        """, rules=["adhoc-sharding"])
+    assert rules_of(res) == ["adhoc-sharding", "adhoc-sharding"]
+
+
+def test_adhoc_sharding_negative(tmp_path):
+    # engine-sanctioned constructors and unrelated names of the same spelling
+    res = lint_src(tmp_path, """\
+        from deeplearning4j_tpu.parallel import partition
+
+        def PartitionSpec(x):  # local helper, not jax.sharding's
+            return x
+
+        def place(mesh, tree):
+            spec = partition.pspec("data")
+            PartitionSpec(spec)
+            return partition.named_sharding(mesh, spec)
+        """, rules=["adhoc-sharding"])
+    assert res.violations == []
+
+
+def test_adhoc_sharding_suppressed(tmp_path):
+    res = lint_src(tmp_path, """\
+        from jax.sharding import NamedSharding
+
+        def stage(mesh, spec, x):
+            # lint: adhoc-sharding-ok (host staging buffer, not a layout decision)
+            s = NamedSharding(mesh, spec)
+            return s
+        """, rules=["adhoc-sharding"])
+    assert res.violations == []
+    assert [v.rule for v in res.suppressed] == ["adhoc-sharding"]
+
+
+def test_adhoc_sharding_excludes_engine_files():
+    rule = next(r for r in lint.default_rules()
+                if r.name == "adhoc-sharding")
+    assert any("partition.py" in g for g in rule.exclude)
+    assert any("compile_seam.py" in g for g in rule.exclude)
+
+
 # ------------------------------------------------------- suppression grammar
 def test_suppression_without_reason_rejected(tmp_path):
     res = lint_src(tmp_path, """\

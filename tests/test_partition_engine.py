@@ -1,0 +1,385 @@
+"""Partition-rule sharding engine + compile seam (parallel/partition.py,
+parallel/compile_seam.py).
+
+Pins the PR-8 contract: named-tree walking, regex rule matching with
+first-match-wins precedence, scalar/tiny fall-through, the hard error on
+unmatched non-scalar leaves, divisibility demotion, the Megatron dp_tp
+semantics, ZeRO-3 per-device byte accounting (gauge), the
+Pallas-under-shard_map engagement fix through the seam, and — the
+gold-standard check (reference TestCompareParameterAveragingSparkVs
+SingleMachine, SURVEY.md §4) — that dp / dp_tp / zero3 training through
+``.sharding(rule_set)`` is numerically equivalent to single-device fit.
+"""
+import jax
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.iterators import ListDataSetIterator
+from deeplearning4j_tpu.models import transformer_lm
+from deeplearning4j_tpu.nn.conf.builders import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.observability.metrics import (
+    global_registry, tree_nbytes)
+from deeplearning4j_tpu.parallel import partition
+from deeplearning4j_tpu.parallel.compile_seam import compile_step
+from deeplearning4j_tpu.parallel.mesh import build_mesh
+from deeplearning4j_tpu.parallel.partition import (
+    Col, FirstDivisible, PartitionRuleError, Row, dp_tp_rules,
+    match_partition_rules, model_top_names, named_tree_map, pspec as P,
+    per_device_bytes, rules_for, zero3_rules)
+from deeplearning4j_tpu.parallel.wrapper import ParallelWrapper
+
+
+# --------------------------------------------------------------- tree walk
+def test_named_tree_map_joins_paths():
+    tree = {"a": {"W": np.zeros((2, 2))}, "b": [np.zeros(3), np.zeros(2)]}
+    seen = {}
+    named_tree_map(lambda p, leaf: seen.setdefault(p, leaf.shape), tree)
+    assert sorted(seen) == ["a/W", "b/0", "b/1"]
+
+
+def test_named_tree_map_top_names_rewrite():
+    tree = [{"W": np.zeros((2, 2))}, {"W": np.zeros((2, 2))}]
+    paths = []
+    named_tree_map(lambda p, _l: paths.append(p), tree,
+                   top_names={"0": "0.DenseLayer", "1": "1.OutputLayer"})
+    assert sorted(paths) == ["0.DenseLayer/W", "1.OutputLayer/W"]
+
+
+def test_model_top_names_from_list_conf():
+    conf = (NeuralNetConfiguration.builder().list()
+            .layer(DenseLayer(n_in=4, n_out=8))
+            .layer(OutputLayer(n_in=8, n_out=2)).build())
+    names = model_top_names([{}, {}], conf)
+    assert names == {"0": "0.DenseLayer", "1": "1.OutputLayer"}
+
+
+# ------------------------------------------------------------ rule matching
+def test_rule_precedence_first_match_wins():
+    mesh = build_mesh({"data": 8})
+    tree = {"layer": {"W": np.zeros((8, 4)), "V": np.zeros((8, 4))}}
+    rules = [(r"/W(/|$)", FirstDivisible("data")), (r".*", P())]
+    specs = match_partition_rules(rules, tree, mesh=mesh)
+    assert specs["layer"]["W"] == P("data")
+    assert specs["layer"]["V"] == P()
+    # the same specific rule AFTER the catch-all never fires: precedence is
+    # positional, so "prepend to override" is the extension idiom
+    flipped = match_partition_rules(list(reversed(rules)), tree, mesh=mesh)
+    assert flipped["layer"]["W"] == P()
+
+
+def test_scalar_and_tiny_leaves_fall_through():
+    mesh = build_mesh({"data": 8})
+    tree = {"l": {"s": np.float32(3.0), "one": np.zeros((1,)),
+                  "tiny": np.zeros((3,)), "big": np.zeros((8,))}}
+    specs = match_partition_rules([(r".*", FirstDivisible("data"))],
+                                  tree, mesh=mesh)
+    assert specs["l"]["s"] == P()          # scalar: never consults rules
+    assert specs["l"]["one"] == P()        # size-1
+    assert specs["l"]["tiny"] == P()       # 1-D below TINY_VECTOR
+    assert specs["l"]["big"] == P("data")  # at the floor: rules apply
+
+
+def test_unmatched_nonscalar_leaf_is_a_hard_error():
+    with pytest.raises(PartitionRuleError, match="no partition rule"):
+        match_partition_rules([(r"/W(/|$)", P())],
+                              {"layer": {"Q": np.zeros((8, 8))}})
+    # ... but scalars don't need a rule at all
+    specs = match_partition_rules([], {"layer": {"s": np.float32(0)}})
+    assert specs["layer"]["s"] == P()
+
+
+def test_rule_values_are_rank_polymorphic():
+    mesh = build_mesh({"data": 4, "model": 2})
+    tree = {"l": {"dense": np.zeros((8, 16)),
+                  "conv": np.zeros((3, 3, 8, 16)),
+                  "experts": np.zeros((4, 8, 6)),
+                  "bias": np.zeros((16,))}}
+    col = match_partition_rules([(r".*", Col("model"))], tree, mesh=mesh)
+    assert col["l"]["dense"] == P(None, "model")
+    assert col["l"]["conv"] == P(None, None, None, "model")
+    assert col["l"]["experts"] == P(None, None, "model")
+    assert col["l"]["bias"] == P("model")
+    row = match_partition_rules([(r".*", Row("model"))], tree, mesh=mesh)
+    assert row["l"]["dense"] == P("model", None)
+    assert row["l"]["conv"] == P(None, None, "model", None)
+    assert row["l"]["bias"] == P()        # 1-D: row-split bias replicates
+    z = match_partition_rules([(r".*", FirstDivisible("data"))], tree,
+                              mesh=mesh)
+    assert z["l"]["dense"] == P("data")            # 8 % 4 == 0
+    assert z["l"]["experts"] == P("data")          # dim0 4 % 4 == 0
+    assert z["l"]["conv"] == P(None, None, "data")  # 3,3 indivisible; 8 is
+
+
+def test_indivisible_dims_demote_to_replicated():
+    mesh = build_mesh({"data": 4, "model": 2})
+    tree = {"l": {"odd": np.zeros((8, 15)), "skinny": np.zeros((5, 3))}}
+    specs = match_partition_rules([(r".*", Col("model"))], tree, mesh=mesh)
+    assert specs["l"]["odd"] == P()       # 15 % 2 != 0
+    assert specs["l"]["skinny"] == P()
+    # a plain-PartitionSpec rule value demotes the same way
+    specs = match_partition_rules([(r".*", P("data"))], tree, mesh=mesh)
+    assert specs["l"]["odd"] == P("data")  # 8 % 4 == 0
+    assert specs["l"]["skinny"] == P()     # 5 % 4 != 0
+
+
+def test_dp_tp_rules_megatron_semantics():
+    """Column-split up-projections + their biases; row-split down-projections
+    with replicated biases; gate/norm params replicated. One rule covers a
+    param and its optimizer moments (the moment path extends the param's)."""
+    mesh = build_mesh({"data": 4, "model": 2})
+    blk = {"Wqkv": np.zeros((32, 96)), "Wo": np.zeros((32, 32)),
+           "W1": np.zeros((32, 64)), "W2": np.zeros((64, 32)),
+           "b1": np.zeros((64,)), "b2": np.zeros((32,)),
+           "Wg": np.zeros((32, 8)), "g1": np.zeros((32,))}
+    tree = {"blk": blk,
+            "opt": {"Wqkv": {"m": np.zeros((32, 96))}}}
+    specs = match_partition_rules(dp_tp_rules(), tree, mesh=mesh)
+    assert specs["blk"]["Wqkv"] == P(None, "model")
+    assert specs["blk"]["Wo"] == P("model", None)
+    assert specs["blk"]["W1"] == P(None, "model")
+    assert specs["blk"]["W2"] == P("model", None)
+    assert specs["blk"]["b1"] == P("model")
+    assert specs["blk"]["b2"] == P()   # row-split partner bias: replicated
+    assert specs["blk"]["Wg"] == P()   # MoE gate: replicated
+    assert specs["blk"]["g1"] == P()   # norm gain: replicated
+    # the moment inherits the param's rule via the extended path .../Wqkv/m
+    assert specs["opt"]["Wqkv"]["m"] == P(None, "model")
+
+
+def test_rules_for_unknown_name():
+    with pytest.raises(ValueError, match="unknown rule set"):
+        rules_for("fsdp2")
+
+
+# ---------------------------------------------------- byte accounting/gauge
+def test_per_device_bytes_and_gauge_zero3():
+    mesh = build_mesh({"data": 8})
+    tree = {"l": {"W": np.zeros((16, 4), np.float32),
+                  "b": np.zeros((3,), np.float32)}}
+    specs = match_partition_rules(zero3_rules(), tree, mesh=mesh)
+    # W sharded 8-way (256 -> 32), tiny b stays whole (12)
+    assert per_device_bytes(tree, specs, mesh) == 32 + 12
+    # a bare P() prefix means fully replicated
+    assert per_device_bytes(tree, P(), mesh) == tree_nbytes(tree)
+
+    recorded = partition.record_param_bytes("ut_zero3", tree, specs, mesh)
+    assert recorded == 44
+    series = global_registry().snapshot()[
+        "dl4j_sharded_param_bytes_per_device"]["series"]
+    vals = {s["labels"]["rule_set"]: s["value"] for s in series}
+    assert vals["ut_zero3"] == 44
+
+
+def test_spec_counter_records_resolved_specs():
+    before = _spec_counts("ut_counter")
+    partition.record_specs("ut_counter",
+                           [P("data"), P()], {"x": P(None, "model")})
+    after = _spec_counts("ut_counter")
+    assert after.get("P(data)", 0) - before.get("P(data)", 0) == 1
+    assert after.get("P()", 0) - before.get("P()", 0) == 1
+    assert after.get("P(None,model)", 0) - before.get("P(None,model)", 0) == 1
+
+
+def _spec_counts(rule_set):
+    snap = global_registry().snapshot().get(
+        "dl4j_sharding_spec_total", {"series": []})
+    return {s["labels"]["spec"]: s["value"] for s in snap["series"]
+            if s["labels"]["rule_set"] == rule_set}
+
+
+# ------------------------------------------- pallas engagement through seam
+def _dispatch_counts():
+    snap = global_registry().snapshot().get(
+        "dl4j_pallas_dispatch_total", {"series": []})
+    return {(s["labels"]["kernel"], s["labels"]["engaged"]): s["value"]
+            for s in snap["series"]}
+
+
+def test_pallas_engages_under_seam_shard_map():
+    """THE regression the seam's check_vma=False default exists for: a flash
+    kernel inside a shard_map body compiled through compile_step must ENGAGE
+    (interpret mode on CPU), where a vma-checked body silently downgrades it
+    to XLA math. Pinned via the dispatch counter, which counts per trace."""
+    from deeplearning4j_tpu.ops.pallas_kernels import flash_attention
+
+    mesh = build_mesh({"data": 8})
+    rng = np.random.default_rng(0)
+    q, k, v = (np.asarray(rng.normal(size=(8, 64, 2, 8)), np.float32)
+               for _ in range(3))
+
+    def body(qq, kk, vv):
+        return flash_attention(qq, kk, vv, False, interpret=True)
+
+    before = _dispatch_counts()
+    step = compile_step("ut.flash_unchecked", body, mesh=mesh,
+                        rule_set="dp", in_specs=(P("data"),) * 3,
+                        out_specs=P("data"), strategy="shard_map",
+                        check_vma=False)
+    out = np.asarray(step(q, k, v))
+    assert out.shape == q.shape and np.isfinite(out).all()
+    mid = _dispatch_counts()
+    key_t = ("flash_attention", "true")
+    key_f = ("flash_attention", "false")
+    assert mid.get(key_t, 0) > before.get(key_t, 0)
+
+    # contrast: the checked body must NOT engage (counter says so too)
+    checked = compile_step("ut.flash_checked", body, mesh=mesh,
+                           rule_set="dp", in_specs=(P("data"),) * 3,
+                           out_specs=P("data"), strategy="shard_map",
+                           check_vma=True)
+    np.asarray(checked(q, k, v))
+    after = _dispatch_counts()
+    assert after.get(key_f, 0) > mid.get(key_f, 0)
+    assert after.get(key_t, 0) == mid.get(key_t, 0)
+
+
+def test_compile_step_rejects_unknown_strategy():
+    mesh = build_mesh({"data": 8})
+    with pytest.raises(ValueError, match="unknown compile strategy"):
+        compile_step("ut.bad", lambda x: x, mesh=mesh, rule_set="dp",
+                     strategy="pmap")
+
+
+# -------------------------------------------------------- equivalence suite
+VOCAB, WIDTH, HEADS, T, B = 8, 32, 4, 16, 8
+
+
+def _lm_batches(n=3, seed=0):
+    rng = np.random.default_rng(seed)
+    out = []
+    for _ in range(n):
+        ids = rng.integers(0, VOCAB, size=(B, T + 1))
+        x = np.eye(VOCAB, dtype=np.float32)[ids[:, :-1]]
+        y = np.eye(VOCAB, dtype=np.float32)[ids[:, 1:]]
+        out.append(DataSet(x, y))
+    return out
+
+
+def _dense_conf(seed=7):
+    return (NeuralNetConfiguration.builder().seed(seed).learning_rate(0.05)
+            .updater("adam").list()
+            .layer(DenseLayer(n_in=8, n_out=16, activation="tanh"))
+            .layer(OutputLayer(n_in=16, n_out=3, loss="mcxent",
+                               activation="softmax")).build())
+
+
+def _dense_batches(n=4, seed=0, b=32):
+    rng = np.random.default_rng(seed)
+    out = []
+    for _ in range(n):
+        x = rng.normal(size=(b, 8)).astype(np.float32)
+        y = np.zeros((b, 3), np.float32)
+        y[np.arange(b), rng.integers(0, 3, b)] = 1
+        out.append(DataSet(x, y))
+    return out
+
+
+def _single_device_fit(conf, batches):
+    net = MultiLayerNetwork(conf).init()
+    for ds in batches:
+        net.fit(ds.features, ds.labels)
+    return net
+
+
+def test_dp_tp_sharding_equals_single_device():
+    """.sharding('dp_tp') on a {data, model} mesh: Megatron splits on the
+    attention/MLP weights, same numbers as dense single-device training —
+    the specs are layout hints, GSPMD inserts the collectives."""
+    batches = _lm_batches()
+    conf = lambda: transformer_lm(VOCAB, width=WIDTH, n_layers=2,
+                                  n_heads=HEADS, max_len=T,
+                                  learning_rate=0.01)
+    single = _single_device_fit(conf(), batches)
+
+    net = MultiLayerNetwork(conf()).init()
+    mesh = build_mesh({"data": 4, "model": 2})
+    pw = (ParallelWrapper.builder(net).mesh(mesh).prefetch_buffer(0)
+          .sharding("dp_tp").build())
+    pw.fit(ListDataSetIterator(batches))
+    np.testing.assert_allclose(np.asarray(single.params()),
+                               np.asarray(net.params()),
+                               atol=1e-4, rtol=1e-4)
+    # the engine actually split something: a TP-sharded leaf holds half
+    wqkv = next(p["Wqkv"] for p in net.params_list if "Wqkv" in p)
+    assert wqkv.addressable_shards[0].data.nbytes * 2 == wqkv.nbytes
+
+
+def test_zero3_sharding_equals_single_device():
+    """.sharding('zero3'): params AND moments live ~1/N per device (pinned
+    through the new gauge), training equals single-device fit exactly."""
+    batches = _dense_batches()
+    single = _single_device_fit(_dense_conf(), batches)
+
+    net = MultiLayerNetwork(_dense_conf()).init()
+    pw = (ParallelWrapper.builder(net).workers(8).prefetch_buffer(0)
+          .sharding("zero3").build())
+    pw.fit(ListDataSetIterator(batches))
+    np.testing.assert_allclose(np.asarray(single.params()),
+                               np.asarray(net.params()), atol=2e-6)
+    w = net.params_list[0]["W"]                  # (8, 16): dim0 8-way
+    assert w.addressable_shards[0].data.nbytes * 8 == w.nbytes
+    m = net.updater_state[1]["W"]["m"]           # moments ride the same rule
+    assert m.addressable_shards[0].data.nbytes * 8 == m.nbytes
+
+    series = global_registry().snapshot()[
+        "dl4j_sharded_param_bytes_per_device"]["series"]
+    vals = {s["labels"]["rule_set"]: s["value"] for s in series}
+    total = tree_nbytes(net.params_list)
+    # every non-tiny leaf divides by 8 here, so per-device ~ total/8 (the
+    # 12-byte output bias is the only replicated remainder)
+    assert total / 8 <= vals["zero3"] <= total / 8 + 16
+
+
+def test_zero3_multistep_prefetch_equals_single_device():
+    """The fused K-step dispatch path (k_step_groups) + device prefetch,
+    compiled through the same seam with the same zero3 spec trees, stays
+    numerically identical — 10 uniform batches form an 8-group + remainder,
+    exercising sync_multistep AND sync_step under sharded specs."""
+    batches = _dense_batches(n=10, seed=3)
+    single = _single_device_fit(_dense_conf(seed=11), batches)
+
+    net = MultiLayerNetwork(_dense_conf(seed=11)).init()
+    pw = (ParallelWrapper.builder(net).workers(8).prefetch_buffer(2)
+          .sharding("zero3").build())
+    pw.fit(ListDataSetIterator(batches))
+    np.testing.assert_allclose(np.asarray(single.params()),
+                               np.asarray(net.params()), atol=2e-6)
+
+
+def test_sharding_rule_set_validation():
+    net = MultiLayerNetwork(_dense_conf()).init()
+    with pytest.raises(ValueError, match="unknown sharding rule set"):
+        ParallelWrapper.builder(net).workers(8).sharding("3d").build()
+    with pytest.raises(ValueError, match="'model' axis"):
+        ParallelWrapper.builder(net).workers(8).sharding("dp_tp").build()
+    with pytest.raises(ValueError, match="averaging_frequency"):
+        (ParallelWrapper.builder(net)
+         .mesh(build_mesh({"data": 4, "model": 2}))
+         .averaging_frequency(4).sharding("dp_tp").build())
+
+
+def test_dp_tp_engage_or_fail():
+    """An explicit dp_tp request on a net where NO dim divides the model
+    axis must raise, not silently replicate everything (the engage-or-fail
+    principle shared with .expert_parallel())."""
+    conf = (NeuralNetConfiguration.builder().seed(1).list()
+            .layer(DenseLayer(n_in=5, n_out=7, activation="tanh"))
+            .layer(OutputLayer(n_in=7, n_out=3, loss="mcxent",
+                               activation="softmax")).build())
+    net = MultiLayerNetwork(conf).init()
+    pw = (ParallelWrapper.builder(net)
+          .mesh(build_mesh({"data": 4, "model": 2})).prefetch_buffer(0)
+          .sharding("dp_tp").build())
+    with pytest.raises(ValueError, match="nothing would shard"):
+        pw.fit(ListDataSetIterator(_odd_batches()))
+
+
+def _odd_batches():
+    rng = np.random.default_rng(2)
+    x = rng.normal(size=(8, 5)).astype(np.float32)
+    y = np.zeros((8, 3), np.float32)
+    y[np.arange(8), rng.integers(0, 3, 8)] = 1
+    return [DataSet(x, y)]
