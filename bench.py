@@ -823,6 +823,95 @@ def bench_fit_lenet(batch: int, iters: int, ksteps: int,
     }
 
 
+def bench_serve(batch, iters, ksteps, serve_qps=None, serve_latency_ms=None):
+    """Micro-batching A/B on the serving engine (ISSUE 9 headline).
+
+    Unlike the fit benches this is fully CPU-measurable: the win is
+    dispatch amortization, not MXU width. The harness first calibrates the
+    UNBATCHED saturation point (closed-loop peak through the real HTTP
+    stack), then offers 1.5x that rate open-loop to both configurations —
+    so "unbatched saturates" holds on any host without hand-tuned QPS —
+    and reports the batched achieved throughput as the headline. The full
+    A/B record (p50/p99, achieved QPS, batch occupancy, recompile count)
+    is appended to scripts/serve_load.jsonl next to bench_log, and
+    steady-state health is pinned by recompiles == bucket count.
+    """
+    import numpy as np
+
+    from deeplearning4j_tpu.keras_server import (InferenceServer,
+                                                 ModelRegistry)
+    from deeplearning4j_tpu.keras_server.loadgen import (
+        run_ab, run_closed_loop, run_closed_loop_proc)
+    from deeplearning4j_tpu.nn.conf.builders import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    # deliberately small: serving capacity on tiny per-request batches is
+    # dispatch-overhead-bound, which is exactly what micro-batching
+    # amortizes; a wide model just re-measures matmul FLOPs
+    n_in, hidden, n_out = 16, 128, 8
+    conf = (NeuralNetConfiguration.builder()
+            .seed(7).learning_rate(0.1).updater("adam")
+            .weight_init("xavier")
+            .list()
+            .layer(DenseLayer(n_in=n_in, n_out=hidden, activation="relu"))
+            .layer(DenseLayer(n_in=hidden, n_out=hidden, activation="relu"))
+            .layer(OutputLayer(n_in=hidden, n_out=n_out, loss="mcxent",
+                               activation="softmax"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    example = np.random.default_rng(0).normal(
+        size=(1, n_in)).astype(np.float32)
+
+    if serve_qps:
+        qps = float(serve_qps)
+        unbatched_peak = None
+    else:
+        # calibrate: unbatched closed-loop peak (client out-of-process,
+        # like the measured phases) = the saturation point
+        registry = ModelRegistry()
+        registry.register("serve_mlp", net, version="cal")
+        cal = InferenceServer(registry, max_batch=1, max_latency_s=0.0,
+                              max_queue=512).start()
+        try:
+            run_closed_loop(cal.port, "serve_mlp", example, workers=1,
+                            requests_per_worker=8)  # warm the compile
+            peak = run_closed_loop_proc(cal.port, "serve_mlp",
+                                        example.shape, workers=8,
+                                        requests_per_worker=150)
+        finally:
+            cal.stop()
+        unbatched_peak = peak["achieved_qps"]
+        qps = max(50.0, round(1.5 * unbatched_peak, 1))
+
+    record_path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "scripts",
+        "serve_load.jsonl")
+    rec = run_ab(net, model="serve_mlp", qps=qps,
+                 duration_s=max(float(iters), 1.0), max_batch=batch,
+                 max_latency_s=(serve_latency_ms or 4.0) / 1e3,
+                 max_queue=2048, example=example, record_path=record_path)
+    batched, unbatched = rec["batched"], rec["unbatched"]
+    return {
+        "samples_per_sec": batched["achieved_qps"],  # headline: batched QPS
+        "offered_qps": qps,
+        "calibrated_unbatched_peak_qps": unbatched_peak,
+        "unbatched_qps": unbatched["achieved_qps"],
+        "batched_speedup": rec["batched_speedup"],
+        "p50_ms_unbatched": unbatched["p50_ms"],
+        "p99_ms_unbatched": unbatched["p99_ms"],
+        "p50_ms_batched": batched["p50_ms"],
+        "p99_ms_batched": batched["p99_ms"],
+        "p99_improvement": rec["p99_improvement"],
+        "batch_occupancy": batched["batch_occupancy"],
+        "bucket_count": batched["bucket_count"],
+        "recompiles": batched["recompiles"],
+        "max_batch": batch,
+        "serve_record": record_path,
+        "api": "keras_server.InferenceServer /v1/predict",
+    }
+
+
 _METRICS = {
     "lenet": "lenet_mnist_samples_per_sec",
     "fit_lenet": "lenet_fit_api_samples_per_sec",
@@ -834,7 +923,11 @@ _METRICS = {
     "vgg16": "vgg16_samples_per_sec_per_chip",
     "word2vec": "word2vec_pairs_per_sec",
     "attention": "flash_attention_tokens_per_sec",
+    "serve": "serve_batched_requests_per_sec",
 }
+
+#: models whose headline is not a training samples/sec number
+_UNITS = {"serve": "requests/sec"}
 
 _DEFAULT_MODEL = "resnet50"  # the flagship; bare bench.py runs it
 
@@ -849,6 +942,7 @@ _DEFAULTS = {  # model -> (batch, iters, ksteps)
     "moe": (8, 5, 4),
     "word2vec": (1024, 10, 32),
     "attention": (4, 5, 4),
+    "serve": (32, 3, 1),  # batch = serving max_batch, iters = seconds/phase
 }
 
 
@@ -858,7 +952,8 @@ def _bench_fns():
             "fit_lenet": bench_fit_lenet, "fit_resnet50": bench_fit_resnet50,
             "char_rnn": bench_char_rnn, "transformer": bench_transformer,
             "moe": bench_moe,
-            "word2vec": bench_word2vec, "attention": bench_attention}
+            "word2vec": bench_word2vec, "attention": bench_attention,
+            "serve": bench_serve}
 
 
 #: per-model default dtype policy = the measured-best config on chip
@@ -868,7 +963,10 @@ def _bench_fns():
 #: `python bench.py --model X` therefore reports each model's production
 #: configuration; --f32/--bf16-matmul/--bf16-act force a specific one.
 _DTYPE_DEFAULT = {"lenet": "bf16", "fit_lenet": "bf16",
-                  "word2vec": "bf16", "attention": "bf16"}
+                  "word2vec": "bf16", "attention": "bf16",
+                  # serving measures f32 end-to-end request latency; bf16
+                  # convert ops on tiny batches would dominate like LeNet
+                  "serve": "f32"}
 
 
 def _dtype_mode(model: str, *, bf16_act: bool, bf16_matmul: bool,
@@ -927,6 +1025,11 @@ def _child_main(args) -> None:
         kwargs["hidden"] = args.hidden
     if args.lstm_impl and args.model == "char_rnn":
         kwargs["lstm_impl"] = args.lstm_impl
+    if args.model == "serve":
+        if args.serve_qps:
+            kwargs["serve_qps"] = args.serve_qps
+        if args.serve_latency_ms:
+            kwargs["serve_latency_ms"] = args.serve_latency_ms
     if getattr(args, "sharding", None):
         if args.model not in _SHARDING_CAPABLE:
             raise SystemExit(
@@ -977,7 +1080,7 @@ def _child_main(args) -> None:
     print(json.dumps({
         "metric": _METRICS[args.model],
         "value": round(r["samples_per_sec"], 2),
-        "unit": "samples/sec",
+        "unit": _UNITS.get(args.model, "samples/sec"),
         "vs_baseline": vs,
         "detail": r,
     }), flush=True)
@@ -1049,6 +1152,15 @@ def main() -> None:
                          "only (config-distinct). The record carries the "
                          "achieved param_bytes_per_device from "
                          "dl4j_sharded_param_bytes_per_device")
+    ap.add_argument("--serve-qps", type=float, default=None,
+                    help="serve bench offered open-loop request rate "
+                         "(config-distinct). Default: auto-calibrate — "
+                         "measure the unbatched closed-loop saturation "
+                         "point through the real HTTP stack, then offer "
+                         "1.5x that rate to both A/B phases")
+    ap.add_argument("--serve-latency-ms", type=float, default=None,
+                    help="serve bench micro-batcher max coalescing wait "
+                         "(config-distinct); default 4ms")
     ap.add_argument("--telemetry-out", default=None,
                     help="append a metrics-registry snapshot (JSONL) to this "
                          "file beside the headline JSON; measurement-only — "
@@ -1153,7 +1265,7 @@ def main() -> None:
     rec = {
         "metric": _METRICS[args.model],
         "value": 0.0,
-        "unit": "samples/sec",
+        "unit": _UNITS.get(args.model, "samples/sec"),
         "vs_baseline": 0.0,
         "error": kind + ": " + last_err.replace("\n", " | "),
     }
@@ -1218,6 +1330,12 @@ XPLANE_ATTRIBUTION_FIELDS = ("xplane_attribution", "profile_trace",
 #: may stand in only for an UNSHARDED request, never for a --sharding row
 _SHARDING_AXIS_LANDED_TS = "2026-08-05T20:00:00Z"
 
+#: when the serving-engine grid axes landed (round 9) — no bench_log row
+#: before this instant can be a '--model serve' row at all, and rows logged
+#: since carry the offered-QPS / coalescing-latency knobs as config axes so
+#: an outage can never serve a number measured under a different load shape
+_SERVE_AXIS_LANDED_TS = "2026-08-05T22:00:00Z"
+
 
 def _config_key(args_str: str, ts: str = None) -> dict:
     """The fields that make two bench invocations the SAME config: model,
@@ -1265,11 +1383,19 @@ def _config_key(args_str: str, ts: str = None) -> dict:
             # pre-round-8 rows predate the sharding engine: they all measured
             # the single-device fit path, whatever flags a later reader asks
             sharding = None
+    serve_qps = serve_latency_ms = None
+    if model == "serve" and not (ts is not None
+                                 and ts < _SERVE_AXIS_LANDED_TS):
+        # 'auto' (the calibrated default) is its own config: a row captured
+        # at an explicit --serve-qps must not stand in for a calibrated run
+        serve_qps = val("--serve-qps") or "auto"
+        serve_latency_ms = val("--serve-latency-ms") or "4"
     return {"model": model, "batch": val("--batch"),
             "ksteps": val("--ksteps"), "dtype": mode, "rdtype": rdtype,
             "seq": val("--seq"), "vocab": val("--vocab"),
             "hidden": val("--hidden"), "lstm_impl": lstm_impl,
-            "sharding": sharding}
+            "sharding": sharding, "serve_qps": serve_qps,
+            "serve_latency_ms": serve_latency_ms}
 
 
 def _last_healthy_from_log(args_str: str, path: str = None):

@@ -1,12 +1,22 @@
-"""Keras gateway server: train Keras-exported models in this runtime.
+"""Keras gateway + the batched serving engine.
 
-Reference: deeplearning4j-keras (SURVEY.md §2.8) — a py4j ``GatewayServer``
-(keras/Server.java:18) exposes ``DeepLearning4jEntryPoint.fit()``
-(DeepLearning4jEntryPoint.java:21), which loads a Keras-exported model plus an
-HDF5 minibatch dataset iterator (HDF5MiniBatchDataSetIterator.java) and trains
-in the JVM. Here the gateway is a newline-delimited-JSON TCP server (py4j's
-wire role) and the entry point drives the TPU training path on the imported
-network.
+Two services live in this package:
+
+- the **training gateway** (below): reference deeplearning4j-keras
+  (SURVEY.md §2.8) — a py4j ``GatewayServer`` (keras/Server.java:18)
+  exposes ``DeepLearning4jEntryPoint.fit()``
+  (DeepLearning4jEntryPoint.java:21), which loads a Keras-exported model
+  plus an HDF5 minibatch dataset iterator and trains in the JVM. Here the
+  gateway is a newline-delimited-JSON TCP server (py4j's wire role) and
+  the entry point drives the TPU training path on the imported network.
+
+- the **serving engine** (registry/batcher/serving/streaming/admission/
+  loadgen modules): a versioned :class:`ModelRegistry` pinning non-donated
+  compiled predict programs, a :class:`MicroBatcher` coalescing concurrent
+  requests into padded power-of-two shape buckets (bounded compile cache),
+  and an :class:`InferenceServer` with ``/v1/predict``, 429 backpressure,
+  and streaming timestep output over the ``rnnTimeStep`` seam. See
+  GUIDE.md "Serving engine".
 """
 from __future__ import annotations
 
@@ -199,3 +209,30 @@ def call(host: str, port: int, method: str, token: Optional[str] = None,
     if not resp.get("ok"):
         raise RuntimeError(resp.get("error", "gateway call failed"))
     return resp["result"]
+
+
+# ----------------------------------------------------------- serving engine
+from deeplearning4j_tpu.keras_server.admission import (  # noqa: E402
+    AdmissionController, RejectedError)
+from deeplearning4j_tpu.keras_server.registry import (  # noqa: E402
+    ModelRegistry, ModelVersion, global_model_registry,
+    set_global_model_registry)
+from deeplearning4j_tpu.keras_server.batcher import (  # noqa: E402
+    MicroBatcher, batch_bucket)
+from deeplearning4j_tpu.keras_server.streaming import (  # noqa: E402
+    StreamSessions)
+from deeplearning4j_tpu.keras_server.serving import (  # noqa: E402
+    InferenceServer, active_server, serve_status)
+from deeplearning4j_tpu.keras_server.loadgen import (  # noqa: E402
+    run_ab, run_closed_loop, run_open_loop)
+
+__all__ = [
+    "HDF5MiniBatchDataSetIterator", "DeepLearning4jEntryPoint", "Server",
+    "call",
+    "AdmissionController", "RejectedError",
+    "ModelRegistry", "ModelVersion", "global_model_registry",
+    "set_global_model_registry",
+    "MicroBatcher", "batch_bucket", "StreamSessions",
+    "InferenceServer", "active_server", "serve_status",
+    "run_ab", "run_closed_loop", "run_open_loop",
+]

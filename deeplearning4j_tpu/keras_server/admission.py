@@ -1,0 +1,70 @@
+"""Admission control: bounded pending work, fail-fast overload.
+
+A serving box melts down when it queues unboundedly — latency grows without
+limit and every request eventually times out. The controller caps the
+number of admitted-but-unfinished requests; past the cap, ``admit()``
+raises :class:`RejectedError` immediately and the HTTP layer maps it to
+``429 Too Many Requests`` with a ``Retry-After`` hint. The queue-depth
+gauge (``dl4j_serve_queue_depth``) is updated on BOTH edges so the metric
+always agrees with what a 429 claims (pinned by tests/test_serving.py).
+"""
+from __future__ import annotations
+
+import threading
+
+from deeplearning4j_tpu.observability import names as _n
+from deeplearning4j_tpu.observability.metrics import global_registry
+
+
+class RejectedError(RuntimeError):
+    """Request refused at admission (maps to HTTP 429)."""
+
+    def __init__(self, pending: int, limit: int, retry_after_s: float):
+        super().__init__(
+            f"serving queue full ({pending}/{limit} pending); "
+            f"retry in ~{retry_after_s:.3f}s")
+        self.pending = pending
+        self.limit = limit
+        self.retry_after_s = retry_after_s
+
+
+class AdmissionController:
+    """Counting semaphore with metrics and a Retry-After estimate."""
+
+    def __init__(self, max_pending: int = 256,
+                 expected_latency_s: float = 0.05, metrics=None):
+        if max_pending < 1:
+            raise ValueError("max_pending must be >= 1")
+        self.max_pending = int(max_pending)
+        self.expected_latency_s = float(expected_latency_s)
+        self._lock = threading.Lock()
+        self._pending = 0
+        self.rejected = 0
+        m = metrics or global_registry()
+        self._g_depth = m.gauge(
+            _n.SERVE_QUEUE_DEPTH, "admitted-but-unfinished serve requests")
+        self._c_rejected = m.counter(
+            _n.SERVE_REJECTED_TOTAL, "requests refused at admission (429)")
+
+    @property
+    def pending(self) -> int:
+        with self._lock:
+            return self._pending
+
+    def admit(self, n: int = 1) -> None:
+        """Admit ``n`` requests or raise :class:`RejectedError`."""
+        with self._lock:
+            if self._pending + n > self.max_pending:
+                self.rejected += n
+                self._c_rejected.inc(n)
+                # crude but honest: a full queue drains one expected-latency
+                # per slot; clients treat it as a floor, not a promise
+                raise RejectedError(self._pending, self.max_pending,
+                                    self.expected_latency_s)
+            self._pending += n
+            self._g_depth.set(self._pending)
+
+    def release(self, n: int = 1) -> None:
+        with self._lock:
+            self._pending = max(0, self._pending - n)
+            self._g_depth.set(self._pending)

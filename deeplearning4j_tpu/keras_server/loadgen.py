@@ -1,0 +1,349 @@
+"""Load generation for the serving engine: open/closed loop over HTTP.
+
+The open-loop client is the honest one for capacity questions: requests
+are scheduled on a fixed clock (``offered_qps``) regardless of how fast
+the server answers, so saturation shows up as growing latency and 429s
+instead of the client politely slowing down (closed-loop coordinated
+omission). Latency is measured from the request's SCHEDULED time, so
+client-side lag counts against the server the way a real user would
+experience it. The closed-loop client (N workers, back-to-back) measures
+best-case per-stream latency and peak sustainable throughput.
+
+``run_ab`` is the headline harness: the same model served unbatched
+(``max_batch=1``) vs micro-batched at the same offered QPS, one JSONL
+record with p50/p99, achieved QPS, batch occupancy, and the compile-
+tracker recompile count — the acceptance check is
+``recompiles == bucket count`` (steady state never recompiles).
+
+By default ``run_ab`` runs the load client **in a separate process**
+(``python -m deeplearning4j_tpu.keras_server.loadgen``): client, HTTP
+handlers, and the dispatcher otherwise contend for ONE interpreter lock,
+which caps both phases at the same combined-GIL ceiling and masks the
+batching win the A/B exists to measure. Client startup (process spawn +
+imports) happens before the client schedules its first request, so it
+never lands on the measurement clock.
+"""
+from __future__ import annotations
+
+import http.client
+import json
+import os
+import threading
+import time
+from typing import Callable, List, Optional
+
+import numpy as np
+
+def percentile(sorted_vals: List[float], q: float) -> float:
+    """Nearest-rank percentile on an already-sorted list (0 when empty)."""
+    if not sorted_vals:
+        return 0.0
+    i = min(len(sorted_vals) - 1, max(0, int(q * len(sorted_vals))))
+    return sorted_vals[i]
+
+
+def _serve_compile_count() -> int:
+    from deeplearning4j_tpu.nn.inference import PREDICT_PROGRAM_NAME
+    from deeplearning4j_tpu.observability.compile_tracker import \
+        global_tracker
+    return sum(1 for e in global_tracker().snapshot_events()
+               if PREDICT_PROGRAM_NAME in e.get("fn", ""))
+
+
+class _Stats:
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.latencies_ms: List[float] = []
+        self.ok = 0
+        self.rejected = 0
+        self.errors = 0
+
+    def record(self, status: int, latency_ms: float) -> None:
+        with self.lock:
+            if status == 200:
+                self.ok += 1
+                self.latencies_ms.append(latency_ms)
+            elif status == 429:
+                self.rejected += 1
+            else:
+                self.errors += 1
+
+    def summary(self) -> dict:
+        with self.lock:
+            lat = sorted(self.latencies_ms)
+            return {"ok": self.ok, "rejected": self.rejected,
+                    "errors": self.errors,
+                    "p50_ms": round(percentile(lat, 0.50), 3),
+                    "p90_ms": round(percentile(lat, 0.90), 3),
+                    "p99_ms": round(percentile(lat, 0.99), 3)}
+
+
+def _connect(host: str, port: int,
+             timeout: float = 30.0) -> http.client.HTTPConnection:
+    """Persistent connection with Nagle off — mirrors the server side; a
+    buffered small-segment request otherwise hits the 40ms delayed-ACK
+    stall and the load test measures the kernel timer, not the server."""
+    import socket
+    conn = http.client.HTTPConnection(host, port, timeout=timeout)
+    conn.connect()
+    conn.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    return conn
+
+
+def _post_predict(conn: http.client.HTTPConnection, model: str,
+                  payload: bytes) -> int:
+    conn.request("POST", "/v1/predict", body=payload,
+                 headers={"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    resp.read()
+    return resp.status
+
+
+def _worker_bodies(model: str, example) -> Callable[[int], bytes]:
+    if callable(example):
+        return lambda i: json.dumps(
+            {"model": model, "inputs": np.asarray(example(i)).tolist()}
+        ).encode()
+    body = json.dumps(
+        {"model": model, "inputs": np.asarray(example).tolist()}).encode()
+    return lambda i: body
+
+
+def run_open_loop(port: int, model: str, example, *, qps: float,
+                  duration_s: float, workers: int = 32,
+                  host: str = "127.0.0.1") -> dict:
+    """Fixed-rate load: request i fires at ``t0 + i/qps``; a late worker
+    pool never thins the offered schedule (requests queue client-side and
+    the latency clock keeps running from the scheduled instant)."""
+    n_total = max(1, int(qps * duration_s))
+    make_body = _worker_bodies(model, example)
+    stats = _Stats()
+    counter = {"i": 0}
+    counter_lock = threading.Lock()
+    t0 = time.perf_counter() + 0.05  # let workers reach their first wait
+
+    def work():
+        conn = _connect(host, port)
+        while True:
+            with counter_lock:
+                i = counter["i"]
+                if i >= n_total:
+                    break
+                counter["i"] = i + 1
+            t_sched = t0 + i / qps
+            delay = t_sched - time.perf_counter()
+            if delay > 0:
+                time.sleep(delay)
+            try:
+                status = _post_predict(conn, model, make_body(i))
+            except OSError:
+                conn.close()
+                conn = _connect(host, port)
+                stats.record(-1, 0.0)
+                continue
+            stats.record(status,
+                         (time.perf_counter() - t_sched) * 1e3)
+        conn.close()
+
+    threads = [threading.Thread(target=work, daemon=True)
+               for _ in range(max(1, min(workers, n_total)))]
+    t_start = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = max(time.perf_counter() - t_start, 1e-9)
+    out = stats.summary()
+    out.update({"mode": "open", "offered_qps": round(qps, 3),
+                "achieved_qps": round(out["ok"] / wall, 3),
+                "duration_s": round(wall, 3), "requests": n_total})
+    return out
+
+
+def run_closed_loop(port: int, model: str, example, *, workers: int,
+                    requests_per_worker: int,
+                    host: str = "127.0.0.1") -> dict:
+    """N concurrent streams, back-to-back requests: peak throughput."""
+    make_body = _worker_bodies(model, example)
+    stats = _Stats()
+
+    def work(wid: int):
+        conn = _connect(host, port)
+        for j in range(requests_per_worker):
+            t_send = time.perf_counter()
+            try:
+                status = _post_predict(
+                    conn, model, make_body(wid * requests_per_worker + j))
+            except OSError:
+                conn.close()
+                conn = _connect(host, port)
+                stats.record(-1, 0.0)
+                continue
+            stats.record(status, (time.perf_counter() - t_send) * 1e3)
+        conn.close()
+
+    threads = [threading.Thread(target=work, args=(w,), daemon=True)
+               for w in range(workers)]
+    t_start = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = max(time.perf_counter() - t_start, 1e-9)
+    out = stats.summary()
+    out.update({"mode": "closed", "workers": workers,
+                "achieved_qps": round(out["ok"] / wall, 3),
+                "duration_s": round(wall, 3),
+                "requests": workers * requests_per_worker})
+    return out
+
+
+def _client_cmd(port: int, model: str, shape, *, extra: List[str]) -> list:
+    import sys
+    return [sys.executable, "-m", "deeplearning4j_tpu.keras_server.loadgen",
+            "--port", str(port), "--model", model,
+            "--shape", ",".join(str(int(s)) for s in shape)] + extra
+
+
+def _run_client(cmd: list, timeout_s: float) -> dict:
+    """Launch the load client in its own process and parse its JSON line."""
+    import subprocess
+    root = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = root + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(cmd, capture_output=True, text=True, env=env,
+                          timeout=timeout_s)
+    for line in reversed((proc.stdout or "").strip().splitlines()):
+        try:
+            rec = json.loads(line)
+        except (json.JSONDecodeError, ValueError):
+            continue
+        if isinstance(rec, dict) and "achieved_qps" in rec:
+            return rec
+    raise RuntimeError(
+        f"load client produced no record (rc={proc.returncode}): "
+        + (proc.stderr or "")[-400:])
+
+
+def run_open_loop_proc(port: int, model: str, shape, *, qps: float,
+                       duration_s: float, workers: int = 32) -> dict:
+    """run_open_loop in a separate process (own GIL); the client
+    regenerates its payload from ``shape`` (load shape matters, values
+    don't)."""
+    return _run_client(
+        _client_cmd(port, model, shape, extra=[
+            "--qps", str(qps), "--duration", str(duration_s),
+            "--workers", str(workers)]),
+        timeout_s=duration_s * 20 + 120)
+
+
+def run_closed_loop_proc(port: int, model: str, shape, *, workers: int,
+                         requests_per_worker: int) -> dict:
+    return _run_client(
+        _client_cmd(port, model, shape, extra=[
+            "--closed", "--workers", str(workers),
+            "--requests", str(requests_per_worker)]),
+        timeout_s=600)
+
+
+def run_ab(net, *, model: str = "model", qps: float = 200.0,
+           duration_s: float = 3.0, max_batch: int = 32,
+           max_latency_s: float = 0.004, max_queue: int = 512,
+           example=None, workers: int = 32,
+           warmup_requests: int = 8, isolate_client: bool = True,
+           record_path: Optional[str] = None) -> dict:
+    """Serve ``net`` unbatched then micro-batched at the SAME offered QPS;
+    return (and optionally append as JSONL) the A/B record.
+    ``isolate_client=False`` keeps the load client in-process (faster to
+    start, but client GIL contention depresses both phases)."""
+    from .registry import ModelRegistry
+    from .serving import InferenceServer
+    if example is None:
+        raise ValueError("pass example= (one input row, shape [1, ...])")
+    example = np.asarray(example)
+    phases = {}
+    for phase, batch in (("unbatched", 1), ("batched", max_batch)):
+        registry = ModelRegistry()
+        # a fresh clone per phase = a fresh compile cache, so each phase's
+        # recompile count is exactly ITS bucket set (the acceptance pin
+        # `recompiles == bucket count` must not see the other phase's warmup)
+        registry.register(model, net.clone(), version="v1")
+        compiles_before = _serve_compile_count()
+        server = InferenceServer(
+            registry, max_batch=batch,
+            max_latency_s=(0.0 if batch == 1 else max_latency_s),
+            max_queue=max_queue).start()
+        try:
+            # warm the compile cache off the clock (steady-state contract)
+            run_closed_loop(server.port, model, example, workers=1,
+                            requests_per_worker=warmup_requests)
+            if isolate_client:
+                res = run_open_loop_proc(
+                    server.port, model, example.shape, qps=qps,
+                    duration_s=duration_s, workers=workers)
+            else:
+                res = run_open_loop(server.port, model, example, qps=qps,
+                                    duration_s=duration_s, workers=workers)
+            bstats = server.batcher.stats()
+        finally:
+            server.stop()
+        res["batch_occupancy"] = round(bstats["mean_occupancy"], 4)
+        res["bucket_count"] = bstats["bucket_count"]
+        res["dispatches"] = bstats["dispatches"]
+        res["recompiles"] = _serve_compile_count() - compiles_before
+        res["max_batch"] = batch
+        phases[phase] = res
+    rec = {
+        "harness": "keras_server.loadgen.run_ab",
+        "model": model, "offered_qps": qps, "duration_s": duration_s,
+        "max_batch": max_batch, "max_latency_s": max_latency_s,
+        "unbatched": phases["unbatched"], "batched": phases["batched"],
+        "batched_speedup": round(
+            phases["batched"]["achieved_qps"]
+            / max(phases["unbatched"]["achieved_qps"], 1e-9), 3),
+        "p99_improvement": round(
+            phases["unbatched"]["p99_ms"]
+            / max(phases["batched"]["p99_ms"], 1e-9), 3),
+    }
+    if record_path:
+        os.makedirs(os.path.dirname(os.path.abspath(record_path)),
+                    exist_ok=True)
+        with open(record_path, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+    return rec
+
+
+def _client_main() -> None:
+    """`python -m deeplearning4j_tpu.keras_server.loadgen`: the load
+    client `run_ab` launches out-of-process. Prints ONE JSON line."""
+    import argparse
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--port", type=int, required=True)
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--model", required=True)
+    ap.add_argument("--shape", required=True,
+                    help="request input shape, comma-separated")
+    ap.add_argument("--qps", type=float, default=200.0)
+    ap.add_argument("--duration", type=float, default=3.0)
+    ap.add_argument("--workers", type=int, default=32)
+    ap.add_argument("--closed", action="store_true")
+    ap.add_argument("--requests", type=int, default=100,
+                    help="closed-loop requests per worker")
+    args = ap.parse_args()
+    shape = tuple(int(s) for s in args.shape.split(","))
+    example = np.random.default_rng(0).normal(size=shape).astype(np.float32)
+    if args.closed:
+        res = run_closed_loop(args.port, args.model, example,
+                              workers=args.workers,
+                              requests_per_worker=args.requests,
+                              host=args.host)
+    else:
+        res = run_open_loop(args.port, args.model, example, qps=args.qps,
+                            duration_s=args.duration, workers=args.workers,
+                            host=args.host)
+    print(json.dumps(res), flush=True)  # lint: bare-print-ok (the one JSON line on stdout IS this subprocess's result channel — run_ab's _run_client parses it)
+
+
+if __name__ == "__main__":
+    _client_main()

@@ -1,0 +1,170 @@
+"""Model registry: versioned serving models with atomic hot-swap.
+
+Reference analog: ``KerasModelEndpoint`` holds ONE imported model per
+endpoint and reloads in place. Here a registry maps ``name -> {version ->
+ModelVersion}`` where each version pins a compiled **non-donated**
+``predict_fn`` (:func:`nn.inference.make_predict_fn`) over a parameter
+snapshot, so:
+
+- registering version N+1 builds its predict program OFF the serving path,
+  then swaps the active pointer under the lock — in-flight requests that
+  already resolved version N complete against N's pinned buffers (zero
+  request loss, pinned by tests/test_serving.py);
+- a later ``fit()`` on the source network cannot corrupt serving (the
+  snapshot is real buffer copies), and serving cannot be corrupted BY
+  training donation.
+
+Models load from ``model_serializer`` zips (either network type via
+``guess_model``) or Keras HDF5 exports (``KerasModelImport``), or register
+directly from an in-memory network.
+"""
+from __future__ import annotations
+
+import threading
+import zipfile
+from typing import Dict, List, Optional
+
+from deeplearning4j_tpu.nn.inference import PredictFn, make_predict_fn
+from deeplearning4j_tpu.observability import names as _n
+from deeplearning4j_tpu.observability.metrics import global_registry
+
+
+class ModelVersion:
+    """One immutable (name, version) serving unit."""
+
+    def __init__(self, name: str, version: str, net, predict_fn: PredictFn,
+                 source: str = "memory"):
+        self.name = name
+        self.version = version
+        self.net = net
+        self.predict_fn = predict_fn
+        self.source = source
+        #: the streaming seam exists on both network types
+        self.streaming_capable = hasattr(net, "rnn_time_step")
+
+    def describe(self) -> dict:
+        return {"name": self.name, "version": self.version,
+                "source": self.source,
+                "streaming_capable": self.streaming_capable,
+                "predict_calls": self.predict_fn.calls}
+
+
+class ModelRegistry:
+    """Thread-safe versioned model store with an atomic active pointer."""
+
+    def __init__(self, metrics=None):
+        self._lock = threading.RLock()
+        self._versions: Dict[str, Dict[str, ModelVersion]] = {}
+        self._active: Dict[str, str] = {}
+        self._metrics = metrics or global_registry()
+        self._g_models = self._metrics.gauge(
+            _n.SERVE_MODELS_LOADED, "model versions held by the registry")
+        self._c_swaps = self._metrics.counter(
+            _n.SERVE_HOT_SWAPS_TOTAL, "active-version hot swaps")
+
+    # ------------------------------------------------------------- loading
+    def register(self, name: str, net, version: Optional[str] = None,
+                 source: str = "memory") -> ModelVersion:
+        """Pin ``net`` for serving and make it the active version.
+
+        The predict program is built (and its parameter snapshot copied)
+        BEFORE the active pointer moves, so the swap itself is a dict
+        assignment under the lock — atomic with respect to ``active()``.
+        """
+        with self._lock:
+            version = version or f"v{len(self._versions.get(name, {})) + 1}"
+            if version in self._versions.get(name, {}):
+                raise ValueError(
+                    f"model {name!r} already has version {version!r}; "
+                    "versions are immutable — register a new one")
+        pf = make_predict_fn(net, version=version)
+        with self._lock:
+            swapping = name in self._active
+            mv = ModelVersion(name, version, net, pf, source=source)
+            self._versions.setdefault(name, {})[version] = mv
+            self._active[name] = version
+            self._g_models.set(
+                sum(len(v) for v in self._versions.values()))
+            if swapping:
+                self._c_swaps.labels(model=name).inc()
+        return mv
+
+    def load(self, name: str, path: str,
+             version: Optional[str] = None) -> ModelVersion:
+        """Load a model file and register it: a ``model_serializer`` zip
+        (either network type) or a Keras HDF5 export."""
+        if zipfile.is_zipfile(path):
+            from deeplearning4j_tpu.utils.model_serializer import guess_model
+            net = guess_model(path)
+        else:
+            from deeplearning4j_tpu.modelimport.keras_import import (
+                KerasModelImport)
+            try:
+                net = KerasModelImport \
+                    .import_keras_sequential_model_and_weights(path)
+            except ValueError:
+                net = KerasModelImport.import_keras_model_and_weights(path)
+        return self.register(name, net, version=version, source=path)
+
+    # ------------------------------------------------------------- lookup
+    def active(self, name: str) -> ModelVersion:
+        with self._lock:
+            try:
+                return self._versions[name][self._active[name]]
+            except KeyError:
+                raise KeyError(
+                    f"no model {name!r} in registry "
+                    f"(loaded: {sorted(self._versions)})") from None
+
+    def get(self, name: str, version: str) -> ModelVersion:
+        with self._lock:
+            return self._versions[name][version]
+
+    def set_active(self, name: str, version: str) -> ModelVersion:
+        """Point ``name`` at an already-registered version (rollback)."""
+        with self._lock:
+            mv = self._versions[name][version]  # KeyError = no such version
+            if self._active[name] != version:
+                self._active[name] = version
+                self._c_swaps.labels(model=name).inc()
+            return mv
+
+    def names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._versions)
+
+    def status(self) -> dict:
+        """The /serve/status registry half (the batcher adds queue stats)."""
+        with self._lock:
+            return {
+                "models": {
+                    name: {
+                        "active": self._active[name],
+                        "versions": {
+                            v: mv.describe()
+                            for v, mv in sorted(versions.items())},
+                    }
+                    for name, versions in sorted(self._versions.items())},
+            }
+
+
+_GLOBAL: Optional[ModelRegistry] = None
+_GLOBAL_LOCK = threading.Lock()
+
+
+def global_model_registry() -> ModelRegistry:
+    """THE registry the UI's /serve/status route reads."""
+    global _GLOBAL
+    with _GLOBAL_LOCK:
+        if _GLOBAL is None:
+            _GLOBAL = ModelRegistry()
+        return _GLOBAL
+
+
+def set_global_model_registry(
+        registry: Optional[ModelRegistry]) -> Optional[ModelRegistry]:
+    """Swap the global registry (tests); returns the previous one."""
+    global _GLOBAL
+    with _GLOBAL_LOCK:
+        prev, _GLOBAL = _GLOBAL, registry
+        return prev

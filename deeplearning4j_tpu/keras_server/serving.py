@@ -1,0 +1,263 @@
+"""HTTP inference front-end: ``/v1/predict`` + streaming + status.
+
+Same stack as the training UI (``ui/server.py``): stdlib
+``ThreadingHTTPServer``, one handler thread per connection, loopback bind
+by default. The handler threads are pure producers — every predict request
+funnels through the :class:`MicroBatcher`'s single dispatcher, so device
+concurrency is one padded program at a time regardless of client fan-in.
+
+Routes:
+
+- ``POST /v1/predict``  body ``{"model": m, "inputs": [[...], ...]}`` —
+  micro-batched forward; 200 with ``{"predictions", "model", "version",
+  "batched_with", "bucket"}``, 429 + ``Retry-After`` on admission
+  overflow, 404 for unknown models, 503 on dispatch timeout.
+- ``POST /v1/stream``   body ``{"model": m, "session": s, "inputs":
+  [B,T,F]}`` — newline-delimited JSON, ONE line per timestep as it is
+  computed over the ``rnnTimeStep`` seam; hidden state persists
+  server-side under ``session`` across requests.
+- ``POST /v1/stream/reset`` — drop a session's parked state.
+- ``GET /serve/status`` — models/versions, queue depth, bucket occupancy
+  (the same payload the training UI proxies).
+- ``GET /metrics`` — Prometheus text (standalone deployments; the UI
+  server exposes the same registry).
+
+Per-route latency lands in ``dl4j_serve_request_seconds{route=...}``.
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+from concurrent.futures import TimeoutError as _FutureTimeout
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+from urllib.parse import urlparse
+
+import numpy as np
+
+from deeplearning4j_tpu.observability import names as _n
+from deeplearning4j_tpu.observability.metrics import global_registry
+
+from .admission import RejectedError
+from .batcher import MicroBatcher
+from .registry import ModelRegistry, global_model_registry
+from .streaming import StreamSessions
+
+
+class _ServeHandler(BaseHTTPRequestHandler):
+    engine: "InferenceServer"  # bound via type() subclass
+
+    # keep-alive: without this the stdlib default (HTTP/1.0) closes the
+    # socket after EVERY response, so each request pays a TCP connect plus
+    # a fresh handler thread — at serving rates that reconnect tax dwarfs
+    # the model dispatch the micro-batcher is amortizing. Every response
+    # below carries Content-Length (or proper chunked framing), which
+    # HTTP/1.1 persistence requires.
+    protocol_version = "HTTP/1.1"
+
+    # small request/response pairs on a persistent connection are the
+    # Nagle + delayed-ACK worst case (40ms stalls per roundtrip); serving
+    # traffic is latency-critical, so push segments out immediately
+    disable_nagle_algorithm = True
+
+    def log_message(self, fmt, *args):  # silence request logging
+        pass
+
+    # ------------------------------------------------------------- helpers
+    def _json(self, obj, code=200, headers=()):
+        body = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        for k, v in headers:
+            self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _body(self) -> dict:
+        n = int(self.headers.get("Content-Length", 0))
+        if n <= 0:
+            return {}
+        raw = self.rfile.read(n)
+        obj = json.loads(raw.decode())
+        if not isinstance(obj, dict):
+            raise ValueError("request body must be a JSON object")
+        return obj
+
+    # -------------------------------------------------------------- routes
+    def do_GET(self):
+        path = urlparse(self.path).path
+        if path == "/serve/status":
+            self._json(self.engine.status())
+        elif path == "/metrics":
+            body = global_registry().prometheus_text().encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "text/plain; version=0.0.4")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+        else:
+            self._json({"error": f"unknown route {path}"}, code=404)
+
+    def do_POST(self):
+        path = urlparse(self.path).path
+        t0 = time.perf_counter()
+        try:
+            if path == "/v1/predict":
+                self._predict()
+            elif path == "/v1/stream":
+                self._stream()
+            elif path == "/v1/stream/reset":
+                req = self._body()
+                existed = self.engine.sessions.reset(
+                    str(req.get("model", "")), str(req.get("session", "")))
+                self._json({"reset": existed})
+            else:
+                self._json({"error": f"unknown route {path}"}, code=404)
+        except RejectedError as e:
+            self._json(
+                {"error": str(e), "pending": e.pending, "limit": e.limit},
+                code=429,
+                headers=(("Retry-After", f"{max(e.retry_after_s, 0.001):.3f}"),))
+        except KeyError as e:
+            self._json({"error": f"unknown model: {e}"}, code=404)
+        except (ValueError, json.JSONDecodeError) as e:
+            self._json({"error": str(e)}, code=400)
+        except TimeoutError as e:
+            self._json({"error": f"dispatch timed out: {e}"}, code=503)
+        finally:
+            self.engine._h_request.labels(route=path).observe(
+                time.perf_counter() - t0)
+
+    @staticmethod
+    def _inputs(req: dict) -> np.ndarray:
+        if "inputs" not in req:
+            raise ValueError('request body needs an "inputs" field')
+        return np.asarray(req["inputs"], dtype=np.float32)
+
+    def _predict(self) -> None:
+        req = self._body()
+        model = str(req.get("model", ""))
+        x = self._inputs(req)
+        if x.ndim == 1:
+            x = x[None, :]
+        self.engine.registry.active(model)  # 404 before queueing
+        fut = self.engine.batcher.submit(model, x)
+        try:
+            res = fut.result(timeout=self.engine.request_timeout_s)
+        except (_FutureTimeout, TimeoutError):
+            raise TimeoutError(
+                f"no dispatch within {self.engine.request_timeout_s}s")
+        except Exception as e:
+            self._json({"error": f"{type(e).__name__}: {e}"}, code=500)
+            return
+        self._json({
+            "predictions": np.asarray(res["predictions"]).tolist(),
+            "model": res["model"], "version": res["version"],
+            "batched_with": res["batch_rows"], "bucket": res["bucket"]})
+
+    def _stream(self) -> None:
+        req = self._body()
+        model = str(req.get("model", ""))
+        session = str(req.get("session") or f"conn-{id(self.connection)}")
+        x = self._inputs(req)
+        if x.ndim == 2:
+            x = x[:, None, :]
+        if x.ndim != 3:
+            raise ValueError(
+                f"stream inputs must be [B,T,F] or [B,F], got {x.shape}")
+        self.send_response(200)
+        self.send_header("Content-Type", "application/x-ndjson")
+        self.send_header("Transfer-Encoding", "chunked")
+        self.end_headers()
+
+        def chunk(obj: dict) -> None:
+            line = (json.dumps(obj) + "\n").encode()
+            self.wfile.write(f"{len(line):x}\r\n".encode() + line + b"\r\n")
+            self.wfile.flush()
+
+        for t in range(x.shape[1]):
+            step = self.engine.sessions.step(model, session, x[:, t:t + 1, :])
+            chunk({"t": t, "output": step["output"][:, -1, :].tolist(),
+                   "version": step["version"]})
+        chunk({"done": True, "session": session, "timesteps": int(x.shape[1])})
+        self.wfile.write(b"0\r\n\r\n")
+
+
+class InferenceServer:
+    """The serving engine: registry + micro-batcher + HTTP front-end."""
+
+    def __init__(self, registry: Optional[ModelRegistry] = None, *,
+                 host: str = "127.0.0.1", port: int = 0,
+                 max_batch: int = 32, max_latency_s: float = 0.002,
+                 max_queue: int = 256, request_timeout_s: float = 30.0,
+                 stream_ttl_s: float = 300.0):
+        self.registry = registry or global_model_registry()
+        self.batcher = MicroBatcher(
+            self.registry, max_batch=max_batch, max_latency_s=max_latency_s,
+            max_queue=max_queue)
+        self.sessions = StreamSessions(self.registry, ttl_s=stream_ttl_s)
+        self.request_timeout_s = float(request_timeout_s)
+        self._h_request = global_registry().histogram(
+            _n.SERVE_REQUEST_SECONDS, "HTTP request latency per route")
+        handler = type("BoundServeHandler", (_ServeHandler,),
+                       {"engine": self})
+        self._httpd = ThreadingHTTPServer((host, port), handler)
+        self._httpd.daemon_threads = True
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    def start(self) -> "InferenceServer":
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="serve-http", daemon=True)
+        self._thread.start()
+        _set_active_server(self)
+        return self
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self.batcher.close()
+        _set_active_server(None, only_if=self)
+
+    def status(self) -> dict:
+        """Everything /serve/status (here and on the training UI) shows."""
+        return {
+            **self.registry.status(),
+            "queue": self.batcher.stats(),
+            "streams": self.sessions.status(),
+        }
+
+
+# The most recent started server, so the training UI's /serve/status route
+# can show serving next to training health without holding a reference.
+_ACTIVE: Optional[InferenceServer] = None
+_ACTIVE_LOCK = threading.Lock()
+
+
+def _set_active_server(server: Optional[InferenceServer],
+                       only_if: Optional[InferenceServer] = None) -> None:
+    global _ACTIVE
+    with _ACTIVE_LOCK:
+        if only_if is not None and _ACTIVE is not only_if:
+            return
+        _ACTIVE = server
+
+
+def active_server() -> Optional[InferenceServer]:
+    with _ACTIVE_LOCK:
+        return _ACTIVE
+
+
+def serve_status() -> dict:
+    """Registry + queue status for whatever is serving right now (the
+    training UI's /serve/status payload; registry-only when no
+    InferenceServer has started)."""
+    srv = active_server()
+    if srv is not None:
+        return srv.status()
+    return {**global_model_registry().status(), "queue": None, "streams": {}}

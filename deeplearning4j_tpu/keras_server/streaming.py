@@ -1,0 +1,122 @@
+"""Streaming timestep inference over the ``rnn_time_step`` seam.
+
+The reference's serving story for recurrent models is ``rnnTimeStep`` plus
+``rnnGet/SetPreviousState`` — feed one timestep, carry hidden state across
+calls, hand state around for session affinity. This module turns that seam
+into server-side sessions:
+
+- ONE streaming clone per (model, version) — cloned once so streaming
+  state never touches the registry's pinned predict snapshot, and shared
+  across sessions so the ``rnn_time_step`` program compiles once per
+  distinct batch shape, not once per session;
+- per-session state is parked host-side between calls via
+  ``rnn_get_previous_state``/``rnn_set_previous_state`` (exactly the
+  reference's serving-handoff contract), swapped in under the model lock
+  for each step;
+- sessions idle past ``ttl_s`` are evicted on the next touch.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from deeplearning4j_tpu.observability import names as _n
+from deeplearning4j_tpu.observability.metrics import global_registry
+
+from .registry import ModelRegistry
+
+
+class _StreamModel:
+    """The shared streaming clone + its per-session parked states."""
+
+    def __init__(self, net):
+        self.net = net.clone()
+        self.lock = threading.Lock()
+        #: session id -> (parked rnn state, last-touch monotonic time)
+        self.states: Dict[str, Tuple[object, float]] = {}
+
+
+class StreamSessions:
+    """Server-side rnnTimeStep sessions with TTL eviction."""
+
+    def __init__(self, registry: ModelRegistry, ttl_s: float = 300.0,
+                 metrics=None):
+        self.registry = registry
+        self.ttl_s = float(ttl_s)
+        self._lock = threading.Lock()
+        self._models: Dict[Tuple[str, str], _StreamModel] = {}
+        m = metrics or global_registry()
+        self._g_sessions = m.gauge(
+            _n.SERVE_STREAM_SESSIONS, "live streaming sessions")
+        self._c_steps = m.counter(
+            _n.SERVE_STREAM_STEPS_TOTAL, "streamed timesteps served")
+
+    def _model(self, name: str) -> Tuple[_StreamModel, str]:
+        mv = self.registry.active(name)
+        if not mv.streaming_capable:
+            raise ValueError(f"model {name!r} has no rnn_time_step seam")
+        key = (mv.name, mv.version)
+        with self._lock:
+            sm = self._models.get(key)
+            if sm is None:
+                sm = self._models[key] = _StreamModel(mv.net)
+            return sm, mv.version
+
+    def _evict_expired(self, sm: _StreamModel, now: float) -> None:
+        for sid, (_, t) in list(sm.states.items()):
+            if now - t > self.ttl_s:
+                del sm.states[sid]
+
+    def _session_count(self) -> int:
+        with self._lock:
+            return sum(len(sm.states) for sm in self._models.values())
+
+    def step(self, model: str, session: str, x) -> dict:
+        """Advance one session by one (or more) timesteps.
+
+        ``x``: ``[B, T, F]`` (or ``[B, F]``, treated as T=1). Returns the
+        output for the LAST timestep plus the model version serving the
+        session. State persists server-side under ``session``.
+        """
+        x = np.asarray(x)
+        if x.ndim == 2:
+            x = x[:, None, :]
+        if x.ndim != 3:
+            raise ValueError(
+                f"streaming input must be [B,T,F] or [B,F], got {x.shape}")
+        sm, version = self._model(model)
+        with sm.lock:
+            now = time.monotonic()
+            self._evict_expired(sm, now)
+            parked = sm.states.get(session)
+            sm.net.rnn_set_previous_state(
+                parked[0] if parked is not None else None)
+            out = sm.net.rnn_time_step(x)
+            if isinstance(out, list):  # ComputationGraph returns [outputs]
+                out = out[0]
+            sm.states[session] = (sm.net.rnn_get_previous_state(), now)
+        self._c_steps.labels(model=model).inc(int(x.shape[1]))
+        self._g_sessions.set(self._session_count())
+        return {"output": np.asarray(out), "model": model,
+                "version": version, "session": session,
+                "timesteps": int(x.shape[1])}
+
+    def reset(self, model: str, session: str) -> bool:
+        """Drop a session's parked state (True if it existed)."""
+        try:
+            sm, _ = self._model(model)
+        except KeyError:
+            return False
+        with sm.lock:
+            existed = sm.states.pop(session, None) is not None
+        self._g_sessions.set(self._session_count())
+        return existed
+
+    def status(self) -> dict:
+        with self._lock:
+            return {
+                f"{name}@{version}": sorted(sm.states)
+                for (name, version), sm in sorted(self._models.items())}

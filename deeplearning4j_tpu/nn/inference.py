@@ -1,0 +1,105 @@
+"""Non-donated compiled inference: the serving seam.
+
+Training dispatches donate params/states/updater into XLA
+(``LazyScore._run_multistep`` jits with ``donate_argnums=(0, 1, 2)``) — the
+buffers are consumed in place, which is exactly right for a fit loop and
+exactly wrong for serving, where the same parameters must survive millions
+of forward passes. :func:`make_predict_fn` pins a **snapshot** of a
+network's parameters/states (real buffer copies, like ``clone()``) to a
+compiled forward program jitted WITHOUT donation, so:
+
+- serving a request can never invalidate the source network's buffers, and
+  training the source network can never invalidate the serving snapshot;
+- the compiled program is policy-keyed and compile-tracked through the same
+  ``LazyScore._jit`` cache as every other program, so recompiles show up in
+  ``dl4j_jit_compile_total`` and the recompile-storm detector;
+- per padded-batch-bucket compiles are the ONLY compiles: a steady-state
+  server replays cached executables (the MicroBatcher's contract).
+
+The reference serves via ``KerasModelEndpoint``/``output()`` with no
+donation concept; here the seam must be explicit because the fit path's
+donation is what makes TPU training fast.
+"""
+from __future__ import annotations
+
+import functools
+import threading
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+#: the program name every serving forward compiles under — load tests and
+#: the compile-cache-bounded test filter CompileTracker events on it
+PREDICT_PROGRAM_NAME = "serve_predict"
+
+
+def _copy_tree(tree):
+    """Real buffer copies, not aliases (same contract as clone())."""
+    return jax.tree_util.tree_map(lambda a: jnp.array(a), tree)
+
+
+class PredictFn:
+    """A compiled, non-donated, snapshot-pinned forward pass.
+
+    Callable: ``predict_fn(x) -> jnp array`` where ``x`` carries a leading
+    batch axis. Thread-safe — concurrent calls share one compiled program
+    per abstract input shape (jax's jit cache handles the rest); the pinned
+    buffers are never donated so calls cannot race on buffer liveness.
+    """
+
+    def __init__(self, net, name: str = PREDICT_PROGRAM_NAME):
+        net._require_init()
+        self._net = net
+        self._name = name
+        # snapshot at pin time: a later fit() on `net` donates ITS buffers,
+        # not these copies, and a hot-swap replaces this object wholesale
+        self._params = _copy_tree(net.params_list)
+        self._states = _copy_tree(net.state_list)
+        self._graph = type(net).__name__ == "ComputationGraph"
+        if self._graph:
+            n_in = len(net.conf.network_inputs)
+            if n_in != 1:
+                raise ValueError(
+                    f"serving supports single-input graphs; this graph has "
+                    f"{n_in} inputs — call net.output(*inputs) directly")
+            self._single_out = len(net.conf.network_outputs) == 1
+            fn = net._output_pure
+        else:
+            fn = functools.partial(net._output_pure, train=False)
+        # LazyScore._jit: policy-keyed, compile-tracked, NO donate argnums
+        self._fn = net._jit(name, fn)
+        self._lock = threading.Lock()
+        self.calls = 0  #: dispatches served (host-side, informational)
+
+    @property
+    def name(self) -> str:
+        return self._name
+
+    def params_snapshot(self):
+        """The pinned parameter pytree (tests assert bit-stability)."""
+        return self._params
+
+    def __call__(self, x) -> Any:
+        x = jnp.asarray(x)
+        if self._graph:
+            outs, _ = self._fn(self._params, self._states, [x])
+            out = outs[0] if self._single_out else outs
+        else:
+            out, _ = self._fn(self._params, self._states, x)
+        with self._lock:
+            self.calls += 1
+        return out
+
+
+def make_predict_fn(net, name: str = PREDICT_PROGRAM_NAME,
+                    version: Optional[str] = None) -> PredictFn:
+    """Pin a non-donated compiled forward for serving.
+
+    ``version`` only decorates the program name (``serve_predict@v2``) so a
+    hot-swapped model's compiles are attributable in the compile tracker;
+    omit it for the plain serving program.
+    """
+    if version:
+        name = f"{name}@{version}"
+    return PredictFn(net, name=name)

@@ -70,6 +70,20 @@ PROFILE_ACTIVE = "dl4j_profile_active"
 # --- model FLOP utilization (observability/compile_tracker.py) --------------
 STEP_MFU = "dl4j_step_mfu"
 
+# --- serving engine (keras_server/{registry,batcher,serving,streaming}.py) -
+SERVE_REQUESTS_TOTAL = "dl4j_serve_requests_total"
+SERVE_REJECTED_TOTAL = "dl4j_serve_rejected_total"
+SERVE_ERRORS_TOTAL = "dl4j_serve_errors_total"
+SERVE_REQUEST_SECONDS = "dl4j_serve_request_seconds"
+SERVE_BATCH_DISPATCH_SECONDS = "dl4j_serve_batch_dispatch_seconds"
+SERVE_BATCHES_TOTAL = "dl4j_serve_batches_total"
+SERVE_QUEUE_DEPTH = "dl4j_serve_queue_depth"
+SERVE_BATCH_OCCUPANCY = "dl4j_serve_batch_occupancy"
+SERVE_MODELS_LOADED = "dl4j_serve_models_loaded"
+SERVE_HOT_SWAPS_TOTAL = "dl4j_serve_hot_swaps_total"
+SERVE_STREAM_SESSIONS = "dl4j_serve_stream_sessions"
+SERVE_STREAM_STEPS_TOTAL = "dl4j_serve_stream_steps_total"
+
 # --- input pipeline (datasets/prefetch.py) ---------------------------------
 PREFETCH_DEPTH = "dl4j_prefetch_depth"
 PREFETCH_BYTES_TOTAL = "dl4j_prefetch_bytes_total"

@@ -360,6 +360,10 @@ class _Handler(BaseHTTPRequestHandler):
             self._json(self.ui.telemetry_data())
         elif path == "/train/health":
             self._json(self.ui.health_data())
+        elif path == "/serve/status":
+            # serving engine pane: models/versions, queue depth, bucket
+            # occupancy — same payload the InferenceServer exposes itself
+            self._json(self.ui.serve_status_data())
         elif path == "/train/health/bundles":
             self._json(self.ui.health_bundles())
         elif path == "/train/profiles":
@@ -536,6 +540,15 @@ class UIServer:
         from deeplearning4j_tpu.observability import global_registry
 
         return global_registry().prometheus_text()
+
+    def serve_status_data(self) -> dict:
+        """Serving-engine snapshot for ``/serve/status``: loaded model
+        versions, queue depth, bucket occupancy — training health and
+        serving share one pane (lazy import: the UI must not pull the
+        serving stack unless something asks for it)."""
+        from deeplearning4j_tpu.keras_server.serving import serve_status
+
+        return serve_status()
 
     def telemetry_data(self) -> dict:
         """JSON registry snapshot + recent compile events for

@@ -14,6 +14,10 @@
 #   ./runtests.sh profile [args]  # trace-attribution engine: XPlane parser
 #                                 # golden tests, TraceSession lock, triggers,
 #                                 # e2e CPU capture + bench attribution row
+#   ./runtests.sh serve [args]    # serving engine: non-donated predict,
+#                                 # bucketed micro-batching semantics, 429
+#                                 # backpressure, hot swap, streaming, HTTP
+#                                 # front-end, bench serve-axis contract
 set -e
 cd "$(dirname "$0")"
 
@@ -40,6 +44,16 @@ if [ "${1-}" = "profile" ]; then
   XLA_FLAGS="--xla_force_host_platform_device_count=8" \
   exec python -m pytest tests/test_profiler.py \
     tests/test_bench_contract.py::test_xplane_attribution_contract -q "$@"
+fi
+
+if [ "${1-}" = "serve" ]; then
+  shift
+  PALLAS_AXON_POOL_IPS= \
+  JAX_PLATFORMS=cpu \
+  XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+  exec python -m pytest tests/test_serving.py tests/test_serving_http.py \
+    tests/test_bench_contract.py::test_config_key_serve_axes \
+    tests/test_bench_contract.py::test_grid_row_serve -q "$@"
 fi
 
 if [ "${1-}" = "health" ]; then

@@ -64,6 +64,10 @@ run --model char_rnn --hidden 1024
 # param_bytes_per_device, dp_tp prices the Megatron column/row splits
 run --model fit_resnet50 --sharding zero3
 run --model transformer --sharding dp_tp
+# serving-engine headline row (ISSUE 9): micro-batched vs unbatched A/B at
+# the auto-calibrated saturation rate; the full record (p50/p99, occupancy,
+# recompiles == bucket count) also lands in scripts/serve_load.jsonl
+run --model serve
 if [ "$MODE" = full ]; then
     run --model lenet
     run --model lenet --bf16-act

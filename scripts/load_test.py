@@ -1,0 +1,137 @@
+#!/usr/bin/env python
+"""Load-test the serving engine: open/closed-loop QPS sweep, p50/p99.
+
+Two modes:
+
+- **Self-contained A/B** (default, no --port): builds a small MLP,
+  serves it unbatched vs micro-batched at the same offered QPS through
+  the real HTTP stack, prints the A/B record, and appends it as JSONL to
+  --record (default scripts/serve_load.jsonl, next to bench_log). This
+  is the same harness `python bench.py --model serve` wraps; run it here
+  when you want the raw record without the bench driver's retry/JSON
+  envelope.
+
+      python scripts/load_test.py --qps 400 --duration 3 --max-batch 32
+
+- **Target an already-running InferenceServer** (--port): sweep offered
+  QPS open-loop (honest about saturation: the client never slows down,
+  so overload shows as latency growth and 429s), or measure closed-loop
+  peak throughput with --closed. One JSON line per sweep point.
+
+      python scripts/load_test.py --port 8099 --model mlp \
+          --shape 1,16 --sweep 50,100,200,400 --duration 2
+      python scripts/load_test.py --port 8099 --model mlp \
+          --shape 1,16 --closed --workers 16 --requests 200
+"""
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _example(shape_csv: str):
+    import numpy as np
+    shape = tuple(int(s) for s in shape_csv.split(","))
+    return np.random.default_rng(0).normal(size=shape).astype(np.float32)
+
+
+def _target_mode(args) -> int:
+    from deeplearning4j_tpu.keras_server.loadgen import (run_closed_loop,
+                                                         run_open_loop)
+    example = _example(args.shape)
+    if args.closed:
+        res = run_closed_loop(args.port, args.model, example,
+                              workers=args.workers,
+                              requests_per_worker=args.requests,
+                              host=args.host)
+        print(json.dumps(res))
+        return 0
+    for qps in (float(q) for q in args.sweep.split(",")):
+        res = run_open_loop(args.port, args.model, example, qps=qps,
+                            duration_s=args.duration, workers=args.workers,
+                            host=args.host)
+        print(json.dumps(res), flush=True)
+    return 0
+
+
+def _ab_mode(args) -> int:
+    import numpy as np
+    from deeplearning4j_tpu.keras_server.loadgen import run_ab
+    from deeplearning4j_tpu.nn.conf.builders import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    n_in, hidden = args.n_in, args.hidden
+    conf = (NeuralNetConfiguration.builder()
+            .seed(7).learning_rate(0.1).updater("adam")
+            .weight_init("xavier")
+            .list()
+            .layer(DenseLayer(n_in=n_in, n_out=hidden, activation="relu"))
+            .layer(DenseLayer(n_in=hidden, n_out=hidden, activation="relu"))
+            .layer(OutputLayer(n_in=hidden, n_out=10, loss="mcxent",
+                               activation="softmax"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    example = np.random.default_rng(0).normal(
+        size=(1, n_in)).astype(np.float32)
+    rec = run_ab(net, model="load_test_mlp", qps=args.qps,
+                 duration_s=args.duration, max_batch=args.max_batch,
+                 max_latency_s=args.max_latency_ms / 1e3,
+                 max_queue=args.max_queue, example=example,
+                 workers=args.workers, record_path=args.record)
+    print(json.dumps(rec, indent=2))
+    ok = (rec["batched_speedup"] > 1.0 and rec["p99_improvement"] > 1.0
+          and rec["batched"]["recompiles"] == rec["batched"]["bucket_count"])
+    print(f"# batched_speedup={rec['batched_speedup']}x "
+          f"p99_improvement={rec['p99_improvement']}x "
+          f"recompiles={rec['batched']['recompiles']} "
+          f"buckets={rec['batched']['bucket_count']} -> "
+          f"{'PASS' if ok else 'FAIL'}", file=sys.stderr)
+    return 0 if ok else 1
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(
+        description=__doc__, formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--port", type=int, default=None,
+                    help="target an already-running InferenceServer "
+                         "(default: self-contained A/B)")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--model", default="model",
+                    help="registered model name on the target server")
+    ap.add_argument("--shape", default="1,64",
+                    help="request input shape, comma-separated (target mode)")
+    ap.add_argument("--sweep", default="50,100,200,400",
+                    help="comma-separated offered-QPS sweep (target mode)")
+    ap.add_argument("--closed", action="store_true",
+                    help="closed-loop peak-throughput probe instead of the "
+                         "open-loop sweep (target mode)")
+    ap.add_argument("--requests", type=int, default=200,
+                    help="closed-loop requests per worker")
+    ap.add_argument("--qps", type=float, default=400.0,
+                    help="offered QPS for the A/B (self-contained mode)")
+    ap.add_argument("--duration", type=float, default=3.0,
+                    help="seconds per phase / sweep point")
+    ap.add_argument("--workers", type=int, default=32)
+    ap.add_argument("--max-batch", type=int, default=32)
+    ap.add_argument("--max-latency-ms", type=float, default=4.0,
+                    help="micro-batcher coalescing wait (A/B batched phase)")
+    ap.add_argument("--max-queue", type=int, default=2048)
+    ap.add_argument("--hidden", type=int, default=128,
+                    help="A/B model hidden width")
+    ap.add_argument("--n-in", type=int, default=16,
+                    help="A/B model input width (also the request payload "
+                         "size — serving is wire-cost sensitive)")
+    ap.add_argument("--record", default=os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "serve_load.jsonl"),
+        help="JSONL record path (A/B mode); '' disables")
+    args = ap.parse_args()
+    if args.port is not None:
+        return _target_mode(args)
+    return _ab_mode(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

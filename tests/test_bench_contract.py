@@ -358,3 +358,51 @@ def test_xplane_attribution_contract():
     # new model is consciously added or consciously excluded)
     assert bench._PROFILE_CAPABLE == frozenset(
         {"lenet", "resnet50", "vgg16", "char_rnn", "transformer", "moe"})
+
+
+def test_config_key_serve_axes():
+    """The serving A/B's load shape is config-distinct: an explicit
+    --serve-qps row must not stand in for the auto-calibrated headline
+    (offered rate IS the config under an open-loop client), the coalescing
+    window is an axis for the same reason, other models don't grow phantom
+    serve axes, and the ts-gate ignores the axes on rows that predate the
+    serving engine — the same pattern as the sharding gate."""
+    import bench
+
+    a = bench._config_key("--model serve")
+    b = bench._config_key("--model serve --serve-qps 800")
+    c = bench._config_key("--model serve --serve-latency-ms 8")
+    assert a != b and a["serve_qps"] == "auto" and b["serve_qps"] == "800"
+    assert a != c and c["serve_latency_ms"] == "8"
+    assert a["serve_latency_ms"] == "4"  # the bench_serve default, pinned
+    # non-serve models don't grow phantom axes
+    r = bench._config_key("--model resnet50")
+    assert r["serve_qps"] is None and r["serve_latency_ms"] is None
+    # rows logged before the serving engine landed cannot be serve rows;
+    # the gate strips the axes rather than invent a config for them
+    old = bench._config_key("--model serve --serve-qps 800",
+                            ts="2026-08-05T21:59:59Z")
+    new = bench._config_key("--model serve --serve-qps 800",
+                            ts="2026-08-05T22:00:01Z")
+    assert old["serve_qps"] is None and new["serve_qps"] == "800"
+    ts = bench._SERVE_AXIS_LANDED_TS
+    assert ts.endswith("Z") and ts > bench._SHARDING_AXIS_LANDED_TS
+
+
+def test_grid_row_serve():
+    """The serve scenario is wired through the whole bench surface: grid
+    membership, the requests/sec unit (the one non-samples/sec headline),
+    the f32 dtype default (bf16 convert ops would dominate the tiny
+    serving model like they do LeNet), and profile-incapable (the A/B
+    runs its own servers, not the multistep harness)."""
+    import bench
+
+    assert bench._METRICS["serve"] == "serve_batched_requests_per_sec"
+    assert "serve" in bench._DEFAULTS and "serve" in bench._bench_fns()
+    assert bench._UNITS["serve"] == "requests/sec"
+    assert bench._DTYPE_DEFAULT["serve"] == "f32"
+    assert "serve" not in bench._PROFILE_CAPABLE
+    assert "serve" not in bench._SHARDING_CAPABLE
+    batch, iters, _ = bench._DEFAULTS["serve"]
+    assert batch >= 8  # max_batch: must exercise multiple pow2 buckets
+    assert iters >= 2  # seconds per phase
