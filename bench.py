@@ -912,6 +912,140 @@ def bench_serve(batch, iters, ksteps, serve_qps=None, serve_latency_ms=None):
     }
 
 
+class _StragglerIterator:
+    """Sync-DP straggler model: the barrier waits for the slowest worker
+    every step, so one k×-slow worker stalls EVERY iteration by its extra
+    step time. Injected as a per-batch sleep in front of the fused sync
+    step (a fused DP step has no per-worker thread to slow down)."""
+
+    def __init__(self, batches, stall_s: float):
+        self._batches = batches
+        self._stall = stall_s
+
+    def reset(self):
+        pass
+
+    def __iter__(self):
+        for ds in self._batches:
+            time.sleep(self._stall)
+            yield ds
+
+
+def bench_ps_async(batch, iters, ksteps, ps_workers=None, ps_straggler=None):
+    """Straggler A/B: async parameter server vs the sync-DP barrier
+    (ISSUE 10 headline). CPU-measured by design, like serve: the win is
+    host-side orchestration (no per-step barrier), not MXU width — the
+    parent driver forces JAX_PLATFORMS=cpu + an 8-device host platform so
+    the sync phase gets a real data mesh on any box.
+
+    Phase A (throughput + time-to-loss): one worker of W sleeps k× the
+    median per-step delay. Sync = ParallelWrapper over a data mesh at equal
+    worker count, stalled every step by the straggler's extra time (the
+    barrier semantic); async = ParameterServerParallelWrapper with the same
+    sleeps injected per worker thread — the straggler only slows its own
+    pushes. Phase B (loss parity at equal samples): 2 separate-process TCP
+    workers with bf16 delta compression vs a single-process sync-DP fit of
+    the same LeNet on the same batches — 2 epochs each, so parity is
+    measured at the label-noise plateau both paths converge to (comparing
+    mid-descent would measure descent speed, not fidelity).
+    """
+    import jax
+
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.datasets.iterators import ListDataSetIterator
+    from deeplearning4j_tpu.models.lenet import lenet_mnist
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.parallel.mesh import build_mesh
+    from deeplearning4j_tpu.parallel.param_server import (
+        ParameterServerParallelWrapper)
+    from deeplearning4j_tpu.parallel.wrapper import ParallelWrapper
+
+    W = int(ps_workers or 4)
+    k = float(ps_straggler or 4.0)
+    delay_s = 0.02  # median per-step worker delay; straggler sleeps k*this
+    push_frequency, staleness_cap = 4, 8
+    n_batches = iters * ksteps
+
+    # learnable 10-class cluster data on the LeNet input shape, so the
+    # time-to-loss and parity numbers track real convergence; 25% label
+    # noise gives the loss an irreducible floor (~1.0 nats) both paths
+    # plateau at — a relative parity gap near zero loss is meaningless
+    rng = np.random.default_rng(0)
+    means = rng.normal(0.0, 1.0, (10, 784)).astype(np.float32)
+    data = []
+    for _ in range(n_batches):
+        lab = rng.integers(0, 10, batch)
+        x = (means[lab] + rng.normal(0, 0.5, (batch, 784))).astype(np.float32)
+        noisy = np.where(rng.random(batch) < 0.25,
+                         rng.integers(0, 10, batch), lab)
+        data.append(DataSet(x, np.eye(10, dtype=np.float32)[noisy]))
+    gx = np.concatenate([d.features for d in data])
+    gy = np.concatenate([d.labels for d in data])
+
+    base = MultiLayerNetwork(lenet_mnist()).init()
+
+    # --- phase A sync: the barrier pays the straggler's extra time per step
+    sync_net = base.clone()
+    mesh = build_mesh({"data": min(W, len(jax.devices()))})
+    pw = ParallelWrapper(sync_net, prefetch=0, mesh=mesh)
+    pw.fit(ListDataSetIterator(data[:2]))  # compile outside the timed loop
+    t0 = time.perf_counter()
+    pw.fit(_StragglerIterator(data, k * delay_s))  # barrier = slowest worker
+    sync_dt = time.perf_counter() - t0
+    sync_loss = float(sync_net.score(gx, gy))
+
+    # --- phase A async: same sleeps per worker thread, no barrier
+    async_net = base.clone()
+    delays = [k * delay_s] + [delay_s] * (W - 1)
+    ps = (ParameterServerParallelWrapper.builder(async_net)
+          .workers(W).push_frequency(push_frequency)
+          .staleness(staleness_cap).transport("inproc")
+          .worker_delays(*delays).build())
+    ps.fit(ListDataSetIterator(data[:2]))  # compile outside the timed loop
+    t0 = time.perf_counter()
+    ps.fit(ListDataSetIterator(data))
+    async_dt = time.perf_counter() - t0
+    async_loss = float(async_net.score(gx, gy))
+
+    # --- phase B: 2-process TCP async vs single-process sync-DP, equal
+    # samples from the same init (loss-parity proof; bf16 deltas on the wire)
+    tcp_net = base.clone()
+    # push_frequency 2 here: shorter windows keep wire staleness ~0-1 and
+    # let the background puller rebase mid-window, which is what holds the
+    # parity gap down (measured: 2.8% at pf=2 vs 4.6% at pf=4)
+    tcp = (ParameterServerParallelWrapper.builder(tcp_net)
+           .workers(2).push_frequency(2)
+           .staleness(staleness_cap).transport("tcp")
+           .compression("bf16").build())
+    t0 = time.perf_counter()
+    tcp.fit(ListDataSetIterator(data), epochs=2)
+    tcp_dt = time.perf_counter() - t0
+    oracle = base.clone()
+    oracle.fit_iterator(ListDataSetIterator(data), epochs=2)
+    tcp_loss = float(tcp_net.score(gx, gy))
+    sync_dp_loss = float(oracle.score(gx, gy))
+
+    return {
+        "samples_per_sec": batch * n_batches / async_dt,
+        "sync_samples_per_sec": batch * n_batches / sync_dt,
+        "async_speedup": (batch * n_batches / async_dt)
+        / (batch * n_batches / sync_dt),
+        "async_time_s": async_dt, "sync_time_s": sync_dt,
+        "async_loss": async_loss, "sync_loss": sync_loss,
+        "workers": W, "straggler_factor": k,
+        "straggler_base_delay_ms": delay_s * 1e3,
+        "push_frequency": push_frequency, "staleness_cap": staleness_cap,
+        "applied_pushes": ps.server.pushes,
+        "rejected_pushes": ps.server.rejected,
+        "tcp_workers": 2, "tcp_epochs": 2, "tcp_time_s": tcp_dt,
+        "tcp_async_loss": tcp_loss, "sync_dp_loss": sync_dp_loss,
+        "tcp_loss_gap": abs(tcp_loss / sync_dp_loss - 1.0),
+        "tcp_worker_stats": tcp.worker_stats,
+        "batch": batch, "iters": iters, "ksteps": ksteps,
+        "api": "parallel.ParameterServerParallelWrapper",
+    }
+
+
 _METRICS = {
     "lenet": "lenet_mnist_samples_per_sec",
     "fit_lenet": "lenet_fit_api_samples_per_sec",
@@ -924,6 +1058,7 @@ _METRICS = {
     "word2vec": "word2vec_pairs_per_sec",
     "attention": "flash_attention_tokens_per_sec",
     "serve": "serve_batched_requests_per_sec",
+    "ps_async": "ps_async_samples_per_sec",
 }
 
 #: models whose headline is not a training samples/sec number
@@ -943,6 +1078,7 @@ _DEFAULTS = {  # model -> (batch, iters, ksteps)
     "word2vec": (1024, 10, 32),
     "attention": (4, 5, 4),
     "serve": (32, 3, 1),  # batch = serving max_batch, iters = seconds/phase
+    "ps_async": (32, 48, 1),  # iters = total minibatches through each path
 }
 
 
@@ -953,7 +1089,7 @@ def _bench_fns():
             "char_rnn": bench_char_rnn, "transformer": bench_transformer,
             "moe": bench_moe,
             "word2vec": bench_word2vec, "attention": bench_attention,
-            "serve": bench_serve}
+            "serve": bench_serve, "ps_async": bench_ps_async}
 
 
 #: per-model default dtype policy = the measured-best config on chip
@@ -966,7 +1102,10 @@ _DTYPE_DEFAULT = {"lenet": "bf16", "fit_lenet": "bf16",
                   "word2vec": "bf16", "attention": "bf16",
                   # serving measures f32 end-to-end request latency; bf16
                   # convert ops on tiny batches would dominate like LeNet
-                  "serve": "f32"}
+                  "serve": "f32",
+                  # PS A/B measures host-side orchestration (barrier vs
+                  # async push/pull), not MXU width: f32 like serve
+                  "ps_async": "f32"}
 
 
 def _dtype_mode(model: str, *, bf16_act: bool, bf16_matmul: bool,
@@ -1030,6 +1169,11 @@ def _child_main(args) -> None:
             kwargs["serve_qps"] = args.serve_qps
         if args.serve_latency_ms:
             kwargs["serve_latency_ms"] = args.serve_latency_ms
+    if args.model == "ps_async":
+        if args.ps_workers:
+            kwargs["ps_workers"] = args.ps_workers
+        if args.ps_straggler:
+            kwargs["ps_straggler"] = args.ps_straggler
     if getattr(args, "sharding", None):
         if args.model not in _SHARDING_CAPABLE:
             raise SystemExit(
@@ -1161,6 +1305,13 @@ def main() -> None:
     ap.add_argument("--serve-latency-ms", type=float, default=None,
                     help="serve bench micro-batcher max coalescing wait "
                          "(config-distinct); default 4ms")
+    ap.add_argument("--ps-workers", type=int, default=None,
+                    help="ps_async bench worker count for the straggler A/B "
+                         "(config-distinct); default 4")
+    ap.add_argument("--ps-straggler", type=float, default=None,
+                    help="ps_async bench straggler factor: one worker of "
+                         "--ps-workers sleeps this multiple of the median "
+                         "per-step delay (config-distinct); default 4")
     ap.add_argument("--telemetry-out", default=None,
                     help="append a metrics-registry snapshot (JSONL) to this "
                          "file beside the headline JSON; measurement-only — "
@@ -1199,6 +1350,16 @@ def main() -> None:
     # child (--child's parser ignores --attempts/--attempt-timeout)
     cmd = [sys.executable, os.path.abspath(__file__), "--child"] + sys.argv[1:]
 
+    # ps_async measures host-side orchestration and is CPU-measured by
+    # design (the straggler A/B needs a data mesh at worker count on any
+    # box, TPU relay or not); every other model inherits the env untouched
+    child_env = None
+    if args.model == "ps_async":
+        child_env = os.environ.copy()
+        child_env["JAX_PLATFORMS"] = "cpu"
+        child_env["PALLAS_AXON_POOL_IPS"] = ""
+        child_env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+
     def _scan_json(stdout) -> dict | None:
         if isinstance(stdout, bytes):
             stdout = stdout.decode("utf-8", errors="replace")
@@ -1225,7 +1386,8 @@ def main() -> None:
         t_attempt = time.time()
         try:
             proc = subprocess.run(cmd, capture_output=True, text=True,
-                                  timeout=args.attempt_timeout)
+                                  timeout=args.attempt_timeout,
+                                  env=child_env)
             rec = _scan_json(proc.stdout)
             if rec is None:
                 last_was_timeout = False
@@ -1336,6 +1498,13 @@ _SHARDING_AXIS_LANDED_TS = "2026-08-05T20:00:00Z"
 #: an outage can never serve a number measured under a different load shape
 _SERVE_AXIS_LANDED_TS = "2026-08-05T22:00:00Z"
 
+#: when the async parameter-server engine landed (round 10) — no bench_log
+#: row before this instant can be a '--model ps_async' row at all, and rows
+#: logged since carry the worker-count / straggler-factor knobs as config
+#: axes so an outage can never serve a number measured under a different
+#: straggler shape
+_PS_AXIS_LANDED_TS = "2026-08-05T22:00:30Z"
+
 
 def _config_key(args_str: str, ts: str = None) -> dict:
     """The fields that make two bench invocations the SAME config: model,
@@ -1390,12 +1559,20 @@ def _config_key(args_str: str, ts: str = None) -> dict:
         # at an explicit --serve-qps must not stand in for a calibrated run
         serve_qps = val("--serve-qps") or "auto"
         serve_latency_ms = val("--serve-latency-ms") or "4"
+    ps_workers = ps_straggler = None
+    if model == "ps_async" and not (ts is not None
+                                    and ts < _PS_AXIS_LANDED_TS):
+        # defaults are their own config: a 2-worker or 8x-straggler capture
+        # must never stand in for the standard 4-worker/4x A/B
+        ps_workers = val("--ps-workers") or "4"
+        ps_straggler = val("--ps-straggler") or "4"
     return {"model": model, "batch": val("--batch"),
             "ksteps": val("--ksteps"), "dtype": mode, "rdtype": rdtype,
             "seq": val("--seq"), "vocab": val("--vocab"),
             "hidden": val("--hidden"), "lstm_impl": lstm_impl,
             "sharding": sharding, "serve_qps": serve_qps,
-            "serve_latency_ms": serve_latency_ms}
+            "serve_latency_ms": serve_latency_ms,
+            "ps_workers": ps_workers, "ps_straggler": ps_straggler}
 
 
 def _last_healthy_from_log(args_str: str, path: str = None):

@@ -84,6 +84,20 @@ SERVE_HOT_SWAPS_TOTAL = "dl4j_serve_hot_swaps_total"
 SERVE_STREAM_SESSIONS = "dl4j_serve_stream_sessions"
 SERVE_STREAM_STEPS_TOTAL = "dl4j_serve_stream_steps_total"
 
+# --- async parameter server (parallel/{param_server,ps_transport}.py) ------
+PS_PUSHES_TOTAL = "dl4j_ps_pushes_total"
+PS_PULLS_TOTAL = "dl4j_ps_pulls_total"
+PS_STALENESS = "dl4j_ps_staleness"
+PS_PUSH_WEIGHT = "dl4j_ps_push_weight"
+PS_VERSION = "dl4j_ps_version"
+PS_WIRE_BYTES_TOTAL = "dl4j_ps_wire_bytes_total"
+PS_WORKER_STEPS_TOTAL = "dl4j_ps_worker_steps_total"
+
+# --- streaming routes + broker (streaming/{__init__,broker}.py) ------------
+ROUTE_ERRORS_TOTAL = "dl4j_route_errors_total"
+BROKER_MESSAGES_TOTAL = "dl4j_broker_messages_total"
+BROKER_RECONNECTS_TOTAL = "dl4j_broker_reconnects_total"
+
 # --- input pipeline (datasets/prefetch.py) ---------------------------------
 PREFETCH_DEPTH = "dl4j_prefetch_depth"
 PREFETCH_BYTES_TOTAL = "dl4j_prefetch_bytes_total"

@@ -1,0 +1,218 @@
+"""Parameter-server transports: one API, two interchangeable backends.
+
+The worker loop (param_server.run_worker_loop) only sees ``pull()`` and
+``push(delta, base_version)``:
+
+* ``InprocTransport`` — direct method calls into a shared `ParameterServer`
+  (worker threads; deterministic, zero-copy; what the unit tests use).
+* ``TcpTransport`` + ``ParameterServerTcpFrontend`` — stdlib sockets with
+  length-prefixed framed messages (streaming/wire.py), workers in separate
+  OS processes so the GIL cannot mask the async win. Pushed deltas may ride
+  as bf16 (`codec="bf16"`); pull responses and the canonical store stay f32.
+
+The reference's Aeron media driver + ParameterServerNode pair maps onto
+frontend + server object; replacing UDP with framed loopback TCP keeps the
+protocol inspectable with nothing beyond the stdlib.
+"""
+from __future__ import annotations
+
+import socket
+import threading
+from typing import Optional, Tuple
+
+import numpy as np
+
+from deeplearning4j_tpu.observability.flight_recorder import (
+    global_recorder as _flight_recorder,
+)
+from deeplearning4j_tpu.observability.metrics import (
+    global_registry as _obs_registry,
+)
+from deeplearning4j_tpu.observability.names import PS_WIRE_BYTES_TOTAL
+from deeplearning4j_tpu.observability.watchdog import beat as _wd_beat
+from deeplearning4j_tpu.parallel.param_server import (
+    ParameterServer, PushResult,
+)
+from deeplearning4j_tpu.streaming import wire
+
+_wire_bytes = _obs_registry().counter(
+    PS_WIRE_BYTES_TOTAL, "PS bytes on the wire, by op and codec")
+
+
+class Transport:
+    """What a PS worker holds: pull the versioned global params, push a
+    delta against the version it pulled."""
+
+    def pull(self) -> Tuple[int, np.ndarray]:
+        raise NotImplementedError
+
+    def push(self, delta: np.ndarray, base_version: int) -> PushResult:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+class InprocTransport(Transport):
+    def __init__(self, server: ParameterServer):
+        self._server = server
+
+    def pull(self) -> Tuple[int, np.ndarray]:
+        return self._server.pull_flat()
+
+    def push(self, delta: np.ndarray, base_version: int) -> PushResult:
+        return self._server.push_delta(delta, base_version)
+
+
+class TcpTransport(Transport):
+    """Client side of the framed loopback protocol. NOT thread-safe: each
+    worker (and its background puller) opens its own connection via
+    ``clone()``."""
+
+    def __init__(self, addr: Tuple[str, int], codec: str = "none",
+                 timeout: float = 60.0):
+        self._addr = tuple(addr)
+        self._codec = codec
+        self._timeout = timeout
+        self._lock = threading.Lock()
+        self._sock = wire.connect(self._addr, timeout=timeout)
+        self._tx = _wire_bytes.labels(op="push", codec=codec)
+        self._rx = _wire_bytes.labels(op="pull", codec="none")
+
+    def clone(self) -> "TcpTransport":
+        return TcpTransport(self._addr, self._codec, self._timeout)
+
+    def pull(self) -> Tuple[int, np.ndarray]:
+        with self._lock:
+            reply, payload, _ = wire.request(self._sock, {"op": "pull"})
+        self._rx.inc(len(payload))
+        vec = wire.decode_array(reply["array"], payload)
+        return reply["version"], vec
+
+    def push(self, delta: np.ndarray, base_version: int) -> PushResult:
+        meta, payload = wire.encode_array(
+            np.asarray(delta, np.float32), self._codec)
+        with self._lock:
+            reply, buf, sent = wire.request(
+                self._sock,
+                {"op": "push", "base_version": int(base_version),
+                 "array": meta}, payload)
+        self._tx.inc(sent)
+        params = wire.decode_array(reply["array"], buf)
+        return PushResult(accepted=reply["accepted"],
+                          version=reply["version"],
+                          staleness=reply["staleness"],
+                          weight=reply["weight"], params=params)
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:  # lint: swallowed-exception-ok (best-effort close on teardown)
+            pass
+
+
+class ParameterServerTcpFrontend:
+    """Serves one `ParameterServer` to TCP workers: accept loop + one thread
+    per connection, framed request/reply. Beats the watchdog from the server
+    loop and leaves flight-recorder breadcrumbs so a wedged worker fleet is
+    diagnosable post-mortem."""
+
+    def __init__(self, server: ParameterServer, host: str = "127.0.0.1",
+                 port: int = 0):
+        self._server = server
+        self._host, self._port = host, port
+        self._lsock: Optional[socket.socket] = None
+        self._stop = threading.Event()
+        self._threads: list = []
+        self._conns: list = []
+        self._lock = threading.Lock()
+
+    @property
+    def port(self) -> int:
+        return self._port
+
+    def start(self) -> "ParameterServerTcpFrontend":
+        self._lsock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._lsock.bind((self._host, self._port))
+        self._lsock.listen(64)
+        self._lsock.settimeout(0.2)
+        self._port = self._lsock.getsockname()[1]
+        t = threading.Thread(target=self._accept_loop, daemon=True,
+                             name="ps-tcp-accept")
+        t.start()
+        self._threads.append(t)
+        _flight_recorder().record("ps_server_start", port=self._port)
+        return self
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            _wd_beat()
+            try:
+                conn, peer = self._lsock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                break  # listener closed under us during stop()
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            with self._lock:
+                self._conns.append(conn)
+            t = threading.Thread(target=self._serve_conn, daemon=True,
+                                 args=(conn, peer), name="ps-tcp-conn")
+            t.start()
+            self._threads.append(t)
+
+    def _serve_conn(self, conn: socket.socket, peer) -> None:
+        with conn:
+            while not self._stop.is_set():
+                try:
+                    header, payload = wire.recv_frame(conn)
+                except (ConnectionError, OSError):
+                    return  # worker hung up (normal end of its run)
+                try:
+                    reply, buf = self._handle(header, payload)
+                except Exception as e:
+                    _flight_recorder().record("ps_server_error",
+                                              peer=str(peer), error=repr(e))
+                    try:
+                        wire.send_frame(conn, {"error": repr(e)})
+                    except OSError:  # lint: swallowed-exception-ok (peer already gone; error recorded above)
+                        pass
+                    return
+                _wd_beat(self._server.version)
+                try:
+                    wire.send_frame(conn, reply, buf)
+                except (ConnectionError, OSError):
+                    return  # worker died mid-reply; its stats are lost only
+
+    def _handle(self, header: dict, payload: bytes):
+        op = header.get("op")
+        if op == "pull":
+            version, vec = self._server.pull_flat()
+            meta, buf = wire.encode_array(vec, "none")
+            return {"version": version, "array": meta}, buf
+        if op == "push":
+            delta = wire.decode_array(header["array"], payload)
+            res = self._server.push_delta(delta, header["base_version"])
+            meta, buf = wire.encode_array(res.params, "none")
+            return {"accepted": res.accepted, "version": res.version,
+                    "staleness": res.staleness, "weight": res.weight,
+                    "array": meta}, buf
+        raise ValueError(f"unknown PS op {op!r}")
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._lsock is not None:
+            self._lsock.close()
+        with self._lock:
+            for conn in self._conns:
+                try:
+                    conn.close()
+                except OSError:  # lint: swallowed-exception-ok (already closed by handler thread)
+                    pass
+        for t in self._threads:
+            t.join(timeout=5)
+        _flight_recorder().record("ps_server_stop", port=self._port,
+                                  version=self._server.version,
+                                  pushes=self._server.pushes,
+                                  rejected=self._server.rejected)

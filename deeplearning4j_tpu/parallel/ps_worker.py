@@ -1,0 +1,71 @@
+"""Out-of-process parameter-server worker.
+
+Run as::
+
+    python -m deeplearning4j_tpu.parallel.ps_worker \
+        --addr 127.0.0.1:<port> --conf conf.json --data worker0.npz \
+        --worker-id 0 --push-frequency 4 --codec bf16 --delay 0.0
+
+Spawned by ``ParameterServerParallelWrapper`` (transport="tcp") and by the
+multi-process tests — the same separate-OS-process pattern as
+tests/_dist_worker.py, but joined through the PS TCP protocol instead of
+jax.distributed: each worker owns its interpreter and device, pulls the
+initial params from the server, trains its batch shard asynchronously
+(pushing staleness-weighted deltas), and prints ONE JSON stats line on
+stdout for the parent to parse.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--addr", required=True, help="host:port of the PS")
+    ap.add_argument("--conf", required=True, help="model config JSON path")
+    ap.add_argument("--data", required=True,
+                    help=".npz with x (n,B,...) / y (n,B,...) batch stacks")
+    ap.add_argument("--worker-id", type=int, default=0)
+    ap.add_argument("--push-frequency", type=int, default=4)
+    ap.add_argument("--codec", default="none", choices=("none", "bf16"))
+    ap.add_argument("--delay", type=float, default=0.0,
+                    help="straggler fault injection: sleep per step")
+    args = ap.parse_args(argv)
+
+    import numpy as np
+
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.nn.conf.serde import from_json
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.parallel.param_server import (
+        make_compiled_worker_step, run_worker_loop)
+    from deeplearning4j_tpu.parallel.ps_transport import TcpTransport
+
+    with open(args.conf) as f:
+        conf = from_json(f.read())
+    net = MultiLayerNetwork(conf).init()  # shapes only; params come from PS
+
+    blob = np.load(args.data)
+    batches = [DataSet(x, y) for x, y in zip(blob["x"], blob["y"])]
+    it = iter(batches)
+
+    host, port = args.addr.rsplit(":", 1)
+    transport = TcpTransport((host, int(port)), codec=args.codec)
+    step = make_compiled_worker_step(net, transport="tcp")
+    try:
+        stats = run_worker_loop(
+            transport=transport, replica=net,
+            step_fn=(step.fn if step is not None else None),
+            next_batch=lambda: next(it, None),
+            push_frequency=args.push_frequency,
+            delay_s=args.delay, worker_id=args.worker_id)
+    finally:
+        transport.close()
+    # stdout carries exactly one JSON line: the parent's parse contract
+    print(json.dumps(stats), flush=True)  # lint: bare-print-ok (subprocess stdout protocol, not logging)
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

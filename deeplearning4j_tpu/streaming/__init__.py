@@ -17,6 +17,18 @@ from typing import Any, Callable, List, Optional
 
 import numpy as np
 
+from deeplearning4j_tpu.observability.flight_recorder import (
+    global_recorder as _flight_recorder,
+)
+from deeplearning4j_tpu.observability.metrics import (
+    global_registry as _obs_registry,
+)
+from deeplearning4j_tpu.observability.names import ROUTE_ERRORS_TOTAL
+
+_route_errors = _obs_registry().counter(
+    ROUTE_ERRORS_TOTAL, "handler exceptions swallowed by streaming routes, "
+                        "by route class")
+
 
 class Route:
     """A consume loop on a background thread (reference Camel route)."""
@@ -28,6 +40,7 @@ class Route:
         self._thread: Optional[threading.Thread] = None
         self.processed = 0
         self.errors: List[str] = []
+        self._err_series = _route_errors.labels(route=type(self).__name__)
 
     def start(self) -> "Route":
         self._thread = threading.Thread(target=self._run, daemon=True)
@@ -43,8 +56,14 @@ class Route:
             try:
                 self.handler(msg)
                 self.processed += 1
-            except Exception as e:  # route keeps consuming
+            except Exception as e:  # route keeps consuming — but loudly:
+                # the errors list alone made a poisoned route invisible to
+                # dashboards; count it and leave a flight-recorder breadcrumb
                 self.errors.append(f"{type(e).__name__}: {e}")
+                self._err_series.inc()
+                _flight_recorder().record(
+                    "route_error", route=type(self).__name__,
+                    error=f"{type(e).__name__}: {e}")
             finally:
                 self.source.task_done()
 

@@ -1,0 +1,335 @@
+"""Loopback TCP broker + reconnecting consumer behind the Route API.
+
+Reference: dl4j-streaming's Camel+Kafka routes (CamelKafkaRouteBuilder) —
+the broker role Kafka played maps onto a stdlib-socket loopback server with
+Kafka's two load-bearing properties kept:
+
+* **offset-addressed topic logs** — every published message gets a dense
+  offset in its topic; consumers fetch *from* an offset, so delivery is
+  replayable;
+* **committed consumer offsets** — a consumer group commits the offset it
+  has fully handled; after a connection drop the consumer reconnects, asks
+  the broker for its committed offset, and resumes from the next message —
+  zero message loss (at-least-once: the one in-flight message may redeliver
+  if the drop lands between handling and commit).
+
+Wire format is streaming/wire.py's framed JSON+payload (the same frames the
+parameter-server TCP transport speaks). ``ReconnectingConsumer`` implements
+the queue seam ``Route`` consumes (`get`/`task_done`/`unfinished_tasks`/
+`all_tasks_done`), so ``Route(consumer, handler)`` — and therefore
+``BrokerTrainingRoute`` — works unchanged over the network.
+"""
+from __future__ import annotations
+
+import queue
+import socket
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from deeplearning4j_tpu.observability.flight_recorder import (
+    global_recorder as _flight_recorder,
+)
+from deeplearning4j_tpu.observability.metrics import (
+    global_registry as _obs_registry,
+)
+from deeplearning4j_tpu.observability.names import (
+    BROKER_MESSAGES_TOTAL, BROKER_RECONNECTS_TOTAL,
+)
+from deeplearning4j_tpu.streaming import Route, wire
+
+_messages = _obs_registry().counter(
+    BROKER_MESSAGES_TOTAL, "broker messages by op (publish|deliver)")
+_published = _messages.labels(op="publish")
+_delivered = _messages.labels(op="deliver")
+_reconnects = _obs_registry().counter(
+    BROKER_RECONNECTS_TOTAL, "consumer reconnects after a dropped broker "
+                             "connection").labels()
+
+
+class LoopbackBroker:
+    """In-memory topic logs served over loopback TCP (the Kafka stand-in).
+
+    Ops: publish(topic)->offset; fetch(topic, offset, max_wait_s) -> one
+    message or {"eof": true}; commit(topic, group, offset); committed(topic,
+    group) -> offset. `drop_connections()` force-closes every live client
+    socket — the fault injection the reconnect tests lean on.
+    """
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self._host, self._port = host, port
+        self._topics: Dict[str, List[Tuple[dict, bytes]]] = {}
+        self._commits: Dict[Tuple[str, str], int] = {}
+        self._cond = threading.Condition()
+        self._stop = threading.Event()
+        self._lsock: Optional[socket.socket] = None
+        self._conns: List[socket.socket] = []
+        self._threads: List[threading.Thread] = []
+
+    @property
+    def port(self) -> int:
+        return self._port
+
+    @property
+    def address(self) -> Tuple[str, int]:
+        return (self._host, self._port)
+
+    def start(self) -> "LoopbackBroker":
+        self._lsock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._lsock.bind((self._host, self._port))
+        self._lsock.listen(32)
+        self._lsock.settimeout(0.2)
+        self._port = self._lsock.getsockname()[1]
+        t = threading.Thread(target=self._accept_loop, daemon=True,
+                             name="broker-accept")
+        t.start()
+        self._threads.append(t)
+        return self
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._lsock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                break  # listener closed during stop()
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            with self._cond:
+                self._conns.append(conn)
+            t = threading.Thread(target=self._serve_conn, args=(conn,),
+                                 daemon=True, name="broker-conn")
+            t.start()
+            self._threads.append(t)
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        with conn:
+            while not self._stop.is_set():
+                try:
+                    header, payload = wire.recv_frame(conn)
+                    reply, buf = self._handle(header, payload)
+                    wire.send_frame(conn, reply, buf)
+                except (ConnectionError, OSError):
+                    return  # client gone (or dropped by fault injection)
+                except Exception as e:
+                    _flight_recorder().record("broker_error", error=repr(e))
+                    try:
+                        wire.send_frame(conn, {"error": repr(e)})
+                    except OSError:  # lint: swallowed-exception-ok (peer already gone; error recorded above)
+                        pass
+                    return
+
+    def _handle(self, header: dict, payload: bytes):
+        op = header.get("op")
+        if op == "publish":
+            with self._cond:
+                log = self._topics.setdefault(header["topic"], [])
+                offset = len(log)
+                log.append((header.get("meta", {}), payload))
+                self._cond.notify_all()
+            _published.inc()
+            return {"offset": offset}, b""
+        if op == "fetch":
+            topic, offset = header["topic"], int(header["offset"])
+            deadline = time.time() + float(header.get("max_wait_s", 0.0))
+            with self._cond:
+                while True:
+                    log = self._topics.get(topic, [])
+                    if offset < len(log):
+                        meta, buf = log[offset]
+                        _delivered.inc()
+                        return {"offset": offset, "meta": meta}, buf
+                    left = deadline - time.time()
+                    if left <= 0 or self._stop.is_set():
+                        return {"eof": True}, b""
+                    self._cond.wait(min(left, 0.1))
+        if op == "commit":
+            with self._cond:
+                key = (header["topic"], header["group"])
+                self._commits[key] = max(self._commits.get(key, -1),
+                                         int(header["offset"]))
+            return {"ok": True}, b""
+        if op == "committed":
+            with self._cond:
+                off = self._commits.get((header["topic"], header["group"]),
+                                        -1)
+            return {"offset": off}, b""
+        raise ValueError(f"unknown broker op {op!r}")
+
+    def depth(self, topic: str) -> int:
+        with self._cond:
+            return len(self._topics.get(topic, []))
+
+    def drop_connections(self) -> int:
+        """Fault injection: force-close every live client socket (consumers
+        must reconnect and resume from their committed offset)."""
+        with self._cond:
+            conns, self._conns = self._conns, []
+        for conn in conns:
+            try:
+                conn.shutdown(socket.SHUT_RDWR)
+            except OSError:  # lint: swallowed-exception-ok (racing a client that closed first is the point)
+                pass
+            conn.close()
+        _flight_recorder().record("broker_drop_connections", n=len(conns))
+        return len(conns)
+
+    def stop(self) -> None:
+        self._stop.set()
+        with self._cond:
+            self._cond.notify_all()
+        if self._lsock is not None:
+            self._lsock.close()
+        self.drop_connections()
+        for t in self._threads:
+            t.join(timeout=5)
+
+
+class BrokerProducer:
+    """Publish framed array messages to a topic. A dead connection (e.g.
+    after the broker's fault-injection drop) reconnects and retries once —
+    a publish either returns its offset or raises."""
+
+    def __init__(self, addr: Tuple[str, int]):
+        self._addr = tuple(addr)
+        self._sock = wire.connect(self._addr)
+
+    def publish(self, topic: str, arrays: Dict[str, np.ndarray],
+                meta: Optional[dict] = None, codec: str = "none") -> int:
+        metas, payload = wire.pack_arrays(arrays, codec)
+        header = {"op": "publish", "topic": topic,
+                  "meta": dict(meta or {}, arrays=metas)}
+        try:
+            reply, _, _ = wire.request(self._sock, header, payload)
+        except (ConnectionError, OSError):
+            self._sock.close()
+            self._sock = wire.connect(self._addr)
+            reply, _, _ = wire.request(self._sock, header, payload)
+        return reply["offset"]
+
+    def close(self) -> None:
+        self._sock.close()
+
+
+class ReconnectingConsumer:
+    """A queue-shaped view of one (topic, group) subscription.
+
+    Implements the exact seam ``Route._run``/``drain`` consume — ``get``,
+    ``task_done``, ``unfinished_tasks``, ``all_tasks_done`` — over a broker
+    connection that is allowed to die: every socket error triggers a
+    reconnect + resume from the server-side committed offset, so a forced
+    drop mid-stream loses nothing. ``task_done`` commits the delivered
+    offset (handled-then-commit => at-least-once).
+    """
+
+    def __init__(self, addr: Tuple[str, int], topic: str,
+                 group: str = "default", reconnect_backoff_s: float = 0.05):
+        self._addr = tuple(addr)
+        self.topic, self.group = topic, group
+        self._backoff = reconnect_backoff_s
+        self._sock: Optional[socket.socket] = None
+        self._next: Optional[int] = None   # next offset to fetch
+        self._delivered: Optional[int] = None  # offset awaiting task_done
+        self.reconnects = 0
+        self.unfinished_tasks = 0
+        self.all_tasks_done = threading.Condition()
+
+    # ------------------------------------------------------------ transport
+    def _connect(self) -> None:
+        self._sock = wire.connect(self._addr, timeout=10.0)
+        reply, _, _ = wire.request(
+            self._sock, {"op": "committed", "topic": self.topic,
+                         "group": self.group})
+        self._next = reply["offset"] + 1  # resume AFTER the committed one
+
+    def _ensure(self) -> None:
+        if self._sock is None:
+            if self._next is not None:  # not the first connect: a drop
+                self.reconnects += 1
+                _reconnects.inc()
+                _flight_recorder().record(
+                    "broker_reconnect", topic=self.topic, group=self.group,
+                    n=self.reconnects)
+            self._connect()
+
+    def _drop(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:  # lint: swallowed-exception-ok (socket already dead is why we are here)
+                pass
+            self._sock = None
+
+    # ------------------------------------------------------- queue protocol
+    def get(self, timeout: float = 0.05):
+        """Next message as (meta, {name: array}); raises queue.Empty when the
+        log is exhausted within ``timeout`` (Route's poll contract)."""
+        deadline = time.time() + timeout
+        while True:
+            try:
+                self._ensure()
+                reply, payload, _ = wire.request(
+                    self._sock,
+                    {"op": "fetch", "topic": self.topic,
+                     "offset": self._next,
+                     "max_wait_s": max(0.0, deadline - time.time())})
+            except (ConnectionError, OSError, RuntimeError):
+                self._drop()
+                if time.time() >= deadline:
+                    raise queue.Empty from None
+                time.sleep(self._backoff)
+                continue
+            if reply.get("eof"):
+                raise queue.Empty
+            meta = reply["meta"]
+            arrays = wire.unpack_arrays(meta.get("arrays", []), payload)
+            self._delivered = reply["offset"]
+            self._next = reply["offset"] + 1
+            with self.all_tasks_done:
+                self.unfinished_tasks += 1
+            return meta, arrays
+
+    def task_done(self) -> None:
+        offset, self._delivered = self._delivered, None
+        if offset is not None:
+            try:
+                self._ensure()
+                wire.request(self._sock,
+                             {"op": "commit", "topic": self.topic,
+                              "group": self.group, "offset": offset})
+            except (ConnectionError, OSError, RuntimeError):
+                # commit lost with the connection: the message redelivers
+                # after reconnect (at-least-once), never silently skipped
+                self._drop()
+        with self.all_tasks_done:
+            if self.unfinished_tasks > 0:
+                self.unfinished_tasks -= 1
+            if not self.unfinished_tasks:
+                self.all_tasks_done.notify_all()
+
+    def close(self) -> None:
+        self._drop()
+
+
+class BrokerTrainingRoute(Route):
+    """Online training fed by the broker: (x, y) array messages from a
+    (topic, group) subscription -> model.fit — the networked equivalent of
+    streaming.TrainingRoute, surviving broker connection drops."""
+
+    def __init__(self, model, addr: Tuple[str, int], topic: str,
+                 group: str = "train"):
+        self.model = model
+        super().__init__(ReconnectingConsumer(addr, topic, group),
+                         self._train)
+
+    def _train(self, msg) -> None:
+        _, arrays = msg
+        self.model.fit(np.asarray(arrays["x"], np.float32),
+                       np.asarray(arrays["y"], np.float32))
+
+    def stop(self) -> None:
+        super().stop()
+        self.source.close()

@@ -1,0 +1,129 @@
+"""Length-prefixed framed messages over stdlib sockets + ndarray serde.
+
+One wire format shared by the two loopback transports in this repo — the
+async parameter-server TCP backend (parallel/ps_transport.py) and the
+streaming broker (streaming/broker.py). A frame is::
+
+    !II          header_len, payload_len   (8-byte big-endian prefix)
+    header_len   UTF-8 JSON header (op, offsets, array metadata, ...)
+    payload_len  raw array bytes (concatenated, C-order)
+
+Arrays ride the payload with their (name, dtype, shape, codec) recorded in
+the header under "arrays", so a frame is self-describing. The optional
+``bf16`` codec halves float32 wire bytes (round-to-nearest via ml_dtypes,
+which JAX already depends on) — used for pushed parameter deltas where a
+half-precision delta is within SGD noise; canonical server state stays f32.
+"""
+from __future__ import annotations
+
+import json
+import socket
+import struct
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+_PREFIX = struct.Struct("!II")
+
+#: codecs understood by encode_array/decode_array
+CODECS = ("none", "bf16")
+
+
+def _bf16_dtype():
+    import ml_dtypes  # bundled with jax; no new dependency
+    return ml_dtypes.bfloat16
+
+
+def encode_array(a: np.ndarray, codec: str = "none") -> Tuple[dict, bytes]:
+    """-> (metadata dict, payload bytes). ``bf16`` only compresses floating
+    arrays; integer arrays pass through unchanged (and say so in the meta)."""
+    a = np.ascontiguousarray(a)
+    if codec == "bf16" and a.dtype.kind == "f":
+        buf = np.asarray(a, dtype=_bf16_dtype()).tobytes()
+        meta = {"dtype": str(a.dtype), "shape": list(a.shape),
+                "codec": "bf16"}
+    elif codec in CODECS:
+        buf = a.tobytes()
+        meta = {"dtype": str(a.dtype), "shape": list(a.shape),
+                "codec": "none"}
+    else:
+        raise ValueError(f"unknown wire codec {codec!r}; expected {CODECS}")
+    return meta, buf
+
+
+def decode_array(meta: dict, buf: bytes) -> np.ndarray:
+    shape = tuple(meta["shape"])
+    if meta["codec"] == "bf16":
+        a = np.frombuffer(buf, dtype=_bf16_dtype()).astype(meta["dtype"])
+    else:
+        a = np.frombuffer(buf, dtype=np.dtype(meta["dtype"])).copy()
+    return a.reshape(shape)
+
+
+def pack_arrays(arrays: Dict[str, np.ndarray],
+                codec: str = "none") -> Tuple[List[dict], bytes]:
+    """Concatenate named arrays into one payload + ordered metadata list."""
+    metas, chunks = [], []
+    for name, a in arrays.items():
+        meta, buf = encode_array(np.asarray(a), codec)
+        meta["name"] = name
+        meta["nbytes"] = len(buf)
+        metas.append(meta)
+        chunks.append(buf)
+    return metas, b"".join(chunks)
+
+
+def unpack_arrays(metas: List[dict], payload: bytes) -> Dict[str, np.ndarray]:
+    out, off = {}, 0
+    for meta in metas:
+        n = meta["nbytes"]
+        out[meta["name"]] = decode_array(meta, payload[off:off + n])
+        off += n
+    return out
+
+
+def send_frame(sock: socket.socket, header: dict,
+               payload: bytes = b"") -> int:
+    """Write one frame; returns bytes put on the wire."""
+    hdr = json.dumps(header, separators=(",", ":")).encode("utf-8")
+    buf = _PREFIX.pack(len(hdr), len(payload)) + hdr + payload
+    sock.sendall(buf)
+    return len(buf)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    chunks = []
+    while n:
+        chunk = sock.recv(min(n, 1 << 20))
+        if not chunk:
+            raise ConnectionError("peer closed mid-frame")
+        chunks.append(chunk)
+        n -= len(chunk)
+    return b"".join(chunks)
+
+
+def recv_frame(sock: socket.socket) -> Tuple[dict, bytes]:
+    """Read one frame; raises ConnectionError on EOF / truncated stream."""
+    hdr_len, payload_len = _PREFIX.unpack(_recv_exact(sock, _PREFIX.size))
+    header = json.loads(_recv_exact(sock, hdr_len).decode("utf-8"))
+    payload = _recv_exact(sock, payload_len) if payload_len else b""
+    return header, payload
+
+
+def request(sock: socket.socket, header: dict,
+            payload: bytes = b"") -> Tuple[dict, bytes, int]:
+    """One RPC round-trip: send a frame, read the reply frame.
+    Returns (reply_header, reply_payload, bytes_sent)."""
+    sent = send_frame(sock, header, payload)
+    reply, buf = recv_frame(sock)
+    if "error" in reply:
+        raise RuntimeError(f"peer error for op={header.get('op')!r}: "
+                           f"{reply['error']}")
+    return reply, buf, sent
+
+
+def connect(addr: Tuple[str, int], timeout: Optional[float] = 30.0,
+            ) -> socket.socket:
+    sock = socket.create_connection(addr, timeout=timeout)
+    sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    return sock

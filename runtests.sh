@@ -18,6 +18,10 @@
 #                                 # bucketed micro-batching semantics, 429
 #                                 # backpressure, hot swap, streaming, HTTP
 #                                 # front-end, bench serve-axis contract
+#   ./runtests.sh ps [args]       # async parameter-server engine: staleness
+#                                 # math, bf16 wire codec, transport parity,
+#                                 # 2-process TCP loss parity, loopback
+#                                 # broker reconnect, bench ps-axis contract
 set -e
 cd "$(dirname "$0")"
 
@@ -54,6 +58,18 @@ if [ "${1-}" = "serve" ]; then
   exec python -m pytest tests/test_serving.py tests/test_serving_http.py \
     tests/test_bench_contract.py::test_config_key_serve_axes \
     tests/test_bench_contract.py::test_grid_row_serve -q "$@"
+fi
+
+if [ "${1-}" = "ps" ]; then
+  shift
+  # includes the slow 2-process TCP loss-parity run
+  PALLAS_AXON_POOL_IPS= \
+  JAX_PLATFORMS=cpu \
+  XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+  exec python -m pytest tests/test_param_server.py \
+    tests/test_streaming_broker.py \
+    tests/test_bench_contract.py::test_config_key_ps_axes \
+    tests/test_bench_contract.py::test_grid_row_ps_async -q "$@"
 fi
 
 if [ "${1-}" = "health" ]; then

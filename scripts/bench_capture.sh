@@ -68,6 +68,11 @@ run --model transformer --sharding dp_tp
 # the auto-calibrated saturation rate; the full record (p50/p99, occupancy,
 # recompiles == bucket count) also lands in scripts/serve_load.jsonl
 run --model serve
+# async-PS headline row (ISSUE 10): straggler A/B — one 4x-slow worker of 4,
+# async push/pull vs the sync-DP barrier at equal worker count, plus the
+# 2-process TCP loss-parity phase (CPU-measured by design, like serve: the
+# win is host-side orchestration, not MXU width)
+run --model ps_async
 if [ "$MODE" = full ]; then
     run --model lenet
     run --model lenet --bf16-act

@@ -406,3 +406,51 @@ def test_grid_row_serve():
     batch, iters, _ = bench._DEFAULTS["serve"]
     assert batch >= 8  # max_batch: must exercise multiple pow2 buckets
     assert iters >= 2  # seconds per phase
+
+
+def test_config_key_ps_axes():
+    """The ps_async A/B's straggler shape is config-distinct: a 2-worker or
+    8x-straggler capture must never stand in for the standard 4-worker/4x
+    row (the barrier cost being measured IS a function of both), other
+    models don't grow phantom ps axes, and the ts-gate strips the axes on
+    rows that predate the async-PS engine — same pattern as serve."""
+    import bench
+
+    a = bench._config_key("--model ps_async")
+    b = bench._config_key("--model ps_async --ps-workers 8")
+    c = bench._config_key("--model ps_async --ps-straggler 2")
+    assert a != b and a["ps_workers"] == "4" and b["ps_workers"] == "8"
+    assert a != c and c["ps_straggler"] == "2"
+    assert a["ps_straggler"] == "4"  # the bench_ps_async default, pinned
+    # non-ps models don't grow phantom axes
+    r = bench._config_key("--model lenet")
+    assert r["ps_workers"] is None and r["ps_straggler"] is None
+    # rows logged before the async-PS engine landed cannot be ps rows
+    old = bench._config_key("--model ps_async --ps-workers 8",
+                            ts="2026-08-05T22:00:29Z")
+    new = bench._config_key("--model ps_async --ps-workers 8",
+                            ts="2026-08-05T22:00:31Z")
+    assert old["ps_workers"] is None and new["ps_workers"] == "8"
+    ts = bench._PS_AXIS_LANDED_TS
+    assert ts.endswith("Z") and ts > bench._SERVE_AXIS_LANDED_TS
+
+
+def test_grid_row_ps_async():
+    """The ps_async scenario is wired through the whole bench surface:
+    grid membership, samples/sec unit, f32 dtype default (the A/B measures
+    host-side barrier vs async orchestration, not MXU width — dtype
+    conversion noise would pollute it), and neither profile- nor
+    sharding-capable (it runs its own ParallelWrapper/PS harnesses, not
+    the multistep harness those frozensets describe)."""
+    import bench
+
+    assert bench._METRICS["ps_async"] == "ps_async_samples_per_sec"
+    assert "ps_async" in bench._DEFAULTS and "ps_async" in bench._bench_fns()
+    assert "ps_async" not in bench._UNITS  # samples/sec, the default unit
+    assert bench._DTYPE_DEFAULT["ps_async"] == "f32"
+    assert "ps_async" not in bench._PROFILE_CAPABLE
+    assert "ps_async" not in bench._SHARDING_CAPABLE
+    batch, iters, ksteps = bench._DEFAULTS["ps_async"]
+    # enough minibatches that every worker pushes several windows per phase
+    # and the loss-parity phase reaches the label-noise plateau
+    assert iters * ksteps >= 32

@@ -1,0 +1,358 @@
+"""Async parameter-server engine tests (reference dl4j-spark-parameterserver
+ParameterServerParallelWrapper + ParameterServerNode): staleness-bounded
+delta pushes, bf16 wire codec, inproc/tcp transport parity, multi-process
+loss parity, and regression pins for the two pre-engine bugs (last-pusher
+dominance, shutdown double-count)."""
+import socket
+import threading
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.iterators import ListDataSetIterator
+from deeplearning4j_tpu.nn.conf.builders import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.parallel.param_server import (
+    DEFAULT_STALENESS_CAP, ParameterServer, ParameterServerParallelWrapper,
+    flatten_tree, unflatten_tree,
+)
+from deeplearning4j_tpu.parallel.ps_transport import (
+    InprocTransport, ParameterServerTcpFrontend, TcpTransport,
+)
+from deeplearning4j_tpu.streaming import wire
+
+
+def _net(seed=12345, lr=0.1):
+    conf = (NeuralNetConfiguration.builder()
+            .seed(seed).learning_rate(lr).updater("sgd")
+            .list()
+            .layer(DenseLayer(n_in=4, n_out=8, activation="tanh"))
+            .layer(OutputLayer(n_in=8, n_out=3, loss="mcxent",
+                               activation="softmax"))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _batches(n_batches=16, batch=8, seed=0):
+    rng = np.random.default_rng(seed)
+    out = []
+    for _ in range(n_batches):
+        x = rng.normal(size=(batch, 4)).astype(np.float32)
+        labels = (x[:, 0] + x[:, 1] > 0).astype(int)
+        y = np.zeros((batch, 3), np.float32)
+        y[np.arange(batch), labels] = 1
+        out.append(DataSet(x, y))
+    return out
+
+
+def _server(n=8, **kw):
+    return ParameterServer([np.zeros(n, np.float32)], **kw)
+
+
+# ---------------------------------------------------------------- staleness
+
+def test_staleness_weight_is_one_over_one_plus_s():
+    """Three pushes all based on version 0: the first lands at weight 1,
+    the i-th at 1/(1+i-1) — the old (a+b)/2 soft-average let the LAST
+    pusher overwrite half the state regardless of how stale it was
+    (last-pusher dominance); the delta rule inverts that."""
+    srv = _server()
+    delta = np.ones(8, np.float32)
+    weights = [srv.push_delta(delta, base_version=0).weight
+               for _ in range(3)]
+    assert weights == [1.0, 0.5, pytest.approx(1 / 3)]
+    # applied sum is 1 + 1/2 + 1/3, not last-writer-dominated
+    _, vec = srv.pull_flat()
+    np.testing.assert_allclose(vec, (1 + 0.5 + 1 / 3) * delta, rtol=1e-6)
+    assert srv.version == 3
+
+
+def test_staleness_cap_rejects_and_returns_fresh_state():
+    srv = _server(staleness_cap=2)
+    delta = np.ones(8, np.float32)
+    for _ in range(3):  # version -> 3
+        srv.push_delta(delta, base_version=srv.version)
+    res = srv.push_delta(delta, base_version=0)  # staleness 3 > cap 2
+    assert not res.accepted and res.weight == 0.0 and res.staleness == 3
+    assert srv.version == 3 and srv.rejected == 1
+    # the rejection carries the fresh head: rebase + retry succeeds
+    np.testing.assert_allclose(res.params, 3 * delta, rtol=1e-6)
+    retry = srv.push_delta(delta, base_version=res.version)
+    assert retry.accepted and retry.weight == 1.0 and srv.version == 4
+
+
+def test_fresh_push_applies_exactly_once_at_weight_one():
+    srv = _server()
+    delta = np.arange(8, dtype=np.float32)
+    res = srv.push_delta(delta, base_version=0)
+    assert res.accepted and res.staleness == 0 and res.weight == 1.0
+    np.testing.assert_allclose(res.params, delta)
+    np.testing.assert_allclose(srv.pull_flat()[1], delta)
+
+
+def test_server_momentum_optimizer_smooths_deltas():
+    srv = _server(optimizer="momentum", momentum=0.5)
+    delta = np.ones(8, np.float32)
+    srv.push_delta(delta, base_version=0)           # vel = 1
+    srv.push_delta(delta, base_version=srv.version)  # vel = 1.5
+    np.testing.assert_allclose(srv.pull_flat()[1], 2.5 * delta, rtol=1e-6)
+
+
+def test_tree_flatten_roundtrip():
+    tree = [np.arange(6, dtype=np.float32).reshape(2, 3),
+            np.ones((4,), np.float32)]
+    vec, spec = flatten_tree(tree)
+    assert vec.shape == (10,) and vec.dtype == np.float32
+    back = unflatten_tree(vec, spec)
+    for a, b in zip(tree, back):
+        np.testing.assert_array_equal(a, np.asarray(b))
+
+
+# --------------------------------------------------------------------- wire
+
+def test_bf16_wire_roundtrip_tolerance():
+    rng = np.random.default_rng(7)
+    a = rng.normal(0, 3, (32, 17)).astype(np.float32)
+    meta, buf = wire.encode_array(a, codec="bf16")
+    assert len(buf) == a.size * 2  # halved wire bytes
+    back = wire.decode_array(meta, buf)
+    assert back.dtype == np.float32 and back.shape == a.shape
+    np.testing.assert_allclose(back, a, rtol=1e-2, atol=1e-2)
+
+
+def test_none_codec_is_exact():
+    a = np.random.default_rng(3).normal(size=(5, 5)).astype(np.float32)
+    meta, buf = wire.encode_array(a, codec="none")
+    np.testing.assert_array_equal(wire.decode_array(meta, buf), a)
+
+
+def test_wire_frame_roundtrip_over_socket():
+    srv, cli = socket.socketpair()
+    try:
+        payload = b"\x00\x01payload"
+        wire.send_frame(cli, {"op": "x", "n": 3}, payload)
+        header, buf = wire.recv_frame(srv)
+        assert header == {"op": "x", "n": 3} and buf == payload
+        cli.close()
+        with pytest.raises(ConnectionError):
+            wire.recv_frame(srv)  # EOF mid-stream is an error, not b""
+    finally:
+        srv.close()
+
+
+# ---------------------------------------------------------------- transport
+
+def test_tcp_transport_parity_with_inproc():
+    """The same push/pull sequence through loopback TCP (codec none) lands
+    bit-identically with the in-process transport."""
+    srv_a = _server()
+    srv_b = _server()
+    frontend = ParameterServerTcpFrontend(srv_b).start()
+    inproc = InprocTransport(srv_a)
+    tcp = TcpTransport(("127.0.0.1", frontend.port))
+    try:
+        rng = np.random.default_rng(11)
+        for _ in range(5):
+            delta = rng.normal(size=8).astype(np.float32)
+            ra = inproc.push(delta, base_version=srv_a.version)
+            rb = tcp.push(delta, base_version=tcp.pull()[0])
+            assert (ra.accepted, ra.version, ra.staleness, ra.weight) == \
+                   (rb.accepted, rb.version, rb.staleness, rb.weight)
+            np.testing.assert_array_equal(ra.params, rb.params)
+        va, veca = inproc.pull()
+        vb, vecb = tcp.pull()
+        assert va == vb
+        np.testing.assert_array_equal(veca, vecb)
+    finally:
+        tcp.close()
+        frontend.stop()
+
+
+def test_tcp_transport_bf16_pushes_decode_within_tolerance():
+    srv = _server()
+    frontend = ParameterServerTcpFrontend(srv).start()
+    tcp = TcpTransport(("127.0.0.1", frontend.port), codec="bf16")
+    try:
+        delta = np.linspace(-2, 2, 8).astype(np.float32)
+        res = tcp.push(delta, base_version=0)
+        assert res.accepted
+        np.testing.assert_allclose(srv.pull_flat()[1], delta,
+                                   rtol=1e-2, atol=1e-2)
+    finally:
+        tcp.close()
+        frontend.stop()
+
+
+# -------------------------------------------------- worker loop regressions
+
+def test_single_worker_matches_single_machine_fit():
+    """One worker, no contention: every window delta lands at staleness 0 /
+    weight 1, so async-PS training IS single-machine training. This pins the
+    shutdown double-count bug — the old wrapper re-pushed the final window
+    on shutdown, applying the last deltas twice."""
+    data = _batches(n_batches=8)
+    ps_net = _net()
+    wrapper = (ParameterServerParallelWrapper.builder(ps_net)
+               .workers(1).push_frequency(4).build())
+    wrapper.fit(ListDataSetIterator(data))
+
+    single = _net()
+    for ds in data:
+        single.fit(ds.features, ds.labels)
+
+    import jax
+    for a, b in zip(jax.tree_util.tree_leaves(ps_net.params_list),
+                    jax.tree_util.tree_leaves(single.params_list)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-5)
+    # 8 batches / push_frequency 4 = exactly 2 pushes, no shutdown re-push
+    assert wrapper.server.pushes == 2
+    assert wrapper.worker_stats[0]["steps"] == 8
+    assert wrapper.worker_stats[0]["pushes"] == 2
+
+
+def test_partial_final_window_flushes_exactly_once():
+    """6 batches at push_frequency 4: one full window plus a 2-step flush =
+    2 pushes; an empty final window (8 batches) must NOT add a third."""
+    data = _batches(n_batches=6)
+    wrapper = (ParameterServerParallelWrapper
+               .builder(_net()).workers(1).push_frequency(4).build())
+    wrapper.fit(ListDataSetIterator(data))
+    assert wrapper.server.pushes == 2
+    assert wrapper.worker_stats[0]["steps"] == 6
+
+
+def test_async_multiworker_trains_and_counts_every_step():
+    data = _batches(n_batches=16)
+    net = _net()
+    gx = np.concatenate([d.features for d in data])
+    gy = np.concatenate([d.labels for d in data])
+    s0 = float(net.score(gx, gy))
+    wrapper = (ParameterServerParallelWrapper.builder(net)
+               .workers(4).push_frequency(2).staleness(4).build())
+    wrapper.fit(ListDataSetIterator(data))
+    assert sum(s["steps"] for s in wrapper.worker_stats) == 16
+    assert wrapper.server.version == wrapper.server.pushes > 0
+    assert float(net.score(gx, gy)) < s0 * 0.9
+
+
+def test_staleness_cap_zero_forces_rebase_retry_but_loses_no_steps():
+    """cap=0 under 4 contending workers: pushes based even one version back
+    are rejected; the worker loop's rebase-and-retry must still land every
+    window (rejected counted, steps conserved)."""
+    data = _batches(n_batches=16)
+    wrapper = (ParameterServerParallelWrapper.builder(_net())
+               .workers(4).push_frequency(1).staleness(0).build())
+    wrapper.fit(ListDataSetIterator(data))
+    assert sum(s["steps"] for s in wrapper.worker_stats) == 16
+    # every worker's windows all landed (a retry that is itself rejected is
+    # dropped only after the second attempt — with cap 0 and 4 workers some
+    # retries happen; the accounting must balance regardless)
+    assert wrapper.server.pushes + wrapper.server.rejected >= 16
+
+
+def test_straggler_worker_does_not_stall_the_others():
+    """Straggler smoke (the bench.py ps_async A/B in miniature): worker 0
+    sleeps 4x the others; total wall time must track the fast workers'
+    share + the straggler's own share, NOT workers * straggler_delay (which
+    is what the sync barrier pays)."""
+    import time as _time
+    data = _batches(n_batches=12)
+    wrapper = (ParameterServerParallelWrapper.builder(_net())
+               .workers(4).push_frequency(2)
+               .worker_delays(0.08, 0.02, 0.02, 0.02).build())
+    t0 = _time.perf_counter()
+    wrapper.fit(ListDataSetIterator(data))
+    dt = _time.perf_counter() - t0
+    assert sum(s["steps"] for s in wrapper.worker_stats) == 12
+    # barrier-world lower bound would be 12 steps * 0.08s = 0.96s
+    assert dt < 0.9, f"straggler stalled the pool: {dt:.2f}s"
+
+
+def test_builder_validation():
+    net = _net()
+    with pytest.raises(ValueError):
+        ParameterServerParallelWrapper(net, transport="carrier-pigeon")
+    with pytest.raises(ValueError):
+        ParameterServerParallelWrapper(net, compression="zip")
+    with pytest.raises(ValueError):
+        # hooks run in-interpreter; tcp workers are separate processes
+        (ParameterServerParallelWrapper.builder(net)
+         .transport("tcp").training_hooks(object()).build())
+
+
+def test_legacy_push_pull_facade_still_works():
+    net = _net()
+    srv = ParameterServer(net.params_list)
+    tree = srv.pull()
+    res = srv.push(tree)  # full-param push against current head
+    assert res.accepted and srv.version == 1
+
+
+# ----------------------------------------------------------- multi-process
+
+@pytest.mark.slow
+def test_tcp_two_process_loss_parity():
+    """2 separate-process TCP workers with bf16 deltas reach within 5% of a
+    single-process sync fit's loss on the same batches (ISSUE 10 phase-B
+    acceptance, shrunk fixture)."""
+    rng = np.random.default_rng(0)
+    means = rng.normal(0.0, 1.0, (3, 4)).astype(np.float32)
+    data = []
+    for _ in range(24):
+        lab = rng.integers(0, 3, 16)
+        x = (means[lab] + rng.normal(0, 0.5, (16, 4))).astype(np.float32)
+        noisy = np.where(rng.random(16) < 0.25, rng.integers(0, 3, 16), lab)
+        data.append(DataSet(x, np.eye(3, dtype=np.float32)[noisy]))
+    gx = np.concatenate([d.features for d in data])
+    gy = np.concatenate([d.labels for d in data])
+
+    base = _net()
+    oracle = base.clone()
+    for ds in data:
+        oracle.fit(ds.features, ds.labels)
+    sync_loss = float(oracle.score(gx, gy))
+
+    tcp_net = base.clone()
+    # 20ms/step pacing: the dense fixture steps in ~1ms, which turns 2-proc
+    # training into a pure race (workers finish before each other's pushes
+    # land); a uniform delay restores realistic push interleaving
+    wrapper = (ParameterServerParallelWrapper.builder(tcp_net)
+               .workers(2).push_frequency(2).transport("tcp")
+               .compression("bf16").worker_delays(0.02, 0.02).build())
+    wrapper.fit(ListDataSetIterator(data))
+    tcp_loss = float(tcp_net.score(gx, gy))
+
+    assert len(wrapper.worker_stats) == 2
+    assert sum(s["steps"] for s in wrapper.worker_stats) == 24
+    # 15% on this shrunk, timing-noisy fixture; the 5% acceptance number is
+    # measured by bench.py ps_async on the LeNet fixture at full scale
+    assert abs(tcp_loss / sync_loss - 1.0) < 0.15, \
+        f"tcp async {tcp_loss:.4f} vs sync {sync_loss:.4f}"
+    assert tcp_loss < 1.0986  # better than uniform ln(3): it really trained
+
+
+# ------------------------------------------------------------- concurrency
+
+def test_server_is_thread_safe_under_contention():
+    srv = _server(n=4)
+    delta = np.ones(4, np.float32)
+    n_threads, pushes_each = 8, 50
+
+    def worker():
+        for _ in range(pushes_each):
+            base = srv.pull_flat()[0]
+            srv.push_delta(delta, base)
+
+    threads = [threading.Thread(target=worker) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert srv.version == srv.pushes == n_threads * pushes_each
+    # every applied weight is in (0, 1]; the vec is a positive multiple of
+    # delta bounded by the push count
+    vec = srv.pull_flat()[1]
+    assert 0 < vec[0] <= n_threads * pushes_each
