@@ -823,7 +823,8 @@ def bench_fit_lenet(batch: int, iters: int, ksteps: int,
     }
 
 
-def bench_serve(batch, iters, ksteps, serve_qps=None, serve_latency_ms=None):
+def bench_serve(batch, iters, ksteps, serve_qps=None, serve_latency_ms=None,
+                serve_batching=None, serve_quant=None):
     """Micro-batching A/B on the serving engine (ISSUE 9 headline).
 
     Unlike the fit benches this is fully CPU-measurable: the win is
@@ -835,6 +836,15 @@ def bench_serve(batch, iters, ksteps, serve_qps=None, serve_latency_ms=None):
     A/B record (p50/p99, achieved QPS, batch occupancy, recompile count)
     is appended to scripts/serve_load.jsonl next to bench_log, and
     steady-state health is pinned by recompiles == bucket count.
+
+    Round 11 adds the DECODE section: the token-streaming A/B
+    (``run_decode_ab`` on a char-RNN) at one fixed offered sessions/sec
+    for every phase — iteration-level continuous batching vs static
+    request-level batching, and int8 weight-only decode vs dense. The
+    ``serve_batching``/``serve_quant`` axes pick which phase supplies the
+    row's decode_tokens_per_sec / decode_ttft_p99_ms numbers
+    (config-distinct: a static or int8 capture must never stand in for
+    the continuous dense row), and the cross-phase ratios ride along.
     """
     import numpy as np
 
@@ -892,6 +902,32 @@ def bench_serve(batch, iters, ksteps, serve_qps=None, serve_latency_ms=None):
                  max_latency_s=(serve_latency_ms or 4.0) / 1e3,
                  max_queue=2048, example=example, record_path=record_path)
     batched, unbatched = rec["batched"], rec["unbatched"]
+
+    # decode section: continuous-vs-static + int8-vs-dense token streaming
+    from deeplearning4j_tpu.keras_server.loadgen import run_decode_ab
+    from deeplearning4j_tpu.models.char_rnn import char_rnn_lstm
+    dec_net = MultiLayerNetwork(char_rnn_lstm(32, hidden=64, layers=2)).init()
+    drec = run_decode_ab(dec_net, model="bench_serve_decode", slots=8,
+                         n_sessions=256, record_path=record_path)
+    serve_batching = serve_batching or "continuous"
+    serve_quant = serve_quant or "none"
+    phase = (drec["int8"] if serve_quant == "int8"
+             else drec[serve_batching])
+    decode = {
+        "serve_batching": serve_batching,
+        "serve_quant": serve_quant,
+        "decode_tokens_per_sec": phase["tokens_per_sec"],
+        "decode_ttft_p99_ms": phase["ttft_p99_ms"],
+        "decode_offered_sps": drec["offered_sps"],
+        "decode_slot_occupancy": phase["mean_occupancy"],
+        "decode_recompiles": phase["recompiles"],
+        "decode_bucket_count": phase["bucket_count"],
+        "decode_speedup": drec["tokens_per_sec_ratio"],
+        "decode_ttft_p99_improvement": drec["ttft_p99_ratio"],
+        "int8_prob_drift": drec["int8_vs_dense"]["mean_prob_drift"],
+        "int8_top1_agreement": drec["int8_vs_dense"]["top1_agreement"],
+        "int8_param_bytes_ratio": drec["int8_vs_dense"]["param_bytes_ratio"],
+    }
     return {
         "samples_per_sec": batched["achieved_qps"],  # headline: batched QPS
         "offered_qps": qps,
@@ -908,7 +944,8 @@ def bench_serve(batch, iters, ksteps, serve_qps=None, serve_latency_ms=None):
         "recompiles": batched["recompiles"],
         "max_batch": batch,
         "serve_record": record_path,
-        "api": "keras_server.InferenceServer /v1/predict",
+        **decode,
+        "api": "keras_server.InferenceServer /v1/predict + /v1/generate",
     }
 
 
@@ -1169,6 +1206,10 @@ def _child_main(args) -> None:
             kwargs["serve_qps"] = args.serve_qps
         if args.serve_latency_ms:
             kwargs["serve_latency_ms"] = args.serve_latency_ms
+        if args.serve_batching:
+            kwargs["serve_batching"] = args.serve_batching
+        if args.serve_quant:
+            kwargs["serve_quant"] = args.serve_quant
     if args.model == "ps_async":
         if args.ps_workers:
             kwargs["ps_workers"] = args.ps_workers
@@ -1305,6 +1346,17 @@ def main() -> None:
     ap.add_argument("--serve-latency-ms", type=float, default=None,
                     help="serve bench micro-batcher max coalescing wait "
                          "(config-distinct); default 4ms")
+    ap.add_argument("--serve-batching", default=None,
+                    choices=("continuous", "static"),
+                    help="serve bench decode scheduling for the row's "
+                         "decode_tokens_per_sec / decode_ttft_p99_ms "
+                         "(config-distinct); default continuous — "
+                         "iteration-level slot admission/eviction vs "
+                         "request-level full-batch drain")
+    ap.add_argument("--serve-quant", default=None, choices=("int8", "none"),
+                    help="serve bench decode weight quantization for the "
+                         "row's decode numbers (config-distinct); default "
+                         "none (policy-dtype dense weights)")
     ap.add_argument("--ps-workers", type=int, default=None,
                     help="ps_async bench worker count for the straggler A/B "
                          "(config-distinct); default 4")
@@ -1505,6 +1557,16 @@ _SERVE_AXIS_LANDED_TS = "2026-08-05T22:00:00Z"
 #: straggler shape
 _PS_AXIS_LANDED_TS = "2026-08-05T22:00:30Z"
 
+#: when the continuous-batching decode section landed on the serve bench
+#: (round 11) — serve rows logged before this instant carry no decode
+#: numbers (their axes normalize to None, never equal to a live request's
+#: resolved "continuous"/"none"), so an outage can never serve a
+#: decode-less row for a request whose headline now includes
+#: decode_tokens_per_sec; rows since carry the scheduling-mode /
+#: weight-quantization knobs as config axes so a static or int8 capture
+#: can never stand in for the continuous dense row
+_SERVE_DECODE_AXIS_LANDED_TS = "2026-08-05T23:30:00Z"
+
 
 def _config_key(args_str: str, ts: str = None) -> dict:
     """The fields that make two bench invocations the SAME config: model,
@@ -1559,6 +1621,13 @@ def _config_key(args_str: str, ts: str = None) -> dict:
         # at an explicit --serve-qps must not stand in for a calibrated run
         serve_qps = val("--serve-qps") or "auto"
         serve_latency_ms = val("--serve-latency-ms") or "4"
+    serve_batching = serve_quant = None
+    if model == "serve" and not (ts is not None
+                                 and ts < _SERVE_DECODE_AXIS_LANDED_TS):
+        # defaults are their own config: a static-batching or int8 capture
+        # must never stand in for the continuous dense decode row
+        serve_batching = val("--serve-batching") or "continuous"
+        serve_quant = val("--serve-quant") or "none"
     ps_workers = ps_straggler = None
     if model == "ps_async" and not (ts is not None
                                     and ts < _PS_AXIS_LANDED_TS):
@@ -1572,6 +1641,7 @@ def _config_key(args_str: str, ts: str = None) -> dict:
             "hidden": val("--hidden"), "lstm_impl": lstm_impl,
             "sharding": sharding, "serve_qps": serve_qps,
             "serve_latency_ms": serve_latency_ms,
+            "serve_batching": serve_batching, "serve_quant": serve_quant,
             "ps_workers": ps_workers, "ps_straggler": ps_straggler}
 
 

@@ -219,12 +219,15 @@ from deeplearning4j_tpu.keras_server.registry import (  # noqa: E402
     set_global_model_registry)
 from deeplearning4j_tpu.keras_server.batcher import (  # noqa: E402
     MicroBatcher, batch_bucket)
+from deeplearning4j_tpu.keras_server.decode import (  # noqa: E402
+    DecodeEngine, DecodeSession)
 from deeplearning4j_tpu.keras_server.streaming import (  # noqa: E402
     StreamSessions)
 from deeplearning4j_tpu.keras_server.serving import (  # noqa: E402
     InferenceServer, active_server, serve_status)
 from deeplearning4j_tpu.keras_server.loadgen import (  # noqa: E402
-    run_ab, run_closed_loop, run_open_loop)
+    run_ab, run_closed_loop, run_decode_ab, run_open_loop,
+    run_token_stream_load)
 
 __all__ = [
     "HDF5MiniBatchDataSetIterator", "DeepLearning4jEntryPoint", "Server",
@@ -233,6 +236,8 @@ __all__ = [
     "ModelRegistry", "ModelVersion", "global_model_registry",
     "set_global_model_registry",
     "MicroBatcher", "batch_bucket", "StreamSessions",
+    "DecodeEngine", "DecodeSession",
     "InferenceServer", "active_server", "serve_status",
-    "run_ab", "run_closed_loop", "run_open_loop",
+    "run_ab", "run_closed_loop", "run_decode_ab", "run_open_loop",
+    "run_token_stream_load",
 ]

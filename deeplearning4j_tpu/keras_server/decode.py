@@ -1,0 +1,586 @@
+"""Continuous (iteration-level) batching for autoregressive decode.
+
+The MicroBatcher (batcher.py) coalesces independent one-shot forwards —
+right for classify/score traffic, wrong for generation: under
+request-level batching a batch runs until its LONGEST sequence finishes,
+so one long session holds every slot hostage and steady-state occupancy
+collapses. This engine batches at the **iteration** level instead (the
+ORCA scheduling model): ONE persistent decode step compiled per capacity
+bucket runs every iteration over a fixed-capacity slot tensor; sessions
+are admitted into free slots BETWEEN steps and evicted the step their
+sequence ends, so the device batch stays full while individual sessions
+churn.
+
+What makes admission cheap is the state layout, grown from
+``StreamSessions``' parked-state idiom into preallocated device-resident
+**per-slot state blocks**:
+
+- transformer: a KV cache ``[cap, max_context, heads, head_dim]`` per
+  block, written at the slot's position each step and attention-masked to
+  ``j <= position`` — a freed slot's stale keys are unreachable by
+  construction, so admission never touches the cache;
+- LSTM (the PR 6 recurrent engine): ``h``/``c`` blocks ``[cap, hidden]``
+  per layer, zeroed INSIDE the compiled step for slots flagged ``fresh``
+  — admission is a host-side slot write, never a recompile.
+
+Prompt prefill feeds prompt tokens one per step through the SAME compiled
+program (teacher forcing; emitted tokens are discarded until the last
+prompt token is consumed), so prompt length is not a compile axis: the
+only compiles are the capacity buckets (powers of two, grown on demand),
+pinned by tests/test_decode.py as ``compile count == bucket count``.
+
+``mode="static"`` runs the SAME compiled step but only admits when every
+slot has drained — the request-level baseline for the A/B in
+scripts/serve_load.jsonl. Because per-slot math is row-independent (the
+bitwise padding property test_serving.py pins for the MLP path), a
+session's token stream is bitwise identical under either schedule.
+
+Sampling is greedy argmax on device: deterministic, so continuous-vs-
+static equality is exact, and the int8-vs-bf16 drift bound (ops/quant.py)
+is measurable on the returned per-token distributions.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.common import get_policy
+from deeplearning4j_tpu.nn.conf.layers.attention import TransformerBlock
+from deeplearning4j_tpu.nn.conf.layers.feedforward import EmbeddingLayer
+from deeplearning4j_tpu.nn.conf.layers.recurrent import (
+    GravesBidirectionalLSTM, LSTM, RnnOutputLayer,
+)
+from deeplearning4j_tpu.observability import names as _n
+from deeplearning4j_tpu.observability.compile_tracker import (
+    global_tracker as _compile_tracker,
+)
+from deeplearning4j_tpu.observability.flight_recorder import (
+    global_recorder as _flight_recorder,
+)
+from deeplearning4j_tpu.observability.metrics import global_registry
+from deeplearning4j_tpu.observability.profiler import (
+    note_dispatch as _profile_note_dispatch,
+)
+from deeplearning4j_tpu.observability.watchdog import beat as _wd_beat
+from deeplearning4j_tpu.ops.quant import (
+    dequantize_tree, gather_rows, quantize_tree, quantized_matmul,
+    tree_param_bytes,
+)
+
+from .admission import RejectedError
+
+#: the compiled-program name of the persistent step — the compile tracker
+#: records one event per capacity bucket under it (tests filter on this)
+DECODE_PROGRAM_NAME = "decode_step"
+
+DECODE_MODES = ("continuous", "static")
+
+
+def _copy_tree(tree):
+    return jax.tree_util.tree_map(lambda a: jnp.array(a), tree)
+
+
+def _streaming_lstm(layer) -> bool:
+    return isinstance(layer, LSTM) and not isinstance(
+        layer, GravesBidirectionalLSTM)
+
+
+class DecodeSession:
+    """One generation request: a prompt plus a token budget.
+
+    The engine appends generated tokens (and their host timestamps) as they
+    materialize; ``result()`` blocks until eviction. ``t_sched`` is the
+    OFFERED arrival time when the caller runs an open-loop schedule — TTFT
+    is measured from it so a backed-up engine cannot hide queueing delay
+    (no coordinated omission).
+    """
+
+    _next_sid = [0]
+    _sid_lock = threading.Lock()
+
+    def __init__(self, prompt, max_new_tokens: int,
+                 t_sched: Optional[float] = None, stream=None):
+        prompt = [int(t) for t in np.asarray(prompt).reshape(-1)]
+        if not prompt:
+            raise ValueError("prompt must contain at least one token id")
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        with self._sid_lock:
+            self._next_sid[0] += 1
+            self.sid = self._next_sid[0]
+        self.prompt = prompt
+        self.max_new_tokens = int(max_new_tokens)
+        self.stream = stream
+        self.tokens: List[int] = []        #: generated token ids
+        self.token_times: List[float] = []  #: host perf_counter per token
+        self.probs: List[np.ndarray] = []   #: per-token dists (opt-in)
+        self.t_submit = time.perf_counter()
+        self.t_sched = self.t_submit if t_sched is None else float(t_sched)
+        self.t_first: Optional[float] = None
+        self.t_done: Optional[float] = None
+        self.evict_reason: Optional[str] = None
+        self.done = threading.Event()
+        # engine-internal slot bookkeeping
+        self._prompt_idx = 0
+
+    @property
+    def ttft_s(self) -> Optional[float]:
+        return None if self.t_first is None else self.t_first - self.t_sched
+
+    def result(self, timeout: Optional[float] = None) -> List[int]:
+        if not self.done.wait(timeout):
+            raise TimeoutError(
+                f"session {self.sid} not finished within {timeout}s")
+        return self.tokens
+
+
+# --------------------------------------------------------------- step builders
+def _build_lstm_step(conf, quant: Optional[str], vocab: int):
+    """Per-iteration step for LSTM stacks: one-hot the slot tokens, thread
+    ``{h, c}`` slot blocks through ``apply_streaming`` (the PR 6 engine),
+    zeroing state for ``fresh`` slots inside the program."""
+    layers = conf.layers
+
+    def step(params_list, state_list, blocks, tokens, fresh, positions):
+        if quant == "int8":
+            params_list = dequantize_tree(params_list)
+        h = jax.nn.one_hot(tokens, vocab, dtype=jnp.float32)[:, None, :]
+        new_blocks = []
+        for i, layer in enumerate(layers):
+            pp = conf.preprocessor(i)
+            if pp is not None:
+                h = pp.pre_process(h)
+            if _streaming_lstm(layer):
+                st = {
+                    "h": jnp.where(fresh[:, None], 0.0, blocks[i]["h"]),
+                    "c": jnp.where(fresh[:, None], 0.0, blocks[i]["c"]),
+                }
+                h, rs = layer.apply_streaming(params_list[i], st, h)
+                new_blocks.append(rs)
+            else:
+                h, _ = layer.apply(params_list[i], state_list[i], h,
+                                   train=False, rng=None)
+                new_blocks.append(blocks[i])
+        probs = h[:, -1, :]
+        return jnp.argmax(probs, axis=-1).astype(jnp.int32), probs, new_blocks
+
+    return step
+
+
+def _build_transformer_step(conf, quant: Optional[str], vocab: int):
+    """Per-iteration step for decoder-only transformer stacks: embed the
+    slot tokens, write this step's k/v into each block's slot cache at the
+    slot position, attend the single query over ``j <= position``, finish
+    with the time-distributed output head. Matmuls that dominate the step
+    route through :func:`ops.quant.quantized_matmul` so the int8 policy is
+    dequant-free where the Pallas path allows."""
+    layers = conf.layers
+    for i in range(len(layers)):
+        if conf.preprocessor(i) is not None:
+            raise ValueError(
+                "decode does not support preprocessors in transformer "
+                "stacks; got one before layer "
+                f"{i} ({type(layers[i]).__name__})")
+
+    def step(params_list, state_list, blocks, tokens, fresh, positions):
+        pol = get_policy()
+        od, cd = pol.output_dtype, pol.compute_dtype
+        cap = tokens.shape[0]
+        x = None
+        new_blocks = []
+        for i, layer in enumerate(layers):
+            p = params_list[i]
+            if isinstance(layer, EmbeddingLayer):
+                x = (gather_rows(p["W"], tokens) + p["b"]).astype(od)
+                x = layer.act_fn()(x)
+                new_blocks.append(blocks[i])
+            elif isinstance(layer, TransformerBlock):
+                F = layer.n_out
+                H = layer.n_heads
+                D = F // H
+                h = TransformerBlock._ln(x, p["ln1_g"], p["ln1_b"])
+                qkv = quantized_matmul(h.astype(cd), p["Wqkv"],
+                                       compute_dtype=cd)
+                q, k, v = jnp.split(qkv.astype(od), 3, axis=-1)
+                q = q.reshape(cap, H, D)
+                k = k.reshape(cap, H, D)
+                v = v.reshape(cap, H, D)
+                K, V = blocks[i]["k"], blocks[i]["v"]
+                tmax = K.shape[1]
+                at_pos = (jnp.arange(tmax)[None, :]
+                          == positions[:, None])[..., None, None]
+                K = jnp.where(at_pos, k[:, None], K)
+                V = jnp.where(at_pos, v[:, None], V)
+                # a freed slot's stale cache rows sit at j > position of the
+                # next tenant, so masking to j <= position doubles as the
+                # admission reset — no cache zeroing on slot reuse
+                valid = (jnp.arange(tmax)[None, None, :]
+                         <= positions[:, None, None])
+                s = jnp.einsum("chd,cthd->cht", q.astype(jnp.float32),
+                               K.astype(jnp.float32)) / jnp.sqrt(
+                                   jnp.float32(D))
+                s = jnp.where(valid, s, jnp.float32(-1e30))
+                w = jax.nn.softmax(s, axis=-1)
+                o = jnp.einsum("cht,cthd->chd", w,
+                               V.astype(jnp.float32)).reshape(cap, F)
+                att = quantized_matmul(o.astype(cd), p["Wo"],
+                                       compute_dtype=cd)
+                x = x + att.astype(od) + p["bo"].astype(od)
+                h = TransformerBlock._ln(x, p["ln2_g"], p["ln2_b"])
+                h = quantized_matmul(h.astype(cd), p["W1"], compute_dtype=cd)
+                h = jax.nn.gelu(h.astype(od) + p["b1"].astype(od))
+                h = quantized_matmul(h.astype(cd), p["W2"], compute_dtype=cd)
+                x = x + h.astype(od) + p["b2"].astype(od)
+                new_blocks.append({"k": K, "v": V})
+            elif isinstance(layer, RnnOutputLayer):
+                logits = quantized_matmul(x.astype(cd), p["W"],
+                                          compute_dtype=cd)
+                x = layer.act_fn()(logits.astype(od) + p["b"].astype(od))
+                new_blocks.append(blocks[i])
+            else:
+                raise ValueError(
+                    f"decode cannot stream layer {type(layer).__name__}")
+        probs = x
+        return jnp.argmax(probs, axis=-1).astype(jnp.int32), probs, new_blocks
+
+    return step
+
+
+class DecodeEngine:
+    """Persistent decode loop with slot-level admission/eviction.
+
+    ``submit()`` queues a session; one daemon pump thread admits, steps and
+    evicts. ``mode="continuous"`` admits into any free slot between steps;
+    ``mode="static"`` (the request-level baseline) admits only when the
+    whole batch has drained. ``quant="int8"`` pins the engine's parameter
+    snapshot under the int8 serving DtypePolicy (ops/quant.py).
+    """
+
+    def __init__(self, net, *, max_context: int = 128, min_slots: int = 2,
+                 max_slots: int = 16, eos_id: Optional[int] = None,
+                 mode: str = "continuous", quant: Optional[str] = None,
+                 capture_probs: bool = False, max_queue: int = 4096,
+                 metrics=None):
+        if mode not in DECODE_MODES:
+            raise ValueError(f"mode must be one of {DECODE_MODES}, "
+                             f"got {mode!r}")
+        if not (1 <= min_slots <= max_slots):
+            raise ValueError("need 1 <= min_slots <= max_slots")
+        net._require_init()
+        conf = net.conf
+        out = conf.layers[-1]
+        if not isinstance(out, RnnOutputLayer):
+            raise ValueError(
+                "decode needs a time-distributed output head "
+                f"(RnnOutputLayer), got {type(out).__name__}")
+        self.vocab = int(out.n_out)
+        first = conf.layers[0]
+        if int(first.n_in) != self.vocab:
+            raise ValueError(
+                f"decode feeds outputs back as inputs: first-layer n_in "
+                f"{first.n_in} must equal output vocab {self.vocab}")
+        has_tf = any(isinstance(l, TransformerBlock) for l in conf.layers)
+        has_lstm = any(_streaming_lstm(l) for l in conf.layers)
+        if has_tf and has_lstm:
+            raise ValueError("decode supports pure-LSTM or pure-transformer "
+                             "stacks, not a mix")
+        if not (has_tf or has_lstm):
+            raise ValueError(
+                "decode needs a stateful sequence model (LSTM stack or "
+                "TransformerBlock stack)")
+        if any(isinstance(l, GravesBidirectionalLSTM) for l in conf.layers):
+            raise ValueError("bidirectional LSTMs cannot stream "
+                             "(the backward pass needs the full sequence)")
+        self.kind = "transformer" if has_tf else "lstm"
+        self.mode = mode
+        self.max_context = int(max_context)
+        self.min_slots = int(min_slots)
+        self.max_slots = int(max_slots)
+        self.eos_id = eos_id
+        self.capture_probs = bool(capture_probs)
+        self.quant = "int8" if quant == "int8" else None
+        self._net = net
+        self._conf = conf
+        # pinned snapshot, exactly like PredictFn: a later fit() on `net`
+        # donates its own buffers, never these
+        self._params = _copy_tree(net.params_list)
+        self._states = _copy_tree(net.state_list)
+        if self.quant == "int8":
+            self._params = quantize_tree(self._params)
+        builder = (_build_transformer_step if has_tf else _build_lstm_step)
+        name = DECODE_PROGRAM_NAME + ("+int8" if self.quant else "")
+        # blocks (arg 2) are donated: the step updates every slot cache in
+        # place instead of allocating a second copy of the KV blocks
+        self._step = net._jit(name, builder(conf, self.quant, self.vocab),
+                              donate=(2,))
+        m = metrics or global_registry()
+        self._g_occupancy = m.gauge(
+            _n.SERVE_SLOT_OCCUPANCY,
+            "active decode slots / slot capacity of the last step")
+        self._h_ttft = m.histogram(
+            _n.SERVE_TTFT_SECONDS,
+            "offered-arrival to first generated token")
+        self._c_tokens = m.counter(
+            _n.SERVE_TOKENS_TOTAL, "generated tokens streamed to sessions")
+        self._c_evictions = m.counter(
+            _n.SERVE_EVICTIONS_TOTAL, "slot evictions by reason")
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._queue: deque = deque()
+        self.max_queue = int(max_queue)
+        self._closed = False
+        self._cap = 0
+        self._slots: List[Optional[DecodeSession]] = []
+        self._tokens_h = np.zeros((0,), np.int32)
+        self._pos_h = np.zeros((0,), np.int32)
+        self._fresh_h = np.zeros((0,), bool)
+        self._blocks = None
+        self._grow_to(self.min_slots)
+        self._steps = 0
+        self._generated = 0
+        self._evicted = 0
+        self._occupancy_sum = 0.0
+        self._buckets: set = set()
+        self._thread = threading.Thread(
+            target=self._loop, name="serve-decode-engine", daemon=True)
+        self._thread.start()
+
+    # ------------------------------------------------------------- slot state
+    def _zero_blocks(self, cap: int):
+        """Preallocated per-slot state blocks for one capacity bucket."""
+        blocks = []
+        for layer in self._conf.layers:
+            if self.kind == "lstm" and _streaming_lstm(layer):
+                h = int(layer.n_out)
+                blocks.append(
+                    {"h": jnp.zeros((cap, h), jnp.float32),
+                     "c": jnp.zeros((cap, h), jnp.float32)})
+            elif self.kind == "transformer" \
+                    and isinstance(layer, TransformerBlock):
+                hd = int(layer.n_out) // int(layer.n_heads)
+                shape = (cap, self.max_context, int(layer.n_heads), hd)
+                blocks.append({"k": jnp.zeros(shape, jnp.float32),
+                               "v": jnp.zeros(shape, jnp.float32)})
+            else:
+                blocks.append({})
+        return blocks
+
+    def _grow_to(self, cap: int) -> None:
+        """Move to a larger capacity bucket: fresh zero blocks with the old
+        slots copied in — sessions in flight keep their state and position."""
+        old = self._cap
+        self._slots += [None] * (cap - old)
+        for name_ in ("_tokens_h", "_pos_h", "_fresh_h"):
+            a = getattr(self, name_)
+            grown = np.zeros((cap,), a.dtype)
+            grown[:old] = a
+            setattr(self, name_, grown)
+        new_blocks = self._zero_blocks(cap)
+        if self._blocks is not None and old:
+            new_blocks = jax.tree_util.tree_map(
+                lambda z, a: z.at[:old].set(a), new_blocks, self._blocks)
+        self._blocks = new_blocks
+        self._cap = cap
+
+    # --------------------------------------------------------------- producer
+    def submit(self, prompt, max_new_tokens: int = 32,
+               t_sched: Optional[float] = None,
+               stream=None) -> DecodeSession:
+        """Queue one generation session; returns immediately."""
+        sess = DecodeSession(prompt, max_new_tokens, t_sched=t_sched,
+                             stream=stream)
+        bad = [t for t in sess.prompt if not 0 <= t < self.vocab]
+        if bad:
+            raise ValueError(f"prompt token ids {bad} outside vocab "
+                             f"[0, {self.vocab})")
+        with self._cond:
+            if self._closed:
+                raise RuntimeError("DecodeEngine is closed")
+            if len(self._queue) >= self.max_queue:
+                # Retry-After: the backlog drains roughly a session per
+                # slot per active session's remaining budget; 1s is the
+                # honest coarse answer at this layer
+                raise RejectedError(len(self._queue), self.max_queue, 1.0)
+            self._queue.append(sess)
+            self._cond.notify()
+        return sess
+
+    # ----------------------------------------------------------------- pump
+    def _active_count(self) -> int:
+        return sum(1 for s in self._slots if s is not None)
+
+    def _admit_locked(self) -> None:
+        """Under the lock: move queued sessions into free slots.
+
+        Continuous mode admits whenever a slot is free; static mode admits
+        only into a fully-drained batch (the request-level baseline). Both
+        grow the capacity bucket (a new compile, power-of-two) when demand
+        outruns the current one.
+        """
+        active = self._active_count()
+        if self.mode == "static" and active:
+            return
+        while self._queue and active >= self._cap \
+                and self._cap < self.max_slots:
+            self._grow_to(min(self._cap * 2, self.max_slots))
+        for i in range(self._cap):
+            if not self._queue:
+                break
+            if self._slots[i] is not None:
+                continue
+            sess = self._queue.popleft()
+            self._slots[i] = sess
+            self._tokens_h[i] = sess.prompt[0]
+            self._pos_h[i] = 0
+            self._fresh_h[i] = True
+            sess._prompt_idx = 0
+            active += 1
+
+    def _evict_locked(self, i: int, reason: str) -> None:
+        sess = self._slots[i]
+        self._slots[i] = None
+        self._evicted += 1
+        self._c_evictions.labels(reason=reason).inc()
+        sess.evict_reason = reason
+        sess.t_done = time.perf_counter()
+        sess.done.set()
+
+    def _pump_once(self) -> bool:
+        """One admit/step/bookkeep iteration; False when idle-and-closed."""
+        with self._cond:
+            while True:
+                self._admit_locked()
+                if self._active_count():
+                    break
+                if self._closed and not self._queue:
+                    return False
+                self._cond.wait(0.05)
+            cap = self._cap
+            active = [(i, self._slots[i]) for i in range(cap)
+                      if self._slots[i] is not None]
+            tokens = jnp.asarray(self._tokens_h)
+            fresh = jnp.asarray(self._fresh_h)
+            positions = jnp.asarray(self._pos_h)
+            blocks = self._blocks
+        t0 = time.perf_counter()
+        try:
+            next_tok, probs, new_blocks = self._step(
+                self._params, self._states, blocks, tokens, fresh, positions)
+            next_h = np.asarray(next_tok)  # lint: host-sync-in-hot-loop-ok (the emitted token drives admission/eviction and feeds back as the next input; the sync IS the iteration boundary)
+            probs_h = np.asarray(probs) if self.capture_probs else None
+        except Exception as e:
+            _flight_recorder().dump(
+                reason="decode-step-error",
+                extra={"cap": cap, "mode": self.mode, "error": repr(e)})
+            with self._cond:
+                for i, sess in active:
+                    self._evict_locked(i, "error")
+            raise
+        dt = time.perf_counter() - t0
+        now = time.perf_counter()
+        with self._cond:
+            self._blocks = new_blocks
+            self._steps += 1
+            self._buckets.add(cap)
+            occupancy = len(active) / cap
+            self._occupancy_sum += occupancy
+            n_steps = self._steps
+            for i, sess in active:
+                self._fresh_h[i] = False
+                self._pos_h[i] += 1
+                prefilling = sess._prompt_idx < len(sess.prompt) - 1
+                if prefilling:
+                    sess._prompt_idx += 1
+                    self._tokens_h[i] = sess.prompt[sess._prompt_idx]
+                else:
+                    tok = int(next_h[i])
+                    sess.tokens.append(tok)
+                    sess.token_times.append(now)
+                    if probs_h is not None:
+                        sess.probs.append(probs_h[i].copy())
+                    if sess.t_first is None:
+                        sess.t_first = now
+                        self._h_ttft.observe(now - sess.t_sched)
+                    self._generated += 1
+                    self._c_tokens.inc()
+                    if sess.stream is not None:
+                        sess.stream(sess.sid, tok, now)
+                    if self.eos_id is not None and tok == self.eos_id:
+                        self._evict_locked(i, "eos")
+                        continue
+                    if len(sess.tokens) >= sess.max_new_tokens:
+                        self._evict_locked(i, "max_tokens")
+                        continue
+                    self._tokens_h[i] = tok
+                if self.kind == "transformer" \
+                        and self._pos_h[i] >= self.max_context:
+                    self._evict_locked(i, "context")
+        self._g_occupancy.set(occupancy)
+        # a decode iteration advances the step clock like a fit/serve
+        # dispatch: bucket-growth compiles are expected, steady-state
+        # compiles are what the storm detector must catch
+        _compile_tracker().note_step()
+        _profile_note_dispatch(dt)
+        _wd_beat(n_steps)
+        return True
+
+    def _loop(self) -> None:
+        while True:
+            try:
+                if not self._pump_once():
+                    return
+            except Exception:
+                # sessions in flight were failed by _pump_once; keep serving
+                continue
+
+    # ---------------------------------------------------------------- control
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "mode": self.mode,
+                "kind": self.kind,
+                "quant": self.quant,
+                "capacity": self._cap,
+                "max_slots": self.max_slots,
+                "buckets": sorted(self._buckets),
+                "bucket_count": len(self._buckets),
+                "steps": self._steps,
+                "tokens": self._generated,
+                "evictions": self._evicted,
+                "queue_depth": len(self._queue),
+                "active": self._active_count(),
+                "mean_occupancy": (self._occupancy_sum / self._steps
+                                   if self._steps else 0.0),
+                "param_bytes": tree_param_bytes(self._params),
+            }
+
+    def state_bytes(self) -> int:
+        """Device-resident bytes of the slot state blocks (the number the
+        churn regression pins: sessions come and go, this does not grow)."""
+        with self._lock:
+            return tree_param_bytes(self._blocks)
+
+    def drain(self, timeout_s: float = 60.0) -> None:
+        """Block until every queued/active session has finished."""
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            with self._lock:
+                if not self._queue and not self._active_count():
+                    return
+            time.sleep(0.002)
+        raise TimeoutError("decode engine did not drain in time")
+
+    def close(self, timeout_s: float = 30.0) -> None:
+        """Stop accepting sessions; the pump drains what is queued first."""
+        with self._cond:
+            if self._closed:
+                return
+            self._closed = True
+            self._cond.notify_all()
+        self._thread.join(timeout_s)

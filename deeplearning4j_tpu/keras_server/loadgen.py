@@ -22,6 +22,16 @@ which caps both phases at the same combined-GIL ceiling and masks the
 batching win the A/B exists to measure. Client startup (process spawn +
 imports) happens before the client schedules its first request, so it
 never lands on the measurement clock.
+
+``run_token_stream_load``/``run_decode_ab`` are the decode-path analogue:
+an in-process open-loop TOKEN-streaming client against a
+:class:`~deeplearning4j_tpu.keras_server.decode.DecodeEngine`. Sessions
+are offered at a fixed sessions/sec clock; per-token host timestamps give
+TTFT (from the SCHEDULED arrival, same no-coordinated-omission rule) and
+inter-token latency percentiles. The A/B pits iteration-level continuous
+batching against request-level static batching at equal offered rate, and
+int8 weight-only decode against dense — same seeded session mix, fresh
+clone per phase so ``recompiles == bucket count`` holds per phase.
 """
 from __future__ import annotations
 
@@ -30,7 +40,7 @@ import json
 import os
 import threading
 import time
-from typing import Callable, List, Optional
+from typing import Callable, List, Optional, Tuple
 
 import numpy as np
 
@@ -312,6 +322,199 @@ def run_ab(net, *, model: str = "model", qps: float = 200.0,
         with open(record_path, "a") as f:
             f.write(json.dumps(rec) + "\n")
     return rec
+
+
+# ----------------------------------------------------- token-streaming load
+def _decode_compile_count() -> int:
+    from .decode import DECODE_PROGRAM_NAME
+    from deeplearning4j_tpu.observability.compile_tracker import \
+        global_tracker
+    return sum(1 for e in global_tracker().snapshot_events()
+               if DECODE_PROGRAM_NAME in e.get("fn", ""))
+
+
+def _decode_workload(n_sessions: int, vocab: int, prompt_len: int,
+                     max_new_tokens: int, seed: int):
+    """One deterministic session mix shared by every A/B phase.
+
+    Budgets are LONG-TAILED (3/4 short, 1/4 near the ceiling) because
+    that is what decode traffic looks like and it is exactly what
+    request-level batching is bad at: one near-ceiling session holds the
+    whole batch hostage while the short ones sit drained in their slots.
+    """
+    rng = np.random.default_rng(seed)
+    prompts = [list(map(int, rng.integers(0, vocab,
+                                          size=int(rng.integers(1, prompt_len + 1)))))
+               for _ in range(n_sessions)]
+    short_hi = max(max_new_tokens // 3, 3)
+    budgets = [int(rng.integers(max_new_tokens // 2, max_new_tokens + 1))
+               if rng.random() < 0.25 else int(rng.integers(2, short_hi))
+               for _ in range(n_sessions)]
+    return prompts, budgets
+
+
+def run_token_stream_load(engine, prompts, budgets, *,
+                          offered_sps: float,
+                          timeout_s: float = 600.0) -> dict:
+    """Open-loop token-streaming load against a :class:`DecodeEngine`.
+
+    Session ``i`` is OFFERED at ``t0 + i/offered_sps`` regardless of how
+    fast the engine drains — a saturated engine shows up as growing TTFT,
+    never as a politely-thinning arrival schedule (no coordinated
+    omission: TTFT is measured from the scheduled arrival, which
+    ``submit(t_sched=...)`` pins). Per-token host timestamps give the
+    inter-token latency distribution; tokens/sec is counted over the wall
+    clock from first offer to last completion.
+    """
+    t0, sessions = _offer_sessions(engine, prompts, budgets, offered_sps)
+    for s in sessions:
+        s.result(timeout=timeout_s)
+    res = _summarize_sessions(sessions, t0)
+    res["offered_sps"] = round(offered_sps, 3)
+    return res
+
+
+def _offer_sessions(engine, prompts, budgets, offered_sps: float):
+    """Submit the whole mix on the open-loop clock; returns (t0, sessions)."""
+    t0 = time.perf_counter() + 0.02
+    sessions = []
+    for i, (p, b) in enumerate(zip(prompts, budgets)):
+        t_sched = t0 + i / offered_sps
+        delay = t_sched - time.perf_counter()
+        if delay > 0:
+            time.sleep(delay)
+        sessions.append(engine.submit(p, b, t_sched=t_sched))
+    return t0, sessions
+
+
+def run_decode_ab(net, *, model: str = "decode", slots: int = 8,
+                  n_sessions: int = 48, prompt_len: int = 4,
+                  max_new_tokens: int = 24, offered_sps: Optional[float] = None,
+                  eos_id: Optional[int] = None, max_context: int = 128,
+                  quant_ab: bool = True, seed: int = 0,
+                  record_path: Optional[str] = None) -> dict:
+    """Continuous vs static (request-level) decode at EQUAL offered
+    sessions/sec, plus an int8-vs-dense accuracy/throughput A/B.
+
+    Every phase runs the identical deterministic session mix on a fresh
+    ``net.clone()`` (fresh compile cache, so ``recompiles == bucket
+    count`` holds per phase) at the same slot capacity. With
+    ``offered_sps=None`` the rate is calibrated to saturate: 1.5x the
+    continuous engine's drained session rate from a burst probe — the
+    regime where slot occupancy, not arrival, is the binding constraint.
+    The headline ratio is tokens/sec; TTFT p99 must not regress.
+    """
+    from .decode import DecodeEngine
+    prompts, budgets = _decode_workload(
+        n_sessions, _decode_vocab(net), prompt_len, max_new_tokens, seed)
+
+    if offered_sps is None:
+        probe = DecodeEngine(net.clone(), min_slots=slots, max_slots=slots,
+                             eos_id=eos_id, max_context=max_context)
+        try:
+            _decode_warmup(probe)
+            n_probe = min(2 * slots, n_sessions)
+            res = run_token_stream_load(
+                probe, prompts[:n_probe], budgets[:n_probe],
+                offered_sps=1e6)  # burst: measure drain rate, not arrival
+        finally:
+            probe.close()
+        offered_sps = max(1.5 * res["achieved_sps"], 1.0)
+
+    def phase(mode: str, quant=None, capture=False) -> Tuple[dict, list]:
+        before = _decode_compile_count()
+        eng = DecodeEngine(net.clone(), min_slots=slots, max_slots=slots,
+                           mode=mode, quant=quant, eos_id=eos_id,
+                           max_context=max_context, capture_probs=capture)
+        try:
+            _decode_warmup(eng)  # bucket compile happens off the clock
+            t0, sessions = _offer_sessions(eng, prompts, budgets, offered_sps)
+            for s in sessions:
+                s.result(timeout=600.0)
+            res = _summarize_sessions(sessions, t0)
+            st = eng.stats()
+        finally:
+            eng.close()
+        res.update({
+            "mode": mode, "quant": quant,
+            "offered_sps": round(offered_sps, 3),
+            "mean_occupancy": round(st["mean_occupancy"], 4),
+            "bucket_count": st["bucket_count"],
+            "steps": st["steps"],
+            "recompiles": _decode_compile_count() - before,
+            "param_bytes": st["param_bytes"],
+        })
+        return res, sessions
+
+    cont, cont_sessions = phase("continuous", capture=quant_ab)
+    stat, _ = phase("static")
+    rec = {
+        "harness": "keras_server.loadgen.run_decode_ab",
+        "model": model, "slots": slots, "n_sessions": n_sessions,
+        "offered_sps": round(offered_sps, 3),
+        "max_new_tokens": max_new_tokens, "prompt_len": prompt_len,
+        "continuous": cont, "static": stat,
+        "tokens_per_sec_ratio": round(
+            cont["tokens_per_sec"] / max(stat["tokens_per_sec"], 1e-9), 3),
+        "ttft_p99_ratio": round(
+            stat["ttft_p99_ms"] / max(cont["ttft_p99_ms"], 1e-9), 3),
+    }
+    if quant_ab:
+        q, q_sessions = phase("continuous", quant="int8", capture=True)
+        drifts, agree = [], []
+        for qs, ds in zip(q_sessions, cont_sessions):
+            n = min(len(qs.probs), len(ds.probs))
+            if not n:
+                continue
+            qp = np.stack(qs.probs[:n])
+            dp = np.stack(ds.probs[:n])
+            drifts.append(float(np.mean(np.abs(qp - dp))))
+            agree.append(float(np.mean(
+                qp.argmax(-1) == dp.argmax(-1))))
+        rec["int8"] = q
+        rec["int8_vs_dense"] = {
+            "mean_prob_drift": round(float(np.mean(drifts)), 6),
+            "top1_agreement": round(float(np.mean(agree)), 4),
+            "tokens_per_sec_ratio": round(
+                q["tokens_per_sec"] / max(cont["tokens_per_sec"], 1e-9), 3),
+            "param_bytes_ratio": round(
+                cont["param_bytes"] / max(q["param_bytes"], 1), 3),
+        }
+    if record_path:
+        os.makedirs(os.path.dirname(os.path.abspath(record_path)),
+                    exist_ok=True)
+        with open(record_path, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+    return rec
+
+
+def _decode_vocab(net) -> int:
+    return int(net.conf.layers[-1].n_out)
+
+
+def _decode_warmup(engine) -> None:
+    """One throwaway session so the bucket's compile never lands on the
+    measurement clock (it still lands in the phase's recompile delta)."""
+    engine.submit([0], 2).result(timeout=600.0)
+
+
+def _summarize_sessions(sessions, t0: float) -> dict:
+    t_end = max(s.t_done for s in sessions)
+    wall = max(t_end - t0, 1e-9)
+    n_tokens = sum(len(s.tokens) for s in sessions)
+    ttft = sorted(s.ttft_s * 1e3 for s in sessions if s.ttft_s is not None)
+    itl = sorted((b - a) * 1e3 for s in sessions
+                 for a, b in zip(s.token_times, s.token_times[1:]))
+    return {
+        "sessions": len(sessions), "tokens": n_tokens,
+        "achieved_sps": round(len(sessions) / wall, 3),
+        "tokens_per_sec": round(n_tokens / wall, 3),
+        "duration_s": round(wall, 3),
+        "ttft_p50_ms": round(percentile(ttft, 0.50), 3),
+        "ttft_p99_ms": round(percentile(ttft, 0.99), 3),
+        "itl_p50_ms": round(percentile(itl, 0.50), 3),
+        "itl_p99_ms": round(percentile(itl, 0.99), 3),
+    }
 
 
 def _client_main() -> None:

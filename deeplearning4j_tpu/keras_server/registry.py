@@ -33,18 +33,22 @@ class ModelVersion:
     """One immutable (name, version) serving unit."""
 
     def __init__(self, name: str, version: str, net, predict_fn: PredictFn,
-                 source: str = "memory"):
+                 source: str = "memory", quant: str = None):
         self.name = name
         self.version = version
         self.net = net
         self.predict_fn = predict_fn
         self.source = source
+        #: serving DtypePolicy this version was pinned under (None = the
+        #: network's policy dtype; "int8" = quantized weights at rest)
+        self.quant = quant
         #: the streaming seam exists on both network types
         self.streaming_capable = hasattr(net, "rnn_time_step")
 
     def describe(self) -> dict:
         return {"name": self.name, "version": self.version,
-                "source": self.source,
+                "source": self.source, "quant": self.quant,
+                "param_bytes": self.predict_fn.param_bytes,
                 "streaming_capable": self.streaming_capable,
                 "predict_calls": self.predict_fn.calls}
 
@@ -64,12 +68,16 @@ class ModelRegistry:
 
     # ------------------------------------------------------------- loading
     def register(self, name: str, net, version: Optional[str] = None,
-                 source: str = "memory") -> ModelVersion:
+                 source: str = "memory",
+                 quant: Optional[str] = None) -> ModelVersion:
         """Pin ``net`` for serving and make it the active version.
 
         The predict program is built (and its parameter snapshot copied)
         BEFORE the active pointer moves, so the swap itself is a dict
         assignment under the lock — atomic with respect to ``active()``.
+        ``quant="int8"`` opts the version into the int8 serving DtypePolicy:
+        per-channel scales calibrated at pin time, int8 weights at rest for
+        both the predict program and this version's decode engines.
         """
         with self._lock:
             version = version or f"v{len(self._versions.get(name, {})) + 1}"
@@ -77,10 +85,11 @@ class ModelRegistry:
                 raise ValueError(
                     f"model {name!r} already has version {version!r}; "
                     "versions are immutable — register a new one")
-        pf = make_predict_fn(net, version=version)
+        pf = make_predict_fn(net, version=version, quant=quant)
         with self._lock:
             swapping = name in self._active
-            mv = ModelVersion(name, version, net, pf, source=source)
+            mv = ModelVersion(name, version, net, pf, source=source,
+                              quant=pf.quant)
             self._versions.setdefault(name, {})[version] = mv
             self._active[name] = version
             self._g_models.set(
@@ -89,8 +98,8 @@ class ModelRegistry:
                 self._c_swaps.labels(model=name).inc()
         return mv
 
-    def load(self, name: str, path: str,
-             version: Optional[str] = None) -> ModelVersion:
+    def load(self, name: str, path: str, version: Optional[str] = None,
+             quant: Optional[str] = None) -> ModelVersion:
         """Load a model file and register it: a ``model_serializer`` zip
         (either network type) or a Keras HDF5 export."""
         if zipfile.is_zipfile(path):
@@ -104,7 +113,8 @@ class ModelRegistry:
                     .import_keras_sequential_model_and_weights(path)
             except ValueError:
                 net = KerasModelImport.import_keras_model_and_weights(path)
-        return self.register(name, net, version=version, source=path)
+        return self.register(name, net, version=version, source=path,
+                             quant=quant)
 
     # ------------------------------------------------------------- lookup
     def active(self, name: str) -> ModelVersion:

@@ -17,6 +17,11 @@ Routes:
   computed over the ``rnnTimeStep`` seam; hidden state persists
   server-side under ``session`` across requests.
 - ``POST /v1/stream/reset`` — drop a session's parked state.
+- ``POST /v1/generate`` body ``{"model": m, "prompt": [ids],
+  "max_new_tokens": n}`` — autoregressive generation over the continuous-
+  batching :class:`DecodeEngine` (decode.py): newline-delimited JSON, ONE
+  line per generated token as the persistent decode loop emits it; the
+  request shares slot capacity with every other in-flight generation.
 - ``GET /serve/status`` — models/versions, queue depth, bucket occupancy
   (the same payload the training UI proxies).
 - ``GET /metrics`` — Prometheus text (standalone deployments; the UI
@@ -27,6 +32,7 @@ Per-route latency lands in ``dl4j_serve_request_seconds{route=...}``.
 from __future__ import annotations
 
 import json
+import queue
 import threading
 import time
 from concurrent.futures import TimeoutError as _FutureTimeout
@@ -41,6 +47,7 @@ from deeplearning4j_tpu.observability.metrics import global_registry
 
 from .admission import RejectedError
 from .batcher import MicroBatcher
+from .decode import DecodeEngine
 from .registry import ModelRegistry, global_model_registry
 from .streaming import StreamSessions
 
@@ -108,6 +115,8 @@ class _ServeHandler(BaseHTTPRequestHandler):
                 self._predict()
             elif path == "/v1/stream":
                 self._stream()
+            elif path == "/v1/generate":
+                self._generate()
             elif path == "/v1/stream/reset":
                 req = self._body()
                 existed = self.engine.sessions.reset(
@@ -184,6 +193,47 @@ class _ServeHandler(BaseHTTPRequestHandler):
         chunk({"done": True, "session": session, "timesteps": int(x.shape[1])})
         self.wfile.write(b"0\r\n\r\n")
 
+    def _generate(self) -> None:
+        req = self._body()
+        model = str(req.get("model", ""))
+        prompt = req.get("prompt")
+        if not isinstance(prompt, list) or not prompt:
+            raise ValueError(
+                'generate needs a non-empty "prompt" list of token ids')
+        max_new = int(req.get("max_new_tokens", 32))
+        eng = self.engine.decoder(model)
+        tokens_q: "queue.Queue" = queue.Queue()
+        sess = eng.submit(prompt, max_new,
+                          stream=lambda sid, tok, t: tokens_q.put((tok, t)))
+        self.send_response(200)
+        self.send_header("Content-Type", "application/x-ndjson")
+        self.send_header("Transfer-Encoding", "chunked")
+        self.end_headers()
+
+        def chunk(obj: dict) -> None:
+            line = (json.dumps(obj) + "\n").encode()
+            self.wfile.write(f"{len(line):x}\r\n".encode() + line + b"\r\n")
+            self.wfile.flush()
+
+        i = 0
+        deadline = time.monotonic() + self.engine.request_timeout_s
+        while True:
+            try:
+                tok, _t = tokens_q.get(timeout=0.02)
+            except queue.Empty:
+                if sess.done.is_set() and tokens_q.empty():
+                    break
+                if time.monotonic() > deadline:
+                    chunk({"error": "generation timed out"})
+                    break
+                continue
+            chunk({"i": i, "token": int(tok)})
+            i += 1
+        chunk({"done": True, "tokens": sess.tokens,
+               "reason": sess.evict_reason,
+               "ttft_s": sess.ttft_s})
+        self.wfile.write(b"0\r\n\r\n")
+
 
 class InferenceServer:
     """The serving engine: registry + micro-batcher + HTTP front-end."""
@@ -192,13 +242,20 @@ class InferenceServer:
                  host: str = "127.0.0.1", port: int = 0,
                  max_batch: int = 32, max_latency_s: float = 0.002,
                  max_queue: int = 256, request_timeout_s: float = 30.0,
-                 stream_ttl_s: float = 300.0):
+                 stream_ttl_s: float = 300.0, decode_min_slots: int = 2,
+                 decode_max_slots: int = 16, decode_max_context: int = 256,
+                 decode_eos_id: Optional[int] = None):
         self.registry = registry or global_model_registry()
         self.batcher = MicroBatcher(
             self.registry, max_batch=max_batch, max_latency_s=max_latency_s,
             max_queue=max_queue)
         self.sessions = StreamSessions(self.registry, ttl_s=stream_ttl_s)
         self.request_timeout_s = float(request_timeout_s)
+        self._decode_opts = dict(
+            min_slots=decode_min_slots, max_slots=decode_max_slots,
+            max_context=decode_max_context, eos_id=decode_eos_id)
+        self._decoders: dict = {}
+        self._dec_lock = threading.Lock()
         self._h_request = global_registry().histogram(
             _n.SERVE_REQUEST_SECONDS, "HTTP request latency per route")
         handler = type("BoundServeHandler", (_ServeHandler,),
@@ -218,18 +275,40 @@ class InferenceServer:
         _set_active_server(self)
         return self
 
+    def decoder(self, model: str) -> DecodeEngine:
+        """The continuous-batching decode engine for ``model``'s active
+        version, created lazily and shared by every /v1/generate request —
+        the slot tensor IS the cross-request batch. A version inherits its
+        int8 serving DtypePolicy from how it was registered."""
+        mv = self.registry.active(model)
+        key = (mv.name, mv.version)
+        with self._dec_lock:
+            eng = self._decoders.get(key)
+            if eng is None:
+                eng = self._decoders[key] = DecodeEngine(
+                    mv.net, quant=mv.quant, **self._decode_opts)
+            return eng
+
     def stop(self) -> None:
         self._httpd.shutdown()
         self._httpd.server_close()
         self.batcher.close()
+        with self._dec_lock:
+            for eng in self._decoders.values():
+                eng.close()
+            self._decoders.clear()
         _set_active_server(None, only_if=self)
 
     def status(self) -> dict:
         """Everything /serve/status (here and on the training UI) shows."""
+        with self._dec_lock:
+            decode = {f"{name}@{version}": eng.stats()
+                      for (name, version), eng in sorted(self._decoders.items())}
         return {
             **self.registry.status(),
             "queue": self.batcher.stats(),
             "streams": self.sessions.status(),
+            "decode": decode,
         }
 
 

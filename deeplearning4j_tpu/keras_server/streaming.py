@@ -13,7 +13,13 @@ into server-side sessions:
   ``rnn_get_previous_state``/``rnn_set_previous_state`` (exactly the
   reference's serving-handoff contract), swapped in under the model lock
   for each step;
-- sessions idle past ``ttl_s`` are evicted on the next touch.
+- sessions idle past ``ttl_s`` are evicted on the next touch, and eviction
+  **releases the parked device state block** — ``delete()`` on every leaf,
+  after un-aliasing the clone's live ``_rnn_state`` (the most recently
+  stepped session's parked tree IS that attribute, so dropping the dict
+  entry alone would keep its buffers resident). The churn regression in
+  tests/test_decode.py pins that 1k evicted sessions do not grow
+  device-resident bytes.
 """
 from __future__ import annotations
 
@@ -21,6 +27,7 @@ import threading
 import time
 from typing import Dict, Optional, Tuple
 
+import jax
 import numpy as np
 
 from deeplearning4j_tpu.observability import names as _n
@@ -53,6 +60,8 @@ class StreamSessions:
             _n.SERVE_STREAM_SESSIONS, "live streaming sessions")
         self._c_steps = m.counter(
             _n.SERVE_STREAM_STEPS_TOTAL, "streamed timesteps served")
+        self._c_evictions = m.counter(
+            _n.SERVE_EVICTIONS_TOTAL, "slot evictions by reason")
 
     def _model(self, name: str) -> Tuple[_StreamModel, str]:
         mv = self.registry.active(name)
@@ -65,10 +74,26 @@ class StreamSessions:
                 sm = self._models[key] = _StreamModel(mv.net)
             return sm, mv.version
 
+    @staticmethod
+    def _release_state(sm: _StreamModel, state) -> None:
+        """Eagerly free a parked state's device buffers (caller holds
+        ``sm.lock``). The parked tree of the most recently stepped session
+        aliases the clone's live ``_rnn_state`` (``rnn_get_previous_state``
+        returns it by reference), so that alias is cleared first; then every
+        leaf is ``delete()``d instead of waiting on the GC — parked blocks
+        are the serving tier's HBM, not garbage."""
+        if sm.net.rnn_get_previous_state() is state:
+            sm.net.rnn_clear_previous_state()
+        for leaf in jax.tree_util.tree_leaves(state):
+            if hasattr(leaf, "is_deleted") and not leaf.is_deleted():
+                leaf.delete()
+
     def _evict_expired(self, sm: _StreamModel, now: float) -> None:
-        for sid, (_, t) in list(sm.states.items()):
+        for sid, (state, t) in list(sm.states.items()):
             if now - t > self.ttl_s:
                 del sm.states[sid]
+                self._release_state(sm, state)
+                self._c_evictions.labels(reason="ttl").inc()
 
     def _session_count(self) -> int:
         with self._lock:
@@ -111,7 +136,11 @@ class StreamSessions:
         except KeyError:
             return False
         with sm.lock:
-            existed = sm.states.pop(session, None) is not None
+            parked = sm.states.pop(session, None)
+            existed = parked is not None
+            if existed:
+                self._release_state(sm, parked[0])
+                self._c_evictions.labels(reason="reset").inc()
         self._g_sessions.set(self._session_count())
         return existed
 

@@ -39,6 +39,23 @@ def _copy_tree(tree):
     return jax.tree_util.tree_map(lambda a: jnp.array(a), tree)
 
 
+def _with_dequant(fn):
+    """Wrap a forward so its first act is reconstituting dense params from
+    the int8 snapshot — inside the jit, so the arguments stay int8."""
+    @functools.wraps(fn)
+    def wrapped(params, *rest, **kw):
+        from deeplearning4j_tpu.ops.quant import dequantize_tree
+        return fn(dequantize_tree(params), *rest, **kw)
+    return wrapped
+
+
+#: serving DtypePolicy values make_predict_fn accepts: None/"bf16" serve the
+#: pinned snapshot at the network's policy dtype; "int8" additionally
+#: quantizes large matrix leaves (ops/quant.py) so the resident params are
+#: 8-bit and the dequant runs inside the compiled program
+QUANT_MODES = (None, "bf16", "int8")
+
+
 class PredictFn:
     """A compiled, non-donated, snapshot-pinned forward pass.
 
@@ -46,16 +63,29 @@ class PredictFn:
     batch axis. Thread-safe — concurrent calls share one compiled program
     per abstract input shape (jax's jit cache handles the rest); the pinned
     buffers are never donated so calls cannot race on buffer liveness.
+
+    ``quant="int8"`` is the opt-in serving DtypePolicy: per-channel scales
+    are calibrated at pin time over the snapshot (ops/quant.py), the pinned
+    tree holds int8 codes (4x resident-bytes cut vs f32), and the jitted
+    program dequantizes lazily so XLA fuses the cast into each consumer.
     """
 
-    def __init__(self, net, name: str = PREDICT_PROGRAM_NAME):
+    def __init__(self, net, name: str = PREDICT_PROGRAM_NAME,
+                 quant: Optional[str] = None):
         net._require_init()
+        if quant not in QUANT_MODES:
+            raise ValueError(f"quant must be one of {QUANT_MODES}, "
+                             f"got {quant!r}")
         self._net = net
         self._name = name
+        self.quant = quant if quant == "int8" else None
         # snapshot at pin time: a later fit() on `net` donates ITS buffers,
         # not these copies, and a hot-swap replaces this object wholesale
         self._params = _copy_tree(net.params_list)
         self._states = _copy_tree(net.state_list)
+        if self.quant == "int8":
+            from deeplearning4j_tpu.ops.quant import quantize_tree
+            self._params = quantize_tree(self._params)
         self._graph = type(net).__name__ == "ComputationGraph"
         if self._graph:
             n_in = len(net.conf.network_inputs)
@@ -67,6 +97,8 @@ class PredictFn:
             fn = net._output_pure
         else:
             fn = functools.partial(net._output_pure, train=False)
+        if self.quant == "int8":
+            fn = _with_dequant(fn)
         # LazyScore._jit: policy-keyed, compile-tracked, NO donate argnums
         self._fn = net._jit(name, fn)
         self._lock = threading.Lock()
@@ -76,8 +108,15 @@ class PredictFn:
     def name(self) -> str:
         return self._name
 
+    @property
+    def param_bytes(self) -> int:
+        """Resident bytes of the pinned params (int8 shows the 4x cut)."""
+        from deeplearning4j_tpu.ops.quant import tree_param_bytes
+        return tree_param_bytes(self._params)
+
     def params_snapshot(self):
-        """The pinned parameter pytree (tests assert bit-stability)."""
+        """The pinned parameter pytree (tests assert bit-stability).
+        Under quant="int8" the matrix leaves are QuantizedLeaf records."""
         return self._params
 
     def __call__(self, x) -> Any:
@@ -93,13 +132,18 @@ class PredictFn:
 
 
 def make_predict_fn(net, name: str = PREDICT_PROGRAM_NAME,
-                    version: Optional[str] = None) -> PredictFn:
+                    version: Optional[str] = None,
+                    quant: Optional[str] = None) -> PredictFn:
     """Pin a non-donated compiled forward for serving.
 
     ``version`` only decorates the program name (``serve_predict@v2``) so a
     hot-swapped model's compiles are attributable in the compile tracker;
-    omit it for the plain serving program.
+    omit it for the plain serving program. ``quant="int8"`` opts this pin
+    into the int8 serving DtypePolicy (the program name gains ``+int8`` so
+    quantized compiles stay attributable too).
     """
     if version:
         name = f"{name}@{version}"
-    return PredictFn(net, name=name)
+    if quant == "int8":
+        name = f"{name}+int8"
+    return PredictFn(net, name=name, quant=quant)

@@ -84,6 +84,12 @@ SERVE_HOT_SWAPS_TOTAL = "dl4j_serve_hot_swaps_total"
 SERVE_STREAM_SESSIONS = "dl4j_serve_stream_sessions"
 SERVE_STREAM_STEPS_TOTAL = "dl4j_serve_stream_steps_total"
 
+# --- continuous-batching decode engine (keras_server/{decode,streaming}.py) -
+SERVE_SLOT_OCCUPANCY = "dl4j_serve_slot_occupancy"
+SERVE_TTFT_SECONDS = "dl4j_serve_ttft_seconds"
+SERVE_TOKENS_TOTAL = "dl4j_serve_tokens_total"
+SERVE_EVICTIONS_TOTAL = "dl4j_serve_evictions_total"
+
 # --- async parameter server (parallel/{param_server,ps_transport}.py) ------
 PS_PUSHES_TOTAL = "dl4j_ps_pushes_total"
 PS_PULLS_TOTAL = "dl4j_ps_pulls_total"

@@ -1,0 +1,194 @@
+"""Int8 weight quantization for the serving path (the serving DtypePolicy).
+
+Training precision is governed by ``common.DtypePolicy``; serving adds one
+more lever the fit path must never see: **weight-only int8**. At pin time
+(:func:`quantize_tree`, called from ``nn/inference.py`` / the decode engine)
+every large floating matrix leaf is replaced by a :class:`QuantizedLeaf` —
+symmetric per-output-channel scales calibrated from the pinned snapshot
+(absmax / 127, no calibration data needed for weight-only) plus an ``int8``
+code tensor. The params that live in HBM and in jit arguments are then 8-bit:
+a 4x (vs f32) resident-bytes cut per pinned version, which is what lets one
+chip hold more hot versions and bigger KV caches.
+
+Compute path, in order of preference:
+
+- :func:`quantized_matmul` — the dequant-free seam. On TPU (or in interpret
+  mode) a Pallas kernel streams int8 weight tiles into VMEM and applies the
+  per-channel scale to the f32 accumulator tile **in registers**: the dense
+  bf16/f32 weight matrix is never materialized anywhere. Elsewhere the XLA
+  fallback computes ``(x @ q.astype(compute)) * scale`` — the cast is fused
+  into the matmul operand read and the scale into its epilogue, so memory
+  traffic stays int8 even though a cast happens per tile.
+- :func:`dequantize_tree` — the bf16 fallback for code paths that reach a
+  layer's stock ``apply`` (generic ``PredictFn`` forwards): runs INSIDE the
+  jitted program, so weights at rest stay int8 and XLA fuses the dequant
+  into each consumer.
+
+Accuracy contract (pinned by tests/test_decode.py): per-channel symmetric
+int8 keeps serving outputs within a documented drift bound of the bf16/f32
+reference — mean |prob drift| <= 2e-2 and >= 90%% greedy top-1 agreement on
+the char-RNN and transformer evals. Anything worse is a quantizer bug, not
+an expected artifact.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+Array = jax.Array
+
+#: leaves smaller than this stay dense: biases, LN scales and tiny heads
+#: carry no memory win and their quantization error is pure downside
+MIN_QUANT_ELEMS = 1024
+
+
+class QuantizedLeaf(NamedTuple):
+    """One int8-quantized weight: ``q`` int8 codes, ``scale`` f32 per
+    output channel (last axis), ``float(q) * scale`` reconstructs. A
+    NamedTuple is already a pytree node, so quantized trees flow through
+    jit/device_put; consumers that must see WHOLE leaves pass
+    ``is_leaf=is_quantized``."""
+
+    q: Array      # int8, original weight shape
+    scale: Array  # f32, shape == (w.shape[-1],)
+
+
+def is_quantized(leaf: Any) -> bool:
+    return isinstance(leaf, QuantizedLeaf)
+
+
+def quantize_per_channel(w: Array) -> QuantizedLeaf:
+    """Symmetric per-output-channel (last axis) int8 quantization.
+
+    Scales are calibrated from the tensor itself: absmax/127 per channel —
+    weight-only quantization needs no activation statistics. All-zero
+    channels get scale 1 so reconstruction stays exact (0 * 1 == 0).
+    """
+    wf = jnp.asarray(w, jnp.float32)
+    absmax = jnp.max(jnp.abs(wf), axis=tuple(range(wf.ndim - 1)))
+    scale = jnp.where(absmax > 0, absmax / 127.0, 1.0)
+    q = jnp.clip(jnp.round(wf / scale), -127, 127).astype(jnp.int8)
+    return QuantizedLeaf(q=q, scale=scale.astype(jnp.float32))
+
+
+def dequantize_leaf(leaf: QuantizedLeaf, dtype=jnp.float32) -> Array:
+    return (leaf.q.astype(jnp.float32) * leaf.scale).astype(dtype)
+
+
+def _eligible(leaf: Any, min_elems: int) -> bool:
+    a = leaf
+    return (hasattr(a, "ndim") and a.ndim >= 2 and a.size >= min_elems
+            and jnp.issubdtype(jnp.asarray(a).dtype, jnp.floating))
+
+
+def quantize_tree(tree, min_elems: int = MIN_QUANT_ELEMS):
+    """Quantize every eligible matrix leaf of a param pytree to int8.
+
+    Eligible = floating, ndim >= 2, size >= ``min_elems``; everything else
+    (biases, norms, peepholes, embedded scalars) is kept as-is. Runs at pin
+    time, off the serving path.
+    """
+    return jax.tree_util.tree_map(
+        lambda a: quantize_per_channel(a) if _eligible(a, min_elems) else a,
+        tree)
+
+
+def dequantize_tree(tree, dtype=jnp.float32):
+    """Reconstruct a dense tree from a quantized one (bf16-fallback seam).
+
+    Called INSIDE a jitted program: the jit arguments (and HBM residents)
+    stay int8, and XLA fuses each leaf's dequant into its consumers.
+    """
+    return jax.tree_util.tree_map(
+        lambda a: dequantize_leaf(a, dtype) if is_quantized(a) else a,
+        tree, is_leaf=is_quantized)
+
+
+def gather_rows(w, idx) -> Array:
+    """Row gather (embedding lookup) that understands :class:`QuantizedLeaf`:
+    the int8 rows are gathered first, so HBM traffic is 1 byte/element, and
+    the per-channel scale is applied to the gathered rows only."""
+    if is_quantized(w):
+        return w.q[idx].astype(jnp.float32) * w.scale
+    return jnp.asarray(w)[idx]
+
+
+def tree_param_bytes(tree) -> int:
+    """Resident bytes of a (possibly quantized) param tree — the number the
+    int8 policy exists to shrink; surfaced via ModelVersion.describe()."""
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        total += int(getattr(leaf, "size", 0)) * int(
+            jnp.dtype(getattr(leaf, "dtype", jnp.float32)).itemsize)
+    return total
+
+
+# ------------------------------------------------------------ dequant-free matmul
+_BLK_N = 128
+
+
+def _int8_matmul_kernel(x_ref, q_ref, s_ref, o_ref):
+    """One N-tile program: f32 accumulate x @ q with the per-channel scale
+    applied to the accumulator tile in registers — the dense weight tile
+    never exists outside VMEM/registers."""
+    acc = jnp.dot(x_ref[...].astype(jnp.float32),
+                  q_ref[...].astype(jnp.float32),
+                  preferred_element_type=jnp.float32)
+    o_ref[...] = acc * s_ref[...]
+
+
+def _pallas_int8_ok(x: Array, leaf: QuantizedLeaf, interpret: bool) -> bool:
+    from deeplearning4j_tpu.ops.pallas_kernels import use_pallas
+    if not (use_pallas() or interpret):
+        return False
+    k, n = leaf.q.shape[-2], leaf.q.shape[-1]
+    return (x.ndim == 2 and leaf.q.ndim == 2
+            and n % _BLK_N == 0 and k % 128 == 0)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _int8_matmul_pallas(x, q, scale, interpret=False):
+    m, k = x.shape
+    n = q.shape[-1]
+    return pl.pallas_call(
+        _int8_matmul_kernel,
+        grid=(n // _BLK_N,),
+        in_specs=[
+            pl.BlockSpec((m, k), lambda j: (0, 0)),
+            pl.BlockSpec((k, _BLK_N), lambda j: (0, j)),
+            pl.BlockSpec((1, _BLK_N), lambda j: (0, j)),
+        ],
+        out_specs=pl.BlockSpec((m, _BLK_N), lambda j: (0, j)),
+        out_shape=jax.ShapeDtypeStruct((m, n), jnp.float32),
+        interpret=interpret,
+    )(x, q, scale.reshape(1, n))
+
+
+def quantized_matmul(x: Array, w, *, compute_dtype=None,
+                     interpret: Optional[bool] = None) -> Array:
+    """``x @ w`` where ``w`` may be a :class:`QuantizedLeaf` or a dense
+    array — THE matmul seam for quantization-aware code paths (the decode
+    step). Dense weights take the plain matmul; quantized weights take the
+    Pallas dequant-free kernel when the hardware and tile alignment allow,
+    else the cast-fused XLA fallback. Output is f32 (callers cast into
+    their policy dtype, matching the ``preferred_element_type`` idiom)."""
+    if not is_quantized(w):
+        cd = compute_dtype or x.dtype
+        return jnp.matmul(x.astype(cd), jnp.asarray(w).astype(cd),
+                          preferred_element_type=jnp.float32)
+    from deeplearning4j_tpu.ops.pallas_kernels import _note_dispatch
+    if interpret is None:
+        import os
+        interpret = os.environ.get("DL4J_INT8_INTERPRET") == "1"
+    if _pallas_int8_ok(x, w, interpret):
+        _note_dispatch("int8_matmul", True)
+        return _int8_matmul_pallas(x, w.q, w.scale, interpret=interpret)
+    _note_dispatch("int8_matmul", False)
+    cd = compute_dtype or x.dtype
+    acc = jnp.matmul(x.astype(cd), w.q.astype(cd),
+                     preferred_element_type=jnp.float32)
+    return acc * w.scale

@@ -22,6 +22,11 @@
 #                                 # math, bf16 wire codec, transport parity,
 #                                 # 2-process TCP loss parity, loopback
 #                                 # broker reconnect, bench ps-axis contract
+#   ./runtests.sh decode [args]   # continuous-batching decode engine:
+#                                 # continuous-vs-static bitwise equality,
+#                                 # mid-decode admission/eviction, int8
+#                                 # drift bounds, compile-per-bucket, the
+#                                 # streaming churn regression, /v1/generate
 set -e
 cd "$(dirname "$0")"
 
@@ -70,6 +75,15 @@ if [ "${1-}" = "ps" ]; then
     tests/test_streaming_broker.py \
     tests/test_bench_contract.py::test_config_key_ps_axes \
     tests/test_bench_contract.py::test_grid_row_ps_async -q "$@"
+fi
+
+if [ "${1-}" = "decode" ]; then
+  shift
+  PALLAS_AXON_POOL_IPS= \
+  JAX_PLATFORMS=cpu \
+  XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+  exec python -m pytest tests/test_decode.py \
+    tests/test_bench_contract.py::test_config_key_serve_decode_axes -q "$@"
 fi
 
 if [ "${1-}" = "health" ]; then

@@ -64,9 +64,12 @@ run --model char_rnn --hidden 1024
 # param_bytes_per_device, dp_tp prices the Megatron column/row splits
 run --model fit_resnet50 --sharding zero3
 run --model transformer --sharding dp_tp
-# serving-engine headline row (ISSUE 9): micro-batched vs unbatched A/B at
-# the auto-calibrated saturation rate; the full record (p50/p99, occupancy,
-# recompiles == bucket count) also lands in scripts/serve_load.jsonl
+# serving-engine headline row (ISSUE 9 + 11): micro-batched vs unbatched
+# A/B at the auto-calibrated saturation rate, plus the decode section —
+# continuous vs static token streaming and int8 vs dense weights at one
+# offered sessions/sec (decode_speedup, decode_ttft_p99_improvement,
+# int8_prob_drift ride the row); full records (p50/p99, occupancy,
+# recompiles == bucket count) also land in scripts/serve_load.jsonl
 run --model serve
 # async-PS headline row (ISSUE 10): straggler A/B — one 4x-slow worker of 4,
 # async push/pull vs the sync-DP barrier at equal worker count, plus the
@@ -99,6 +102,10 @@ if [ "$MODE" = full ]; then
     run --model fit_resnet50 --sharding dp_tp
     run --model transformer --sharding dp
     run --model transformer --sharding zero3
+    # decode-axis captures: the int8-headlined and static-headlined serve
+    # configs (config-distinct from the continuous dense headline row)
+    run --model serve --serve-quant int8
+    run --model serve --serve-batching static
     # batch sweep for the flagship at the winning dtype
     run --model resnet50 --batch 64
     run --model resnet50 --batch 256

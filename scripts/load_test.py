@@ -22,6 +22,14 @@ Two modes:
           --shape 1,16 --sweep 50,100,200,400 --duration 2
       python scripts/load_test.py --port 8099 --model mlp \
           --shape 1,16 --closed --workers 16 --requests 200
+
+- **Decode A/B** (--decode): builds a char-RNN LSTM and runs the
+  token-streaming A/B — iteration-level continuous batching vs static
+  request-level batching at equal offered sessions/sec, plus int8 vs
+  dense decode. PASS requires >= 1.5x tokens/sec, TTFT p99 no worse,
+  and recompiles == bucket count in every phase.
+
+      python scripts/load_test.py --decode --slots 8 --sessions 64
 """
 import argparse
 import json
@@ -92,6 +100,33 @@ def _ab_mode(args) -> int:
     return 0 if ok else 1
 
 
+def _decode_mode(args) -> int:
+    from deeplearning4j_tpu.keras_server.loadgen import run_decode_ab
+    from deeplearning4j_tpu.models.char_rnn import char_rnn_lstm
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    net = MultiLayerNetwork(
+        char_rnn_lstm(args.vocab, hidden=args.hidden, layers=2)).init()
+    rec = run_decode_ab(net, model="load_test_char_rnn", slots=args.slots,
+                        n_sessions=args.sessions,
+                        max_new_tokens=args.max_new_tokens,
+                        record_path=args.record)
+    print(json.dumps(rec, indent=2))
+    drift = rec["int8_vs_dense"]
+    ok = (rec["tokens_per_sec_ratio"] >= 1.5
+          and rec["ttft_p99_ratio"] >= 1.0
+          and all(rec[ph]["recompiles"] == rec[ph]["bucket_count"]
+                  for ph in ("continuous", "static", "int8"))
+          and drift["mean_prob_drift"] <= 2e-2
+          and drift["top1_agreement"] >= 0.9)
+    print(f"# tokens_per_sec_ratio={rec['tokens_per_sec_ratio']}x "
+          f"ttft_p99_ratio={rec['ttft_p99_ratio']}x "
+          f"int8_drift={drift['mean_prob_drift']} "
+          f"int8_top1={drift['top1_agreement']} -> "
+          f"{'PASS' if ok else 'FAIL'}", file=sys.stderr)
+    return 0 if ok else 1
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(
         description=__doc__, formatter_class=argparse.RawDescriptionHelpFormatter)
@@ -124,12 +159,25 @@ def main() -> int:
     ap.add_argument("--n-in", type=int, default=16,
                     help="A/B model input width (also the request payload "
                          "size — serving is wire-cost sensitive)")
+    ap.add_argument("--decode", action="store_true",
+                    help="token-streaming decode A/B: continuous vs static "
+                         "batching, int8 vs dense")
+    ap.add_argument("--slots", type=int, default=8,
+                    help="decode slot capacity (both A/B phases)")
+    ap.add_argument("--sessions", type=int, default=256,
+                    help="decode A/B session count (longer run, less noise)")
+    ap.add_argument("--max-new-tokens", type=int, default=24,
+                    help="decode A/B per-session token budget ceiling")
+    ap.add_argument("--vocab", type=int, default=32,
+                    help="decode A/B char-RNN vocabulary size")
     ap.add_argument("--record", default=os.path.join(
         os.path.dirname(os.path.abspath(__file__)), "serve_load.jsonl"),
         help="JSONL record path (A/B mode); '' disables")
     args = ap.parse_args()
     if args.port is not None:
         return _target_mode(args)
+    if args.decode:
+        return _decode_mode(args)
     return _ab_mode(args)
 
 

@@ -389,6 +389,39 @@ def test_config_key_serve_axes():
     assert ts.endswith("Z") and ts > bench._SHARDING_AXIS_LANDED_TS
 
 
+def test_config_key_serve_decode_axes():
+    """The decode section's scheduling mode and weight quantization are
+    config-distinct serve axes: a static-batching or int8 capture must
+    never stand in for the continuous dense row (they measure different
+    engines), other models don't grow phantom axes, and the ts-gate
+    strips the axes on rows that predate the decode section — those rows
+    carry no decode numbers, so normalizing their axes to None (never
+    equal to a live request's resolved defaults) keeps an outage from
+    serving a decode-less row for a decode-bearing request."""
+    import bench
+
+    a = bench._config_key("--model serve")
+    b = bench._config_key("--model serve --serve-batching static")
+    c = bench._config_key("--model serve --serve-quant int8")
+    assert a != b and a["serve_batching"] == "continuous" \
+        and b["serve_batching"] == "static"
+    assert a != c and a["serve_quant"] == "none" \
+        and c["serve_quant"] == "int8"
+    # non-serve models don't grow phantom axes
+    r = bench._config_key("--model resnet50")
+    assert r["serve_batching"] is None and r["serve_quant"] is None
+    # rows logged before the decode section landed never match post-landing
+    # requests (axes None vs resolved defaults)
+    old = bench._config_key("--model serve", ts="2026-08-05T23:29:59Z")
+    new = bench._config_key("--model serve", ts="2026-08-05T23:30:01Z")
+    assert old["serve_batching"] is None and old["serve_quant"] is None
+    assert new["serve_batching"] == "continuous" \
+        and new["serve_quant"] == "none"
+    assert old != bench._config_key("--model serve")
+    ts = bench._SERVE_DECODE_AXIS_LANDED_TS
+    assert ts.endswith("Z") and ts > bench._PS_AXIS_LANDED_TS
+
+
 def test_grid_row_serve():
     """The serve scenario is wired through the whole bench surface: grid
     membership, the requests/sec unit (the one non-samples/sec headline),
