@@ -824,7 +824,8 @@ def bench_fit_lenet(batch: int, iters: int, ksteps: int,
 
 
 def bench_serve(batch, iters, ksteps, serve_qps=None, serve_latency_ms=None,
-                serve_batching=None, serve_quant=None):
+                serve_batching=None, serve_quant=None,
+                serve_replicas=None, serve_sharding=None):
     """Micro-batching A/B on the serving engine (ISSUE 9 headline).
 
     Unlike the fit benches this is fully CPU-measurable: the win is
@@ -845,6 +846,15 @@ def bench_serve(batch, iters, ksteps, serve_qps=None, serve_latency_ms=None,
     row's decode_tokens_per_sec / decode_ttft_p99_ms numbers
     (config-distinct: a static or int8 capture must never stand in for
     the continuous dense row), and the cross-phase ratios ride along.
+
+    Round 12 adds the REPLICA SCALING section: QPS-vs-replicas through the
+    least-queue-depth router (``run_replica_ab``) at equal offered load,
+    calibrated off the single-replica batched saturation point. The
+    ``serve_replicas``/``serve_sharding`` axes are config-distinct; with
+    ``serve_sharding="dp_tp"`` each replica pins its params sharded over
+    its own mesh slice (the parent driver forces an 8-device CPU host
+    platform for sharded rows, like ps_async). Per-replica steady-state
+    health is pinned by recompiles == bucket count PER replica.
     """
     import numpy as np
 
@@ -928,6 +938,63 @@ def bench_serve(batch, iters, ksteps, serve_qps=None, serve_latency_ms=None,
         "int8_top1_agreement": drec["int8_vs_dense"]["top1_agreement"],
         "int8_param_bytes_ratio": drec["int8_vs_dense"]["param_bytes_ratio"],
     }
+
+    # replica scaling section: N pinned programs behind the least-queue
+    # router. Wider than the dispatch-bound A/B model on purpose — replica
+    # scale-out multiplies DEVICE capacity, so the scaled resource must be
+    # device time; on the tiny MLP above both phases would sit on the same
+    # host-dispatch ceiling and the ratio would measure nothing
+    from deeplearning4j_tpu.keras_server.loadgen import run_replica_ab
+    n_rep = int(serve_replicas or 2)
+    shard = None if serve_sharding in (None, "none") else serve_sharding
+    rn_in, rhidden, rn_out = 64, 256, 8
+    rconf = (NeuralNetConfiguration.builder()
+             .seed(11).learning_rate(0.1).updater("adam")
+             .weight_init("xavier")
+             .list()
+             .layer(DenseLayer(n_in=rn_in, n_out=rhidden, activation="relu"))
+             .layer(DenseLayer(n_in=rhidden, n_out=rhidden,
+                               activation="relu"))
+             .layer(DenseLayer(n_in=rhidden, n_out=rhidden,
+                               activation="relu"))
+             .layer(OutputLayer(n_in=rhidden, n_out=rn_out, loss="mcxent",
+                                activation="softmax"))
+             .build())
+    rep_net = MultiLayerNetwork(rconf).init()
+    rep_example = np.random.default_rng(1).normal(
+        size=(1, rn_in)).astype(np.float32)
+    # calibrate the single-replica BATCHED saturation point, then offer 2x
+    # it to both phases: the baseline saturates, the scaled phase shows
+    # its real headroom at the same offered load
+    registry = ModelRegistry()
+    registry.register("serve_rep", rep_net, version="cal")
+    cal = InferenceServer(registry, max_batch=batch,
+                          max_latency_s=(serve_latency_ms or 4.0) / 1e3,
+                          max_queue=2048).start()
+    try:
+        run_closed_loop(cal.port, "serve_rep", rep_example, workers=2,
+                        requests_per_worker=8)
+        rpeak = run_closed_loop_proc(cal.port, "serve_rep",
+                                     rep_example.shape, workers=8,
+                                     requests_per_worker=100)
+    finally:
+        cal.stop()
+    rep_qps = max(50.0, round(2.0 * rpeak["achieved_qps"], 1))
+    rrec = run_replica_ab(
+        rep_net, model="serve_rep", replicas=n_rep, sharding=shard,
+        qps=rep_qps, duration_s=max(float(iters), 1.0), max_batch=batch,
+        max_latency_s=(serve_latency_ms or 4.0) / 1e3, max_queue=4096,
+        example=rep_example, record_path=record_path)
+    replica_sec = {
+        "serve_replicas": n_rep,
+        "serve_sharding": serve_sharding or "none",
+        "replica_offered_qps": rep_qps,
+        "replica_qps_1": rrec["replicas_1"]["achieved_qps"],
+        "replica_qps_n": rrec["replicas_n"]["achieved_qps"],
+        "replica_speedup": rrec["replica_speedup"],
+        "replica_recompiles_match_buckets":
+            rrec["recompiles_match_buckets"],
+    }
     return {
         "samples_per_sec": batched["achieved_qps"],  # headline: batched QPS
         "offered_qps": qps,
@@ -945,6 +1012,7 @@ def bench_serve(batch, iters, ksteps, serve_qps=None, serve_latency_ms=None,
         "max_batch": batch,
         "serve_record": record_path,
         **decode,
+        **replica_sec,
         "api": "keras_server.InferenceServer /v1/predict + /v1/generate",
     }
 
@@ -1210,6 +1278,10 @@ def _child_main(args) -> None:
             kwargs["serve_batching"] = args.serve_batching
         if args.serve_quant:
             kwargs["serve_quant"] = args.serve_quant
+        if args.serve_replicas:
+            kwargs["serve_replicas"] = args.serve_replicas
+        if args.serve_sharding:
+            kwargs["serve_sharding"] = args.serve_sharding
     if args.model == "ps_async":
         if args.ps_workers:
             kwargs["ps_workers"] = args.ps_workers
@@ -1357,6 +1429,22 @@ def main() -> None:
                     help="serve bench decode weight quantization for the "
                          "row's decode numbers (config-distinct); default "
                          "none (policy-dtype dense weights)")
+    ap.add_argument("--serve-replicas", type=int, default=None,
+                    help="serve bench replica count for the QPS-vs-replicas "
+                         "scaling section (config-distinct); default 2 — N "
+                         "independent pinned programs behind the least-"
+                         "queue-depth router vs a single replica at equal "
+                         "offered load")
+    ap.add_argument("--serve-sharding", default=None,
+                    choices=("dp_tp", "none"),
+                    help="serve bench replica pin placement "
+                         "(config-distinct); default none (one device per "
+                         "replica). dp_tp shards each replica's pinned "
+                         "params over its own mesh slice via the partition-"
+                         "rule engine — bitwise-equal gather-at-use "
+                         "serving, forced onto an 8-device CPU host "
+                         "platform (NOT the fit path's --sharding axis: "
+                         "serve rows never take --sharding)")
     ap.add_argument("--ps-workers", type=int, default=None,
                     help="ps_async bench worker count for the straggler A/B "
                          "(config-distinct); default 4")
@@ -1404,9 +1492,13 @@ def main() -> None:
 
     # ps_async measures host-side orchestration and is CPU-measured by
     # design (the straggler A/B needs a data mesh at worker count on any
-    # box, TPU relay or not); every other model inherits the env untouched
+    # box, TPU relay or not); a sharded-replica serve row likewise needs
+    # an 8-device host platform so each replica gets a real mesh slice;
+    # every other model inherits the env untouched
     child_env = None
-    if args.model == "ps_async":
+    if args.model == "ps_async" or (
+            args.model == "serve"
+            and getattr(args, "serve_sharding", None) == "dp_tp"):
         child_env = os.environ.copy()
         child_env["JAX_PLATFORMS"] = "cpu"
         child_env["PALLAS_AXON_POOL_IPS"] = ""
@@ -1567,6 +1659,15 @@ _PS_AXIS_LANDED_TS = "2026-08-05T22:00:30Z"
 #: can never stand in for the continuous dense row
 _SERVE_DECODE_AXIS_LANDED_TS = "2026-08-05T23:30:00Z"
 
+#: when the sharded multi-replica serving section landed (round 12) —
+#: serve rows logged before this instant predate the ReplicaSet and carry
+#: no replica-scaling numbers (their axes normalize to None), so an outage
+#: can never serve a replica-less row for a request whose headline now
+#: includes replica_speedup; rows since carry the replica-count / pin-
+#: placement knobs as config axes so a 4-replica or dp_tp-sharded capture
+#: can never stand in for the standard 2-replica single-device row
+_SERVE_REPLICA_AXIS_LANDED_TS = "2026-08-06T00:00:00Z"
+
 
 def _config_key(args_str: str, ts: str = None) -> dict:
     """The fields that make two bench invocations the SAME config: model,
@@ -1628,6 +1729,13 @@ def _config_key(args_str: str, ts: str = None) -> dict:
         # must never stand in for the continuous dense decode row
         serve_batching = val("--serve-batching") or "continuous"
         serve_quant = val("--serve-quant") or "none"
+    serve_replicas = serve_sharding = None
+    if model == "serve" and not (ts is not None
+                                 and ts < _SERVE_REPLICA_AXIS_LANDED_TS):
+        # defaults are their own config: a 4-replica or dp_tp-sharded
+        # capture must never stand in for the 2-replica single-device row
+        serve_replicas = val("--serve-replicas") or "2"
+        serve_sharding = val("--serve-sharding") or "none"
     ps_workers = ps_straggler = None
     if model == "ps_async" and not (ts is not None
                                     and ts < _PS_AXIS_LANDED_TS):
@@ -1642,6 +1750,8 @@ def _config_key(args_str: str, ts: str = None) -> dict:
             "sharding": sharding, "serve_qps": serve_qps,
             "serve_latency_ms": serve_latency_ms,
             "serve_batching": serve_batching, "serve_quant": serve_quant,
+            "serve_replicas": serve_replicas,
+            "serve_sharding": serve_sharding,
             "ps_workers": ps_workers, "ps_straggler": ps_straggler}
 
 

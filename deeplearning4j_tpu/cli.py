@@ -9,6 +9,8 @@ ParallelWrapper training of a serialized model) and PlayUIServer's main
     python -m deeplearning4j_tpu.cli parallel-train --model m.zip \
         --workers 4 --averaging-frequency 1 --epochs 1 [--dataset mnist]
     python -m deeplearning4j_tpu.cli keras-server --port 25333
+    python -m deeplearning4j_tpu.cli serve --model m.zip \
+        --replicas 4 --sharding dp_tp --port 8080
 """
 from __future__ import annotations
 
@@ -120,6 +122,30 @@ def _cmd_keras_server(args) -> int:
         return 0
 
 
+def _cmd_serve(args) -> int:
+    from deeplearning4j_tpu.keras_server import InferenceServer
+
+    srv = InferenceServer(
+        host=args.host, port=args.port, replicas=args.replicas,
+        sharding=args.sharding, max_batch=args.max_batch,
+        max_latency_s=args.max_latency_ms / 1e3, max_queue=args.max_queue)
+    if srv.replica_set is not None:
+        srv.replica_set.load(args.name, args.model, quant=args.quant)
+    else:
+        srv.registry.load(args.name, args.model, quant=args.quant)
+    srv.start()
+    mode = (f"{args.replicas} replica(s)"
+            + (f", {args.sharding}-sharded" if args.sharding else ""))
+    print(f"inference server listening on http://{args.host}:{srv.port} "
+          f"({mode}; POST /v1/predict, GET /serve/status)")
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        srv.stop()
+        return 0
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="deeplearning4j_tpu")
     sub = p.add_subparsers(dest="cmd", required=True)
@@ -168,6 +194,32 @@ def build_parser() -> argparse.ArgumentParser:
     ks = sub.add_parser("keras-server", help="start the Keras gateway")
     ks.add_argument("--port", type=int, default=25333)
     ks.set_defaults(fn=_cmd_keras_server)
+
+    sv = sub.add_parser(
+        "serve", help="serve a model over HTTP (micro-batched /v1/predict; "
+                      "optionally N replicas and/or sharded pins)")
+    sv.add_argument("--model", required=True,
+                    help="model file: model_serializer zip or Keras HDF5")
+    sv.add_argument("--name", default="default",
+                    help="model name requests address (default: 'default')")
+    sv.add_argument("--host", default="127.0.0.1")
+    sv.add_argument("--port", type=int, default=8080)
+    sv.add_argument("--replicas", type=int, default=1,
+                    help="independent pinned programs behind the least-"
+                         "queue-depth router (one device each)")
+    sv.add_argument("--sharding", default=None,
+                    choices=("dp", "dp_tp", "zero3"),
+                    help="partition-rule set for each replica's pinned "
+                         "params (its own mesh slice; gather-at-use, "
+                         "bitwise-equal to single-device)")
+    sv.add_argument("--quant", default=None, choices=("int8",),
+                    help="int8 serving DtypePolicy for the pinned weights")
+    sv.add_argument("--max-batch", type=int, default=32)
+    sv.add_argument("--max-latency-ms", type=float, default=2.0,
+                    help="micro-batcher fill-or-deadline coalescing wait")
+    sv.add_argument("--max-queue", type=int, default=256,
+                    help="admission limit per replica (429 past it)")
+    sv.set_defaults(fn=_cmd_serve)
     return p
 
 

@@ -65,13 +65,13 @@ def batch_bucket(n: int, max_batch: int) -> int:
 
 
 class _Request:
-    __slots__ = ("model", "x", "n", "key", "future", "t_enqueue")
+    __slots__ = ("model", "xs", "n", "key", "future", "t_enqueue")
 
-    def __init__(self, model: str, x: np.ndarray, key: Tuple,
+    def __init__(self, model: str, xs: Tuple[np.ndarray, ...], key: Tuple,
                  t_enqueue: float):
         self.model = model
-        self.x = x
-        self.n = int(x.shape[0])
+        self.xs = xs
+        self.n = int(xs[0].shape[0])
         self.key = key
         self.future: Future = Future()
         self.t_enqueue = t_enqueue
@@ -88,15 +88,26 @@ class MicroBatcher:
     def __init__(self, registry: ModelRegistry, *, max_batch: int = 32,
                  max_latency_s: float = 0.002, max_queue: int = 256,
                  admission: Optional[AdmissionController] = None,
-                 metrics=None):
+                 metrics=None, replica: Optional[int] = None):
         if max_batch < 1:
             raise ValueError("max_batch must be >= 1")
         self.registry = registry
         self.max_batch = int(max_batch)
         self.max_latency_s = float(max_latency_s)
+        #: ReplicaSet member index, or None for a standalone batcher — only
+        #: adds the per-replica gauge labels and the result-dict field
+        self.replica = replica
         self.admission = admission or AdmissionController(
             max_pending=max_queue, expected_latency_s=max_latency_s)
         m = metrics or global_registry()
+        self._g_replica_queue = self._g_replica_occ = None
+        if replica is not None:
+            self._g_replica_queue = m.gauge(
+                _n.SERVE_REPLICA_QUEUE_DEPTH,
+                "admitted-but-unanswered requests per replica")
+            self._g_replica_occ = m.gauge(
+                _n.SERVE_REPLICA_OCCUPANCY,
+                "rows/bucket of the replica's last dispatch")
         self._c_requests = m.counter(
             _n.SERVE_REQUESTS_TOTAL, "predict requests admitted")
         self._c_errors = m.counter(
@@ -121,24 +132,36 @@ class MicroBatcher:
 
     # ------------------------------------------------------------- producer
     @staticmethod
-    def _group_key(model: str, x: np.ndarray) -> Tuple:
-        return (model, x.shape[1:], str(x.dtype))
+    def _group_key(model: str, xs: Tuple[np.ndarray, ...]) -> Tuple:
+        return (model,) + tuple((x.shape[1:], str(x.dtype)) for x in xs)
 
     def submit(self, model: str, x) -> Future:
         """Queue one request (``x`` carries a leading batch axis; a single
-        example must arrive as shape ``[1, ...]``). Raises
+        example must arrive as shape ``[1, ...]``; a multi-input graph
+        takes a list/tuple of arrays sharing the leading axis). Raises
         :class:`RejectedError` when admission refuses (HTTP 429)."""
-        x = np.asarray(x)
-        if x.ndim < 2:
+        if isinstance(x, (list, tuple)):
+            xs = tuple(np.asarray(a) for a in x)
+            if not xs:
+                raise ValueError("empty input list")
+        else:
+            xs = (np.asarray(x),)
+        for a in xs:
+            if a.ndim < 2:
+                raise ValueError(
+                    f"request needs a leading batch axis, got shape "
+                    f"{a.shape}")
+        if len({a.shape[0] for a in xs}) != 1:
             raise ValueError(
-                f"request needs a leading batch axis, got shape {x.shape}")
-        if x.shape[0] > self.max_batch:
+                "multi-input request arrays must share the leading batch "
+                f"axis, got {[a.shape[0] for a in xs]}")
+        if xs[0].shape[0] > self.max_batch:
             raise ValueError(
-                f"request batch {x.shape[0]} exceeds max_batch "
+                f"request batch {xs[0].shape[0]} exceeds max_batch "
                 f"{self.max_batch}; split it client-side")
         self.admission.admit()
         self._c_requests.labels(model=model).inc()
-        req = _Request(model, x, self._group_key(model, x),
+        req = _Request(model, xs, self._group_key(model, xs),
                        time.perf_counter())
         with self._cond:
             if self._closed:
@@ -146,6 +169,9 @@ class MicroBatcher:
                 raise RuntimeError("MicroBatcher is closed")
             self._queue.append(req)
             self._cond.notify()
+        if self._g_replica_queue is not None:
+            self._g_replica_queue.labels(
+                replica=str(self.replica)).set(self.admission.pending)
         return req.future
 
     # ------------------------------------------------------------ dispatcher
@@ -184,13 +210,25 @@ class MicroBatcher:
         rows = sum(r.n for r in group)
         bucket = batch_bucket(rows, self.max_batch)
         try:
+            # (replica, version) resolve HERE, at dispatch time: the atomic
+            # active pointer means a group enqueued against version N can
+            # legally dispatch against N+1 — each is internally consistent
             mv = self.registry.active(group[0].model)
-            x = np.concatenate([r.x for r in group], axis=0)
-            if bucket > rows:
-                pad = np.zeros((bucket - rows,) + x.shape[1:], x.dtype)
-                x = np.concatenate([x, pad], axis=0)
+            n_inputs = len(group[0].xs)
+            xs = []
+            for j in range(n_inputs):
+                x = np.concatenate([r.xs[j] for r in group], axis=0)
+                if bucket > rows:
+                    pad = np.zeros((bucket - rows,) + x.shape[1:], x.dtype)
+                    x = np.concatenate([x, pad], axis=0)
+                xs.append(x)
             t0 = time.perf_counter()
-            out = np.asarray(mv.predict_fn(x))  # lint: host-sync-in-hot-loop-ok (serving must materialize the response; the sync IS the dispatch being timed)
+            raw = mv.predict_fn(*xs)
+            multi_out = isinstance(raw, (list, tuple))
+            if not multi_out:
+                raw = [raw]
+            # lint: host-sync-in-hot-loop-ok (serving must materialize the response; the sync IS the dispatch being timed)
+            outs = [np.asarray(o) for o in raw]
             dt = time.perf_counter() - t0
         except Exception as e:
             self._c_errors.inc(len(group))
@@ -203,6 +241,9 @@ class MicroBatcher:
             return
         finally:
             self.admission.release(len(group))
+            if self._g_replica_queue is not None:
+                self._g_replica_queue.labels(
+                    replica=str(self.replica)).set(self.admission.pending)
         occupancy = rows / bucket
         # a serve dispatch advances the step clock like a fit dispatch, so
         # the recompile-storm window is measured in dispatches (bucket
@@ -211,6 +252,9 @@ class MicroBatcher:
         self._c_batches.labels(model=mv.name).inc()
         self._h_dispatch.observe(dt)
         self._g_occupancy.set(occupancy)
+        if self._g_replica_occ is not None:
+            self._g_replica_occ.labels(
+                replica=str(self.replica)).set(occupancy)
         _profile_note_dispatch(dt)
         with self._lock:
             self._dispatches += 1
@@ -219,14 +263,17 @@ class MicroBatcher:
             n_dispatch = self._dispatches
         _flight_recorder().record(
             "serve_batch", model=mv.name, version=mv.version, rows=rows,
-            bucket=bucket, requests=len(group), dispatch_s=dt)
+            bucket=bucket, requests=len(group), dispatch_s=dt,
+            **({"replica": self.replica} if self.replica is not None else {}))
         _wd_beat(n_dispatch)
         off = 0
         for r in group:
+            pred = [o[off:off + r.n] for o in outs]
             r.future.set_result(
-                {"predictions": out[off:off + r.n], "model": mv.name,
-                 "version": mv.version, "batch_rows": rows,
-                 "bucket": bucket})
+                {"predictions": pred if multi_out else pred[0],
+                 "model": mv.name, "version": mv.version,
+                 "batch_rows": rows, "bucket": bucket,
+                 "replica": self.replica})
             off += r.n
 
     def _loop(self) -> None:
@@ -254,6 +301,7 @@ class MicroBatcher:
                 "bucket_count": len(self._buckets_seen),
                 "max_batch": self.max_batch,
                 "max_latency_s": self.max_latency_s,
+                "replica": self.replica,
             }
 
     def close(self, timeout_s: float = 5.0) -> None:

@@ -566,6 +566,12 @@ class DecodeEngine:
         with self._lock:
             return tree_param_bytes(self._blocks)
 
+    def idle(self) -> bool:
+        """No queued or active sessions — a hot-swapped-away version's
+        engine is safe to retire exactly when this is True."""
+        with self._lock:
+            return not self._queue and not self._active_count()
+
     def drain(self, timeout_s: float = 60.0) -> None:
         """Block until every queued/active session has finished."""
         deadline = time.monotonic() + timeout_s

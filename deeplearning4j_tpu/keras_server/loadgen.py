@@ -324,6 +324,122 @@ def run_ab(net, *, model: str = "model", qps: float = 200.0,
     return rec
 
 
+def _replica_compile_counts(n_replicas: int) -> List[int]:
+    """Per-replica serve-predict compile counts: every ReplicaSet member's
+    program name ends in ``~r<i>`` (nn/inference.make_predict_fn), which is
+    what makes `recompiles == buckets` checkable PER replica."""
+    from deeplearning4j_tpu.nn.inference import PREDICT_PROGRAM_NAME
+    from deeplearning4j_tpu.observability.compile_tracker import \
+        global_tracker
+    counts = [0] * n_replicas
+    for e in global_tracker().snapshot_events():
+        fn = e.get("fn", "")
+        if PREDICT_PROGRAM_NAME not in fn:
+            continue
+        for i in range(n_replicas):
+            if fn.endswith(f"~r{i}"):
+                counts[i] += 1
+                break
+    return counts
+
+
+def run_replica_ab(net, *, model: str = "model", replicas: int = 2,
+                   sharding: Optional[str] = None, qps: float = 200.0,
+                   duration_s: float = 3.0, max_batch: int = 32,
+                   max_latency_s: float = 0.004, max_queue: int = 512,
+                   example=None, workers: int = 32,
+                   warmup_requests: int = 8, isolate_client: bool = True,
+                   record_path: Optional[str] = None) -> dict:
+    """QPS-vs-replicas scaling A/B: 1 replica vs ``replicas`` behind the
+    least-queue router, at the SAME offered QPS (pick one that saturates
+    the single replica, so the scaled phase shows real headroom).
+    ``sharding`` routes every replica's pin through the partition-rule
+    engine on its own mesh slice. The scaled phase reports per-replica
+    recompiles vs bucket counts (the compile-cache contract holds per
+    replica because each pin is its own ``~r<i>`` program)."""
+    from .registry import ModelRegistry
+    from .serving import InferenceServer
+    if example is None:
+        raise ValueError("pass example= (one input row, shape [1, ...])")
+    example = np.asarray(example)
+    phases = {}
+    for phase, n in (("baseline", 1), ("scaled", max(replicas, 1))):
+        compiles_before = _serve_compile_count()
+        counts_before = _replica_compile_counts(max(replicas, 1))
+        # a fresh clone per phase = a fresh compile cache per phase (same
+        # contract as run_ab); the baseline stays on the classic single-
+        # batcher path unless sharding forces replica mode
+        if n > 1 or sharding is not None:
+            server = InferenceServer(
+                replicas=n, sharding=sharding, max_batch=max_batch,
+                max_latency_s=max_latency_s, max_queue=max_queue)
+            server.register(model, net.clone(), version="v1")
+        else:
+            registry = ModelRegistry()
+            registry.register(model, net.clone(), version="v1")
+            server = InferenceServer(
+                registry, max_batch=max_batch, max_latency_s=max_latency_s,
+                max_queue=max_queue)
+        server.start()
+        try:
+            # warm every replica's compile cache off the clock: concurrent
+            # closed-loop workers spread over the router
+            run_closed_loop(server.port, model, example,
+                            workers=max(2, 2 * n),
+                            requests_per_worker=warmup_requests)
+            if isolate_client:
+                res = run_open_loop_proc(
+                    server.port, model, example.shape, qps=qps,
+                    duration_s=duration_s, workers=workers)
+            else:
+                res = run_open_loop(server.port, model, example, qps=qps,
+                                    duration_s=duration_s, workers=workers)
+            if server.replica_set is not None:
+                qstats = server.replica_set.queue_stats()
+                rstats = server.replica_set.stats()["replicas"]
+            else:
+                qstats = server.batcher.stats()
+                rstats = None
+        finally:
+            server.stop()
+        res["batch_occupancy"] = round(qstats["mean_occupancy"], 4)
+        res["bucket_count"] = qstats["bucket_count"]
+        res["dispatches"] = qstats["dispatches"]
+        res["recompiles"] = _serve_compile_count() - compiles_before
+        res["replicas"] = n
+        if rstats is not None:
+            counts_after = _replica_compile_counts(n)
+            res["per_replica"] = [
+                {"replica": r["replica"], "routed": r["routed"],
+                 "dispatches": r["dispatches"],
+                 "bucket_count": r["bucket_count"],
+                 "recompiles": counts_after[i] - counts_before[i],
+                 "recompiles_match_buckets":
+                     counts_after[i] - counts_before[i]
+                     == r["bucket_count"]}
+                for i, r in enumerate(rstats)]
+        phases[phase] = res
+    rec = {
+        "harness": "keras_server.loadgen.run_replica_ab",
+        "model": model, "offered_qps": qps, "duration_s": duration_s,
+        "max_batch": max_batch, "replicas": replicas,
+        "sharding": sharding or "none",
+        "replicas_1": phases["baseline"], "replicas_n": phases["scaled"],
+        "replica_speedup": round(
+            phases["scaled"]["achieved_qps"]
+            / max(phases["baseline"]["achieved_qps"], 1e-9), 3),
+        "recompiles_match_buckets": all(
+            p["recompiles_match_buckets"]
+            for p in phases["scaled"].get("per_replica", [])),
+    }
+    if record_path:
+        os.makedirs(os.path.dirname(os.path.abspath(record_path)),
+                    exist_ok=True)
+        with open(record_path, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+    return rec
+
+
 # ----------------------------------------------------- token-streaming load
 def _decode_compile_count() -> int:
     from .decode import DECODE_PROGRAM_NAME

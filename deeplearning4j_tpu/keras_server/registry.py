@@ -29,6 +29,20 @@ from deeplearning4j_tpu.observability import names as _n
 from deeplearning4j_tpu.observability.metrics import global_registry
 
 
+def load_model_file(path: str):
+    """Parse a serving model file into a network: a ``model_serializer``
+    zip (either network type) or a Keras HDF5 export. Shared by
+    :meth:`ModelRegistry.load` and ``ReplicaSet.load``."""
+    if zipfile.is_zipfile(path):
+        from deeplearning4j_tpu.utils.model_serializer import guess_model
+        return guess_model(path)
+    from deeplearning4j_tpu.modelimport.keras_import import KerasModelImport
+    try:
+        return KerasModelImport.import_keras_sequential_model_and_weights(path)
+    except ValueError:
+        return KerasModelImport.import_keras_model_and_weights(path)
+
+
 class ModelVersion:
     """One immutable (name, version) serving unit."""
 
@@ -48,6 +62,7 @@ class ModelVersion:
     def describe(self) -> dict:
         return {"name": self.name, "version": self.version,
                 "source": self.source, "quant": self.quant,
+                "sharding": self.predict_fn.sharding,
                 "param_bytes": self.predict_fn.param_bytes,
                 "streaming_capable": self.streaming_capable,
                 "predict_calls": self.predict_fn.calls}
@@ -69,7 +84,9 @@ class ModelRegistry:
     # ------------------------------------------------------------- loading
     def register(self, name: str, net, version: Optional[str] = None,
                  source: str = "memory",
-                 quant: Optional[str] = None) -> ModelVersion:
+                 quant: Optional[str] = None,
+                 sharding: Optional[str] = None, mesh=None, device=None,
+                 replica: Optional[int] = None) -> ModelVersion:
         """Pin ``net`` for serving and make it the active version.
 
         The predict program is built (and its parameter snapshot copied)
@@ -78,6 +95,9 @@ class ModelRegistry:
         ``quant="int8"`` opts the version into the int8 serving DtypePolicy:
         per-channel scales calibrated at pin time, int8 weights at rest for
         both the predict program and this version's decode engines.
+        ``sharding``/``mesh``/``device``/``replica`` choose the pin
+        placement (see :class:`nn.inference.PredictFn`) — the ReplicaSet
+        passes its per-replica mesh or device through here.
         """
         with self._lock:
             version = version or f"v{len(self._versions.get(name, {})) + 1}"
@@ -85,7 +105,9 @@ class ModelRegistry:
                 raise ValueError(
                     f"model {name!r} already has version {version!r}; "
                     "versions are immutable — register a new one")
-        pf = make_predict_fn(net, version=version, quant=quant)
+        pf = make_predict_fn(net, version=version, quant=quant,
+                             sharding=sharding, mesh=mesh, device=device,
+                             replica=replica)
         with self._lock:
             swapping = name in self._active
             mv = ModelVersion(name, version, net, pf, source=source,
@@ -102,19 +124,8 @@ class ModelRegistry:
              quant: Optional[str] = None) -> ModelVersion:
         """Load a model file and register it: a ``model_serializer`` zip
         (either network type) or a Keras HDF5 export."""
-        if zipfile.is_zipfile(path):
-            from deeplearning4j_tpu.utils.model_serializer import guess_model
-            net = guess_model(path)
-        else:
-            from deeplearning4j_tpu.modelimport.keras_import import (
-                KerasModelImport)
-            try:
-                net = KerasModelImport \
-                    .import_keras_sequential_model_and_weights(path)
-            except ValueError:
-                net = KerasModelImport.import_keras_model_and_weights(path)
-        return self.register(name, net, version=version, source=path,
-                             quant=quant)
+        return self.register(name, load_model_file(path), version=version,
+                             source=path, quant=quant)
 
     # ------------------------------------------------------------- lookup
     def active(self, name: str) -> ModelVersion:

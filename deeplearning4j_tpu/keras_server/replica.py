@@ -1,0 +1,283 @@
+"""Multi-replica serving: N pinned programs behind a least-queue router.
+
+One ``PredictFn`` is one compiled program on one placement — a single
+device, or a sharded mesh slice (``nn/inference.py``). A :class:`ReplicaSet`
+runs N of them **independently**: each replica owns its own
+``ModelRegistry`` (its own pinned snapshots), its own
+``AdmissionController`` and its own ``MicroBatcher`` dispatcher thread, so
+replicas share no lock on the hot path and a wedged replica cannot stall
+its siblings. The front router picks the replica with the fewest
+admitted-but-unanswered requests (least-queue-depth, ties to the lowest
+index) and falls through to the next on admission rejection — backpressure
+(HTTP 429) happens only when EVERY replica is full.
+
+Placement (over ``jax.devices()`` or an explicit device list):
+
+- unsharded: replica i pins on ``devices[i % len(devices)]`` — N chips,
+  N independent programs, horizontal QPS scale;
+- ``sharding="dp_tp"`` (or any rule set): the device list is cut into N
+  contiguous slices and each replica gets its own mesh over its slice
+  (``parallel.mesh.build_mesh``), so tensor-parallel serving and replica
+  scale-out compose — 8 chips = 4 replicas x 2-way-sharded params.
+
+**Rolling hot swap.** ``register()`` upgrades one replica at a time: mark
+it draining (the router stops routing to it while siblings can serve),
+wait for its queue to empty, then let its registry do the PR 9
+atomic-pointer swap, then undrain and move to the next replica. In-flight
+requests always complete against the version they resolved at dispatch —
+zero request loss across a full fleet upgrade (pinned by
+tests/test_serving_replica.py) — and the fleet serves at N-1 capacity
+during the roll instead of pausing.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import Future
+from typing import Dict, List, Optional
+
+from deeplearning4j_tpu.observability import names as _n
+from deeplearning4j_tpu.observability.metrics import global_registry
+
+from .admission import RejectedError
+from .batcher import MicroBatcher
+from .registry import ModelRegistry, ModelVersion, load_model_file
+
+
+class Replica:
+    """One serving lane: private registry + admission + dispatcher."""
+
+    def __init__(self, index: int, *, device=None, mesh=None,
+                 sharding: Optional[str] = None, max_batch: int = 32,
+                 max_latency_s: float = 0.002, max_queue: int = 256,
+                 metrics=None):
+        self.index = index
+        self.device = device
+        self.mesh = mesh
+        self.sharding = sharding
+        #: router-visible: a draining replica takes no NEW requests while
+        #: its registry swaps versions (its queued work still completes)
+        self.draining = False
+        self.registry = ModelRegistry(metrics=metrics)
+        self.batcher = MicroBatcher(
+            self.registry, max_batch=max_batch, max_latency_s=max_latency_s,
+            max_queue=max_queue, metrics=metrics, replica=index)
+
+    def queue_depth(self) -> int:
+        """Admitted-but-unanswered requests (the router's load signal)."""
+        return self.batcher.admission.pending
+
+    def devices(self) -> list:
+        if self.mesh is not None:
+            return [str(d) for d in self.mesh.devices.flatten()]
+        if self.device is not None:
+            return [str(self.device)]
+        return []
+
+
+class ReplicaSet:
+    """N independent replicas behind a least-queue-depth router."""
+
+    def __init__(self, n_replicas: int, *, sharding: Optional[str] = None,
+                 mesh_axes: Optional[Dict[str, int]] = None,
+                 devices=None, max_batch: int = 32,
+                 max_latency_s: float = 0.002, max_queue: int = 256,
+                 metrics=None, drain_timeout_s: float = 30.0):
+        if n_replicas < 1:
+            raise ValueError("n_replicas must be >= 1")
+        self.sharding = sharding
+        self.drain_timeout_s = float(drain_timeout_s)
+        m = metrics or global_registry()
+        self._c_routed = m.counter(
+            _n.SERVE_REPLICA_ROUTED_TOTAL,
+            "requests routed per replica (least-queue-depth dispatch)")
+        self._g_active_version = m.gauge(
+            _n.SERVE_REPLICA_ACTIVE_VERSION,
+            "1 on the (replica, model, version) series currently active")
+        self._lock = threading.Lock()
+        self._versions: Dict[str, List[str]] = {}
+        self._routed: Dict[int, int] = {i: 0 for i in range(n_replicas)}
+        self._gauge_active: Dict[tuple, str] = {}
+        self._replicas = [
+            Replica(i, max_batch=max_batch, max_latency_s=max_latency_s,
+                    max_queue=max_queue, metrics=m, **placement)
+            for i, placement in enumerate(
+                self._placements(n_replicas, sharding, mesh_axes, devices))]
+
+    @staticmethod
+    def _placements(n: int, sharding: Optional[str],
+                    mesh_axes: Optional[Dict[str, int]],
+                    devices) -> List[dict]:
+        import jax
+        devs = list(devices) if devices is not None else list(jax.devices())
+        if sharding is None:
+            # round-robin: more replicas than devices is legal (CPU scale
+            # tests; oversubscribed chips are the operator's call)
+            return [{"device": devs[i % len(devs)]} for i in range(n)]
+        from deeplearning4j_tpu.parallel.mesh import build_mesh
+        per = len(devs) // n
+        if per < 1:
+            raise ValueError(
+                f"{n} sharded replicas need >= {n} devices, "
+                f"have {len(devs)}")
+        if mesh_axes is None:
+            # default slice shape: give the model axis the factor of two
+            # when available — dp_tp with model=1 would be sharding theater
+            model = 2 if per % 2 == 0 else 1
+            mesh_axes = {"data": per // model, "model": model}
+        need = 1
+        for v in mesh_axes.values():
+            need *= v
+        if need > per:
+            raise ValueError(
+                f"mesh_axes {mesh_axes} needs {need} devices per replica "
+                f"but only {per} are available for each of {n} replicas")
+        return [{"mesh": build_mesh(mesh_axes, devices=devs[i * per:
+                                                           i * per + need]),
+                 "sharding": sharding} for i in range(n)]
+
+    # ------------------------------------------------------------ registry
+    @property
+    def n_replicas(self) -> int:
+        return len(self._replicas)
+
+    @property
+    def replicas(self) -> List[Replica]:
+        return list(self._replicas)
+
+    @property
+    def primary_registry(self) -> ModelRegistry:
+        """Replica 0's registry — the front door's model lookup (404s,
+        streaming, decode) reads this; all replicas hold the same
+        (name, version) catalog after every ``register()``."""
+        return self._replicas[0].registry
+
+    def _wait_drained(self, replica: Replica) -> bool:
+        deadline = time.monotonic() + self.drain_timeout_s
+        while time.monotonic() < deadline:
+            if replica.queue_depth() == 0:
+                return True
+            time.sleep(0.002)
+        return False
+
+    def register(self, name: str, net, version: Optional[str] = None,
+                 source: str = "memory",
+                 quant: Optional[str] = None) -> ModelVersion:
+        """Rolling registration: pin ``net`` on every replica, one at a
+        time, draining each before its atomic pointer swap.
+
+        The version is allocated once at ReplicaSet level so all replicas
+        agree on the catalog. During the roll, siblings keep serving the
+        old version — a fleet-wide upgrade never drops below N-1 live
+        replicas and loses zero in-flight requests.
+        """
+        with self._lock:
+            versions = self._versions.setdefault(name, [])
+            version = version or f"v{len(versions) + 1}"
+            if version in versions:
+                raise ValueError(
+                    f"model {name!r} already has version {version!r}; "
+                    "versions are immutable — register a new one")
+            versions.append(version)
+        first: Optional[ModelVersion] = None
+        for r in self._replicas:
+            # drain only when a sibling can absorb the traffic — a lone
+            # replica swaps atomically under load instead of pausing
+            drain = any(not o.draining for o in self._replicas if o is not r)
+            r.draining = drain
+            try:
+                if drain:
+                    self._wait_drained(r)
+                mv = r.registry.register(
+                    name, net, version=version, source=source, quant=quant,
+                    sharding=r.sharding, mesh=r.mesh, device=r.device,
+                    replica=r.index)
+            finally:
+                r.draining = False
+            prev = self._gauge_active.get((r.index, name))
+            if prev is not None:
+                self._g_active_version.labels(
+                    replica=str(r.index), model=name, version=prev).set(0)
+            self._g_active_version.labels(
+                replica=str(r.index), model=name, version=version).set(1)
+            self._gauge_active[(r.index, name)] = version
+            if first is None:
+                first = mv
+        return first
+
+    def load(self, name: str, path: str, version: Optional[str] = None,
+             quant: Optional[str] = None) -> ModelVersion:
+        """Load a model file once and roll it onto every replica."""
+        return self.register(name, load_model_file(path), version=version,
+                             source=path, quant=quant)
+
+    # -------------------------------------------------------------- router
+    def submit(self, model: str, x) -> Future:
+        """Route one request to the least-loaded non-draining replica,
+        falling through to the next on admission rejection; raises the
+        last :class:`RejectedError` only when every replica refused."""
+        candidates = [r for r in self._replicas if not r.draining] \
+            or list(self._replicas)
+        last: Optional[RejectedError] = None
+        for r in sorted(candidates, key=lambda r: (r.queue_depth(),
+                                                   r.index)):
+            try:
+                fut = r.batcher.submit(model, x)
+            except RejectedError as e:
+                last = e
+                continue
+            self._c_routed.labels(replica=str(r.index)).inc()
+            with self._lock:
+                self._routed[r.index] += 1
+            return fut
+        assert last is not None
+        raise last
+
+    # ------------------------------------------------------------- control
+    def queue_stats(self) -> dict:
+        """Aggregate stats in the single-batcher shape (the /serve/status
+        "queue" block keeps its schema in replica mode)."""
+        per = [r.batcher.stats() for r in self._replicas]
+        dispatches = sum(s["dispatches"] for s in per)
+        return {
+            "queue_depth": sum(s["queue_depth"] for s in per),
+            "pending": sum(s["pending"] for s in per),
+            "max_queue": sum(s["max_queue"] for s in per),
+            "rejected": sum(s["rejected"] for s in per),
+            "dispatches": dispatches,
+            "mean_occupancy": (
+                sum(s["mean_occupancy"] * s["dispatches"] for s in per)
+                / dispatches if dispatches else 0.0),
+            "bucket_count": sum(s["bucket_count"] for s in per),
+            "max_batch": per[0]["max_batch"],
+            "max_latency_s": per[0]["max_latency_s"],
+            "replicas": len(per),
+        }
+
+    def stats(self) -> dict:
+        """Per-replica detail for /serve/status's "replicas" block."""
+        with self._lock:
+            routed = dict(self._routed)
+        reps = []
+        for r in self._replicas:
+            s = r.batcher.stats()
+            reps.append({
+                "replica": r.index,
+                "draining": r.draining,
+                "queue_depth": r.queue_depth(),
+                "routed": routed[r.index],
+                "dispatches": s["dispatches"],
+                "mean_occupancy": s["mean_occupancy"],
+                "bucket_count": s["bucket_count"],
+                "rejected": s["rejected"],
+                "sharding": r.sharding,
+                "devices": r.devices(),
+                "active": {name: r.registry.active(name).version
+                           for name in r.registry.names()},
+            })
+        return {"n_replicas": len(self._replicas),
+                "sharding": self.sharding, "replicas": reps}
+
+    def close(self, timeout_s: float = 5.0) -> None:
+        for r in self._replicas:
+            r.batcher.close(timeout_s)

@@ -49,6 +49,7 @@ from .admission import RejectedError
 from .batcher import MicroBatcher
 from .decode import DecodeEngine
 from .registry import ModelRegistry, global_model_registry
+from .replica import ReplicaSet
 from .streaming import StreamSessions
 
 
@@ -152,7 +153,7 @@ class _ServeHandler(BaseHTTPRequestHandler):
         if x.ndim == 1:
             x = x[None, :]
         self.engine.registry.active(model)  # 404 before queueing
-        fut = self.engine.batcher.submit(model, x)
+        fut = self.engine.submit_predict(model, x)
         try:
             res = fut.result(timeout=self.engine.request_timeout_s)
         except (_FutureTimeout, TimeoutError):
@@ -161,10 +162,13 @@ class _ServeHandler(BaseHTTPRequestHandler):
         except Exception as e:
             self._json({"error": f"{type(e).__name__}: {e}"}, code=500)
             return
-        self._json({
+        payload = {
             "predictions": np.asarray(res["predictions"]).tolist(),
             "model": res["model"], "version": res["version"],
-            "batched_with": res["batch_rows"], "bucket": res["bucket"]})
+            "batched_with": res["batch_rows"], "bucket": res["bucket"]}
+        if res.get("replica") is not None:
+            payload["replica"] = res["replica"]
+        self._json(payload)
 
     def _stream(self) -> None:
         req = self._body()
@@ -244,11 +248,29 @@ class InferenceServer:
                  max_queue: int = 256, request_timeout_s: float = 30.0,
                  stream_ttl_s: float = 300.0, decode_min_slots: int = 2,
                  decode_max_slots: int = 16, decode_max_context: int = 256,
-                 decode_eos_id: Optional[int] = None):
-        self.registry = registry or global_model_registry()
-        self.batcher = MicroBatcher(
-            self.registry, max_batch=max_batch, max_latency_s=max_latency_s,
-            max_queue=max_queue)
+                 decode_eos_id: Optional[int] = None,
+                 replicas: int = 1, sharding: Optional[str] = None,
+                 replica_devices=None,
+                 replica_mesh_axes: Optional[dict] = None):
+        self.replica_set: Optional[ReplicaSet] = None
+        if replicas > 1 or sharding is not None:
+            if registry is not None:
+                raise ValueError(
+                    "replica mode owns its per-replica registries; pass "
+                    "registry=None and register through server.register()")
+            self.replica_set = ReplicaSet(
+                replicas, sharding=sharding, devices=replica_devices,
+                mesh_axes=replica_mesh_axes, max_batch=max_batch,
+                max_latency_s=max_latency_s, max_queue=max_queue)
+            # replica 0's registry is the front door's catalog (404 check,
+            # streaming, decode) — every roll keeps all replicas in sync
+            self.registry = self.replica_set.primary_registry
+            self.batcher: Optional[MicroBatcher] = None
+        else:
+            self.registry = registry or global_model_registry()
+            self.batcher = MicroBatcher(
+                self.registry, max_batch=max_batch,
+                max_latency_s=max_latency_s, max_queue=max_queue)
         self.sessions = StreamSessions(self.registry, ttl_s=stream_ttl_s)
         self.request_timeout_s = float(request_timeout_s)
         self._decode_opts = dict(
@@ -275,6 +297,23 @@ class InferenceServer:
         _set_active_server(self)
         return self
 
+    def register(self, name: str, net, version: Optional[str] = None,
+                 quant: Optional[str] = None):
+        """Register a model for serving: the rolling replica path when in
+        replica mode, the plain registry otherwise."""
+        if self.replica_set is not None:
+            return self.replica_set.register(name, net, version=version,
+                                             quant=quant)
+        return self.registry.register(name, net, version=version,
+                                      quant=quant)
+
+    def submit_predict(self, model: str, x):
+        """The handler's dispatch seam: least-queue-depth routing across
+        the ReplicaSet, or the single micro-batcher."""
+        if self.replica_set is not None:
+            return self.replica_set.submit(model, x)
+        return self.batcher.submit(model, x)
+
     def decoder(self, model: str) -> DecodeEngine:
         """The continuous-batching decode engine for ``model``'s active
         version, created lazily and shared by every /v1/generate request —
@@ -287,12 +326,22 @@ class InferenceServer:
             if eng is None:
                 eng = self._decoders[key] = DecodeEngine(
                     mv.net, quant=mv.quant, **self._decode_opts)
+            # hot swap moved the active pointer: retire this model's
+            # stale-version engines once they have nothing in flight (their
+            # pinned params + slot state are dead weight after a roll)
+            for (n0, v0), stale in list(self._decoders.items()):
+                if n0 == mv.name and v0 != mv.version and stale.idle():
+                    stale.close()
+                    del self._decoders[(n0, v0)]
             return eng
 
     def stop(self) -> None:
         self._httpd.shutdown()
         self._httpd.server_close()
-        self.batcher.close()
+        if self.batcher is not None:
+            self.batcher.close()
+        if self.replica_set is not None:
+            self.replica_set.close()
         with self._dec_lock:
             for eng in self._decoders.values():
                 eng.close()
@@ -304,12 +353,16 @@ class InferenceServer:
         with self._dec_lock:
             decode = {f"{name}@{version}": eng.stats()
                       for (name, version), eng in sorted(self._decoders.items())}
-        return {
+        st = {
             **self.registry.status(),
-            "queue": self.batcher.stats(),
+            "queue": (self.batcher.stats() if self.batcher is not None
+                      else self.replica_set.queue_stats()),
             "streams": self.sessions.status(),
             "decode": decode,
         }
+        if self.replica_set is not None:
+            st["replicas"] = self.replica_set.stats()
+        return st
 
 
 # The most recent started server, so the training UI's /serve/status route

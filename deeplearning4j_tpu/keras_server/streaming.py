@@ -72,6 +72,12 @@ class StreamSessions:
             sm = self._models.get(key)
             if sm is None:
                 sm = self._models[key] = _StreamModel(mv.net)
+            # hot swap moved the active pointer: drop this model's stale-
+            # version clones once they park no sessions (new steps resolve
+            # the active version, so an empty stale clone can never refill)
+            for (n0, v0), old in list(self._models.items()):
+                if n0 == mv.name and v0 != mv.version and not old.states:
+                    del self._models[(n0, v0)]
             return sm, mv.version
 
     @staticmethod

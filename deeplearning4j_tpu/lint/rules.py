@@ -729,8 +729,10 @@ class SwallowedException(Rule):
 # ---------------------------------------------------------------------------
 @register
 class AdhocSharding(Rule):
-    """No ``NamedSharding(`` / ``PartitionSpec(`` construction outside the
-    partition-rule engine (``parallel/partition.py`` + ``compile_seam.py``).
+    """No ``NamedSharding(`` / ``PartitionSpec(`` / ``Mesh(`` construction
+    outside the partition-rule engine (``parallel/partition.py`` +
+    ``compile_seam.py``; ``Mesh`` additionally allows ``parallel/mesh.py``,
+    its one constructor site).
 
     Hand-built shardings are how the framework ended up with four parallel
     fit paths that each wired their own layouts — and where the layout lives
@@ -739,19 +741,27 @@ class AdhocSharding(Rule):
     (``dl4j_sharding_spec_total``), and compile-tracked; call sites import
     ``partition.pspec`` for trace-level specs and
     ``partition.named_sharding``/``tree_shardings``/``device_put`` for
-    placement. Jurisdiction: direct calls to the ``jax.sharding``
-    constructors (by from-import, alias, or dotted attribute). A staging
-    path with a genuine reason to hand-place (datasets/prefetch producer
-    threads) suppresses with that reason spelled out.
+    placement, and build meshes through ``parallel.mesh.build_mesh``. That
+    jurisdiction covers the serving tier too: a ReplicaSet's per-replica
+    mesh slices and every sharded ``PredictFn`` pin route through the same
+    engine as the fit paths. Jurisdiction: direct calls to the
+    ``jax.sharding`` constructors (by from-import, alias, or dotted
+    attribute). A staging path with a genuine reason to hand-place
+    (datasets/prefetch producer threads) suppresses with that reason
+    spelled out.
     """
 
     name = "adhoc-sharding"
-    description = ("NamedSharding/PartitionSpec constructed outside "
-                   "parallel/partition.py + compile_seam.py (use "
-                   "partition.pspec / partition.named_sharding)")
+    description = ("NamedSharding/PartitionSpec/Mesh constructed outside "
+                   "parallel/partition.py + compile_seam.py + mesh.py (use "
+                   "partition.pspec / partition.named_sharding / "
+                   "mesh.build_mesh)")
     exclude = ("*/parallel/partition.py", "*/parallel/compile_seam.py")
 
-    _CTORS = ("NamedSharding", "PartitionSpec")
+    _CTORS = ("NamedSharding", "PartitionSpec", "Mesh")
+    #: Mesh's one legitimate constructor site — NamedSharding/PartitionSpec
+    #: stay forbidden there, so it is a per-ctor exclusion, not `exclude`
+    _MESH_HOME = "parallel/mesh.py"
     _ORIGIN = "jax.sharding"
 
     def check(self, ctx: FileContext) -> Iterator[Violation]:
@@ -785,12 +795,16 @@ class AdhocSharding(Rule):
                     head, leaf = d.split(".", 1)[0], d.rsplit(".", 1)[-1]
                     if leaf in self._CTORS and head in mod_aliases:
                         kind = leaf
+            if kind == "Mesh" and str(ctx.path).replace(
+                    "\\", "/").endswith(self._MESH_HOME):
+                continue
             if kind:
                 yield self.violation(
                     ctx, node.lineno,
                     f"ad-hoc {kind}() construction — layouts come from the "
                     "partition-rule engine (partition.pspec / "
-                    "partition.named_sharding / compile_seam.compile_step)")
+                    "partition.named_sharding / mesh.build_mesh / "
+                    "compile_seam.compile_step)")
 
 
 def default_rules() -> List[Rule]:

@@ -16,6 +16,24 @@ compiled forward program jitted WITHOUT donation, so:
 - per padded-batch-bucket compiles are the ONLY compiles: a steady-state
   server replays cached executables (the MicroBatcher's contract).
 
+``sharding="dp_tp"`` + ``mesh=`` routes the pin through the partition-rule
+engine instead of a single device: the snapshot is ``device_put`` per the
+same rules that shard training (``parallel/partition.py``), cutting resident
+bytes per device by the shard factor, and the program compiles through the
+``parallel/compile_seam`` jit-with-shardings path.
+
+**The serving bitwise contract.** Distributed *compute* (true Megatron-style
+tensor parallelism) makes GSPMD insert partial-sum all-reduces that reorder
+f32 accumulation — ~1-ulp accurate, never bitwise (the training suite's
+dp_tp equivalence test uses atol=1e-4 for exactly this reason). Serving
+promises bitwise equality with the single-device program, so the sharded
+path shards params **at rest** and gathers **at use**: the first act inside
+the jitted program is ``with_sharding_constraint(params, replicated)`` — an
+exact all-gather layout change, no arithmetic — and each batch row then
+computes with the identical single-device reduction order. The win is
+resident bytes (serve models bigger than one HBM) and data-axis batch
+scale-out, not distributed matmuls; do not "optimize" the gather away.
+
 The reference serves via ``KerasModelEndpoint``/``output()`` with no
 donation concept; here the seam must be explicit because the fit path's
 donation is what makes TPU training fast.
@@ -59,26 +77,46 @@ QUANT_MODES = (None, "bf16", "int8")
 class PredictFn:
     """A compiled, non-donated, snapshot-pinned forward pass.
 
-    Callable: ``predict_fn(x) -> jnp array`` where ``x`` carries a leading
-    batch axis. Thread-safe — concurrent calls share one compiled program
-    per abstract input shape (jax's jit cache handles the rest); the pinned
-    buffers are never donated so calls cannot race on buffer liveness.
+    Callable: ``predict_fn(*inputs) -> jnp array`` where each input carries
+    a leading batch axis (multi-input ComputationGraphs take one positional
+    array per declared graph input). Thread-safe — concurrent calls share
+    one compiled program per abstract input shape (jax's jit cache handles
+    the rest); the pinned buffers are never donated so calls cannot race on
+    buffer liveness.
 
     ``quant="int8"`` is the opt-in serving DtypePolicy: per-channel scales
     are calibrated at pin time over the snapshot (ops/quant.py), the pinned
     tree holds int8 codes (4x resident-bytes cut vs f32), and the jitted
     program dequantizes lazily so XLA fuses the cast into each consumer.
+
+    ``sharding="dp_tp"`` + ``mesh=`` pins the snapshot sharded per the
+    partition rules (params live split across the mesh; int8 composes — the
+    codes shard, and the gather moves int8 bytes) and compiles through the
+    compile seam. Outputs are fully replicated and bitwise-equal to the
+    single-device program (see the module docstring for why the params are
+    gathered at use rather than compute-sharded). ``device=`` instead pins
+    the snapshot onto one specific device — the ReplicaSet's per-replica
+    placement on a multi-chip host.
     """
 
     def __init__(self, net, name: str = PREDICT_PROGRAM_NAME,
-                 quant: Optional[str] = None):
+                 quant: Optional[str] = None,
+                 sharding: Optional[str] = None,
+                 mesh=None, device=None):
         net._require_init()
         if quant not in QUANT_MODES:
             raise ValueError(f"quant must be one of {QUANT_MODES}, "
                              f"got {quant!r}")
+        if sharding is not None and mesh is None:
+            raise ValueError("sharding requires a mesh (parallel.build_mesh)")
+        if sharding is not None and device is not None:
+            raise ValueError("pass sharding+mesh OR device, not both")
         self._net = net
         self._name = name
         self.quant = quant if quant == "int8" else None
+        self.sharding = sharding
+        self.mesh = mesh
+        self.device = device
         # snapshot at pin time: a later fit() on `net` donates ITS buffers,
         # not these copies, and a hot-swap replaces this object wholesale
         self._params = _copy_tree(net.params_list)
@@ -88,25 +126,74 @@ class PredictFn:
             self._params = quantize_tree(self._params)
         self._graph = type(net).__name__ == "ComputationGraph"
         if self._graph:
-            n_in = len(net.conf.network_inputs)
-            if n_in != 1:
-                raise ValueError(
-                    f"serving supports single-input graphs; this graph has "
-                    f"{n_in} inputs — call net.output(*inputs) directly")
+            self._n_in = len(net.conf.network_inputs)
             self._single_out = len(net.conf.network_outputs) == 1
             fn = net._output_pure
         else:
+            self._n_in = 1
             fn = functools.partial(net._output_pure, train=False)
         if self.quant == "int8":
             fn = _with_dequant(fn)
-        # LazyScore._jit: policy-keyed, compile-tracked, NO donate argnums
-        self._fn = net._jit(name, fn)
+        self.param_specs = None
+        if sharding is not None:
+            self._fn = self._compile_sharded(net, name, fn)
+        else:
+            if device is not None:
+                self._params = jax.device_put(self._params, device)
+                self._states = jax.device_put(self._states, device)
+            # LazyScore._jit: policy-keyed, compile-tracked, NO donate argnums
+            self._fn = net._jit(name, fn)
         self._lock = threading.Lock()
         self.calls = 0  #: dispatches served (host-side, informational)
+
+    def _compile_sharded(self, net, name, fn):
+        """Pin the snapshot sharded-at-rest and compile the gathered-at-use
+        program through the compile seam (records the per-device bytes
+        gauge for this rule set)."""
+        from deeplearning4j_tpu import common
+        from deeplearning4j_tpu.parallel import compile_seam, partition
+        mesh = self.mesh
+        specs = partition.match_partition_rules(
+            partition.rules_for(self.sharding), self._params,
+            mesh=mesh, conf=getattr(net, "conf", None))
+        self.param_specs = specs
+        self._params = partition.device_put(self._params, mesh, specs)
+        self._states = partition.device_put(self._states, mesh,
+                                            partition.pspec())
+        gather = partition.tree_shardings(
+            mesh, jax.tree_util.tree_map(lambda _: partition.pspec(), specs))
+
+        @functools.wraps(fn)
+        def gathered(params, *rest, **kw):
+            # exact all-gather (layout change, no arithmetic): every device
+            # then runs the identical single-device reduction order, which
+            # is what keeps the sharded program bitwise-equal (int8 codes
+            # gather as int8 — 4x cheaper on the wire than f32)
+            return fn(jax.lax.with_sharding_constraint(params, gather),
+                      *rest, **kw)
+
+        conf_dtype = getattr(getattr(getattr(net, "conf", None),
+                                     "global_conf", None), "dtype", None)
+        step = compile_seam.compile_step(
+            f"{type(net).__name__}.{name}",
+            common.wrap_with_policy(gathered, conf_dtype),
+            mesh=mesh, rule_set=self.sharding,
+            # batch entries stay None: __call__ stages each input with
+            # batch_spec() and jit inherits the committed placement
+            in_specs=(specs, partition.pspec(), None),
+            out_specs=partition.pspec(),
+            cache_key=common.effective_policy_key(conf_dtype),
+            params=self._params, param_specs=specs)
+        return step
 
     @property
     def name(self) -> str:
         return self._name
+
+    @property
+    def n_inputs(self) -> int:
+        """Positional input arrays one call takes (1 for sequential nets)."""
+        return self._n_in
 
     @property
     def param_bytes(self) -> int:
@@ -114,18 +201,42 @@ class PredictFn:
         from deeplearning4j_tpu.ops.quant import tree_param_bytes
         return tree_param_bytes(self._params)
 
+    @property
+    def per_device_param_bytes(self) -> Optional[int]:
+        """Resident param bytes on ONE device of the mesh when sharded
+        (= param_bytes / shard factor, the tensor-parallel serving win);
+        None for unsharded pins."""
+        if self.sharding is None:
+            return None
+        from deeplearning4j_tpu.parallel import partition
+        return partition.per_device_bytes(self._params, self.param_specs,
+                                          self.mesh)
+
     def params_snapshot(self):
         """The pinned parameter pytree (tests assert bit-stability).
         Under quant="int8" the matrix leaves are QuantizedLeaf records."""
         return self._params
 
-    def __call__(self, x) -> Any:
+    def _stage(self, x):
         x = jnp.asarray(x)
+        if self.mesh is not None:
+            from deeplearning4j_tpu.parallel import partition
+            return partition.device_put(
+                x, self.mesh, partition.batch_spec(self.mesh, x.shape[0]))
+        if self.device is not None:
+            return jax.device_put(x, self.device)
+        return x
+
+    def __call__(self, *xs) -> Any:
+        if len(xs) != self._n_in:
+            raise ValueError(f"model takes {self._n_in} input(s), "
+                             f"got {len(xs)}")
+        staged = [self._stage(x) for x in xs]
         if self._graph:
-            outs, _ = self._fn(self._params, self._states, [x])
+            outs, _ = self._fn(self._params, self._states, staged)
             out = outs[0] if self._single_out else outs
         else:
-            out, _ = self._fn(self._params, self._states, x)
+            out, _ = self._fn(self._params, self._states, staged[0])
         with self._lock:
             self.calls += 1
         return out
@@ -133,17 +244,26 @@ class PredictFn:
 
 def make_predict_fn(net, name: str = PREDICT_PROGRAM_NAME,
                     version: Optional[str] = None,
-                    quant: Optional[str] = None) -> PredictFn:
+                    quant: Optional[str] = None,
+                    sharding: Optional[str] = None,
+                    mesh=None, device=None,
+                    replica: Optional[int] = None) -> PredictFn:
     """Pin a non-donated compiled forward for serving.
 
     ``version`` only decorates the program name (``serve_predict@v2``) so a
     hot-swapped model's compiles are attributable in the compile tracker;
     omit it for the plain serving program. ``quant="int8"`` opts this pin
     into the int8 serving DtypePolicy (the program name gains ``+int8`` so
-    quantized compiles stay attributable too).
+    quantized compiles stay attributable too). ``replica`` likewise only
+    decorates the name (``~r0``) so each ReplicaSet member's per-bucket
+    compiles count separately. ``sharding``/``mesh``/``device`` choose the
+    pin placement — see :class:`PredictFn`.
     """
     if version:
         name = f"{name}@{version}"
     if quant == "int8":
         name = f"{name}+int8"
-    return PredictFn(net, name=name, quant=quant)
+    if replica is not None:
+        name = f"{name}~r{replica}"
+    return PredictFn(net, name=name, quant=quant,
+                     sharding=sharding, mesh=mesh, device=device)

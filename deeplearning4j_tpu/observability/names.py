@@ -84,6 +84,12 @@ SERVE_HOT_SWAPS_TOTAL = "dl4j_serve_hot_swaps_total"
 SERVE_STREAM_SESSIONS = "dl4j_serve_stream_sessions"
 SERVE_STREAM_STEPS_TOTAL = "dl4j_serve_stream_steps_total"
 
+# --- sharded multi-replica serving (keras_server/replica.py) ---------------
+SERVE_REPLICA_QUEUE_DEPTH = "dl4j_serve_replica_queue_depth"
+SERVE_REPLICA_OCCUPANCY = "dl4j_serve_replica_occupancy"
+SERVE_REPLICA_ACTIVE_VERSION = "dl4j_serve_replica_active_version"
+SERVE_REPLICA_ROUTED_TOTAL = "dl4j_serve_replica_routed_total"
+
 # --- continuous-batching decode engine (keras_server/{decode,streaming}.py) -
 SERVE_SLOT_OCCUPANCY = "dl4j_serve_slot_occupancy"
 SERVE_TTFT_SECONDS = "dl4j_serve_ttft_seconds"

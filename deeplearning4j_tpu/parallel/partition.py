@@ -307,6 +307,16 @@ def device_put(tree, mesh: Mesh, spec_tree):
     return jax.device_put(tree, tree_shardings(mesh, spec_tree))
 
 
+def batch_spec(mesh: Mesh, n: int, axis: str = "data") -> PartitionSpec:
+    """Leading-batch-axis spec for staging a batch of ``n`` rows: split on
+    ``axis`` when the mesh divides ``n`` evenly, replicated otherwise (odd
+    tail buckets must still dispatch, just without the data split)."""
+    f = _axis_factor(mesh, axis)
+    if f and f > 1 and n % f == 0:
+        return PartitionSpec(axis)
+    return PartitionSpec()
+
+
 # --------------------------------------------------------------- telemetry
 _spec_counter = _obs_registry().counter(
     SHARDING_SPEC_TOTAL,

@@ -27,6 +27,11 @@
 #                                 # mid-decode admission/eviction, int8
 #                                 # drift bounds, compile-per-bucket, the
 #                                 # streaming churn regression, /v1/generate
+#   ./runtests.sh serve-shard [args]  # sharded multi-replica serving:
+#                                 # dp_tp bitwise-vs-single-device, rolling
+#                                 # hot swap zero-loss, least-queue router,
+#                                 # multi-input graphs, per-replica metrics,
+#                                 # bench replica-axis contract
 set -e
 cd "$(dirname "$0")"
 
@@ -84,6 +89,15 @@ if [ "${1-}" = "decode" ]; then
   XLA_FLAGS="--xla_force_host_platform_device_count=8" \
   exec python -m pytest tests/test_decode.py \
     tests/test_bench_contract.py::test_config_key_serve_decode_axes -q "$@"
+fi
+
+if [ "${1-}" = "serve-shard" ]; then
+  shift
+  PALLAS_AXON_POOL_IPS= \
+  JAX_PLATFORMS=cpu \
+  XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+  exec python -m pytest tests/test_serving_replica.py \
+    tests/test_bench_contract.py::test_config_key_serve_replica_axes -q "$@"
 fi
 
 if [ "${1-}" = "health" ]; then

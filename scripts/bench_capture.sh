@@ -71,6 +71,13 @@ run --model transformer --sharding dp_tp
 # int8_prob_drift ride the row); full records (p50/p99, occupancy,
 # recompiles == bucket count) also land in scripts/serve_load.jsonl
 run --model serve
+# sharded multi-replica serving headline row (ISSUE 12): 4 tensor-parallel
+# replicas (8 chips = 4 replicas x 2-way dp_tp slices) behind the least-
+# queue router vs the single-replica baseline at the same offered rate —
+# replica_speedup and replica_recompiles_match_buckets ride the row (the
+# >=1.6x two-replica floor is a capture-host property; single-core CI
+# can't exhibit it)
+run --model serve --serve-sharding dp_tp --serve-replicas 4
 # async-PS headline row (ISSUE 10): straggler A/B — one 4x-slow worker of 4,
 # async push/pull vs the sync-DP barrier at equal worker count, plus the
 # 2-process TCP loss-parity phase (CPU-measured by design, like serve: the

@@ -422,6 +422,41 @@ def test_config_key_serve_decode_axes():
     assert ts.endswith("Z") and ts > bench._PS_AXIS_LANDED_TS
 
 
+def test_config_key_serve_replica_axes():
+    """The replica-scaling section's fleet size and serving rule set are
+    config-distinct serve axes: a 4-replica or dp_tp-sharded capture must
+    never stand in for the 2-replica single-device row (they measure
+    different serving topologies), other models don't grow phantom axes,
+    and the ts-gate strips the axes on rows that predate the ReplicaSet —
+    those rows carry no replica-scaling numbers, so normalizing their axes
+    to None keeps an outage from serving a replica-less row. The serve
+    scenario's sharding rides its OWN ``--serve-sharding`` flag, never the
+    fit path's ``--sharding`` axis."""
+    import bench
+
+    a = bench._config_key("--model serve")
+    b = bench._config_key("--model serve --serve-replicas 4")
+    c = bench._config_key("--model serve --serve-sharding dp_tp")
+    assert a != b and a["serve_replicas"] == "2" \
+        and b["serve_replicas"] == "4"
+    assert a != c and a["serve_sharding"] == "none" \
+        and c["serve_sharding"] == "dp_tp"
+    # non-serve models don't grow phantom axes
+    r = bench._config_key("--model resnet50")
+    assert r["serve_replicas"] is None and r["serve_sharding"] is None
+    # rows logged before the replica section landed never match
+    # post-landing requests (axes None vs resolved defaults)
+    old = bench._config_key("--model serve", ts="2026-08-05T23:59:59Z")
+    new = bench._config_key("--model serve", ts="2026-08-06T00:00:01Z")
+    assert old["serve_replicas"] is None and old["serve_sharding"] is None
+    assert new["serve_replicas"] == "2" and new["serve_sharding"] == "none"
+    assert old != bench._config_key("--model serve")
+    ts = bench._SERVE_REPLICA_AXIS_LANDED_TS
+    assert ts.endswith("Z") and ts > bench._SERVE_DECODE_AXIS_LANDED_TS
+    # serve never joins the fit path's sharding grid
+    assert "serve" not in bench._SHARDING_CAPABLE
+
+
 def test_grid_row_serve():
     """The serve scenario is wired through the whole bench surface: grid
     membership, the requests/sec unit (the one non-samples/sec headline),
