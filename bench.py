@@ -1130,7 +1130,7 @@ def bench_ps_async(batch, iters, ksteps, ps_workers=None, ps_straggler=None):
     tcp_loss = float(tcp_net.score(gx, gy))
     sync_dp_loss = float(oracle.score(gx, gy))
 
-    return {
+    r = {
         "samples_per_sec": batch * n_batches / async_dt,
         "sync_samples_per_sec": batch * n_batches / sync_dt,
         "async_speedup": (batch * n_batches / async_dt)
@@ -1149,6 +1149,186 @@ def bench_ps_async(batch, iters, ksteps, ps_workers=None, ps_straggler=None):
         "batch": batch, "iters": iters, "ksteps": ksteps,
         "api": "parallel.ParameterServerParallelWrapper",
     }
+    _append_ps_ab("ps_async", r)
+    return r
+
+
+def _append_ps_ab(model: str, record: dict) -> None:
+    """Append one PS A/B row to scripts/ps_ab.jsonl: the straggler record
+    (ps_async, ISSUE 10) and the worker-kill record (elastic) accrete side
+    by side so the fleet-health story is one file. Measurement log only —
+    never read back for bench_log config matching."""
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "scripts", "ps_ab.jsonl")
+    row = {"ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+           "model": model, "record": record}
+    try:
+        with open(path, "a") as f:
+            f.write(json.dumps(row) + "\n")
+    except OSError:  # lint: swallowed-exception-ok (read-only checkout must not fail the bench)
+        pass
+
+
+def bench_elastic(batch, iters, ksteps, elastic_workers=None,
+                  elastic_kill=None):
+    """Worker-kill A/B on the elastic trainer (ISSUE 13 headline):
+    SIGKILL one of W separate-process workers mid-fit and measure the
+    throughput dip plus the recovery time back to 90% of the pre-kill
+    rate (lease expiry -> shard handoff -> replacement registers,
+    restores from the PS, and resumes the shard at the committed broker
+    offset). CPU-measured by design like ps_async: the number under test
+    is host-side membership/handoff orchestration, not MXU width.
+
+    Throughput proxy: the PS version counter advances once per applied
+    push window (push_frequency steps x batch samples), sampled on a
+    timeline thread; rates are versions/sec over a sliding window scaled
+    to samples/sec. The kill fires when the fleet reaches
+    ``elastic_kill`` of the expected total push windows.
+    """
+    import threading
+
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.datasets.iterators import ListDataSetIterator
+    from deeplearning4j_tpu.nn.conf.builders import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.parallel.elastic import ElasticTrainer
+
+    W = int(elastic_workers or 4)
+    kill_frac = float(elastic_kill if elastic_kill is not None else 0.5)
+    push_frequency, delay_s = 4, 0.2
+    n_batches = iters * ksteps
+
+    # learnable 10-class cluster data on a small dense net: worker
+    # processes must start fast (the respawn latency IS part of the
+    # measured recovery), so no conv stack here
+    rng = np.random.default_rng(0)
+    means = rng.normal(0.0, 1.0, (10, 64)).astype(np.float32)
+    data = []
+    for _ in range(n_batches):
+        lab = rng.integers(0, 10, batch)
+        x = (means[lab] + rng.normal(0, 0.5, (batch, 64))).astype(np.float32)
+        data.append(DataSet(x, np.eye(10, dtype=np.float32)[lab]))
+
+    conf = (NeuralNetConfiguration.builder()
+            .seed(12345).learning_rate(0.05).updater("sgd")
+            .list()
+            .layer(DenseLayer(n_in=64, n_out=32, activation="tanh"))
+            .layer(OutputLayer(n_in=32, n_out=10, loss="mcxent",
+                               activation="softmax"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+
+    trainer = (ElasticTrainer.builder(net)
+               .workers(W).push_frequency(push_frequency)
+               .staleness(8).lease_timeout(10.0)
+               .respawn(True)
+               .worker_delays(*([delay_s] * W))
+               .fit_timeout(180.0).build())
+
+    # expected applied windows over the whole run; the kill fires at
+    # kill_frac of that — "halfway" by work done, not wall time
+    expected_versions = max(1, n_batches // push_frequency)
+    kill_at = max(1, int(expected_versions * kill_frac))
+
+    timeline = []  # (t, version) samples
+    killed_at = [None]  # wall-clock instant of the SIGKILL
+
+    def _observe() -> None:
+        while trainer.server is None and not fit_done.is_set():
+            time.sleep(0.01)
+        while not fit_done.is_set():
+            v = trainer.server.version
+            timeline.append((time.perf_counter(), v))
+            if (kill_frac > 0 and killed_at[0] is None and v >= kill_at
+                    and trainer.chaos_kill(0)):
+                killed_at[0] = time.perf_counter()
+            time.sleep(0.25)
+
+    fit_done = threading.Event()
+    obs = threading.Thread(target=_observe, daemon=True,
+                           name="elastic-bench-observer")
+    obs.start()
+    t0 = time.perf_counter()
+    try:
+        trainer.fit(ListDataSetIterator(data))
+    finally:
+        fit_done.set()
+    fit_dt = time.perf_counter() - t0
+    obs.join(timeout=2.0)
+
+    # sliding-window rates (versions/sec over the trailing second),
+    # scaled to samples/sec via window size x batch
+    scale = push_frequency * batch
+
+    def _rates(points):
+        out = []
+        for i in range(1, len(points)):
+            j = i
+            while j > 0 and points[i][0] - points[j - 1][0] < 1.0:
+                j -= 1
+            dt = points[i][0] - points[j][0]
+            if dt > 0:
+                out.append((points[i][0],
+                            (points[i][1] - points[j][1]) / dt * scale))
+        return out
+
+    rates = _rates(timeline)
+    dip_pct = recovery_s = None
+    pre_rate = post_min = None
+    if killed_at[0] is not None and rates:
+        pre = [r for t, r in rates if t <= killed_at[0] and r > 0]
+        post = [(t, r) for t, r in rates if t > killed_at[0]]
+        if pre and post:
+            pre_rate = float(np.median(pre))
+            # recovery = first instant the rate is back at >=90% of the
+            # pre-kill median AND stays there for a full second (push
+            # windows are bursty; a single sample above the bar is noise,
+            # not a respawned worker)
+            recovery_s = fit_dt - (killed_at[0] - t0)  # worst case: never
+            recovered_t = None
+            for i, (t, r) in enumerate(post):
+                if r < 0.9 * pre_rate:
+                    continue
+                hold = [q for u, q in post[i:] if u - t <= 1.0]
+                if all(q >= 0.9 * pre_rate for q in hold):
+                    recovery_s = t - killed_at[0]
+                    recovered_t = t
+                    break
+            # the dip is what the fleet lost BETWEEN kill and recovery —
+            # the end-of-run drain taper (shards finishing) must not
+            # masquerade as preemption damage
+            dip_end = recovered_t if recovered_t is not None \
+                else killed_at[0] + 10.0
+            dipped = [r for t, r in post if t <= dip_end]
+            if dipped:
+                post_min = min(dipped)
+                dip_pct = max(0.0, (1.0 - post_min / pre_rate) * 100.0)
+
+    st = trainer.stats
+    r = {
+        "samples_per_sec": batch * n_batches / fit_dt,
+        "fit_time_s": fit_dt,
+        "worker_loss_dip_pct": dip_pct,
+        "recovery_seconds": recovery_s,
+        "pre_kill_samples_per_sec": pre_rate,
+        "post_kill_min_samples_per_sec": post_min,
+        "workers": W, "kill_fraction": kill_frac, "killed_shard": 0,
+        "kill_at_version": kill_at,
+        "worker_step_delay_ms": delay_s * 1e3,
+        "push_frequency": push_frequency,
+        "published_batches": st["published"],
+        "worker_steps": st["steps"],
+        "handoffs": st["handoffs"], "fenced": st["fenced"],
+        "lease_expiries": st["lease_expiries"], "joins": st["joins"],
+        "final_loss": float(net.score(
+            np.concatenate([d.features for d in data]),
+            np.concatenate([d.labels for d in data]))),
+        "batch": batch, "iters": iters, "ksteps": ksteps,
+        "api": "parallel.ElasticTrainer",
+    }
+    _append_ps_ab("elastic", r)
+    return r
 
 
 _METRICS = {
@@ -1164,6 +1344,7 @@ _METRICS = {
     "attention": "flash_attention_tokens_per_sec",
     "serve": "serve_batched_requests_per_sec",
     "ps_async": "ps_async_samples_per_sec",
+    "elastic": "elastic_ps_samples_per_sec",
 }
 
 #: models whose headline is not a training samples/sec number
@@ -1184,6 +1365,7 @@ _DEFAULTS = {  # model -> (batch, iters, ksteps)
     "attention": (4, 5, 4),
     "serve": (32, 3, 1),  # batch = serving max_batch, iters = seconds/phase
     "ps_async": (32, 48, 1),  # iters = total minibatches through each path
+    "elastic": (32, 192, 1),  # iters = total minibatches across the fleet
 }
 
 
@@ -1194,7 +1376,8 @@ def _bench_fns():
             "char_rnn": bench_char_rnn, "transformer": bench_transformer,
             "moe": bench_moe,
             "word2vec": bench_word2vec, "attention": bench_attention,
-            "serve": bench_serve, "ps_async": bench_ps_async}
+            "serve": bench_serve, "ps_async": bench_ps_async,
+            "elastic": bench_elastic}
 
 
 #: per-model default dtype policy = the measured-best config on chip
@@ -1210,7 +1393,10 @@ _DTYPE_DEFAULT = {"lenet": "bf16", "fit_lenet": "bf16",
                   "serve": "f32",
                   # PS A/B measures host-side orchestration (barrier vs
                   # async push/pull), not MXU width: f32 like serve
-                  "ps_async": "f32"}
+                  "ps_async": "f32",
+                  # elastic measures membership/handoff orchestration on
+                  # subprocess CPU workers: same reasoning as ps_async
+                  "elastic": "f32"}
 
 
 def _dtype_mode(model: str, *, bf16_act: bool, bf16_matmul: bool,
@@ -1287,6 +1473,11 @@ def _child_main(args) -> None:
             kwargs["ps_workers"] = args.ps_workers
         if args.ps_straggler:
             kwargs["ps_straggler"] = args.ps_straggler
+    if args.model == "elastic":
+        if args.elastic_workers:
+            kwargs["elastic_workers"] = args.elastic_workers
+        if args.elastic_kill is not None:
+            kwargs["elastic_kill"] = args.elastic_kill
     if getattr(args, "sharding", None):
         if args.model not in _SHARDING_CAPABLE:
             raise SystemExit(
@@ -1452,6 +1643,15 @@ def main() -> None:
                     help="ps_async bench straggler factor: one worker of "
                          "--ps-workers sleeps this multiple of the median "
                          "per-step delay (config-distinct); default 4")
+    ap.add_argument("--elastic-workers", type=int, default=None,
+                    help="elastic bench fleet size: separate-process "
+                         "workers behind the membership oracle "
+                         "(config-distinct); default 4")
+    ap.add_argument("--elastic-kill", type=float, default=None,
+                    help="elastic bench kill point: SIGKILL shard 0's "
+                         "worker when this fraction of the expected push "
+                         "windows has landed (config-distinct); default "
+                         "0.5, 0 disables the kill")
     ap.add_argument("--telemetry-out", default=None,
                     help="append a metrics-registry snapshot (JSONL) to this "
                          "file beside the headline JSON; measurement-only — "
@@ -1490,13 +1690,15 @@ def main() -> None:
     # child (--child's parser ignores --attempts/--attempt-timeout)
     cmd = [sys.executable, os.path.abspath(__file__), "--child"] + sys.argv[1:]
 
-    # ps_async measures host-side orchestration and is CPU-measured by
-    # design (the straggler A/B needs a data mesh at worker count on any
-    # box, TPU relay or not); a sharded-replica serve row likewise needs
-    # an 8-device host platform so each replica gets a real mesh slice;
-    # every other model inherits the env untouched
+    # ps_async and elastic measure host-side orchestration and are
+    # CPU-measured by design (the straggler A/B needs a data mesh at
+    # worker count on any box, TPU relay or not; the elastic coordinator
+    # and its subprocess workers must not contend for the relay); a
+    # sharded-replica serve row likewise needs an 8-device host platform
+    # so each replica gets a real mesh slice; every other model inherits
+    # the env untouched
     child_env = None
-    if args.model == "ps_async" or (
+    if args.model in ("ps_async", "elastic") or (
             args.model == "serve"
             and getattr(args, "serve_sharding", None) == "dp_tp"):
         child_env = os.environ.copy()
@@ -1668,6 +1870,13 @@ _SERVE_DECODE_AXIS_LANDED_TS = "2026-08-05T23:30:00Z"
 #: can never stand in for the standard 2-replica single-device row
 _SERVE_REPLICA_AXIS_LANDED_TS = "2026-08-06T00:00:00Z"
 
+#: when the elastic trainer landed (round 13) — no bench_log row before
+#: this instant can be a '--model elastic' row at all, and rows logged
+#: since carry the fleet-size / kill-point knobs as config axes so an
+#: outage can never serve a no-kill or 8-worker capture for the standard
+#: 4-worker kill-at-50% recovery row
+_ELASTIC_AXIS_LANDED_TS = "2026-08-06T02:00:00Z"
+
 
 def _config_key(args_str: str, ts: str = None) -> dict:
     """The fields that make two bench invocations the SAME config: model,
@@ -1743,6 +1952,13 @@ def _config_key(args_str: str, ts: str = None) -> dict:
         # must never stand in for the standard 4-worker/4x A/B
         ps_workers = val("--ps-workers") or "4"
         ps_straggler = val("--ps-straggler") or "4"
+    elastic_workers = elastic_kill = None
+    if model == "elastic" and not (ts is not None
+                                   and ts < _ELASTIC_AXIS_LANDED_TS):
+        # defaults are their own config: a no-kill or 8-worker capture
+        # must never stand in for the 4-worker kill-at-50% recovery row
+        elastic_workers = val("--elastic-workers") or "4"
+        elastic_kill = val("--elastic-kill") or "0.5"
     return {"model": model, "batch": val("--batch"),
             "ksteps": val("--ksteps"), "dtype": mode, "rdtype": rdtype,
             "seq": val("--seq"), "vocab": val("--vocab"),
@@ -1752,7 +1968,9 @@ def _config_key(args_str: str, ts: str = None) -> dict:
             "serve_batching": serve_batching, "serve_quant": serve_quant,
             "serve_replicas": serve_replicas,
             "serve_sharding": serve_sharding,
-            "ps_workers": ps_workers, "ps_straggler": ps_straggler}
+            "ps_workers": ps_workers, "ps_straggler": ps_straggler,
+            "elastic_workers": elastic_workers,
+            "elastic_kill": elastic_kill}
 
 
 def _last_healthy_from_log(args_str: str, path: str = None):

@@ -8,6 +8,8 @@ ParallelWrapper training of a serialized model) and PlayUIServer's main
     python -m deeplearning4j_tpu.cli ui --port 9000
     python -m deeplearning4j_tpu.cli parallel-train --model m.zip \
         --workers 4 --averaging-frequency 1 --epochs 1 [--dataset mnist]
+    python -m deeplearning4j_tpu.cli elastic-train --model m.zip \
+        --workers 4 --lease-timeout 15 --checkpoint-dir ckpt/
     python -m deeplearning4j_tpu.cli keras-server --port 25333
     python -m deeplearning4j_tpu.cli serve --model m.zip \
         --replicas 4 --sharding dp_tp --port 8080
@@ -109,6 +111,45 @@ def _cmd_parallel_train(args) -> int:
     return 0
 
 
+def _cmd_elastic_train(args) -> int:
+    from deeplearning4j_tpu.parallel.elastic import ElasticTrainer
+    from deeplearning4j_tpu.utils.model_serializer import (
+        guess_model, write_model,
+    )
+
+    net = guess_model(args.model)
+    if args.dataset == "mnist":
+        from deeplearning4j_tpu.datasets.mnist import MnistDataSetIterator
+        it = MnistDataSetIterator(args.batch, train=True,
+                                  num_examples=args.num_examples)
+    else:
+        from deeplearning4j_tpu.datasets.fetchers import CifarDataSetIterator
+        it = CifarDataSetIterator(args.batch, train=True, flatten=False,
+                                  num_examples=args.num_examples)
+    builder = (ElasticTrainer.builder(net)
+               .workers(args.workers)
+               .push_frequency(args.push_frequency)
+               .staleness(args.staleness)
+               .compression(args.compression)
+               .lease_timeout(args.lease_timeout)
+               .respawn(not args.no_respawn))
+    if args.checkpoint_dir:
+        builder = builder.checkpoint(args.checkpoint_dir,
+                                     interval_s=args.checkpoint_interval)
+    trainer = builder.build()
+    trainer.fit(it, epochs=args.epochs)
+    stats = trainer.stats
+    print(f"elastic fit done: {stats['steps']} steps over "
+          f"{stats['joins']} worker joins, {stats['handoffs']} handoffs, "
+          f"{stats['fenced']} fenced pushes"
+          + (" (warm-started from checkpoint)" if stats["restored"] else ""))
+    if args.output:
+        write_model(net, args.output)
+        print(f"trained model written to {args.output}")
+    print(f"final score: {net.score_value}")
+    return 0
+
+
 def _cmd_keras_server(args) -> int:
     from deeplearning4j_tpu.keras_server import Server
 
@@ -190,6 +231,32 @@ def build_parser() -> argparse.ArgumentParser:
                     help="append a metrics-registry snapshot (JSONL, incl. "
                          "compile events) to PATH after training")
     tr.set_defaults(fn=_cmd_parallel_train)
+
+    el = sub.add_parser(
+        "elastic-train",
+        help="preemption-tolerant async-PS training: leased worker "
+             "membership, broker shard handoff, checkpoint warm start")
+    el.add_argument("--model", required=True, help="model zip path")
+    el.add_argument("--dataset", default="mnist", help="mnist | cifar")
+    el.add_argument("--workers", type=int, default=4)
+    el.add_argument("--push-frequency", type=int, default=4)
+    el.add_argument("--staleness", type=int, default=8)
+    el.add_argument("--compression", default="none",
+                    choices=("none", "bf16"))
+    el.add_argument("--batch", type=int, default=128)
+    el.add_argument("--epochs", type=int, default=1)
+    el.add_argument("--num-examples", type=int, default=None)
+    el.add_argument("--lease-timeout", type=float, default=15.0,
+                    help="seconds of heartbeat silence before a worker is "
+                         "declared dead and its shard handed off")
+    el.add_argument("--no-respawn", action="store_true",
+                    help="fail instead of replacing a dead worker")
+    el.add_argument("--checkpoint-dir", default=None,
+                    help="async sharded checkpoints; a committed one warm-"
+                         "starts the PS on restart")
+    el.add_argument("--checkpoint-interval", type=float, default=30.0)
+    el.add_argument("--output", help="write trained model zip here")
+    el.set_defaults(fn=_cmd_elastic_train)
 
     ks = sub.add_parser("keras-server", help="start the Keras gateway")
     ks.add_argument("--port", type=int, default=25333)

@@ -12,8 +12,29 @@ from __future__ import annotations
 
 import dataclasses
 import shutil
+import threading
+import time
 from pathlib import Path
-from typing import List, Optional
+from typing import Callable, Dict, List, Optional
+
+from deeplearning4j_tpu.observability.flight_recorder import (
+    global_recorder as _flight_recorder,
+)
+from deeplearning4j_tpu.observability.metrics import (
+    global_registry as _obs_registry,
+)
+from deeplearning4j_tpu.observability.names import (
+    ELASTIC_JOINS_TOTAL, ELASTIC_LEASE_EXPIRIES_TOTAL, ELASTIC_LIVE_WORKERS,
+)
+
+_live_workers = _obs_registry().gauge(
+    ELASTIC_LIVE_WORKERS, "workers holding a live membership lease").labels()
+_lease_expiries = _obs_registry().counter(
+    ELASTIC_LEASE_EXPIRIES_TOTAL,
+    "membership leases declared dead after missing heartbeats").labels()
+_joins = _obs_registry().counter(
+    ELASTIC_JOINS_TOTAL, "worker registrations with the membership "
+                         "oracle").labels()
 
 
 class StorageProvider:
@@ -248,3 +269,174 @@ class TpuProvisioner:
             "num_slices": self.num_slices,
             "spot": self.preemptible,
         }
+
+
+@dataclasses.dataclass
+class WorkerLease:
+    """One worker's membership record: a fencing ``epoch`` (globally
+    monotonic per registration) plus a heartbeat-renewed deadline."""
+
+    member: int
+    epoch: int
+    shard: int
+    name: str
+    deadline: float
+    alive: bool = True
+    reason: Optional[str] = None   # why the lease ended, once it has
+
+
+@dataclasses.dataclass
+class MembershipOracle(TpuProvisioner):
+    """TpuProvisioner grown into the elastic-training membership authority.
+
+    Provisioning describes the pool a deployment *requests*; the oracle
+    tracks the pool that actually *showed up*: workers ``register`` (getting
+    a member id + fencing epoch + lease), renew via ``heartbeat``, and leave
+    via ``deregister``. A lease that is not renewed within
+    ``lease_timeout_s`` is declared dead — liveness is decided server-side,
+    never by the worker's own opinion of itself.
+
+    The epoch is the fence: every registration draws a fresh, globally
+    monotonic epoch, and the parameter server (``ParameterServer(...,
+    membership=oracle)``) rejects pushes carrying a dead or superseded
+    ``(member, epoch)``. A zombie — a preempted worker resumed after its
+    lease lapsed and its shard was handed off — can still talk, but its
+    pushes no longer land. Pushes deliberately do NOT renew the lease: only
+    heartbeats prove liveness, so a zombie busy-pushing stays dead.
+
+    ``clock`` is injectable (default ``time.monotonic``) so lease math is
+    unit-testable with a fake clock.
+    """
+
+    lease_timeout_s: float = 15.0
+    clock: Callable[[], float] = time.monotonic
+
+    def __post_init__(self) -> None:
+        self._lock = threading.Lock()
+        self._members: Dict[int, WorkerLease] = {}
+        self._epoch = 0
+        self.lease_expiries = 0
+        self.joins = 0
+
+    # ----------------------------------------------------------- membership
+    def register(self, shard: int, worker: str = "") -> WorkerLease:
+        with self._lock:
+            self._epoch += 1
+            lease = WorkerLease(
+                member=self._epoch, epoch=self._epoch, shard=int(shard),
+                name=worker or f"worker-{self._epoch}",
+                deadline=self.clock() + self.lease_timeout_s)
+            self._members[lease.member] = lease
+            self.joins += 1
+            _joins.inc()
+            self._update_gauge_locked()
+        _flight_recorder().record(
+            "worker_join", member=lease.member, epoch=lease.epoch,
+            shard=lease.shard, worker=lease.name)
+        return lease
+
+    def heartbeat(self, member: int, epoch: int) -> bool:
+        """Renew ``member``'s lease; False means the lease is gone (dead,
+        superseded, or lapsed) and the worker must stop pushing."""
+        with self._lock:
+            lease = self._members.get(int(member))
+            if lease is None or lease.epoch != int(epoch):
+                return False
+            if not lease.alive:
+                return False
+            if self.clock() > lease.deadline:
+                self._expire_locked(lease, reason="lease-lapsed")
+                return False
+            lease.deadline = self.clock() + self.lease_timeout_s
+            return True
+
+    def deregister(self, member: int, epoch: int,
+                   reason: str = "done") -> bool:
+        """Graceful leave: the lease ends without counting as an expiry."""
+        with self._lock:
+            lease = self._members.get(int(member))
+            if lease is None or lease.epoch != int(epoch) or not lease.alive:
+                return False
+            lease.alive = False
+            lease.reason = reason
+            self._update_gauge_locked()
+        _flight_recorder().record(
+            "worker_leave", member=lease.member, shard=lease.shard,
+            reason=reason)
+        return True
+
+    def validate(self, member: int, epoch: int) -> bool:
+        """Server-side fencing check at push time: the ``(member, epoch)``
+        pair must name a live, unlapsed lease. Lazily expires a lapsed lease
+        so fencing holds even between ``expire()`` sweeps; does NOT renew."""
+        with self._lock:
+            lease = self._members.get(int(member))
+            if lease is None or lease.epoch != int(epoch):
+                return False
+            if not lease.alive:
+                return False
+            if self.clock() > lease.deadline:
+                self._expire_locked(lease, reason="lease-lapsed")
+                return False
+            return True
+
+    def expire(self, now: Optional[float] = None) -> List[WorkerLease]:
+        """Sweep: declare every lapsed lease dead; returns the newly dead."""
+        now = self.clock() if now is None else now
+        with self._lock:
+            lapsed = [l for l in self._members.values()
+                      if l.alive and now > l.deadline]
+            for lease in lapsed:
+                self._expire_locked(lease, reason="lease-lapsed")
+        return lapsed
+
+    def evict(self, member: int, reason: str = "process-exit") -> bool:
+        """Coordinator-observed death (e.g. SIGKILLed process): fence the
+        lease immediately instead of waiting out the lease timeout. Not
+        counted as a lease expiry — the coordinator saw the body."""
+        with self._lock:
+            lease = self._members.get(int(member))
+            if lease is None or not lease.alive:
+                return False
+            lease.alive = False
+            lease.reason = reason
+            self._update_gauge_locked()
+        _flight_recorder().record(
+            "worker_lost", member=lease.member, shard=lease.shard,
+            reason=reason)
+        return True
+
+    # ------------------------------------------------------------- queries
+    def live_members(self) -> List[WorkerLease]:
+        with self._lock:
+            return [l for l in self._members.values() if l.alive]
+
+    def live_member_for_shard(self, shard: int) -> Optional[WorkerLease]:
+        with self._lock:
+            live = [l for l in self._members.values()
+                    if l.alive and l.shard == int(shard)]
+        return max(live, key=lambda l: l.epoch) if live else None
+
+    def member_by_name(self, name: str) -> Optional[WorkerLease]:
+        with self._lock:
+            named = [l for l in self._members.values() if l.name == name]
+        return max(named, key=lambda l: l.epoch) if named else None
+
+    def lease(self, member: int) -> Optional[WorkerLease]:
+        with self._lock:
+            return self._members.get(int(member))
+
+    # ------------------------------------------------------------ internals
+    def _expire_locked(self, lease: WorkerLease, reason: str) -> None:
+        lease.alive = False
+        lease.reason = reason
+        self.lease_expiries += 1
+        _lease_expiries.inc()
+        self._update_gauge_locked()
+        _flight_recorder().record(
+            "worker_lost", member=lease.member, shard=lease.shard,
+            reason=reason)
+
+    def _update_gauge_locked(self) -> None:
+        _live_workers.set(
+            sum(1 for l in self._members.values() if l.alive))

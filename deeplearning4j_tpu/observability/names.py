@@ -105,6 +105,13 @@ PS_VERSION = "dl4j_ps_version"
 PS_WIRE_BYTES_TOTAL = "dl4j_ps_wire_bytes_total"
 PS_WORKER_STEPS_TOTAL = "dl4j_ps_worker_steps_total"
 
+# --- elastic training (parallel/elastic.py, cloud.MembershipOracle) --------
+ELASTIC_LIVE_WORKERS = "dl4j_elastic_live_workers"
+ELASTIC_LEASE_EXPIRIES_TOTAL = "dl4j_elastic_lease_expiries_total"
+ELASTIC_FENCED_PUSHES_TOTAL = "dl4j_elastic_fenced_pushes_total"
+ELASTIC_HANDOFFS_TOTAL = "dl4j_elastic_handoffs_total"
+ELASTIC_JOINS_TOTAL = "dl4j_elastic_joins_total"
+
 # --- streaming routes + broker (streaming/{__init__,broker}.py) ------------
 ROUTE_ERRORS_TOTAL = "dl4j_route_errors_total"
 BROKER_MESSAGES_TOTAL = "dl4j_broker_messages_total"

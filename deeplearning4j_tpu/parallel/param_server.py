@@ -56,8 +56,8 @@ from deeplearning4j_tpu.observability.metrics import (
     global_registry as _obs_registry,
 )
 from deeplearning4j_tpu.observability.names import (
-    PS_PULLS_TOTAL, PS_PUSHES_TOTAL, PS_PUSH_WEIGHT, PS_STALENESS,
-    PS_VERSION, PS_WORKER_STEPS_TOTAL,
+    ELASTIC_FENCED_PUSHES_TOTAL, PS_PULLS_TOTAL, PS_PUSHES_TOTAL,
+    PS_PUSH_WEIGHT, PS_STALENESS, PS_VERSION, PS_WORKER_STEPS_TOTAL,
 )
 from deeplearning4j_tpu.observability.watchdog import beat as _wd_beat
 
@@ -80,6 +80,10 @@ _version_gauge = _obs_registry().gauge(
     PS_VERSION, "server param version (total applied pushes)").labels()
 _worker_steps = _obs_registry().counter(
     PS_WORKER_STEPS_TOTAL, "local train steps by PS workers")
+_fenced_pushes = _obs_registry().counter(
+    ELASTIC_FENCED_PUSHES_TOTAL,
+    "pushes rejected because the worker's membership epoch is dead "
+    "(zombie fencing)").labels()
 
 
 # --------------------------------------------------------------------------
@@ -124,12 +128,20 @@ def unflatten_tree(vec: np.ndarray, spec: TreeSpec, *, as_jax: bool = False):
 class PushResult:
     """Outcome of one delta push. ``params``/``version`` always carry the
     post-push server state (a rejected push's forced re-pull rides the same
-    round trip)."""
+    round trip). ``fenced`` marks an epoch-fenced rejection: the pusher's
+    membership lease is dead and no rebase/retry can ever succeed."""
     accepted: bool
     version: int
     staleness: int
     weight: float
     params: Optional[np.ndarray] = None
+    fenced: bool = False
+
+
+class StaleEpochFenced(RuntimeError):
+    """The worker's membership epoch was fenced: its lease lapsed (or was
+    superseded) and the server rejects its pushes permanently. The worker
+    must exit; a replacement re-registers with a fresh epoch."""
 
 
 class _ServerOptimizer:
@@ -170,7 +182,7 @@ class ParameterServer:
     def __init__(self, initial_params, *,
                  staleness_cap: int = DEFAULT_STALENESS_CAP,
                  optimizer: str = "sgd", server_lr: float = 1.0,
-                 momentum: float = 0.9):
+                 momentum: float = 0.9, membership=None):
         vec, spec = flatten_tree(initial_params)
         self._vec = vec
         self._spec = spec
@@ -180,21 +192,44 @@ class ParameterServer:
         self.version = 0
         self.pushes = 0          # applied (legacy counter, kept public)
         self.rejected = 0
+        #: cloud.MembershipOracle (or None): when set, pushes carrying a
+        #: (member, epoch) identity are epoch-fenced against its leases
+        self.membership = membership
+        self.fenced = 0
 
     @property
     def spec(self) -> TreeSpec:
         return self._spec
 
     # ------------------------------------------------------------- core API
-    def push_delta(self, delta: np.ndarray,
-                   base_version: int) -> PushResult:
+    def push_delta(self, delta: np.ndarray, base_version: int, *,
+                   member: Optional[int] = None,
+                   epoch: Optional[int] = None) -> PushResult:
         """Apply a worker delta computed against ``base_version``.
 
         staleness s = version - base_version; weight = 1/(1+s). A push with
         s > staleness_cap is rejected (weight 0) and the caller must rebase
         onto the returned fresh state before retrying.
+
+        When a membership oracle is attached and the push carries a
+        ``(member, epoch)`` identity, a dead/superseded epoch is fenced:
+        rejected with ``fenced=True``, permanently — the zombie's delta must
+        never land after its shard was handed off.
         """
         delta = np.asarray(delta, np.float32)
+        if (self.membership is not None and member is not None
+                and not self.membership.validate(member, epoch)):
+            with self._lock:
+                self.fenced += 1
+                self.rejected += 1
+                _fenced_pushes.inc()
+                _pushes_rejected.inc()
+                _flight_recorder().record(
+                    "ps_push_fenced", member=member, epoch=epoch,
+                    version=self.version)
+                return PushResult(False, self.version,
+                                  self.version - int(base_version), 0.0,
+                                  np.copy(self._vec), fenced=True)
         with self._lock:
             staleness = self.version - int(base_version)
             _staleness_hist.observe(staleness)
@@ -314,7 +349,8 @@ def run_worker_loop(*, transport, replica, step_fn, next_batch,
                     push_frequency: int,
                     hooks: Sequence[ParameterServerTrainingHook] = (),
                     delay_s: float = 0.0, worker_id: int = 0,
-                    background_pull: bool = True) -> dict:
+                    background_pull: bool = True,
+                    on_push: Optional[Callable[[bool], None]] = None) -> dict:
     """Train ``replica`` on batches from ``next_batch()`` (None = done),
     pushing a delta every ``push_frequency`` steps; returns worker stats.
 
@@ -323,6 +359,12 @@ def run_worker_loop(*, transport, replica, step_fn, next_batch,
     ``replica.fit`` (non-MultiLayerNetwork models).
     ``delay_s`` is the per-step fault-injection sleep used by the straggler
     benchmarks/tests.
+    ``on_push(accepted)`` fires after each push window resolves — the
+    elastic worker commits its broker offsets there, so samples are marked
+    consumed only once their delta landed (at-least-once accounting).
+    An epoch-fenced push raises ``StaleEpochFenced`` immediately: the
+    worker's lease is dead, retrying cannot help, and training on must not
+    continue (its shard now belongs to a replacement).
     """
     spec = None
     version, base_vec = transport.pull()
@@ -359,68 +401,82 @@ def run_worker_loop(*, transport, replica, step_fn, next_batch,
             if got is not None and got[0] > version:
                 version = got[0]
         res = transport.push(delta, version)
+        if getattr(res, "fenced", False):
+            raise StaleEpochFenced(
+                f"worker {worker_id}: push fenced at version {res.version}")
         if not res.accepted:
             # hard-rejected: rebase the local window onto the forced
             # re-pull state, then re-push at ~zero staleness
             rejected += 1
             res2 = transport.push(delta, res.version)
+            if getattr(res2, "fenced", False):
+                raise StaleEpochFenced(
+                    f"worker {worker_id}: push fenced at version "
+                    f"{res2.version}")
             res = res2 if res2.accepted else res
         if res.accepted:
             pushes += 1
         version, base_vec = res.version, res.params
         _set_replica(base_vec)
         steps_since_push = 0
+        if on_push is not None:
+            on_push(res.accepted)
         if puller is not None:
             puller.request()
 
-    while True:
-        ds = next_batch()
-        if ds is None:
-            break
-        if delay_s > 0.0:
-            time.sleep(delay_s)
-        # mid-window catch-up from the background pull: fold fresh global
-        # progress under the local window without blocking or re-counting it
-        if puller is not None and steps_since_push > 0:
-            got = puller.latest()
-            if got is not None and got[0] > version:
-                local, _ = flatten_tree(replica.params_list)
-                version, fresh = got
-                _set_replica(fresh + (local - base_vec))
-                base_vec = fresh
-                rebased += 1
-                puller.request()
-        for hook in hooks:
-            hook.pre_update(ds, replica)
-        if step_fn is not None:
-            p, s, u, loss = step_fn(
-                replica.params_list, replica.state_list,
-                replica.updater_state, jnp.asarray(ds.features),
-                jnp.asarray(ds.labels), replica._next_rng(),
-                jnp.int32(replica.iteration))
-            replica.params_list, replica.state_list = p, s
-            replica.updater_state = u
-            replica.score_value = loss
-        else:
-            replica.fit(ds.features, ds.labels)
-        replica.iteration += 1
-        for hook in hooks:
-            hook.post_update(ds, replica)
-        steps += 1
-        steps_since_push += 1
-        step_series.inc()
-        _compile_tracker().note_step(fn=f"ps_worker[{worker_id}]")
-        if steps_since_push >= push_frequency:
+    try:
+        while True:
+            ds = next_batch()
+            if ds is None:
+                break
+            if delay_s > 0.0:
+                time.sleep(delay_s)
+            # mid-window catch-up from the background pull: fold fresh
+            # global progress under the local window without blocking or
+            # re-counting it
+            if puller is not None and steps_since_push > 0:
+                got = puller.latest()
+                if got is not None and got[0] > version:
+                    local, _ = flatten_tree(replica.params_list)
+                    version, fresh = got
+                    _set_replica(fresh + (local - base_vec))
+                    base_vec = fresh
+                    rebased += 1
+                    puller.request()
+            for hook in hooks:
+                hook.pre_update(ds, replica)
+            if step_fn is not None:
+                p, s, u, loss = step_fn(
+                    replica.params_list, replica.state_list,
+                    replica.updater_state, jnp.asarray(ds.features),
+                    jnp.asarray(ds.labels), replica._next_rng(),
+                    jnp.int32(replica.iteration))
+                replica.params_list, replica.state_list = p, s
+                replica.updater_state = u
+                replica.score_value = loss
+            else:
+                replica.fit(ds.features, ds.labels)
+            replica.iteration += 1
+            for hook in hooks:
+                hook.post_update(ds, replica)
+            steps += 1
+            steps_since_push += 1
+            step_series.inc()
+            _compile_tracker().note_step(fn=f"ps_worker[{worker_id}]")
+            if steps_since_push >= push_frequency:
+                _push_window()
+        # flush ONLY a partial window: a worker that pushed at the boundary
+        # has nothing left, and re-pushing its last delta would double-count
+        # it (the pre-engine shutdown bug)
+        if steps_since_push > 0:
             _push_window()
-    # flush ONLY a partial window: a worker that pushed at the boundary has
-    # nothing left, and re-pushing its last delta would double-count it
-    # (the pre-engine shutdown bug)
-    if steps_since_push > 0:
-        _push_window()
-    if puller is not None:
-        puller.stop()
-        if bg_transport is not transport:
-            bg_transport.close()
+    finally:
+        # the puller must die even on a fenced/crashed exit, or its daemon
+        # thread keeps hammering the transport after the worker is gone
+        if puller is not None:
+            puller.stop()
+            if bg_transport is not transport:
+                bg_transport.close()
     return {"worker_id": worker_id, "steps": steps, "pushes": pushes,
             "rejected": rejected, "rebased": rebased,
             "final_version": version}

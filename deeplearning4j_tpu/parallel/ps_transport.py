@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import socket
 import threading
+import time
 from typing import Optional, Tuple
 
 import numpy as np
@@ -39,14 +40,42 @@ _wire_bytes = _obs_registry().counter(
     PS_WIRE_BYTES_TOTAL, "PS bytes on the wire, by op and codec")
 
 
+class TransportError(OSError):
+    """The PS is unreachable after the transport's full retry budget. The
+    worker must treat this as its own eviction signal: stop training, clean
+    up, exit — the membership lease will lapse server-side regardless."""
+
+
 class Transport:
     """What a PS worker holds: pull the versioned global params, push a
-    delta against the version it pulled."""
+    delta against the version it pulled. The membership verbs
+    (register/heartbeat/deregister) ride the same seam so liveness and
+    pushes share one failure domain."""
 
     def pull(self) -> Tuple[int, np.ndarray]:
         raise NotImplementedError
 
     def push(self, delta: np.ndarray, base_version: int) -> PushResult:
+        raise NotImplementedError
+
+    # ------------------------------------------------- membership (elastic)
+    def bind_member(self, member: int, epoch: int) -> None:
+        """Attach a (member, epoch) identity: subsequent pushes carry it and
+        the server fences them against the membership oracle's leases."""
+        self._member, self._epoch = int(member), int(epoch)
+
+    @property
+    def member_identity(self) -> Optional[Tuple[int, int]]:
+        member = getattr(self, "_member", None)
+        return None if member is None else (member, self._epoch)
+
+    def register(self, shard: int, worker: str = "") -> dict:
+        raise NotImplementedError
+
+    def heartbeat(self) -> bool:
+        raise NotImplementedError
+
+    def deregister(self, reason: str = "done") -> bool:
         raise NotImplementedError
 
     def close(self) -> None:
@@ -61,30 +90,111 @@ class InprocTransport(Transport):
         return self._server.pull_flat()
 
     def push(self, delta: np.ndarray, base_version: int) -> PushResult:
-        return self._server.push_delta(delta, base_version)
+        ident = self.member_identity
+        if ident is None:
+            return self._server.push_delta(delta, base_version)
+        return self._server.push_delta(delta, base_version,
+                                       member=ident[0], epoch=ident[1])
+
+    def _membership(self):
+        oracle = self._server.membership
+        if oracle is None:
+            raise RuntimeError("ParameterServer has no membership oracle")
+        return oracle
+
+    def register(self, shard: int, worker: str = "") -> dict:
+        lease = self._membership().register(shard, worker=worker)
+        return {"member": lease.member, "epoch": lease.epoch,
+                "lease_s": self._membership().lease_timeout_s}
+
+    def heartbeat(self) -> bool:
+        ident = self.member_identity
+        return (ident is not None
+                and self._membership().heartbeat(ident[0], ident[1]))
+
+    def deregister(self, reason: str = "done") -> bool:
+        ident = self.member_identity
+        return (ident is not None
+                and self._membership().deregister(ident[0], ident[1],
+                                                  reason=reason))
 
 
 class TcpTransport(Transport):
     """Client side of the framed loopback protocol. NOT thread-safe: each
-    worker (and its background puller) opens its own connection via
-    ``clone()``."""
+    worker (and its background puller / heartbeat thread) opens its own
+    connection via ``clone()``.
+
+    Connects lazily and survives a flaky server: every RPC gets a connect
+    timeout, a read timeout, and a bounded exponential-backoff retry budget
+    (a dead PS used to hang the worker forever on a blocking recv). When
+    the budget is spent the RPC raises ``TransportError``. A retried push
+    is at-least-once — the reply may be lost after the delta applied — which
+    the staleness-weighted server absorbs the same way it absorbs any
+    duplicate delta."""
 
     def __init__(self, addr: Tuple[str, int], codec: str = "none",
-                 timeout: float = 60.0):
+                 timeout: float = 60.0, connect_timeout: float = 5.0,
+                 retries: int = 3, backoff_s: float = 0.1,
+                 backoff_cap_s: float = 2.0):
         self._addr = tuple(addr)
         self._codec = codec
         self._timeout = timeout
+        self._connect_timeout = connect_timeout
+        self._retries = max(0, int(retries))
+        self._backoff_s = backoff_s
+        self._backoff_cap_s = backoff_cap_s
         self._lock = threading.Lock()
-        self._sock = wire.connect(self._addr, timeout=timeout)
+        self._sock: Optional[socket.socket] = None
         self._tx = _wire_bytes.labels(op="push", codec=codec)
         self._rx = _wire_bytes.labels(op="pull", codec="none")
 
     def clone(self) -> "TcpTransport":
-        return TcpTransport(self._addr, self._codec, self._timeout)
+        t = TcpTransport(self._addr, self._codec, self._timeout,
+                         self._connect_timeout, self._retries,
+                         self._backoff_s, self._backoff_cap_s)
+        ident = self.member_identity
+        if ident is not None:
+            t.bind_member(*ident)
+        return t
 
+    # ------------------------------------------------------------- plumbing
+    def _drop_sock(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:  # lint: swallowed-exception-ok (socket already dead is why we drop it)
+                pass
+            self._sock = None
+
+    def _rpc(self, header: dict, payload: bytes = b""):
+        """One request/reply with reconnect + bounded exponential backoff.
+        Caller holds self._lock. RuntimeError (a server-side error reply)
+        propagates immediately: the server is alive, retrying is wrong."""
+        last: Optional[BaseException] = None
+        for attempt in range(self._retries + 1):
+            if attempt:
+                delay = min(self._backoff_s * (2 ** (attempt - 1)),
+                            self._backoff_cap_s)
+                time.sleep(delay)
+            try:
+                if self._sock is None:
+                    self._sock = wire.connect(
+                        self._addr, timeout=self._connect_timeout)
+                    self._sock.settimeout(self._timeout)
+                return wire.request(self._sock, header, payload)
+            except RuntimeError:
+                raise
+            except (socket.timeout, ConnectionError, OSError) as e:
+                last = e
+                self._drop_sock()
+        raise TransportError(
+            f"PS at {self._addr} unreachable after {self._retries + 1} "
+            f"attempts (op={header.get('op')!r}): {last!r}") from last
+
+    # ------------------------------------------------------------- core API
     def pull(self) -> Tuple[int, np.ndarray]:
         with self._lock:
-            reply, payload, _ = wire.request(self._sock, {"op": "pull"})
+            reply, payload, _ = self._rpc({"op": "pull"})
         self._rx.inc(len(payload))
         vec = wire.decode_array(reply["array"], payload)
         return reply["version"], vec
@@ -92,23 +202,50 @@ class TcpTransport(Transport):
     def push(self, delta: np.ndarray, base_version: int) -> PushResult:
         meta, payload = wire.encode_array(
             np.asarray(delta, np.float32), self._codec)
+        header = {"op": "push", "base_version": int(base_version),
+                  "array": meta}
+        ident = self.member_identity
+        if ident is not None:
+            header["member"], header["epoch"] = ident
         with self._lock:
-            reply, buf, sent = wire.request(
-                self._sock,
-                {"op": "push", "base_version": int(base_version),
-                 "array": meta}, payload)
+            reply, buf, sent = self._rpc(header, payload)
         self._tx.inc(sent)
         params = wire.decode_array(reply["array"], buf)
         return PushResult(accepted=reply["accepted"],
                           version=reply["version"],
                           staleness=reply["staleness"],
-                          weight=reply["weight"], params=params)
+                          weight=reply["weight"], params=params,
+                          fenced=reply.get("fenced", False))
+
+    # ------------------------------------------------- membership (elastic)
+    def register(self, shard: int, worker: str = "") -> dict:
+        with self._lock:
+            reply, _, _ = self._rpc(
+                {"op": "register", "shard": int(shard), "worker": worker})
+        return reply
+
+    def heartbeat(self) -> bool:
+        ident = self.member_identity
+        if ident is None:
+            return False
+        with self._lock:
+            reply, _, _ = self._rpc(
+                {"op": "heartbeat", "member": ident[0], "epoch": ident[1]})
+        return bool(reply.get("ok"))
+
+    def deregister(self, reason: str = "done") -> bool:
+        ident = self.member_identity
+        if ident is None:
+            return False
+        with self._lock:
+            reply, _, _ = self._rpc(
+                {"op": "deregister", "member": ident[0],
+                 "epoch": ident[1], "reason": reason})
+        return bool(reply.get("ok"))
 
     def close(self) -> None:
-        try:
-            self._sock.close()
-        except OSError:  # lint: swallowed-exception-ok (best-effort close on teardown)
-            pass
+        with self._lock:
+            self._drop_sock()
 
 
 class ParameterServerTcpFrontend:
@@ -193,12 +330,37 @@ class ParameterServerTcpFrontend:
             return {"version": version, "array": meta}, buf
         if op == "push":
             delta = wire.decode_array(header["array"], payload)
-            res = self._server.push_delta(delta, header["base_version"])
+            res = self._server.push_delta(
+                delta, header["base_version"],
+                member=header.get("member"), epoch=header.get("epoch"))
             meta, buf = wire.encode_array(res.params, "none")
             return {"accepted": res.accepted, "version": res.version,
                     "staleness": res.staleness, "weight": res.weight,
-                    "array": meta}, buf
+                    "fenced": res.fenced, "array": meta}, buf
+        if op == "register":
+            oracle = self._require_membership(op)
+            lease = oracle.register(header["shard"],
+                                    worker=header.get("worker", ""))
+            return {"member": lease.member, "epoch": lease.epoch,
+                    "lease_s": oracle.lease_timeout_s}, b""
+        if op == "heartbeat":
+            oracle = self._require_membership(op)
+            ok = oracle.heartbeat(header["member"], header["epoch"])
+            return {"ok": ok}, b""
+        if op == "deregister":
+            oracle = self._require_membership(op)
+            ok = oracle.deregister(header["member"], header["epoch"],
+                                   reason=header.get("reason", "done"))
+            return {"ok": ok}, b""
         raise ValueError(f"unknown PS op {op!r}")
+
+    def _require_membership(self, op: str):
+        oracle = getattr(self._server, "membership", None)
+        if oracle is None:
+            raise ValueError(
+                f"PS op {op!r} requires a membership oracle "
+                "(ParameterServer(..., membership=MembershipOracle()))")
+        return oracle
 
     def stop(self) -> None:
         self._stop.set()

@@ -1,71 +1,231 @@
 """Out-of-process parameter-server worker.
 
-Run as::
+Static-shard mode (spawned by ``ParameterServerParallelWrapper``,
+transport="tcp") trains a pre-materialized .npz batch stack::
 
     python -m deeplearning4j_tpu.parallel.ps_worker \
         --addr 127.0.0.1:<port> --conf conf.json --data worker0.npz \
         --worker-id 0 --push-frequency 4 --codec bf16 --delay 0.0
 
-Spawned by ``ParameterServerParallelWrapper`` (transport="tcp") and by the
-multi-process tests — the same separate-OS-process pattern as
-tests/_dist_worker.py, but joined through the PS TCP protocol instead of
-jax.distributed: each worker owns its interpreter and device, pulls the
-initial params from the server, trains its batch shard asynchronously
-(pushing staleness-weighted deltas), and prints ONE JSON stats line on
-stdout for the parent to parse.
+Elastic mode (spawned by ``parallel.elastic.ElasticTrainer``) registers
+with the membership oracle, heartbeats its lease, and consumes its shard
+from a broker topic under a committed-offset consumer group::
+
+    python -m deeplearning4j_tpu.parallel.ps_worker \
+        --addr 127.0.0.1:<ps_port> --conf conf.json \
+        --broker 127.0.0.1:<broker_port> --topic shard-0 --group shard-0 \
+        --shard 0 --worker-name shard0-gen0
+
+Either way each worker owns its interpreter and device, pulls the initial
+params from the server, trains asynchronously (pushing staleness-weighted
+deltas), and prints ONE JSON stats line on stdout for the parent to parse.
+On exit — clean, fenced, or crashed — the shard .npz (if any) is removed
+(atexit + finally) and a ``worker_exit`` flight-recorder event carries the
+exit reason.
 """
 from __future__ import annotations
 
 import argparse
+import atexit
 import json
+import os
 import sys
 
 
-def main(argv=None) -> None:
+def _parse_addr(addr: str):
+    host, port = addr.rsplit(":", 1)
+    return host, int(port)
+
+
+def _run_npz(args, net, step, transport):
+    import numpy as np
+
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.parallel.param_server import run_worker_loop
+
+    blob = np.load(args.data)
+    batches = [DataSet(x, y) for x, y in zip(blob["x"], blob["y"])]
+    it = iter(batches)
+    return run_worker_loop(
+        transport=transport, replica=net,
+        step_fn=(step.fn if step is not None else None),
+        next_batch=lambda: next(it, None),
+        push_frequency=args.push_frequency,
+        delay_s=args.delay, worker_id=args.worker_id)
+
+
+def _run_elastic(args, net, step, transport):
+    """Membership-leased, broker-fed worker: register -> heartbeat ->
+    consume shard topic -> commit offsets only at push-window boundaries
+    (so a crash redelivers at most one window to the replacement)."""
+    import queue
+    import threading
+
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.parallel.param_server import (
+        StaleEpochFenced, run_worker_loop)
+    from deeplearning4j_tpu.parallel.ps_transport import TransportError
+    from deeplearning4j_tpu.streaming.broker import ReconnectingConsumer
+
+    reg = transport.register(args.shard, worker=args.worker_name)
+    member, epoch = reg["member"], reg["epoch"]
+    lease_s = float(reg["lease_s"])
+    transport.bind_member(member, epoch)
+
+    stop = threading.Event()
+    stop_reason = ["done"]
+    hb = transport.clone()
+
+    def _heartbeats() -> None:
+        # renew at a third of the lease so two misses still leave slack;
+        # a False renewal means the oracle already declared us dead — stop
+        # consuming immediately, the flush will be fenced anyway
+        interval = max(0.05, lease_s / 3.0)
+        while not stop.wait(interval):
+            try:
+                if not hb.heartbeat():
+                    stop_reason[0] = "lease-expired"
+                    stop.set()
+                    return
+            except TransportError:
+                stop_reason[0] = "coordinator-unreachable"
+                stop.set()
+                return
+
+    threading.Thread(target=_heartbeats, daemon=True,
+                     name="ps-heartbeat").start()
+
+    consumer = ReconnectingConsumer(
+        _parse_addr(args.broker), args.topic, group=args.group)
+    saw_fin = [False]
+
+    def next_batch():
+        while not stop.is_set():
+            try:
+                meta, arrays = consumer.get(timeout=0.5)
+            except queue.Empty:
+                continue
+            if meta.get("fin"):
+                saw_fin[0] = True
+                return None
+            return DataSet(arrays["x"], arrays["y"])
+        return None
+
+    def on_push(accepted: bool) -> None:
+        # the window's delta landed on the PS: NOW its samples count as
+        # consumed (commit-after-push = at-least-once, duplicates bounded
+        # by one window)
+        if accepted:
+            consumer.commit_delivered()
+
+    try:
+        stats = run_worker_loop(
+            transport=transport, replica=net,
+            step_fn=(step.fn if step is not None else None),
+            next_batch=next_batch, push_frequency=args.push_frequency,
+            delay_s=args.delay, worker_id=member, on_push=on_push)
+        if saw_fin[0] and not stop.is_set():
+            # the fin marker is the shard-complete record: committing it
+            # tells the coordinator no replacement is needed
+            consumer.commit_delivered()
+    finally:
+        stop.set()
+        consumer.close()
+        hb.close()
+    if stop_reason[0] == "lease-expired":
+        raise StaleEpochFenced("membership lease expired mid-shard")
+    if stop_reason[0] == "coordinator-unreachable":
+        raise TransportError("heartbeat channel lost")
+    try:
+        transport.deregister("done")
+    except TransportError:  # lint: swallowed-exception-ok (lease will lapse server-side; work is already committed)
+        pass
+    stats.update(member=member, epoch=epoch, shard=args.shard,
+                 fin=saw_fin[0])
+    return stats
+
+
+def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--addr", required=True, help="host:port of the PS")
     ap.add_argument("--conf", required=True, help="model config JSON path")
-    ap.add_argument("--data", required=True,
+    ap.add_argument("--data",
                     help=".npz with x (n,B,...) / y (n,B,...) batch stacks")
+    ap.add_argument("--broker", help="host:port of the shard broker "
+                                     "(elastic mode)")
+    ap.add_argument("--topic", help="shard topic to consume (elastic mode)")
+    ap.add_argument("--group", help="consumer group id; the replacement "
+                                    "resumes this group's committed offset")
+    ap.add_argument("--shard", type=int, default=0)
+    ap.add_argument("--worker-name", default="",
+                    help="coordinator-chosen name; lets the parent map this "
+                         "process to its membership lease")
     ap.add_argument("--worker-id", type=int, default=0)
     ap.add_argument("--push-frequency", type=int, default=4)
     ap.add_argument("--codec", default="none", choices=("none", "bf16"))
     ap.add_argument("--delay", type=float, default=0.0,
                     help="straggler fault injection: sleep per step")
     args = ap.parse_args(argv)
+    if bool(args.broker) == bool(args.data):
+        ap.error("exactly one of --data (static shard) or "
+                 "--broker/--topic/--group (elastic) is required")
+    if args.broker and not (args.topic and args.group):
+        ap.error("--broker requires --topic and --group")
 
-    import numpy as np
-
-    from deeplearning4j_tpu.datasets.dataset import DataSet
     from deeplearning4j_tpu.nn.conf.serde import from_json
     from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.observability.flight_recorder import (
+        global_recorder as _flight_recorder,
+    )
     from deeplearning4j_tpu.parallel.param_server import (
-        make_compiled_worker_step, run_worker_loop)
-    from deeplearning4j_tpu.parallel.ps_transport import TcpTransport
+        StaleEpochFenced, make_compiled_worker_step)
+    from deeplearning4j_tpu.parallel.ps_transport import (
+        TcpTransport, TransportError)
+
+    def _cleanup_data() -> None:
+        # the shard file is this worker's to delete: the parent only wrote
+        # it for us, and a preempted pod's scratch must not accumulate
+        if args.data:
+            try:
+                os.unlink(args.data)
+            except OSError:  # lint: swallowed-exception-ok (already removed, or parent tmpdir gone first)
+                pass
+
+    atexit.register(_cleanup_data)
 
     with open(args.conf) as f:
         conf = from_json(f.read())
     net = MultiLayerNetwork(conf).init()  # shapes only; params come from PS
 
-    blob = np.load(args.data)
-    batches = [DataSet(x, y) for x, y in zip(blob["x"], blob["y"])]
-    it = iter(batches)
-
-    host, port = args.addr.rsplit(":", 1)
-    transport = TcpTransport((host, int(port)), codec=args.codec)
+    transport = TcpTransport(_parse_addr(args.addr), codec=args.codec)
     step = make_compiled_worker_step(net, transport="tcp")
+    reason, rc, stats = "done", 0, None
     try:
-        stats = run_worker_loop(
-            transport=transport, replica=net,
-            step_fn=(step.fn if step is not None else None),
-            next_batch=lambda: next(it, None),
-            push_frequency=args.push_frequency,
-            delay_s=args.delay, worker_id=args.worker_id)
+        if args.broker:
+            stats = _run_elastic(args, net, step, transport)
+        else:
+            stats = _run_npz(args, net, step, transport)
+    except StaleEpochFenced as e:
+        reason, rc = "fenced", 3
+        sys.stderr.write(f"{e}\n")
+    except TransportError as e:
+        reason, rc = "coordinator-unreachable", 4
+        sys.stderr.write(f"{e}\n")
+    except BaseException as e:
+        reason = f"error:{type(e).__name__}"
+        raise
     finally:
+        _flight_recorder().record(
+            "worker_exit", worker=args.worker_name or str(args.worker_id),
+            shard=args.shard, reason=reason)
+        _cleanup_data()
         transport.close()
-    # stdout carries exactly one JSON line: the parent's parse contract
-    print(json.dumps(stats), flush=True)  # lint: bare-print-ok (subprocess stdout protocol, not logging)
+    if stats is not None:
+        stats["exit_reason"] = reason
+        # stdout carries exactly one JSON line: the parent's parse contract
+        print(json.dumps(stats), flush=True)  # lint: bare-print-ok (subprocess stdout protocol, not logging)
+    return rc
 
 
 if __name__ == "__main__":
-    main(sys.argv[1:])
+    sys.exit(main(sys.argv[1:]))

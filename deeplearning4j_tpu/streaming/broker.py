@@ -163,6 +163,14 @@ class LoopbackBroker:
         with self._cond:
             return len(self._topics.get(topic, []))
 
+    def committed(self, topic: str, group: str) -> int:
+        """In-process view of a group's committed offset (-1 = nothing
+        committed) — what the elastic coordinator compares to a shard
+        topic's fin offset to decide whether a dead worker left uncommitted
+        samples behind."""
+        with self._cond:
+            return self._commits.get((topic, group), -1)
+
     def drop_connections(self) -> int:
         """Fault injection: force-close every live client socket (consumers
         must reconnect and resume from their committed offset)."""
@@ -233,6 +241,7 @@ class ReconnectingConsumer:
         self._sock: Optional[socket.socket] = None
         self._next: Optional[int] = None   # next offset to fetch
         self._delivered: Optional[int] = None  # offset awaiting task_done
+        self._last_delivered: Optional[int] = None  # high-water, never reset
         self.reconnects = 0
         self.unfinished_tasks = 0
         self.all_tasks_done = threading.Condition()
@@ -287,6 +296,7 @@ class ReconnectingConsumer:
             meta = reply["meta"]
             arrays = wire.unpack_arrays(meta.get("arrays", []), payload)
             self._delivered = reply["offset"]
+            self._last_delivered = reply["offset"]
             self._next = reply["offset"] + 1
             with self.all_tasks_done:
                 self.unfinished_tasks += 1
@@ -309,6 +319,29 @@ class ReconnectingConsumer:
                 self.unfinished_tasks -= 1
             if not self.unfinished_tasks:
                 self.all_tasks_done.notify_all()
+
+    def commit_delivered(self) -> Optional[int]:
+        """Commit the highest offset delivered so far, without the
+        ``task_done`` bookkeeping — the elastic worker's window-commit: it
+        calls this only after a push window lands on the PS, so a crash
+        redelivers at most one window's worth of batches (at-least-once,
+        duplicates bounded by the commit cadence). Returns the committed
+        offset, or None if nothing was delivered yet. A lost commit is
+        deliberately NOT retried here: redelivery is the safe direction."""
+        offset = self._last_delivered
+        if offset is None:
+            return None
+        try:
+            self._ensure()
+            wire.request(self._sock,
+                         {"op": "commit", "topic": self.topic,
+                          "group": self.group, "offset": offset})
+        except (ConnectionError, OSError, RuntimeError):
+            # commit lost with the connection: the window redelivers after
+            # the replacement reconnects (at-least-once, never skipped)
+            self._drop()
+            return None
+        return offset
 
     def close(self) -> None:
         self._drop()

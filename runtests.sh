@@ -32,6 +32,12 @@
 #                                 # hot swap zero-loss, least-queue router,
 #                                 # multi-input graphs, per-replica metrics,
 #                                 # bench replica-axis contract
+#   ./runtests.sh elastic [args]  # elastic preemption-tolerant training:
+#                                 # membership lease math, zombie epoch
+#                                 # fencing, half-open-socket retry bounds,
+#                                 # broker shard handoff, the slow chaos
+#                                 # SIGKILL+respawn loss-parity run, bench
+#                                 # elastic-axis contract
 set -e
 cd "$(dirname "$0")"
 
@@ -98,6 +104,17 @@ if [ "${1-}" = "serve-shard" ]; then
   XLA_FLAGS="--xla_force_host_platform_device_count=8" \
   exec python -m pytest tests/test_serving_replica.py \
     tests/test_bench_contract.py::test_config_key_serve_replica_axes -q "$@"
+fi
+
+if [ "${1-}" = "elastic" ]; then
+  shift
+  # includes the slow chaos SIGKILL+respawn loss-parity run
+  PALLAS_AXON_POOL_IPS= \
+  JAX_PLATFORMS=cpu \
+  XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+  exec python -m pytest tests/test_elastic.py \
+    tests/test_bench_contract.py::test_config_key_elastic_axes \
+    tests/test_bench_contract.py::test_grid_row_elastic -q "$@"
 fi
 
 if [ "${1-}" = "health" ]; then

@@ -83,6 +83,13 @@ run --model serve --serve-sharding dp_tp --serve-replicas 4
 # 2-process TCP loss-parity phase (CPU-measured by design, like serve: the
 # win is host-side orchestration, not MXU width)
 run --model ps_async
+# elastic headline row (ISSUE 13): 4 separate-process workers behind the
+# membership oracle, SIGKILL one at 50% of the expected push windows —
+# worker_loss_dip_pct and recovery_seconds (time back to 90% of the
+# pre-kill rate: lease fence -> shard handoff -> replacement resumes at
+# the committed broker offset) ride the row; the same record also lands
+# in scripts/ps_ab.jsonl beside the ps_async straggler record
+run --model elastic
 if [ "$MODE" = full ]; then
     run --model lenet
     run --model lenet --bf16-act

@@ -522,3 +522,54 @@ def test_grid_row_ps_async():
     # enough minibatches that every worker pushes several windows per phase
     # and the loss-parity phase reaches the label-noise plateau
     assert iters * ksteps >= 32
+
+
+def test_config_key_elastic_axes():
+    """The elastic kill A/B's fleet shape is config-distinct: a no-kill or
+    8-worker capture must never stand in for the standard 4-worker
+    kill-at-50% recovery row (the dip and recovery being measured ARE
+    functions of both), other models don't grow phantom elastic axes, and
+    the ts-gate strips the axes on rows that predate the elastic trainer —
+    same pattern as serve and ps_async."""
+    import bench
+
+    a = bench._config_key("--model elastic")
+    b = bench._config_key("--model elastic --elastic-workers 8")
+    c = bench._config_key("--model elastic --elastic-kill 0")
+    assert a != b and a["elastic_workers"] == "4" \
+        and b["elastic_workers"] == "8"
+    assert a != c and c["elastic_kill"] == "0"
+    assert a["elastic_kill"] == "0.5"  # the bench_elastic default, pinned
+    # non-elastic models don't grow phantom axes
+    r = bench._config_key("--model ps_async")
+    assert r["elastic_workers"] is None and r["elastic_kill"] is None
+    # rows logged before the elastic trainer landed cannot be elastic rows
+    old = bench._config_key("--model elastic --elastic-workers 8",
+                            ts="2026-08-06T01:59:59Z")
+    new = bench._config_key("--model elastic --elastic-workers 8",
+                            ts="2026-08-06T02:00:01Z")
+    assert old["elastic_workers"] is None and new["elastic_workers"] == "8"
+    ts = bench._ELASTIC_AXIS_LANDED_TS
+    assert ts.endswith("Z") and ts > bench._SERVE_REPLICA_AXIS_LANDED_TS
+
+
+def test_grid_row_elastic():
+    """The elastic scenario is wired through the whole bench surface: grid
+    membership, samples/sec unit, f32 dtype default (the kill A/B measures
+    membership/handoff orchestration on subprocess CPU workers, not MXU
+    width), and neither profile- nor sharding-capable (it runs its own
+    coordinator + worker-process harness, not the multistep harness those
+    frozensets describe)."""
+    import bench
+
+    assert bench._METRICS["elastic"] == "elastic_ps_samples_per_sec"
+    assert "elastic" in bench._DEFAULTS and "elastic" in bench._bench_fns()
+    assert "elastic" not in bench._UNITS  # samples/sec, the default unit
+    assert bench._DTYPE_DEFAULT["elastic"] == "f32"
+    assert "elastic" not in bench._PROFILE_CAPABLE
+    assert "elastic" not in bench._SHARDING_CAPABLE
+    batch, iters, ksteps = bench._DEFAULTS["elastic"]
+    # enough minibatches that the fit comfortably outlives a worker
+    # respawn (~3s): the recovery-to-90% number must be measurable before
+    # the surviving shards drain
+    assert iters * ksteps >= 128

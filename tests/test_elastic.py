@@ -1,0 +1,529 @@
+"""Elastic preemption-tolerant training tests (reference Spark
+TrainingMaster fault tolerance + deeplearning4j-aws provisioning):
+membership-oracle lease math with a fake clock, epoch fencing of zombie
+pushes (inproc and over the TCP wire), TcpTransport half-open-socket retry
+bounds, broker consumer-group shard handoff semantics, worker-process
+cleanup, and the slow chaos test — SIGKILL a worker mid-fit and prove loss
+parity with an uninterrupted baseline at equal consumed samples."""
+import json
+import os
+import queue
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.cloud import MembershipOracle, WorkerLease
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.iterators import ListDataSetIterator
+from deeplearning4j_tpu.nn.conf.builders import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.observability import names as _n
+from deeplearning4j_tpu.observability.flight_recorder import global_recorder
+from deeplearning4j_tpu.observability.metrics import global_registry
+from deeplearning4j_tpu.parallel.elastic import ElasticTrainer
+from deeplearning4j_tpu.parallel.param_server import ParameterServer
+from deeplearning4j_tpu.parallel.ps_transport import (
+    ParameterServerTcpFrontend, TcpTransport, TransportError,
+)
+from deeplearning4j_tpu.streaming.broker import (
+    BrokerProducer, LoopbackBroker, ReconnectingConsumer,
+)
+
+
+class FakeClock:
+    def __init__(self, t: float = 0.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+def _oracle(timeout=15.0):
+    clock = FakeClock()
+    return MembershipOracle(lease_timeout_s=timeout, clock=clock), clock
+
+
+def _net(seed=12345, lr=0.1):
+    conf = (NeuralNetConfiguration.builder()
+            .seed(seed).learning_rate(lr).updater("sgd")
+            .list()
+            .layer(DenseLayer(n_in=4, n_out=8, activation="tanh"))
+            .layer(OutputLayer(n_in=8, n_out=3, loss="mcxent",
+                               activation="softmax"))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _counter_value(name: str) -> float:
+    snap = global_registry().snapshot().get(name, {"series": []})
+    return sum(s["value"] for s in snap["series"])
+
+
+# --------------------------------------------------------- membership oracle
+
+def test_register_draws_globally_monotonic_epochs():
+    oracle, _ = _oracle()
+    a = oracle.register(0, worker="a")
+    b = oracle.register(1, worker="b")
+    assert (a.member, a.epoch) == (1, 1)
+    assert (b.member, b.epoch) == (2, 2)
+    assert oracle.joins == 2
+    assert {l.name for l in oracle.live_members()} == {"a", "b"}
+
+
+def test_heartbeat_renews_lease_until_it_lapses():
+    oracle, clock = _oracle(timeout=15.0)
+    lease = oracle.register(0)
+    clock.advance(10.0)
+    assert oracle.heartbeat(lease.member, lease.epoch)
+    clock.advance(10.0)  # 20s total but renewed at t=10: still live
+    assert oracle.heartbeat(lease.member, lease.epoch)
+    clock.advance(16.0)  # past the renewed deadline
+    assert not oracle.heartbeat(lease.member, lease.epoch)
+    assert oracle.lease_expiries == 1
+    assert oracle.lease(lease.member).reason == "lease-lapsed"
+    # dead is permanent: a later heartbeat can never resurrect the lease
+    clock.advance(-20.0)
+    assert not oracle.heartbeat(lease.member, lease.epoch)
+
+
+def test_validate_fences_but_never_renews():
+    oracle, clock = _oracle(timeout=10.0)
+    lease = oracle.register(0)
+    clock.advance(9.0)
+    assert oracle.validate(lease.member, lease.epoch)
+    # validate at t=9 must NOT have pushed the deadline out: only
+    # heartbeats prove liveness (a zombie busy-pushing stays dead)
+    clock.advance(2.0)
+    assert not oracle.validate(lease.member, lease.epoch)
+    assert oracle.lease_expiries == 1
+    assert not oracle.validate(99, 99)  # unknown member
+    live = oracle.register(0)
+    assert not oracle.validate(live.member, live.epoch + 1)  # wrong epoch
+
+
+def test_expire_sweep_returns_only_newly_dead():
+    oracle, clock = _oracle(timeout=5.0)
+    a = oracle.register(0, worker="a")
+    b = oracle.register(1, worker="b")
+    clock.advance(4.0)
+    oracle.heartbeat(b.member, b.epoch)
+    clock.advance(2.0)  # a is 6s silent; b renewed 2s ago
+    lapsed = oracle.expire()
+    assert [l.member for l in lapsed] == [a.member]
+    assert oracle.expire() == []  # already declared: not newly dead again
+    assert [l.member for l in oracle.live_members()] == [b.member]
+
+
+def test_deregister_is_graceful_not_an_expiry():
+    oracle, _ = _oracle()
+    lease = oracle.register(0)
+    assert oracle.deregister(lease.member, lease.epoch, reason="done")
+    assert oracle.lease_expiries == 0
+    assert not oracle.validate(lease.member, lease.epoch)
+    assert not oracle.deregister(lease.member, lease.epoch)  # already gone
+
+
+def test_evict_fences_immediately_without_expiry_count():
+    oracle, _ = _oracle()
+    lease = oracle.register(3, worker="w")
+    assert oracle.evict(lease.member, reason="exit-rc137")
+    assert oracle.lease_expiries == 0
+    assert oracle.lease(lease.member).reason == "exit-rc137"
+    assert not oracle.validate(lease.member, lease.epoch)
+    assert not oracle.evict(lease.member)
+
+
+def test_replacement_supersedes_by_epoch():
+    oracle, _ = _oracle()
+    old = oracle.register(0, worker="shard0-gen0")
+    oracle.evict(old.member)
+    new = oracle.register(0, worker="shard0-gen1")
+    assert new.epoch > old.epoch
+    assert oracle.live_member_for_shard(0).member == new.member
+    assert oracle.member_by_name("shard0-gen1").member == new.member
+
+
+# -------------------------------------------------------------- epoch fencing
+
+def test_zombie_push_is_fenced_and_counted():
+    oracle, clock = _oracle(timeout=5.0)
+    srv = ParameterServer([np.zeros(8, np.float32)], membership=oracle)
+    lease = oracle.register(0)
+    delta = np.ones(8, np.float32)
+
+    res = srv.push_delta(delta, 0, member=lease.member, epoch=lease.epoch)
+    assert res.accepted and not res.fenced and srv.version == 1
+
+    before = _counter_value(_n.ELASTIC_FENCED_PUSHES_TOTAL)
+    clock.advance(6.0)  # lease lapses: the worker is now a zombie
+    res = srv.push_delta(delta, 1, member=lease.member, epoch=lease.epoch)
+    assert res.fenced and not res.accepted
+    assert srv.version == 1  # the model never saw the zombie's delta
+    assert srv.fenced == 1 and srv.rejected == 1
+    assert _counter_value(_n.ELASTIC_FENCED_PUSHES_TOTAL) == before + 1
+    # the fenced reply still carries fresh state (reject-carries-state)
+    assert res.params.shape == (8,)
+
+    # a replacement on the same shard pushes fine under its NEW epoch
+    repl = oracle.register(0)
+    res = srv.push_delta(delta, 1, member=repl.member, epoch=repl.epoch)
+    assert res.accepted and srv.version == 2
+
+
+def test_identityless_push_bypasses_fencing():
+    # static-shard workers (ISSUE 10 mode) carry no identity; a server
+    # with an oracle attached must keep accepting them unchanged
+    oracle, _ = _oracle()
+    srv = ParameterServer([np.zeros(4, np.float32)], membership=oracle)
+    res = srv.push_delta(np.ones(4, np.float32), 0)
+    assert res.accepted and not res.fenced
+
+
+# ------------------------------------------------------------ wire membership
+
+def test_membership_verbs_over_tcp_frontend():
+    oracle, clock = _oracle(timeout=5.0)
+    srv = ParameterServer([np.zeros(6, np.float32)], membership=oracle)
+    frontend = ParameterServerTcpFrontend(srv).start()
+    t = TcpTransport(("127.0.0.1", frontend.port))
+    try:
+        reg = t.register(2, worker="w0")
+        assert reg["member"] == reg["epoch"] == 1
+        assert reg["lease_s"] == 5.0
+        t.bind_member(reg["member"], reg["epoch"])
+        assert t.heartbeat()
+
+        res = t.push(np.ones(6, np.float32), 0)
+        assert res.accepted and not res.fenced
+
+        assert t.deregister("done")
+        res = t.push(np.ones(6, np.float32), 1)
+        assert res.fenced and not res.accepted  # fence crosses the wire
+        assert not t.heartbeat()
+    finally:
+        t.close()
+        frontend.stop()
+
+
+def test_membership_verbs_require_an_oracle():
+    srv = ParameterServer([np.zeros(4, np.float32)])  # no membership
+    frontend = ParameterServerTcpFrontend(srv).start()
+    t = TcpTransport(("127.0.0.1", frontend.port))
+    try:
+        with pytest.raises(RuntimeError, match="membership"):
+            t.register(0)
+    finally:
+        t.close()
+        frontend.stop()
+
+
+# ------------------------------------------------------- transport robustness
+
+def test_half_open_socket_raises_transport_error_in_bounded_time():
+    # a listener that accepts and then never replies: the old transport
+    # blocked forever in recv; now every RPC has a read timeout + bounded
+    # retry budget and surfaces TransportError
+    lsock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    lsock.bind(("127.0.0.1", 0))
+    lsock.listen(4)
+    accepted = []
+
+    def _accept_and_hold():
+        try:
+            while True:
+                conn, _ = lsock.accept()
+                accepted.append(conn)  # hold open, never reply
+        except OSError:
+            pass
+
+    threading.Thread(target=_accept_and_hold, daemon=True).start()
+    t = TcpTransport(lsock.getsockname(), timeout=0.2, connect_timeout=0.5,
+                     retries=2, backoff_s=0.05, backoff_cap_s=0.1)
+    t0 = time.monotonic()
+    try:
+        with pytest.raises(TransportError):
+            t.pull()
+    finally:
+        elapsed = time.monotonic() - t0
+        t.close()
+        lsock.close()
+        for c in accepted:
+            c.close()
+    # 3 attempts x 0.2s read timeout + backoffs; far under the old forever
+    assert elapsed < 5.0
+
+
+def test_connection_refused_raises_transport_error():
+    probe = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    probe.bind(("127.0.0.1", 0))
+    addr = probe.getsockname()
+    probe.close()  # nothing listens here now
+    t = TcpTransport(addr, timeout=0.2, connect_timeout=0.3,
+                     retries=1, backoff_s=0.01)
+    with pytest.raises(TransportError):
+        t.pull()
+    t.close()
+
+
+def test_server_error_reply_is_not_retried():
+    # RuntimeError = the server is alive and answered "no"; burning the
+    # retry budget on it would turn a protocol bug into a slow hang
+    srv = ParameterServer([np.zeros(4, np.float32)])
+    frontend = ParameterServerTcpFrontend(srv).start()
+    t = TcpTransport(("127.0.0.1", frontend.port),
+                     retries=3, backoff_s=5.0)  # retries would cost >15s
+    t0 = time.monotonic()
+    try:
+        with pytest.raises(RuntimeError, match="unknown PS op"):
+            with t._lock:
+                t._rpc({"op": "definitely-not-an-op"})
+    finally:
+        elapsed = time.monotonic() - t0
+        t.close()
+        frontend.stop()
+    assert elapsed < 2.0  # no backoff sleeps happened
+
+
+# ------------------------------------------------------ broker shard handoff
+
+def _publish(broker, topic, n):
+    producer = BrokerProducer(broker.address)
+    try:
+        for i in range(n):
+            producer.publish(topic, {"x": np.full((2,), i, np.float32)},
+                             meta={"idx": i})
+    finally:
+        producer.close()
+
+
+def test_group_resume_at_committed_plus_one():
+    broker = LoopbackBroker().start()
+    try:
+        _publish(broker, "shard-0", 8)
+        assert broker.committed("shard-0", "g") == -1
+
+        # worker A consumes 6 messages but only commits through the 4th
+        # (its last landed push window), then "crashes" (close, no commit)
+        a = ReconnectingConsumer(broker.address, "shard-0", group="g")
+        seen_a = []
+        for _ in range(6):
+            meta, arrays = a.get(timeout=1.0)
+            seen_a.append(meta["idx"])
+            if meta["idx"] == 3:
+                assert a.commit_delivered() == 3
+        a.close()
+        assert seen_a == [0, 1, 2, 3, 4, 5]
+        assert broker.committed("shard-0", "g") == 3
+
+        # the replacement resumes the SAME group at committed+1: offsets
+        # 4 and 5 redeliver (at-least-once, bounded by one commit window),
+        # nothing is skipped, and its final commit drains the topic
+        b = ReconnectingConsumer(broker.address, "shard-0", group="g")
+        seen_b = []
+        while True:
+            try:
+                meta, _ = b.get(timeout=0.3)
+            except queue.Empty:
+                break
+            seen_b.append(meta["idx"])
+        assert seen_b == [4, 5, 6, 7]
+        assert b.commit_delivered() == 7
+        assert broker.committed("shard-0", "g") == 7
+        b.close()
+
+        duplicates = set(seen_a) & set(seen_b)
+        assert duplicates == {4, 5}  # exactly the uncommitted window
+        assert set(seen_a) | set(seen_b) == set(range(8))  # zero loss
+    finally:
+        broker.stop()
+
+
+def test_commit_delivered_before_any_get_is_a_noop():
+    broker = LoopbackBroker().start()
+    try:
+        _publish(broker, "t", 1)
+        c = ReconnectingConsumer(broker.address, "t", group="g2")
+        assert c.commit_delivered() is None
+        assert broker.committed("t", "g2") == -1
+        c.close()
+    finally:
+        broker.stop()
+
+
+# ----------------------------------------------------------- worker process
+
+def test_ps_worker_main_cleans_npz_and_records_exit(tmp_path, capsys):
+    from deeplearning4j_tpu.nn.conf.serde import to_json
+    from deeplearning4j_tpu.parallel import ps_worker
+
+    net = _net()
+    srv = ParameterServer(net.params_list)
+    frontend = ParameterServerTcpFrontend(srv).start()
+
+    conf_path = tmp_path / "conf.json"
+    conf_path.write_text(to_json(net.conf))
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(4, 8, 4)).astype(np.float32)
+    y = np.tile(np.eye(3, dtype=np.float32)[[0, 1, 2, 0, 1, 2, 0, 1]],
+                (4, 1, 1))
+    data_path = tmp_path / "worker0.npz"
+    np.savez(data_path, x=x, y=y)
+
+    try:
+        rc = ps_worker.main([
+            "--addr", f"127.0.0.1:{frontend.port}",
+            "--conf", str(conf_path), "--data", str(data_path),
+            "--worker-id", "7", "--push-frequency", "2"])
+    finally:
+        frontend.stop()
+
+    assert rc == 0
+    assert not data_path.exists()  # shard file removed in finally
+    assert srv.pushes >= 1
+    stats = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert stats["steps"] == 4 and stats["exit_reason"] == "done"
+    exits = [e for e in global_recorder().snapshot()
+             if e["kind"] == "worker_exit" and e.get("worker") == "7"]
+    assert exits and exits[-1]["reason"] == "done"
+
+
+def test_ps_worker_main_rejects_ambiguous_modes(tmp_path):
+    from deeplearning4j_tpu.parallel import ps_worker
+
+    with pytest.raises(SystemExit):
+        ps_worker.main(["--addr", "127.0.0.1:1", "--conf", "c.json"])
+    with pytest.raises(SystemExit):
+        ps_worker.main(["--addr", "127.0.0.1:1", "--conf", "c.json",
+                        "--data", "d.npz", "--broker", "127.0.0.1:2",
+                        "--topic", "t", "--group", "g"])
+    with pytest.raises(SystemExit):  # broker mode needs topic+group
+        ps_worker.main(["--addr", "127.0.0.1:1", "--conf", "c.json",
+                        "--broker", "127.0.0.1:2"])
+
+
+# ------------------------------------------------------------ restore-on-join
+
+def test_maybe_restore_only_from_committed_sidecar(tmp_path):
+    from deeplearning4j_tpu.utils.sharded_checkpoint import save_sharded
+
+    src = _net(seed=7)
+    for _ in range(3):
+        src.fit(np.ones((4, 4), np.float32),
+                np.eye(3, dtype=np.float32)[[0, 1, 2, 0]])
+    ckpt = tmp_path / "ckpt"
+    save_sharded(str(ckpt), src)
+
+    fresh = _net(seed=99)
+    trainer = ElasticTrainer(fresh, checkpoint_dir=str(ckpt))
+    trainer._maybe_restore()
+    assert trainer.restored_from_checkpoint
+    np.testing.assert_allclose(np.asarray(fresh.params_list[0]["W"]),
+                               np.asarray(src.params_list[0]["W"]))
+
+    # a torn save (sidecar missing) is ignored by contract
+    os.unlink(ckpt / "meta.json")
+    t2 = ElasticTrainer(_net(seed=99), checkpoint_dir=str(ckpt))
+    t2._maybe_restore()
+    assert not t2.restored_from_checkpoint
+
+
+# ------------------------------------------------------------- observability
+
+def test_elastic_metric_names_registered():
+    for name in (_n.ELASTIC_LIVE_WORKERS, _n.ELASTIC_LEASE_EXPIRIES_TOTAL,
+                 _n.ELASTIC_FENCED_PUSHES_TOTAL, _n.ELASTIC_HANDOFFS_TOTAL,
+                 _n.ELASTIC_JOINS_TOTAL):
+        assert name in _n.ALL_METRIC_NAMES
+        assert name.startswith("dl4j_elastic_")
+
+
+def test_cli_elastic_train_parser():
+    from deeplearning4j_tpu.cli import build_parser
+
+    args = build_parser().parse_args(
+        ["elastic-train", "--model", "m.zip", "--workers", "3",
+         "--lease-timeout", "7.5", "--no-respawn"])
+    assert args.workers == 3
+    assert args.lease_timeout == 7.5
+    assert args.no_respawn
+
+
+def test_builder_validates_compression():
+    with pytest.raises(ValueError, match="compression"):
+        ElasticTrainer(_net(), compression="zstd")
+
+
+# ----------------------------------------------------------- multi-process
+
+@pytest.mark.slow
+def test_chaos_sigkill_respawn_loss_parity():
+    """SIGKILL one of two workers mid-fit: the shard hands off, the
+    replacement resumes at the committed offset, and the final loss stays
+    within parity of an uninterrupted single-process fit at equal consumed
+    samples. Acceptance: broker offsets account for every batch — no
+    sample window is silently dropped."""
+    rng = np.random.default_rng(0)
+    means = rng.normal(0.0, 1.0, (3, 4)).astype(np.float32)
+    data = []
+    for _ in range(24):
+        lab = rng.integers(0, 3, 16)
+        x = (means[lab] + rng.normal(0, 0.5, (16, 4))).astype(np.float32)
+        noisy = np.where(rng.random(16) < 0.25, rng.integers(0, 3, 16), lab)
+        data.append(DataSet(x, np.eye(3, dtype=np.float32)[noisy]))
+    gx = np.concatenate([d.features for d in data])
+    gy = np.concatenate([d.labels for d in data])
+
+    base = _net()
+    oracle_net = base.clone()
+    for ds in data:
+        oracle_net.fit(ds.features, ds.labels)
+    sync_loss = float(oracle_net.score(gx, gy))
+
+    elastic_net = base.clone()
+    trainer = (ElasticTrainer.builder(elastic_net)
+               .workers(2).push_frequency(2)
+               .lease_timeout(10.0).respawn(True)
+               .worker_delays(0.05, 0.05)
+               .fit_timeout(240.0).build())
+
+    killed = threading.Event()
+
+    def _assassin():
+        # wait for real progress (both workers up and pushing), then
+        # SIGKILL shard 0's worker mid-shard
+        deadline = time.monotonic() + 120.0
+        while time.monotonic() < deadline:
+            if trainer.server is not None and trainer.server.version >= 2:
+                if trainer.chaos_kill(0):
+                    killed.set()
+                return
+            time.sleep(0.05)
+
+    t = threading.Thread(target=_assassin, daemon=True)
+    t.start()
+    trainer.fit(ListDataSetIterator(data))
+    t.join(timeout=5.0)
+
+    assert killed.is_set(), "chaos kill never fired: fixture too fast"
+    assert trainer.handoffs >= 1
+    assert trainer.published == 24
+    # the no-silent-drop proof: every shard's group committed through its
+    # fin marker — each batch was consumed (and pushed) at least once
+    for sc in trainer.shard_commits:
+        assert sc["committed"] >= sc["fin"] >= 0, sc
+    st = trainer.stats
+    assert st["joins"] == 2 + trainer.handoffs
+    assert st["fenced"] == 0  # SIGKILL leaves no zombie to fence
+
+    elastic_loss = float(elastic_net.score(gx, gy))
+    assert abs(elastic_loss / sync_loss - 1.0) < 0.15, \
+        f"elastic {elastic_loss:.4f} vs sync {sync_loss:.4f}"
+    assert elastic_loss < 1.0986  # better than uniform ln(3)
