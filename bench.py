@@ -1036,7 +1036,72 @@ class _StragglerIterator:
             yield ds
 
 
-def bench_ps_async(batch, iters, ksteps, ps_workers=None, ps_straggler=None):
+def _transport_push_ab(base_params, workers: int, rounds: int = 60) -> dict:
+    """Push-window throughput twin for the host data plane (ISSUE 14): W
+    concurrent workers hammering pull+push rounds of the flat LeNet param
+    vector through a real TCP frontend, once over plain TCP frames and once
+    over the shared-memory rings. Same server code, same arithmetic — the
+    ratio is pure byte-plane cost. Staleness cap is effectively off so
+    every push applies (throughput, not convergence, is under test)."""
+    import threading
+
+    from deeplearning4j_tpu.parallel import ps_transport as pst
+    from deeplearning4j_tpu.parallel.param_server import (ParameterServer,
+                                                          flatten_tree)
+
+    flat, _ = flatten_tree(base_params)
+    delta = np.zeros_like(flat)
+
+    def run(kind: str):
+        srv = ParameterServer([flat.copy()], staleness_cap=1 << 40)
+        fe = pst.ParameterServerTcpFrontend(srv).start()
+        cls = pst.ShmTransport if kind == "shm" else pst.TcpTransport
+        transports = [cls(("127.0.0.1", fe.port)) for _ in range(workers)]
+        try:
+            for t in transports:
+                t.pull()  # connect (and for shm: negotiate) untimed
+            if kind == "shm" and not all(
+                    t.shm_active for t in transports):
+                return None  # negotiation refused (no /dev/shm): no number
+            barrier = threading.Barrier(workers + 1)
+
+            def work(t):
+                v, _ = t.pull()
+                barrier.wait()
+                for _ in range(rounds):
+                    v = t.push(delta, v).version
+                barrier.wait()
+
+            threads = [threading.Thread(target=work, args=(t,), daemon=True)
+                       for t in transports]
+            for th in threads:
+                th.start()
+            barrier.wait()
+            t0 = time.perf_counter()
+            barrier.wait()
+            dt = time.perf_counter() - t0
+            for th in threads:
+                th.join(timeout=10.0)
+            return workers * rounds / dt
+        finally:
+            for t in transports:
+                t.close()
+            fe.stop()
+
+    tcp = run("tcp")
+    shm = run("shm")
+    return {
+        "push_ab_workers": workers,
+        "push_ab_rounds": rounds,
+        "push_ab_param_bytes": int(flat.nbytes),
+        "tcp_push_windows_per_sec": round(tcp, 1) if tcp else None,
+        "shm_push_windows_per_sec": round(shm, 1) if shm else None,
+        "shm_push_speedup": (round(shm / tcp, 3) if (tcp and shm) else None),
+    }
+
+
+def bench_ps_async(batch, iters, ksteps, ps_workers=None, ps_straggler=None,
+                   ps_transport=None):
     """Straggler A/B: async parameter server vs the sync-DP barrier
     (ISSUE 10 headline). CPU-measured by design, like serve: the win is
     host-side orchestration (no per-step barrier), not MXU width — the
@@ -1053,6 +1118,13 @@ def bench_ps_async(batch, iters, ksteps, ps_workers=None, ps_straggler=None):
     the same LeNet on the same batches — 2 epochs each, so parity is
     measured at the label-noise plateau both paths converge to (comparing
     mid-descent would measure descent speed, not fidelity).
+
+    ISSUE 14 adds the host-data-plane section: ``ps_transport`` picks the
+    wire the phase-B workers ride ("tcp" frames or the "shm" rings), and
+    every record carries the W-worker push-window throughput twin
+    (``tcp_push_windows_per_sec`` / ``shm_push_windows_per_sec`` /
+    ``shm_push_speedup``) so one row proves what the shared-memory plane
+    buys at this worker count.
     """
     import jax
 
@@ -1067,6 +1139,7 @@ def bench_ps_async(batch, iters, ksteps, ps_workers=None, ps_straggler=None):
 
     W = int(ps_workers or 4)
     k = float(ps_straggler or 4.0)
+    transport = ps_transport or "tcp"
     delay_s = 0.02  # median per-step worker delay; straggler sleeps k*this
     push_frequency, staleness_cap = 4, 8
     n_batches = iters * ksteps
@@ -1120,7 +1193,7 @@ def bench_ps_async(batch, iters, ksteps, ps_workers=None, ps_straggler=None):
     # parity gap down (measured: 2.8% at pf=2 vs 4.6% at pf=4)
     tcp = (ParameterServerParallelWrapper.builder(tcp_net)
            .workers(2).push_frequency(2)
-           .staleness(staleness_cap).transport("tcp")
+           .staleness(staleness_cap).transport(transport)
            .compression("bf16").build())
     t0 = time.perf_counter()
     tcp.fit(ListDataSetIterator(data), epochs=2)
@@ -1146,8 +1219,10 @@ def bench_ps_async(batch, iters, ksteps, ps_workers=None, ps_straggler=None):
         "tcp_async_loss": tcp_loss, "sync_dp_loss": sync_dp_loss,
         "tcp_loss_gap": abs(tcp_loss / sync_dp_loss - 1.0),
         "tcp_worker_stats": tcp.worker_stats,
+        "ps_transport": transport,
         "batch": batch, "iters": iters, "ksteps": ksteps,
         "api": "parallel.ParameterServerParallelWrapper",
+        **_transport_push_ab(base.params_list, W),
     }
     _append_ps_ab("ps_async", r)
     return r
@@ -1170,7 +1245,7 @@ def _append_ps_ab(model: str, record: dict) -> None:
 
 
 def bench_elastic(batch, iters, ksteps, elastic_workers=None,
-                  elastic_kill=None):
+                  elastic_kill=None, ps_transport=None):
     """Worker-kill A/B on the elastic trainer (ISSUE 13 headline):
     SIGKILL one of W separate-process workers mid-fit and measure the
     throughput dip plus the recovery time back to 90% of the pre-kill
@@ -1196,6 +1271,7 @@ def bench_elastic(batch, iters, ksteps, elastic_workers=None,
 
     W = int(elastic_workers or 4)
     kill_frac = float(elastic_kill if elastic_kill is not None else 0.5)
+    transport = ps_transport or "tcp"
     push_frequency, delay_s = 4, 0.2
     n_batches = iters * ksteps
 
@@ -1223,6 +1299,7 @@ def bench_elastic(batch, iters, ksteps, elastic_workers=None,
                .workers(W).push_frequency(push_frequency)
                .staleness(8).lease_timeout(10.0)
                .respawn(True)
+               .transport(transport)
                .worker_delays(*([delay_s] * W))
                .fit_timeout(180.0).build())
 
@@ -1324,10 +1401,93 @@ def bench_elastic(batch, iters, ksteps, elastic_workers=None,
         "final_loss": float(net.score(
             np.concatenate([d.features for d in data]),
             np.concatenate([d.labels for d in data]))),
+        "ps_transport": transport,
         "batch": batch, "iters": iters, "ksteps": ksteps,
         "api": "parallel.ElasticTrainer",
     }
     _append_ps_ab("elastic", r)
+    return r
+
+
+def bench_ingest(batch, iters, ksteps, ingest_codec=None):
+    """Native vs python ingest-decode A/B (ISSUE 14): MB/s turning broker
+    frame payloads of raw record bytes into float32. ``batch`` is the
+    record size in KB (default 4 — sample-sized: a CIFAR image is 3 KB),
+    ``iters`` the timing repetitions (best-of wins: the number under test
+    is decoder bandwidth, not scheduler noise on a shared host); records
+    ride ~512 KB frames, ~128 MB total per rep.
+
+    This is the consumer-side seam the ISSUE names: the python path is
+    the per-record frombuffer/astype fallback — one GIL-bound numpy
+    round-trip per record, fixed cost dominating at sample-sized
+    records — while the native path decodes each frame's payload in ONE
+    fused off-GIL pass (the batched decoder) and splits records as
+    views. CPU-measured by design: host-side ingest, not MXU width.
+    """
+    from deeplearning4j_tpu import nativert
+
+    codec = ingest_codec or "u8"
+    record_kb = int(batch)
+    rec_bytes = record_kb * 1024
+    per_frame = max(1, (512 << 10) // rec_bytes)
+    frame_bytes = per_frame * rec_bytes
+    n_frames = max(1, (128 << 20) // frame_bytes)
+    total_mb = n_frames * frame_bytes / (1 << 20)
+
+    rng = np.random.default_rng(0)
+    if codec == "u8":
+        frames = [rng.integers(0, 256, frame_bytes,
+                               dtype=np.uint8).tobytes()
+                  for _ in range(n_frames)]
+    else:
+        width = nativert._INGEST_WIDTH[nativert.INGEST_CODECS[codec]]
+        n = frame_bytes // width
+        if codec == "bf16":
+            import ml_dtypes
+            payload = rng.standard_normal(n, dtype=np.float32).astype(
+                ml_dtypes.bfloat16).tobytes()
+        else:
+            payload = rng.standard_normal(n, dtype=np.float32).tobytes()
+        frames = [payload for _ in range(n_frames)]
+
+    def _py_run():
+        t0 = time.perf_counter()
+        for frame in frames:
+            v = memoryview(frame)
+            for i in range(per_frame):
+                nativert.decode_records_py(
+                    v[i * rec_bytes:(i + 1) * rec_bytes], codec)
+        return total_mb / (time.perf_counter() - t0)
+
+    def _native_run():
+        t0 = time.perf_counter()
+        for frame in frames:
+            out = nativert.decode_records(frame, codec)
+            np.split(out, per_frame)  # per-record views, no copy
+        return total_mb / (time.perf_counter() - t0)
+
+    native_ok = nativert.native_available()
+    py_mb = max(_py_run() for _ in range(iters))
+    native_mb = max(_native_run() for _ in range(iters)) if native_ok else None
+
+    r = {
+        "samples_per_sec": native_mb if native_mb is not None else py_mb,
+        "path": "native" if native_mb is not None else "python",
+        "record_kb": record_kb,
+        "records_per_frame": per_frame,
+        "frames": n_frames,
+        "total_mb": round(total_mb, 1),
+        "ingest_codec": codec,
+        "python_mb_per_sec": round(py_mb, 1),
+        "native_mb_per_sec": (round(native_mb, 1)
+                              if native_mb is not None else None),
+        "ingest_speedup": (round(native_mb / py_mb, 3)
+                           if native_mb is not None else None),
+        "native_available": native_ok,
+        "batch": batch, "iters": iters, "ksteps": ksteps,
+        "api": "nativert.decode_records",
+    }
+    _append_ps_ab("ingest", r)
     return r
 
 
@@ -1345,10 +1505,11 @@ _METRICS = {
     "serve": "serve_batched_requests_per_sec",
     "ps_async": "ps_async_samples_per_sec",
     "elastic": "elastic_ps_samples_per_sec",
+    "ingest": "native_ingest_decode_mb_per_sec",
 }
 
 #: models whose headline is not a training samples/sec number
-_UNITS = {"serve": "requests/sec"}
+_UNITS = {"serve": "requests/sec", "ingest": "MB/sec"}
 
 _DEFAULT_MODEL = "resnet50"  # the flagship; bare bench.py runs it
 
@@ -1366,6 +1527,7 @@ _DEFAULTS = {  # model -> (batch, iters, ksteps)
     "serve": (32, 3, 1),  # batch = serving max_batch, iters = seconds/phase
     "ps_async": (32, 48, 1),  # iters = total minibatches through each path
     "elastic": (32, 192, 1),  # iters = total minibatches across the fleet
+    "ingest": (4, 4, 1),  # batch = record KB, iters = timing reps
 }
 
 
@@ -1377,7 +1539,7 @@ def _bench_fns():
             "moe": bench_moe,
             "word2vec": bench_word2vec, "attention": bench_attention,
             "serve": bench_serve, "ps_async": bench_ps_async,
-            "elastic": bench_elastic}
+            "elastic": bench_elastic, "ingest": bench_ingest}
 
 
 #: per-model default dtype policy = the measured-best config on chip
@@ -1396,7 +1558,9 @@ _DTYPE_DEFAULT = {"lenet": "bf16", "fit_lenet": "bf16",
                   "ps_async": "f32",
                   # elastic measures membership/handoff orchestration on
                   # subprocess CPU workers: same reasoning as ps_async
-                  "elastic": "f32"}
+                  "elastic": "f32",
+                  # ingest decodes record bytes on the host: no matmuls
+                  "ingest": "f32"}
 
 
 def _dtype_mode(model: str, *, bf16_act: bool, bf16_matmul: bool,
@@ -1478,6 +1642,10 @@ def _child_main(args) -> None:
             kwargs["elastic_workers"] = args.elastic_workers
         if args.elastic_kill is not None:
             kwargs["elastic_kill"] = args.elastic_kill
+    if args.model in ("ps_async", "elastic") and args.ps_transport:
+        kwargs["ps_transport"] = args.ps_transport
+    if args.model == "ingest" and args.ingest_codec:
+        kwargs["ingest_codec"] = args.ingest_codec
     if getattr(args, "sharding", None):
         if args.model not in _SHARDING_CAPABLE:
             raise SystemExit(
@@ -1652,6 +1820,15 @@ def main() -> None:
                          "worker when this fraction of the expected push "
                          "windows has landed (config-distinct); default "
                          "0.5, 0 disables the kill")
+    ap.add_argument("--ps-transport", choices=("tcp", "shm"), default=None,
+                    help="ps_async/elastic bench PS byte plane: 'tcp' "
+                         "loopback frames or 'shm' shared-memory segments "
+                         "negotiated over the same socket (config-distinct); "
+                         "default tcp")
+    ap.add_argument("--ingest-codec", choices=("u8", "bf16", "f32"),
+                    default=None,
+                    help="ingest bench record codec for the native-vs-"
+                         "python decode A/B (config-distinct); default u8")
     ap.add_argument("--telemetry-out", default=None,
                     help="append a metrics-registry snapshot (JSONL) to this "
                          "file beside the headline JSON; measurement-only — "
@@ -1698,7 +1875,7 @@ def main() -> None:
     # so each replica gets a real mesh slice; every other model inherits
     # the env untouched
     child_env = None
-    if args.model in ("ps_async", "elastic") or (
+    if args.model in ("ps_async", "elastic", "ingest") or (
             args.model == "serve"
             and getattr(args, "serve_sharding", None) == "dp_tp"):
         child_env = os.environ.copy()
@@ -1877,6 +2054,11 @@ _SERVE_REPLICA_AXIS_LANDED_TS = "2026-08-06T00:00:00Z"
 #: 4-worker kill-at-50% recovery row
 _ELASTIC_AXIS_LANDED_TS = "2026-08-06T02:00:00Z"
 
+#: when the host data plane landed (ISSUE 14): rows before this predate
+#: --ps-transport (all PS traffic rode tcp frames) and the ingest model;
+#: a pre-plane tcp row must not stand in for today's shm capture
+_DATAPLANE_AXIS_LANDED_TS = "2026-08-06T06:00:00Z"
+
 
 def _config_key(args_str: str, ts: str = None) -> dict:
     """The fields that make two bench invocations the SAME config: model,
@@ -1959,6 +2141,15 @@ def _config_key(args_str: str, ts: str = None) -> dict:
         # must never stand in for the 4-worker kill-at-50% recovery row
         elastic_workers = val("--elastic-workers") or "4"
         elastic_kill = val("--elastic-kill") or "0.5"
+    ps_transport = ingest_codec = None
+    if model in ("ps_async", "elastic") and not (
+            ts is not None and ts < _DATAPLANE_AXIS_LANDED_TS):
+        # defaults are their own config: an shm capture must never stand
+        # in for the tcp baseline row (the A/B the headline compares)
+        ps_transport = val("--ps-transport") or "tcp"
+    if model == "ingest" and not (ts is not None
+                                  and ts < _DATAPLANE_AXIS_LANDED_TS):
+        ingest_codec = val("--ingest-codec") or "u8"
     return {"model": model, "batch": val("--batch"),
             "ksteps": val("--ksteps"), "dtype": mode, "rdtype": rdtype,
             "seq": val("--seq"), "vocab": val("--vocab"),
@@ -1970,7 +2161,8 @@ def _config_key(args_str: str, ts: str = None) -> dict:
             "serve_sharding": serve_sharding,
             "ps_workers": ps_workers, "ps_straggler": ps_straggler,
             "elastic_workers": elastic_workers,
-            "elastic_kill": elastic_kill}
+            "elastic_kill": elastic_kill,
+            "ps_transport": ps_transport, "ingest_codec": ingest_codec}
 
 
 def _last_healthy_from_log(args_str: str, path: str = None):

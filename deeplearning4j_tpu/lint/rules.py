@@ -807,6 +807,62 @@ class AdhocSharding(Rule):
                     "compile_seam.compile_step)")
 
 
+# ---------------------------------------------------------------------------
+@register
+class HotPathCopy(Rule):
+    """No full-buffer copies on the host data plane.
+
+    The wire codec and the shm transport exist so tensor bytes move as
+    memoryviews (``sendmsg`` scatter-gather, seqlock slot reads) — a single
+    ``.tobytes()`` or ``np.frombuffer(...).copy()`` on those paths silently
+    re-introduces the per-batch memcpy the whole plane was built to remove,
+    and it never shows up in ``dl4j_wire_copy_bytes_total`` because it
+    happens outside the billed fallbacks. Jurisdiction is the data plane
+    only: ``streaming/`` and ``parallel/ps_*``. Copies that are genuinely
+    required (a pull-slot vector that outlives the slot's reuse window)
+    suppress with the lifetime reason spelled out.
+    """
+
+    name = "hot-path-copy"
+    description = ("`.tobytes()` or `np.frombuffer(...).copy()` on the host "
+                   "data plane (streaming/ + parallel/ps_*) — keep tensor "
+                   "bytes as memoryviews")
+
+    _JURISDICTION = ("*/streaming/*.py", "*/parallel/ps_*.py")
+
+    def _in_jurisdiction(self, ctx: FileContext) -> bool:
+        paths = (ctx.rel, ctx.path.as_posix())
+        return any(fnmatch.fnmatch(p, pat)
+                   for p in paths for pat in self._JURISDICTION)
+
+    def check(self, ctx: FileContext) -> Iterator[Violation]:
+        tree = ctx.tree
+        if tree is None or not self._in_jurisdiction(ctx):
+            return
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call) or node.args or node.keywords:
+                continue
+            f = node.func
+            if not isinstance(f, ast.Attribute):
+                continue
+            if f.attr == "tobytes":
+                yield self.violation(
+                    ctx, node.lineno,
+                    ".tobytes() materialises a full copy — pass the "
+                    "memoryview (wire._byteview / pack_arrays) instead")
+            elif f.attr == "copy":
+                # only the precise np.frombuffer(...).copy() shape: copying
+                # a freshly-decoded view is the canonical accidental memcpy
+                v = f.value
+                if (isinstance(v, ast.Call)
+                        and (dotted_name(v.func) or "").endswith("frombuffer")):
+                    yield self.violation(
+                        ctx, node.lineno,
+                        "np.frombuffer(...).copy() defeats the zero-copy "
+                        "decode — keep the view, or suppress with the "
+                        "lifetime reason the copy is required")
+
+
 def default_rules() -> List[Rule]:
     """Fresh instances of every registered rule, in registration order."""
     return [cls() for cls in REGISTRY.values()]

@@ -50,7 +50,19 @@ def _build() -> bool:
              str(_SRC_PATH), "-o", str(_LIB_PATH)],
             check=True, capture_output=True, timeout=120)
         return _LIB_PATH.exists()
-    except (OSError, subprocess.SubprocessError):
+    except (OSError, subprocess.SubprocessError) as e:
+        # leave a post-mortem breadcrumb (worker_exit-style): a silent False
+        # here used to mean "mysteriously slow Python paths" with no trace
+        try:
+            from deeplearning4j_tpu.observability.flight_recorder import (
+                global_recorder)
+            stderr = getattr(e, "stderr", b"") or b""
+            global_recorder().record(
+                "native_build_failed", src=str(_SRC_PATH), error=repr(e),
+                stderr=stderr[-500:].decode("utf-8", "replace")
+                if isinstance(stderr, bytes) else str(stderr)[-500:])
+        except Exception:  # lint: swallowed-exception-ok (telemetry must not turn a degraded build into a crash)
+            pass
         return False
 
 
@@ -123,6 +135,18 @@ def _declare(lib: ctypes.CDLL) -> None:
                                      c_i64]
     lib.dl4j_vocab_close.argtypes = [ctypes.c_void_p]
 
+    lib.dl4j_ingest_decode.restype = c_i64
+    lib.dl4j_ingest_decode.argtypes = [c_u8p, c_i64, ctypes.c_int, c_f32p,
+                                       c_i64]
+    lib.dl4j_ingest_create.restype = ctypes.c_void_p
+    lib.dl4j_ingest_create.argtypes = [ctypes.c_int]
+    lib.dl4j_ingest_submit.restype = ctypes.c_int
+    lib.dl4j_ingest_submit.argtypes = [ctypes.c_void_p, c_u8p, c_i64,
+                                       ctypes.c_int]
+    lib.dl4j_ingest_next.restype = c_i64
+    lib.dl4j_ingest_next.argtypes = [ctypes.c_void_p, c_f32p, c_i64]
+    lib.dl4j_ingest_close.argtypes = [ctypes.c_void_p]
+
 
 def get_runtime() -> Optional[ctypes.CDLL]:
     """Load (building if needed) the native runtime; None when unavailable.
@@ -142,7 +166,7 @@ def get_runtime() -> Optional[ctypes.CDLL]:
         try:
             lib = ctypes.CDLL(str(_LIB_PATH))
             _declare(lib)
-            if lib.dl4j_runtime_version() != 3:
+            if lib.dl4j_runtime_version() != 4:
                 return None
             _lib = lib
         except (OSError, AttributeError):
@@ -343,6 +367,131 @@ def encode_stats_native(session_id: str, worker_id: str, timestamp: int,
     finally:
         if h:
             lib.dl4j_stats_abort(h)
+
+
+# ---------------------------------------------------------------------------
+# Batched ingest decode (zero-copy host data plane): raw record bytes -> f32.
+# ctypes releases the GIL for the whole native call, and IngestDecoder adds a
+# C++ producer thread so decode overlaps the training step (the
+# AsyncDataSetIterator role, on the consume side of the broker).
+# ---------------------------------------------------------------------------
+
+#: codec ids shared with dl4j_runtime.cpp (kIngestF32/Bf16/U8)
+INGEST_CODECS = {"f32": 0, "none": 0, "bf16": 1, "u8": 2}
+
+#: floats produced per input byte, by codec id
+_INGEST_WIDTH = {0: 4, 1: 2, 2: 1}  # bytes per element
+
+
+def _ingest_counter():
+    from deeplearning4j_tpu.observability.metrics import global_registry
+    from deeplearning4j_tpu.observability.names import (
+        INGEST_DECODE_BYTES_TOTAL)
+    return global_registry().counter(
+        INGEST_DECODE_BYTES_TOTAL,
+        "raw record bytes decoded to f32 batches, by path (native/python)")
+
+
+def decode_records_py(buf, codec: str = "f32") -> np.ndarray:
+    """Pure-Python fallback decoder (also the bench baseline): one record's
+    bytes -> f32 vector."""
+    cid = INGEST_CODECS[codec]
+    _ingest_counter().labels(path="python").inc(len(buf))
+    if cid == 0:
+        return np.frombuffer(buf, np.float32).copy()  # lint: hot-path-copy-ok (fallback path by definition; native is the hot path)
+    if cid == 1:
+        import ml_dtypes
+        return np.frombuffer(buf, ml_dtypes.bfloat16).astype(np.float32)
+    # multiply by the f32 reciprocal, exactly like the native decoder (and
+    # the native Loader's normalize path) — bitwise parity across paths
+    return (np.frombuffer(buf, np.uint8).astype(np.float32)
+            * np.float32(1.0 / 255.0))
+
+
+def decode_records(buf, codec: str = "f32") -> Optional[np.ndarray]:
+    """One-shot native decode of a record's bytes; None when the native
+    runtime is unavailable or the length is ragged for the codec (callers
+    fall back to ``decode_records_py``)."""
+    lib = get_runtime()
+    if lib is None:
+        return None
+    cid = INGEST_CODECS[codec]
+    raw = np.frombuffer(buf, np.uint8)  # lint: hot-path-copy-ok (view, no .copy(): zero-copy reinterpret of the input bytes)
+    n = len(raw) // _INGEST_WIDTH[cid]
+    out = np.empty(n, np.float32)
+    wrote = lib.dl4j_ingest_decode(
+        raw.ctypes.data_as(c_u8p), len(raw), cid,
+        out.ctypes.data_as(c_f32p), n)
+    if wrote != n:
+        return None
+    _ingest_counter().labels(path="native").inc(len(raw))
+    return out
+
+
+class IngestDecoder:
+    """Pipelined native decoder: ``submit()`` stages raw record bytes into a
+    bounded native queue, a C++ worker thread decodes them to f32, ``next()``
+    collects finished records in submission order.
+
+    The staging queue is BOUNDED: ``submit()`` blocks once ``capacity``
+    records are in flight, so interleave submits with ``next()`` when
+    streaming more than ``capacity`` records (the producer/consumer shape
+    DevicePrefetcher already has). Raises RuntimeError at construction when
+    the native runtime is unavailable — callers that want graceful
+    degradation use ``decode_records``/``decode_records_py``."""
+
+    def __init__(self, capacity: int = 8):
+        lib = get_runtime()
+        if lib is None:
+            raise RuntimeError("native runtime unavailable")
+        self._lib = lib
+        self._h = lib.dl4j_ingest_create(int(capacity))
+        if not self._h:
+            raise RuntimeError("native ingest creation failed")
+        self._sizes: List[int] = []  # FIFO of expected output lengths
+
+    def submit(self, buf, codec: str = "f32") -> None:
+        if not self._h:
+            raise ValueError("decoder is closed")
+        cid = INGEST_CODECS[codec]
+        raw = np.frombuffer(buf, np.uint8)  # lint: hot-path-copy-ok (view, no .copy(): the native side stages its own copy off-GIL)
+        if len(raw) % _INGEST_WIDTH[cid]:
+            raise ValueError(f"ragged record: {len(raw)} bytes is not a "
+                             f"whole number of {codec} elements")
+        rc = self._lib.dl4j_ingest_submit(
+            self._h, raw.ctypes.data_as(c_u8p), len(raw), cid)
+        if rc != 0:
+            raise RuntimeError("ingest pipeline poisoned by a bad record")
+        self._sizes.append(len(raw) // _INGEST_WIDTH[cid])
+        _ingest_counter().labels(path="native").inc(len(raw))
+
+    def next(self) -> Optional[np.ndarray]:
+        """Next decoded f32 record (submission order), or None when every
+        submitted record has been collected."""
+        if not self._h:
+            raise ValueError("decoder is closed")
+        if not self._sizes:
+            return None
+        n = self._sizes.pop(0)
+        out = np.empty(n, np.float32)
+        wrote = self._lib.dl4j_ingest_next(
+            self._h, out.ctypes.data_as(c_f32p), n)
+        if wrote != n:
+            raise RuntimeError(f"ingest decode returned {wrote}, "
+                               f"expected {n}")
+        return out
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.dl4j_ingest_close(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        # lint: swallowed-exception-ok (destructor must not raise during interpreter teardown)
+        except Exception:
+            pass
 
 
 # ---------------------------------------------------------------------------

@@ -117,6 +117,14 @@ ROUTE_ERRORS_TOTAL = "dl4j_route_errors_total"
 BROKER_MESSAGES_TOTAL = "dl4j_broker_messages_total"
 BROKER_RECONNECTS_TOTAL = "dl4j_broker_reconnects_total"
 
+# --- zero-copy host data plane (streaming/wire.py, parallel/ps_transport.py,
+# --- nativert ingest decode) ------------------------------------------------
+WIRE_COPY_BYTES_TOTAL = "dl4j_wire_copy_bytes_total"
+SHM_SEGMENTS = "dl4j_shm_segments"
+SHM_BYTES_TOTAL = "dl4j_shm_bytes_total"
+SHM_REAPED_TOTAL = "dl4j_shm_reaped_total"
+INGEST_DECODE_BYTES_TOTAL = "dl4j_ingest_decode_bytes_total"
+
 # --- input pipeline (datasets/prefetch.py) ---------------------------------
 PREFETCH_DEPTH = "dl4j_prefetch_depth"
 PREFETCH_BYTES_TOTAL = "dl4j_prefetch_bytes_total"

@@ -95,6 +95,7 @@ class ElasticTrainer:
     def __init__(self, model, workers: int = 2, push_frequency: int = 4,
                  staleness: int = DEFAULT_STALENESS_CAP,
                  compression: str = "none",
+                 transport: str = "tcp",
                  server_optimizer: str = "sgd", server_lr: float = 1.0,
                  lease_timeout_s: float = 15.0,
                  respawn: bool = True, max_handoffs_per_shard: int = 4,
@@ -105,11 +106,15 @@ class ElasticTrainer:
         if compression not in ("none", "bf16"):
             raise ValueError(f"unknown compression {compression!r}; "
                              "expected 'none' or 'bf16'")
+        if transport not in ("tcp", "shm"):
+            raise ValueError(f"unknown transport {transport!r}; "
+                             "expected 'tcp' or 'shm'")
         self.model = model
         self.workers = int(workers)
         self.push_frequency = max(1, push_frequency)
         self.staleness = int(staleness)
         self.compression = compression
+        self.transport = transport
         self.server_optimizer = server_optimizer
         self.server_lr = server_lr
         self.lease_timeout_s = float(lease_timeout_s)
@@ -148,6 +153,13 @@ class ElasticTrainer:
 
         def compression(self, codec: str):
             self._kw["compression"] = codec
+            return self
+
+        def transport(self, kind: str):
+            """"tcp" (framed sockets) or "shm" (tensor bytes in per-worker
+            shared-memory rings; control verbs stay on the socket;
+            auto-falls back to tcp frames when segments can't attach)."""
+            self._kw["transport"] = kind
             return self
 
         def server_optimizer(self, kind: str, lr: float = 1.0):
@@ -308,6 +320,7 @@ class ElasticTrainer:
                "--worker-name", shard.name,
                "--push-frequency", str(self.push_frequency),
                "--codec", self.compression,
+               "--ps-transport", self.transport,
                "--delay", str(self._delay(shard.shard))]
         with self._proc_lock:
             shard.proc = subprocess.Popen(

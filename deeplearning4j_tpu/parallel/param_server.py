@@ -296,14 +296,19 @@ class _BackgroundPuller:
     """Fetch fresh (version, params) on a daemon thread while the worker
     computes (DevicePrefetcher philosophy: the transfer hides behind the
     step). `latest()` is non-blocking; `request()` forces an immediate
-    fetch; between requests the thread keeps polling every
-    ``poll_interval_s`` so the buffer is never more than one interval old —
-    the pre-push rebase depends on that bound to keep staleness near 0."""
+    fetch (the wake event fires regardless of where the thread is in its
+    wait); between requests the thread polls at ``poll_interval_s``,
+    doubling the interval up to ``idle_backoff_cap_s`` while the server
+    version is NOT advancing — an idle fleet stops burning CPU on no-change
+    pulls — and snapping back to the base interval on any fresh version or
+    explicit request."""
 
     def __init__(self, pull_fn: Callable[[], Tuple[int, np.ndarray]],
-                 poll_interval_s: float = 0.05):
+                 poll_interval_s: float = 0.05,
+                 idle_backoff_cap_s: float = 0.8):
         self._pull = pull_fn
         self._interval = poll_interval_s
+        self._idle_cap = max(poll_interval_s, idle_backoff_cap_s)
         self._buf: Optional[Tuple[int, np.ndarray]] = None
         self._lock = threading.Lock()
         self._wake = threading.Event()
@@ -312,8 +317,10 @@ class _BackgroundPuller:
         self._thread.start()
 
     def _run(self) -> None:
+        wait = self._interval
+        last_version = -1
         while True:
-            self._wake.wait(self._interval)
+            requested = self._wake.wait(wait)
             self._wake.clear()
             if self._stop:
                 return
@@ -324,9 +331,17 @@ class _BackgroundPuller:
                 # falls back to its push-ack state; nothing to propagate
                 _flight_recorder().record("ps_bg_pull_error", error=str(e))
                 continue
+            fresh = got[0] > last_version
+            last_version = max(last_version, got[0])
             with self._lock:
                 if self._buf is None or got[0] > self._buf[0]:
                     self._buf = got
+            # exponential idle backoff: only stale no-request polls widen
+            # the interval; data or a request() resets it immediately
+            if requested or fresh:
+                wait = self._interval
+            else:
+                wait = min(wait * 2.0, self._idle_cap)
 
     def request(self) -> None:
         self._wake.set()
@@ -515,13 +530,13 @@ class ParameterServerParallelWrapper:
                  transport: str = "inproc",
                  server_optimizer: str = "sgd", server_lr: float = 1.0,
                  worker_delays: Optional[Sequence[float]] = None):
-        if transport not in ("inproc", "tcp"):
+        if transport not in ("inproc", "tcp", "shm"):
             raise ValueError(f"unknown transport {transport!r}; "
-                             "expected 'inproc' or 'tcp'")
+                             "expected 'inproc', 'tcp' or 'shm'")
         if compression not in ("none", "bf16"):
             raise ValueError(f"unknown compression {compression!r}; "
                              "expected 'none' or 'bf16'")
-        if transport == "tcp" and training_hooks:
+        if transport in ("tcp", "shm") and training_hooks:
             raise ValueError(
                 "training hooks run in the worker's interpreter; the tcp "
                 "transport trains in separate processes — use inproc")
@@ -570,8 +585,10 @@ class ParameterServerParallelWrapper:
             return self
 
         def transport(self, kind: str):
-            """"inproc" (worker threads) or "tcp" (worker processes over
-            loopback sockets)."""
+            """"inproc" (worker threads), "tcp" (worker processes over
+            loopback sockets), or "shm" (worker processes; tensor bytes in
+            shared-memory rings, control verbs on the socket — falls back
+            to tcp frames when segments can't attach)."""
             self._kw["transport"] = kind
             return self
 
@@ -599,7 +616,7 @@ class ParameterServerParallelWrapper:
         self.server = ParameterServer(
             self.model.params_list, staleness_cap=self.staleness,
             optimizer=self.server_optimizer, server_lr=self.server_lr)
-        if self.transport == "tcp":
+        if self.transport in ("tcp", "shm"):
             self._fit_tcp(iterator, epochs)
         else:
             self._fit_inproc(iterator, epochs)
@@ -680,9 +697,12 @@ class ParameterServerParallelWrapper:
     def _fit_tcp(self, iterator, epochs: int) -> None:
         """Separate-process workers over loopback TCP (the pattern proven by
         tests/test_distributed_multiprocess.py): the iterator's batches are
-        materialized, round-robin partitioned, and shipped to each worker as
-        an .npz; model config rides as JSON; workers pull initial params
-        from this process's server."""
+        materialized, round-robin partitioned, and shipped to each worker —
+        through a shared-memory segment on the "shm" transport (no
+        compression, no filesystem round-trip; npz tempfile fallback if the
+        host has no usable /dev/shm), as an .npz otherwise; model config
+        rides as JSON; workers pull initial params from this process's
+        server."""
         import json
         import os
         import subprocess
@@ -690,8 +710,7 @@ class ParameterServerParallelWrapper:
         import tempfile
 
         from deeplearning4j_tpu.nn.conf.serde import to_json
-        from deeplearning4j_tpu.parallel.ps_transport import (
-            ParameterServerTcpFrontend)
+        from deeplearning4j_tpu.parallel import ps_transport as _pst
 
         batches = []
         for _ in range(epochs):
@@ -700,8 +719,9 @@ class ParameterServerParallelWrapper:
             batches.extend(iterator)
         shards = [batches[i::self.workers] for i in range(self.workers)]
 
-        frontend = ParameterServerTcpFrontend(self.server).start()
+        frontend = _pst.ParameterServerTcpFrontend(self.server).start()
         procs = []
+        segments: List[str] = []
         try:
             with tempfile.TemporaryDirectory(prefix="dl4j_ps_") as tmp:
                 conf_path = os.path.join(tmp, "conf.json")
@@ -716,12 +736,22 @@ class ParameterServerParallelWrapper:
                 env["PYTHONPATH"] = (repo_root + os.pathsep
                                      + env.get("PYTHONPATH", ""))
                 for i, shard in enumerate(shards):
-                    data_path = os.path.join(tmp, f"worker{i}.npz")
-                    np.savez(data_path,
-                             x=np.stack([np.asarray(d.features)  # lint: host-sync-in-hot-loop-ok (one-time shard serialization before workers spawn, not a train loop)
-                                         for d in shard]),
-                             y=np.stack([np.asarray(d.labels)  # lint: host-sync-in-hot-loop-ok (one-time shard serialization before workers spawn, not a train loop)
-                                         for d in shard]))
+                    x = np.stack([np.asarray(d.features)  # lint: host-sync-in-hot-loop-ok (one-time shard serialization before workers spawn, not a train loop)
+                                  for d in shard])
+                    y = np.stack([np.asarray(d.labels)  # lint: host-sync-in-hot-loop-ok (one-time shard serialization before workers spawn, not a train loop)
+                                  for d in shard])
+                    data_path = None
+                    if self.transport == "shm":
+                        try:
+                            seg = _pst.write_shard_segment(
+                                {"x": x, "y": y}, kind=f"shard{i}")
+                            segments.append(seg)
+                            data_path = "shm://" + seg
+                        except OSError:
+                            data_path = None  # fall through to npz
+                    if data_path is None:
+                        data_path = os.path.join(tmp, f"worker{i}.npz")
+                        np.savez(data_path, x=x, y=y)
                     cmd = [sys.executable, "-m",
                            "deeplearning4j_tpu.parallel.ps_worker",
                            "--addr", f"127.0.0.1:{frontend.port}",
@@ -729,6 +759,7 @@ class ParameterServerParallelWrapper:
                            "--worker-id", str(i),
                            "--push-frequency", str(self.push_frequency),
                            "--codec", self.compression,
+                           "--ps-transport", self.transport,
                            "--delay", str(self._delay(i))]
                     procs.append(subprocess.Popen(
                         cmd, env=env, stdout=subprocess.PIPE,
@@ -747,3 +778,5 @@ class ParameterServerParallelWrapper:
                 if p.poll() is None:
                     p.kill()
             frontend.stop()
+            for seg in segments:
+                _pst.release_segment_by_name(seg)

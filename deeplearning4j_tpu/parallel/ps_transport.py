@@ -1,4 +1,4 @@
-"""Parameter-server transports: one API, two interchangeable backends.
+"""Parameter-server transports: one API, three interchangeable backends.
 
 The worker loop (param_server.run_worker_loop) only sees ``pull()`` and
 ``push(delta, base_version)``:
@@ -9,6 +9,16 @@ The worker loop (param_server.run_worker_loop) only sees ``pull()`` and
   length-prefixed framed messages (streaming/wire.py), workers in separate
   OS processes so the GIL cannot mask the async win. Pushed deltas may ride
   as bf16 (`codec="bf16"`); pull responses and the canonical store stay f32.
+* ``ShmTransport`` — the same-host fast path (ISSUE 14): control verbs stay
+  on the TCP wire, but tensor bytes live in per-worker double-buffered
+  ``multiprocessing.shared_memory`` segments with seqlock-style version
+  stamps. Negotiated over the ordinary TCP connection (``shm_open``); when
+  the segments cannot be attached (cross-host peer, old server) the
+  transport silently degrades to plain TCP frames.
+
+Every segment this process CREATES is registered in a reaper (atexit unlink
++ ``reap_orphans()`` scanning /dev/shm for segments whose creator pid is
+dead), so a SIGKILL'd fleet leaks nothing. Workers only ever *attach*.
 
 The reference's Aeron media driver + ParameterServerNode pair maps onto
 frontend + server object; replacing UDP with framed loopback TCP keeps the
@@ -16,10 +26,16 @@ protocol inspectable with nothing beyond the stdlib.
 """
 from __future__ import annotations
 
+import atexit
+import itertools
+import json
+import os
 import socket
+import struct
 import threading
 import time
-from typing import Optional, Tuple
+from multiprocessing import shared_memory
+from typing import Dict, Optional, Tuple
 
 import numpy as np
 
@@ -29,7 +45,9 @@ from deeplearning4j_tpu.observability.flight_recorder import (
 from deeplearning4j_tpu.observability.metrics import (
     global_registry as _obs_registry,
 )
-from deeplearning4j_tpu.observability.names import PS_WIRE_BYTES_TOTAL
+from deeplearning4j_tpu.observability.names import (
+    PS_WIRE_BYTES_TOTAL, SHM_BYTES_TOTAL, SHM_REAPED_TOTAL, SHM_SEGMENTS,
+)
 from deeplearning4j_tpu.observability.watchdog import beat as _wd_beat
 from deeplearning4j_tpu.parallel.param_server import (
     ParameterServer, PushResult,
@@ -38,6 +56,202 @@ from deeplearning4j_tpu.streaming import wire
 
 _wire_bytes = _obs_registry().counter(
     PS_WIRE_BYTES_TOTAL, "PS bytes on the wire, by op and codec")
+
+_shm_gauge = _obs_registry().gauge(
+    SHM_SEGMENTS, "shared-memory segments currently owned (created, not yet "
+                  "unlinked) by this process").labels()
+_shm_bytes = _obs_registry().counter(
+    SHM_BYTES_TOTAL, "tensor bytes staged through shared-memory segments, "
+                     "by direction")
+_shm_reaped = _obs_registry().counter(
+    SHM_REAPED_TOTAL, "orphaned dl4j shared-memory segments unlinked by "
+                      "reap_orphans (creator pid dead)").labels()
+
+
+# --------------------------------------------------------------------------
+# shared-memory segments: creation registry + reaper
+
+#: every segment name starts with this prefix followed by the CREATOR pid —
+#: reap_orphans() uses the pid to decide a segment is garbage
+_SHM_PREFIX = "dl4j_shm_"
+
+_shm_lock = threading.Lock()
+_shm_created: Dict[str, shared_memory.SharedMemory] = {}
+_shm_counter = itertools.count()
+
+
+def _pid_alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True  # alive, someone else's
+    return True
+
+
+def create_segment(nbytes: int, kind: str) -> shared_memory.SharedMemory:
+    """Create an owned segment named ``dl4j_shm_<pid>_<n>_<kind>`` and
+    register it for atexit unlink + orphan reaping."""
+    name = f"{_SHM_PREFIX}{os.getpid()}_{next(_shm_counter)}_{kind}"
+    shm = shared_memory.SharedMemory(name=name, create=True, size=nbytes)
+    with _shm_lock:
+        _shm_created[shm.name] = shm
+        _shm_gauge.set(len(_shm_created))
+    return shm
+
+
+def attach_segment(name: str) -> shared_memory.SharedMemory:
+    """Attach a peer's segment WITHOUT adopting it: Python 3.10's
+    resource_tracker registers every attach and would unlink the creator's
+    segment when this process exits — unregister immediately so ownership
+    stays with the creator (the reaper covers the crash cases)."""
+    shm = shared_memory.SharedMemory(name=name)
+    with _shm_lock:
+        own = name in _shm_created
+    if not own:  # same-process attach must keep the creator's registration
+        try:
+            from multiprocessing import resource_tracker
+            resource_tracker.unregister(getattr(shm, "_name", "/" + name),
+                                        "shared_memory")
+        except Exception:  # lint: swallowed-exception-ok (tracker internals vary by version; worst case is a benign warning at exit)
+            pass
+    return shm
+
+
+def release_segment(shm: shared_memory.SharedMemory,
+                    unlink: bool = False) -> None:
+    """Close (and for the owner: unlink) one segment. A BufferError on
+    close means a decoded view is still alive somewhere — the mapping is
+    dropped at GC/exit; the unlink (the part that prevents a leak) still
+    happens."""
+    try:
+        shm.close()
+    except BufferError:  # lint: swallowed-exception-ok (exported views pin the mmap; unlink below still removes the name)
+        pass
+    if unlink:
+        try:
+            shm.unlink()
+        except FileNotFoundError:  # lint: swallowed-exception-ok (already reaped by a peer or an earlier pass)
+            pass
+        with _shm_lock:
+            _shm_created.pop(shm.name, None)
+            _shm_gauge.set(len(_shm_created))
+
+
+def release_segment_by_name(name: str) -> bool:
+    """Unlink a segment this process created earlier (shard shipping hands
+    names, not handles, across the spawn boundary)."""
+    with _shm_lock:
+        shm = _shm_created.get(name)
+    if shm is None:
+        return False
+    release_segment(shm, unlink=True)
+    return True
+
+
+def _atexit_unlink_all() -> None:
+    with _shm_lock:
+        segs = list(_shm_created.values())
+    for shm in segs:
+        release_segment(shm, unlink=True)
+
+
+atexit.register(_atexit_unlink_all)
+
+
+def reap_orphans(shm_dir: str = "/dev/shm") -> int:
+    """Unlink every ``dl4j_shm_<pid>_*`` segment whose creator pid is dead
+    (SIGKILL skips atexit; the NEXT coordinator to start sweeps the corpse).
+    Returns the number reaped. No-op on hosts without a /dev/shm."""
+    try:
+        names = os.listdir(shm_dir)
+    except OSError:
+        return 0
+    reaped = 0
+    for name in names:
+        if not name.startswith(_SHM_PREFIX):
+            continue
+        try:
+            pid = int(name[len(_SHM_PREFIX):].split("_", 1)[0])
+        except ValueError:
+            continue
+        if pid == os.getpid() or _pid_alive(pid):
+            continue
+        try:
+            os.unlink(os.path.join(shm_dir, name))
+        except OSError:
+            continue
+        reaped += 1
+    if reaped:
+        _shm_reaped.inc(reaped)
+        _flight_recorder().record("shm_reaped", count=reaped)
+    return reaped
+
+
+# --------------------------------------------------------------------------
+# seqlock double buffer: the tensor lane of the shm transport
+
+class ShmRing:
+    """Two slots in one segment, each ``[seq, version, nbytes | data]``.
+
+    Single-writer seqlock protocol: the writer alternates slots, bumps the
+    slot's seq to ODD before touching data, writes, then publishes the even
+    seq + version + nbytes. A reader hands back a view ONLY when the stored
+    seq is even and matches the seq the control message promised — a torn
+    or stale slot raises instead of returning garbage. The control RPC that
+    carries (slot, seq) already sequences both sides, so the stamps are the
+    integrity check, not the synchronization.
+    """
+
+    SLOT_HDR = struct.Struct("!QQQ")  # seq, version, payload nbytes
+
+    def __init__(self, shm: shared_memory.SharedMemory, capacity: int,
+                 direction: str = "push"):
+        self.shm = shm
+        self.capacity = int(capacity)
+        self._next = 0
+        self._bytes = _shm_bytes.labels(direction=direction)
+
+    @classmethod
+    def segment_size(cls, capacity: int) -> int:
+        return 2 * (cls.SLOT_HDR.size + int(capacity))
+
+    def _base(self, slot: int) -> int:
+        return slot * (self.SLOT_HDR.size + self.capacity)
+
+    def write(self, view, version: int) -> Tuple[int, int]:
+        """Copy ``view`` (a byte view) into the next slot; returns
+        (slot, seq) for the control message. The one memcpy here IS the
+        transfer — nothing else touches these bytes."""
+        nbytes = view.nbytes if isinstance(view, memoryview) else len(view)
+        if nbytes > self.capacity:
+            raise ValueError(f"shm slot overflow: {nbytes} > "
+                             f"capacity {self.capacity}")
+        slot = self._next
+        self._next ^= 1
+        base = self._base(slot)
+        buf = self.shm.buf
+        seq = self.SLOT_HDR.unpack_from(buf, base)[0]
+        self.SLOT_HDR.pack_into(buf, base, seq + 1, int(version), nbytes)
+        data = base + self.SLOT_HDR.size
+        buf[data:data + nbytes] = view
+        self.SLOT_HDR.pack_into(buf, base, seq + 2, int(version), nbytes)
+        self._bytes.inc(nbytes)
+        return slot, seq + 2
+
+    def read(self, slot: int, seq: int) -> Tuple[int, memoryview]:
+        """-> (version, data view). The view aliases the slot: consume it
+        (or copy) before the writer's NEXT write to this slot."""
+        base = self._base(int(slot))
+        got, version, nbytes = self.SLOT_HDR.unpack_from(self.shm.buf, base)
+        if got != seq or got % 2:
+            raise ConnectionError(
+                f"shm seqlock mismatch: slot {slot} has seq {got}, control "
+                f"message promised {seq}" + (" (torn write)" if got % 2
+                                             else ""))
+        data = base + self.SLOT_HDR.size
+        return version, self.shm.buf[data:data + nbytes]
 
 
 class TransportError(OSError):
@@ -143,15 +357,17 @@ class TcpTransport(Transport):
         self._retries = max(0, int(retries))
         self._backoff_s = backoff_s
         self._backoff_cap_s = backoff_cap_s
-        self._lock = threading.Lock()
+        # reentrant: ShmTransport's fallback calls super().pull()/push()
+        # while already holding the lock
+        self._lock = threading.RLock()
         self._sock: Optional[socket.socket] = None
         self._tx = _wire_bytes.labels(op="push", codec=codec)
         self._rx = _wire_bytes.labels(op="pull", codec="none")
 
     def clone(self) -> "TcpTransport":
-        t = TcpTransport(self._addr, self._codec, self._timeout,
-                         self._connect_timeout, self._retries,
-                         self._backoff_s, self._backoff_cap_s)
+        t = type(self)(self._addr, self._codec, self._timeout,
+                       self._connect_timeout, self._retries,
+                       self._backoff_s, self._backoff_cap_s)
         ident = self.member_identity
         if ident is not None:
             t.bind_member(*ident)
@@ -248,6 +464,159 @@ class TcpTransport(Transport):
             self._drop_sock()
 
 
+class ShmTransport(TcpTransport):
+    """Same-host fast path: tensor bytes ride per-worker shared-memory
+    rings, only control verbs (slot, seq, version, array meta) cross the
+    socket.
+
+    Negotiation happens over the ordinary TCP connection: the first
+    pull/push issues ``shm_open``; the server creates a (push ring, pull
+    ring) pair sized to the flat parameter vector, keyed by a session token
+    (NOT the connection — the inherited reconnect/retry machinery keeps
+    working across a dropped socket). If the open is refused (old server)
+    or the segments can't be attached (cross-host peer), the transport
+    records a flight breadcrumb and permanently degrades to the inherited
+    plain-TCP frames — same API, same results, just slower.
+
+    The client COPIES params out of the pull ring before returning: the
+    slot is reused two pulls later, while run_worker_loop still holds the
+    vector. That one copy replaces the socket read; the push direction is
+    fully zero-copy (the server consumes the delta view under its own lock
+    before replying)."""
+
+    def __init__(self, addr: Tuple[str, int], codec: str = "none",
+                 timeout: float = 60.0, connect_timeout: float = 5.0,
+                 retries: int = 3, backoff_s: float = 0.1,
+                 backoff_cap_s: float = 2.0):
+        super().__init__(addr, codec, timeout, connect_timeout, retries,
+                         backoff_s, backoff_cap_s)
+        self._token: Optional[str] = None
+        self._push_ring: Optional[ShmRing] = None
+        self._pull_ring: Optional[ShmRing] = None
+        self._shm_ok: Optional[bool] = None  # None = not yet negotiated
+
+    # ------------------------------------------------------------ negotiate
+    def _negotiate(self) -> bool:
+        """Caller holds self._lock. One attempt per transport lifetime:
+        either the rings attach or we are a TcpTransport from now on."""
+        if self._shm_ok is not None:
+            return self._shm_ok
+        push_seg = pull_seg = None
+        try:
+            reply, _, _ = self._rpc({"op": "shm_open", "pid": os.getpid()})
+            if not reply.get("ok"):
+                raise OSError(reply.get("error", "shm_open refused"))
+            push_seg = attach_segment(reply["push"])
+            pull_seg = attach_segment(reply["pull"])
+            cap = int(reply["capacity"])
+            self._push_ring = ShmRing(push_seg, cap, direction="push")
+            self._pull_ring = ShmRing(pull_seg, cap, direction="pull")
+            self._token = reply["token"]
+            self._shm_ok = True
+        except (RuntimeError, OSError, KeyError, ValueError) as e:
+            # RuntimeError = pre-shm server's "unknown PS op" error reply;
+            # OSError = segments not attachable (cross-host). Either way:
+            # the negotiated fallback IS the inherited TCP path.
+            for seg in (push_seg, pull_seg):
+                if seg is not None:
+                    release_segment(seg)
+            self._push_ring = self._pull_ring = None
+            self._shm_ok = False
+            _flight_recorder().record("ps_shm_fallback",
+                                      addr=str(self._addr), error=repr(e))
+        return self._shm_ok
+
+    @property
+    def shm_active(self) -> Optional[bool]:
+        return self._shm_ok
+
+    # ------------------------------------------------------------- core API
+    def pull(self) -> Tuple[int, np.ndarray]:
+        with self._lock:
+            if not self._negotiate():
+                return super().pull()
+            reply, _, _ = self._rpc({"op": "pull_shm", "token": self._token})
+            _, view = self._pull_ring.read(reply["slot"], reply["seq"])
+            vec = np.frombuffer(view, dtype=np.float32).copy()  # lint: hot-path-copy-ok (slot is reused two pulls later while the worker still holds this vec)
+        return reply["version"], vec
+
+    def push(self, delta: np.ndarray, base_version: int) -> PushResult:
+        meta, payload = wire.encode_array(
+            np.asarray(delta, np.float32), self._codec)
+        header = {"op": "push_shm", "base_version": int(base_version),
+                  "array": meta}
+        ident = self.member_identity
+        if ident is not None:
+            header["member"], header["epoch"] = ident
+        with self._lock:
+            if not self._negotiate():
+                return super().push(delta, base_version)
+            header["token"] = self._token
+            header["slot"], header["seq"] = self._push_ring.write(
+                payload, int(base_version))
+            reply, _, _ = self._rpc(header)
+            _, pview = self._pull_ring.read(reply["pslot"], reply["pseq"])
+            params = np.frombuffer(pview, dtype=np.float32).copy()  # lint: hot-path-copy-ok (same slot-reuse hazard as pull)
+        return PushResult(accepted=reply["accepted"],
+                          version=reply["version"],
+                          staleness=reply["staleness"],
+                          weight=reply["weight"], params=params,
+                          fenced=reply.get("fenced", False))
+
+    def close(self) -> None:
+        with self._lock:
+            for ring in (self._push_ring, self._pull_ring):
+                if ring is not None:
+                    release_segment(ring.shm)  # attach-side: close only
+            self._push_ring = self._pull_ring = None
+            self._shm_ok = None
+            self._token = None
+            self._drop_sock()
+
+
+# --------------------------------------------------------------------------
+# shard shipping: (x, y) batches through one segment instead of an npz
+# tempfile — no compression, no filesystem round-trip; the coordinator owns
+# (and unlinks) the segment, workers attach read-only.
+
+def write_shard_segment(arrays: Dict[str, np.ndarray], kind: str = "shard",
+                        ) -> str:
+    """Pack named arrays into a fresh owned segment:
+    ``!Q json_len | json metas | concatenated array bytes``. Returns the
+    segment name (ship it as ``shm://<name>``)."""
+    metas, views = wire.pack_arrays(arrays)
+    hdr = json.dumps(metas, separators=(",", ":")).encode("utf-8")
+    total = 8 + len(hdr) + sum(v.nbytes for v in views)
+    seg = create_segment(total, kind)
+    buf = seg.buf
+    struct.pack_into("!Q", buf, 0, len(hdr))
+    buf[8:8 + len(hdr)] = hdr
+    off = 8 + len(hdr)
+    for v in views:
+        buf[off:off + v.nbytes] = v
+        off += v.nbytes
+    _shm_bytes.labels(direction="shard").inc(total)
+    return seg.name
+
+
+def read_shard_segment(name: str) -> Dict[str, np.ndarray]:
+    """Attach + decode a shard segment. The returned arrays OWN their data
+    (the segment may be unlinked by the coordinator as soon as the worker
+    starts training), so this materializes — that is the batch load, not
+    the push hot path."""
+    shm = attach_segment(name)
+    try:
+        (hdr_len,) = struct.unpack_from("!Q", shm.buf, 0)
+        metas = json.loads(bytes(shm.buf[8:8 + hdr_len]).decode("utf-8"))
+        body = shm.buf[8 + hdr_len:]
+        out = {k: np.array(v) for k, v in
+               wire.unpack_arrays(metas, body).items()}
+        del body
+    finally:
+        release_segment(shm)
+    return out
+
+
 class ParameterServerTcpFrontend:
     """Serves one `ParameterServer` to TCP workers: accept loop + one thread
     per connection, framed request/reply. Beats the watchdog from the server
@@ -263,6 +632,10 @@ class ParameterServerTcpFrontend:
         self._threads: list = []
         self._conns: list = []
         self._lock = threading.Lock()
+        # shm sessions are keyed by token, NOT connection: a client that
+        # reconnects mid-run keeps its rings. Sessions die with stop().
+        self._shm_sessions: Dict[str, Tuple[ShmRing, ShmRing]] = {}
+        self._shm_next = itertools.count(1)
 
     @property
     def port(self) -> int:
@@ -300,14 +673,20 @@ class ParameterServerTcpFrontend:
             self._threads.append(t)
 
     def _serve_conn(self, conn: socket.socket, peer) -> None:
+        # one reusable receive buffer per connection: every op fully
+        # consumes its payload inside _handle (the push delta is applied
+        # under the server lock before the reply is built), so the next
+        # frame may overwrite it
+        rbuf = bytearray()
         with conn:
             while not self._stop.is_set():
                 try:
-                    header, payload = wire.recv_frame(conn)
+                    header, payload = wire.recv_frame(conn, rbuf)
                 except (ConnectionError, OSError):
                     return  # worker hung up (normal end of its run)
                 try:
                     reply, buf = self._handle(header, payload)
+                    payload = None  # drop the view so rbuf can grow in place
                 except Exception as e:
                     _flight_recorder().record("ps_server_error",
                                               peer=str(peer), error=repr(e))
@@ -352,7 +731,56 @@ class ParameterServerTcpFrontend:
             ok = oracle.deregister(header["member"], header["epoch"],
                                    reason=header.get("reason", "done"))
             return {"ok": ok}, b""
+        if op == "shm_open":
+            return self._shm_open(header), b""
+        if op == "pull_shm":
+            _, pull_ring = self._shm_session(header)
+            version, vec = self._server.pull_flat()
+            slot, seq = pull_ring.write(wire._byteview(vec), version)
+            return {"version": version, "slot": slot, "seq": seq}, b""
+        if op == "push_shm":
+            push_ring, pull_ring = self._shm_session(header)
+            _, dview = push_ring.read(header["slot"], header["seq"])
+            # zero-copy: the delta view aliases the client's push slot; it
+            # is fully consumed by push_delta (under the server lock)
+            # before this reply releases the client to write again
+            delta = wire.decode_array(header["array"], dview)
+            res = self._server.push_delta(
+                delta, header["base_version"],
+                member=header.get("member"), epoch=header.get("epoch"))
+            pslot, pseq = pull_ring.write(wire._byteview(res.params),
+                                          res.version)
+            return {"accepted": res.accepted, "version": res.version,
+                    "staleness": res.staleness, "weight": res.weight,
+                    "fenced": res.fenced, "pslot": pslot, "pseq": pseq}, b""
         raise ValueError(f"unknown PS op {op!r}")
+
+    # -------------------------------------------------------- shm sessions
+    def _shm_open(self, header: dict) -> dict:
+        reap_orphans()  # every new session sweeps dead fleets' segments
+        capacity = self._server.pull_flat()[1].nbytes
+        try:
+            push_seg = create_segment(ShmRing.segment_size(capacity), "push")
+            pull_seg = create_segment(ShmRing.segment_size(capacity), "pull")
+        except OSError as e:
+            return {"ok": False, "error": repr(e)}
+        with self._lock:
+            token = f"shm{next(self._shm_next)}"
+            self._shm_sessions[token] = (
+                ShmRing(push_seg, capacity, direction="push"),
+                ShmRing(pull_seg, capacity, direction="pull"))
+        _flight_recorder().record("ps_shm_open", token=token,
+                                  pid=header.get("pid"), capacity=capacity)
+        return {"ok": True, "token": token, "push": push_seg.name,
+                "pull": pull_seg.name, "capacity": capacity}
+
+    def _shm_session(self, header: dict) -> Tuple[ShmRing, ShmRing]:
+        with self._lock:
+            sess = self._shm_sessions.get(header.get("token"))
+        if sess is None:
+            raise ValueError(f"unknown shm token {header.get('token')!r} "
+                             "(server restarted? reopen the session)")
+        return sess
 
     def _require_membership(self, op: str):
         oracle = getattr(self._server, "membership", None)
@@ -374,6 +802,11 @@ class ParameterServerTcpFrontend:
                     pass
         for t in self._threads:
             t.join(timeout=5)
+        with self._lock:
+            sessions, self._shm_sessions = self._shm_sessions, {}
+        for push_ring, pull_ring in sessions.values():
+            release_segment(push_ring.shm, unlink=True)
+            release_segment(pull_ring.shm, unlink=True)
         _flight_recorder().record("ps_server_stop", port=self._port,
                                   version=self._server.version,
                                   pushes=self._server.pushes,

@@ -1,7 +1,10 @@
 """Out-of-process parameter-server worker.
 
 Static-shard mode (spawned by ``ParameterServerParallelWrapper``,
-transport="tcp") trains a pre-materialized .npz batch stack::
+transport="tcp"/"shm") trains a pre-materialized batch stack — an .npz
+path, or ``shm://<segment>`` when the coordinator shipped the shard
+through a shared-memory segment (``--ps-transport shm`` additionally moves
+the push/pull tensor bytes into shm rings)::
 
     python -m deeplearning4j_tpu.parallel.ps_worker \
         --addr 127.0.0.1:<port> --conf conf.json --data worker0.npz \
@@ -43,7 +46,12 @@ def _run_npz(args, net, step, transport):
     from deeplearning4j_tpu.datasets.dataset import DataSet
     from deeplearning4j_tpu.parallel.param_server import run_worker_loop
 
-    blob = np.load(args.data)
+    if args.data.startswith("shm://"):
+        from deeplearning4j_tpu.parallel.ps_transport import (
+            read_shard_segment)
+        blob = read_shard_segment(args.data[len("shm://"):])
+    else:
+        blob = np.load(args.data)
     batches = [DataSet(x, y) for x, y in zip(blob["x"], blob["y"])]
     it = iter(batches)
     return run_worker_loop(
@@ -163,6 +171,11 @@ def main(argv=None) -> int:
     ap.add_argument("--worker-id", type=int, default=0)
     ap.add_argument("--push-frequency", type=int, default=4)
     ap.add_argument("--codec", default="none", choices=("none", "bf16"))
+    ap.add_argument("--ps-transport", default="tcp",
+                    choices=("tcp", "shm"),
+                    help="shm = tensor bytes through shared-memory rings "
+                         "(negotiated; degrades to tcp frames if segments "
+                         "can't attach)")
     ap.add_argument("--delay", type=float, default=0.0,
                     help="straggler fault injection: sleep per step")
     args = ap.parse_args(argv)
@@ -180,12 +193,13 @@ def main(argv=None) -> int:
     from deeplearning4j_tpu.parallel.param_server import (
         StaleEpochFenced, make_compiled_worker_step)
     from deeplearning4j_tpu.parallel.ps_transport import (
-        TcpTransport, TransportError)
+        ShmTransport, TcpTransport, TransportError)
 
     def _cleanup_data() -> None:
         # the shard file is this worker's to delete: the parent only wrote
         # it for us, and a preempted pod's scratch must not accumulate
-        if args.data:
+        # (shm:// shards are the COORDINATOR's segments — it unlinks them)
+        if args.data and not args.data.startswith("shm://"):
             try:
                 os.unlink(args.data)
             except OSError:  # lint: swallowed-exception-ok (already removed, or parent tmpdir gone first)
@@ -197,7 +211,8 @@ def main(argv=None) -> int:
         conf = from_json(f.read())
     net = MultiLayerNetwork(conf).init()  # shapes only; params come from PS
 
-    transport = TcpTransport(_parse_addr(args.addr), codec=args.codec)
+    cls = ShmTransport if args.ps_transport == "shm" else TcpTransport
+    transport = cls(_parse_addr(args.addr), codec=args.codec)
     step = make_compiled_worker_step(net, transport="tcp")
     reason, rc, stats = "done", 0, None
     try:

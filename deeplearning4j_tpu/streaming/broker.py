@@ -234,10 +234,15 @@ class ReconnectingConsumer:
     """
 
     def __init__(self, addr: Tuple[str, int], topic: str,
-                 group: str = "default", reconnect_backoff_s: float = 0.05):
+                 group: str = "default", reconnect_backoff_s: float = 0.05,
+                 reconnect_backoff_cap_s: float = 1.0,
+                 native_decode: bool = False):
         self._addr = tuple(addr)
         self.topic, self.group = topic, group
         self._backoff = reconnect_backoff_s
+        self._backoff_cap = max(reconnect_backoff_s, reconnect_backoff_cap_s)
+        self._cur_backoff = reconnect_backoff_s
+        self._native_decode = native_decode
         self._sock: Optional[socket.socket] = None
         self._next: Optional[int] = None   # next offset to fetch
         self._delivered: Optional[int] = None  # offset awaiting task_done
@@ -289,12 +294,22 @@ class ReconnectingConsumer:
                 self._drop()
                 if time.time() >= deadline:
                     raise queue.Empty from None
-                time.sleep(self._backoff)
+                # exponential backoff to a cap while the broker stays down;
+                # reset to the base interval as soon as data flows again
+                time.sleep(min(self._cur_backoff,
+                               max(0.0, deadline - time.time())))
+                self._cur_backoff = min(self._cur_backoff * 2.0,
+                                        self._backoff_cap)
                 continue
             if reply.get("eof"):
                 raise queue.Empty
             meta = reply["meta"]
-            arrays = wire.unpack_arrays(meta.get("arrays", []), payload)
+            if self._native_decode:
+                arrays = _decode_arrays_native(meta.get("arrays", []),
+                                               payload)
+            else:
+                arrays = wire.unpack_arrays(meta.get("arrays", []), payload)
+            self._cur_backoff = self._backoff  # data flowed: reset backoff
             self._delivered = reply["offset"]
             self._last_delivered = reply["offset"]
             self._next = reply["offset"] + 1
@@ -345,6 +360,60 @@ class ReconnectingConsumer:
 
     def close(self) -> None:
         self._drop()
+
+
+def _decode_arrays_native(metas: List[dict], payload) -> Dict[str, np.ndarray]:
+    """Consumer-side decode through the native ingest decoder (off-GIL
+    bytes -> f32); any array the native path can't take (missing .so, exotic
+    dtype, ragged length) falls back to the pure-Python wire decode —
+    result parity is bitwise either way."""
+    from deeplearning4j_tpu import nativert as _nrt
+    view = wire._byteview(payload) if len(payload) else memoryview(b"")
+    out, off = {}, 0
+    for meta in metas:
+        n = meta["nbytes"]
+        chunk = view[off:off + n]
+        off += n
+        dec = None
+        if meta.get("dtype") == "float32":
+            codec = {"none": "f32", "bf16": "bf16"}.get(meta.get("codec"))
+            if codec is not None:
+                dec = _nrt.decode_records(chunk, codec)
+        if dec is None:
+            out[meta["name"]] = wire.decode_array(meta, chunk)
+        else:
+            out[meta["name"]] = dec.reshape(tuple(meta["shape"]))
+    return out
+
+
+class BrokerIngestSource:
+    """Iterable over a consumer subscription's array messages, shaped for
+    ``datasets.prefetch.DevicePrefetcher``: construct with
+    ``native_decode=True`` on the consumer and hand this to the prefetcher —
+    records then travel broker -> native off-GIL decode -> staged device
+    batch with the training step overlapping both. Iteration ends at a
+    ``fin``-marked message or after ``idle_timeout_s`` with no data."""
+
+    def __init__(self, consumer: "ReconnectingConsumer",
+                 idle_timeout_s: float = 5.0):
+        self._consumer = consumer
+        self._idle_timeout_s = float(idle_timeout_s)
+
+    def __iter__(self):
+        idle_deadline = time.time() + self._idle_timeout_s
+        while True:
+            try:
+                meta, arrays = self._consumer.get(timeout=0.25)
+            except queue.Empty:
+                if time.time() >= idle_deadline:
+                    return
+                continue
+            idle_deadline = time.time() + self._idle_timeout_s
+            if meta.get("fin"):
+                self._consumer.task_done()
+                return
+            self._consumer.task_done()
+            yield arrays
 
 
 class BrokerTrainingRoute(Route):

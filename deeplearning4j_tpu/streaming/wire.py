@@ -13,20 +13,48 @@ the header under "arrays", so a frame is self-describing. The optional
 ``bf16`` codec halves float32 wire bytes (round-to-nearest via ml_dtypes,
 which JAX already depends on) — used for pushed parameter deltas where a
 half-precision delta is within SGD noise; canonical server state stays f32.
+
+Zero-copy discipline (the host data plane, ISSUE 14): tensor bytes are
+handled as ``memoryview``s end to end. ``encode_array`` returns a view of
+the array's own buffer (the bf16 codec converts — that is arithmetic, not a
+copy bug — and returns a view of the converted array); ``pack_arrays``
+returns the views unjoined; ``send_frame`` scatter-gathers them through
+``socket.sendmsg``; ``recv_frame`` reads with ``recv_into`` — into a
+caller-provided reusable buffer when the call site can prove single-frame
+lifetime, else into one fresh ``bytearray`` whose views the decoded arrays
+keep alive. ``decode_array`` returns a read-only ``np.frombuffer`` view by
+default. Every byte that IS copied on this path (``copy=True`` decodes, the
+``sendmsg``-unavailable fallback) is counted in ``dl4j_wire_copy_bytes_total``
+— the counter staying flat under load is the proof the copies are gone.
 """
 from __future__ import annotations
 
 import json
 import socket
 import struct
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple, Union
 
 import numpy as np
+
+from deeplearning4j_tpu.observability.metrics import (
+    global_registry as _obs_registry,
+)
+from deeplearning4j_tpu.observability.names import WIRE_COPY_BYTES_TOTAL
 
 _PREFIX = struct.Struct("!II")
 
 #: codecs understood by encode_array/decode_array
 CODECS = ("none", "bf16")
+
+#: one buffer or a scatter-gather list of them (send_frame's payload type)
+Buffers = Union[bytes, bytearray, memoryview, Sequence[Union[bytes, bytearray, memoryview]]]
+
+_copy_bytes = _obs_registry().counter(
+    WIRE_COPY_BYTES_TOTAL,
+    "tensor bytes COPIED on the wire hot path, by site — flat under load "
+    "is the zero-copy proof; any growth names the regressing call site")
+_copy_decode = _copy_bytes.labels(site="decode")
+_copy_send = _copy_bytes.labels(site="send_fallback")
 
 
 def _bf16_dtype():
@@ -34,88 +62,153 @@ def _bf16_dtype():
     return ml_dtypes.bfloat16
 
 
-def encode_array(a: np.ndarray, codec: str = "none") -> Tuple[dict, bytes]:
-    """-> (metadata dict, payload bytes). ``bf16`` only compresses floating
-    arrays; integer arrays pass through unchanged (and say so in the meta)."""
+def _byteview(buf) -> memoryview:
+    """A flat unsigned-byte view of any buffer (ndarray, bytes, bytearray,
+    memoryview) without copying."""
+    v = buf if isinstance(buf, memoryview) else memoryview(buf)
+    return v if v.format == "B" and v.ndim == 1 else v.cast("B")
+
+
+def encode_array(a: np.ndarray, codec: str = "none",
+                 ) -> Tuple[dict, memoryview]:
+    """-> (metadata dict, payload view). The view aliases the (contiguous)
+    array's own buffer — the caller must not mutate ``a`` until the view has
+    been sent. ``bf16`` only compresses floating arrays; integer arrays pass
+    through unchanged (and say so in the meta)."""
+    shape = list(a.shape)  # before ascontiguousarray, which 1-d-ifies 0-dim
     a = np.ascontiguousarray(a)
     if codec == "bf16" and a.dtype.kind == "f":
-        buf = np.asarray(a, dtype=_bf16_dtype()).tobytes()
-        meta = {"dtype": str(a.dtype), "shape": list(a.shape),
-                "codec": "bf16"}
+        meta = {"dtype": str(a.dtype), "shape": shape, "codec": "bf16"}
+        # codec conversion, not a copy bug; the uint16 view is free (bf16
+        # ndarrays don't export the buffer protocol themselves)
+        a = np.asarray(a, dtype=_bf16_dtype()).view(np.uint16)
     elif codec in CODECS:
-        buf = a.tobytes()
-        meta = {"dtype": str(a.dtype), "shape": list(a.shape),
-                "codec": "none"}
+        meta = {"dtype": str(a.dtype), "shape": shape, "codec": "none"}
     else:
         raise ValueError(f"unknown wire codec {codec!r}; expected {CODECS}")
-    return meta, buf
+    return meta, _byteview(a.reshape(-1))  # flatten is a view (contiguous)
 
 
-def decode_array(meta: dict, buf: bytes) -> np.ndarray:
+def decode_array(meta: dict, buf, *, copy: bool = False) -> np.ndarray:
+    """Decode one array from its payload bytes/view.
+
+    Default is zero-copy: a read-only ``np.frombuffer`` view over ``buf``
+    (the bf16 codec widens to the recorded dtype — conversion, not a copy).
+    ``copy=True`` materializes a private writable array and bills the bytes
+    to ``dl4j_wire_copy_bytes_total{site="decode"}``.
+    """
     shape = tuple(meta["shape"])
     if meta["codec"] == "bf16":
         a = np.frombuffer(buf, dtype=_bf16_dtype()).astype(meta["dtype"])
     else:
-        a = np.frombuffer(buf, dtype=np.dtype(meta["dtype"])).copy()
+        a = np.frombuffer(buf, dtype=np.dtype(meta["dtype"]))  # lint: hot-path-copy-ok (view, no .copy(): the zero-copy decode itself)
+        if copy:
+            _copy_decode.inc(a.nbytes)
+            a = a.copy()
     return a.reshape(shape)
 
 
-def pack_arrays(arrays: Dict[str, np.ndarray],
-                codec: str = "none") -> Tuple[List[dict], bytes]:
-    """Concatenate named arrays into one payload + ordered metadata list."""
-    metas, chunks = [], []
+def pack_arrays(arrays: Dict[str, np.ndarray], codec: str = "none",
+                ) -> Tuple[List[dict], List[memoryview]]:
+    """Named arrays -> ordered metadata list + scatter-gather view list
+    (feed the list straight to ``send_frame``; nothing is joined)."""
+    metas, views = [], []
     for name, a in arrays.items():
         meta, buf = encode_array(np.asarray(a), codec)
         meta["name"] = name
-        meta["nbytes"] = len(buf)
+        meta["nbytes"] = buf.nbytes
         metas.append(meta)
-        chunks.append(buf)
-    return metas, b"".join(chunks)
+        views.append(buf)
+    return metas, views
 
 
-def unpack_arrays(metas: List[dict], payload: bytes) -> Dict[str, np.ndarray]:
+def unpack_arrays(metas: List[dict], payload) -> Dict[str, np.ndarray]:
+    """Inverse of pack_arrays; ``payload`` is the received frame payload
+    (bytes or view). Arrays are zero-copy views into it."""
+    view = _byteview(payload) if payload else memoryview(b"")
     out, off = {}, 0
     for meta in metas:
         n = meta["nbytes"]
-        out[meta["name"]] = decode_array(meta, payload[off:off + n])
+        out[meta["name"]] = decode_array(meta, view[off:off + n])
         off += n
     return out
 
 
 def send_frame(sock: socket.socket, header: dict,
-               payload: bytes = b"") -> int:
-    """Write one frame; returns bytes put on the wire."""
+               payload: Buffers = b"") -> int:
+    """Write one frame; returns bytes put on the wire. ``payload`` may be a
+    single buffer or a list of buffers — the scatter-gather path hands the
+    views to ``socket.sendmsg`` untouched (no join, no copy)."""
     hdr = json.dumps(header, separators=(",", ":")).encode("utf-8")
-    buf = _PREFIX.pack(len(hdr), len(payload)) + hdr + payload
-    sock.sendall(buf)
-    return len(buf)
+    bufs = payload if isinstance(payload, (list, tuple)) else [payload]
+    views = [_byteview(b) for b in bufs if len(b)]
+    payload_len = sum(v.nbytes for v in views)
+    prefix = _PREFIX.pack(len(hdr), payload_len)
+    total = len(prefix) + len(hdr) + payload_len
+    pending = [memoryview(prefix), memoryview(hdr)] + views
+    sendmsg = getattr(sock, "sendmsg", None)
+    if sendmsg is None:  # non-POSIX fallback: one joined copy, billed
+        _copy_send.inc(payload_len)
+        sock.sendall(b"".join(pending))
+        return total
+    while pending:
+        n = sendmsg(pending)
+        while pending and n >= pending[0].nbytes:
+            n -= pending[0].nbytes
+            pending.pop(0)
+        if pending and n:
+            pending[0] = pending[0][n:]
+    return total
 
 
-def _recv_exact(sock: socket.socket, n: int) -> bytes:
-    chunks = []
-    while n:
-        chunk = sock.recv(min(n, 1 << 20))
-        if not chunk:
+def _recv_into_exact(sock: socket.socket, view: memoryview) -> None:
+    while view.nbytes:
+        n = sock.recv_into(view, view.nbytes)
+        if not n:
             raise ConnectionError("peer closed mid-frame")
-        chunks.append(chunk)
-        n -= len(chunk)
-    return b"".join(chunks)
+        view = view[n:]
 
 
-def recv_frame(sock: socket.socket) -> Tuple[dict, bytes]:
-    """Read one frame; raises ConnectionError on EOF / truncated stream."""
-    hdr_len, payload_len = _PREFIX.unpack(_recv_exact(sock, _PREFIX.size))
-    header = json.loads(_recv_exact(sock, hdr_len).decode("utf-8"))
-    payload = _recv_exact(sock, payload_len) if payload_len else b""
-    return header, payload
+def recv_frame(sock: socket.socket, buffer: Optional[bytearray] = None,
+               ) -> Tuple[dict, memoryview]:
+    """Read one frame; raises ConnectionError on EOF / truncated stream.
+
+    Returns (header, payload view). Without ``buffer`` the payload lands in
+    one fresh bytearray per frame — safe to keep (decoded arrays hold the
+    view). With a reusable ``buffer`` (grown in place as needed) the NEXT
+    recv_frame on the same buffer overwrites it: only for call sites that
+    fully consume the payload before receiving again, e.g. the PS frontend
+    applying a delta under the server lock.
+    """
+    prefix = bytearray(_PREFIX.size)
+    _recv_into_exact(sock, memoryview(prefix))
+    hdr_len, payload_len = _PREFIX.unpack(prefix)
+    hdr = bytearray(hdr_len)
+    _recv_into_exact(sock, memoryview(hdr))
+    header = json.loads(hdr.decode("utf-8"))
+    if not payload_len:
+        return header, memoryview(b"")
+    if buffer is None:
+        buffer = bytearray(payload_len)
+    elif len(buffer) < payload_len:
+        try:
+            buffer.extend(bytes(payload_len - len(buffer)))
+        except BufferError:
+            # a prior frame's view is still alive: fresh allocation instead
+            # of corrupting it (reuse resumes once the caller drops the view)
+            buffer = bytearray(payload_len)
+    view = memoryview(buffer)[:payload_len]
+    _recv_into_exact(sock, view)
+    return header, view.toreadonly()
 
 
-def request(sock: socket.socket, header: dict,
-            payload: bytes = b"") -> Tuple[dict, bytes, int]:
+def request(sock: socket.socket, header: dict, payload: Buffers = b"",
+            buffer: Optional[bytearray] = None,
+            ) -> Tuple[dict, memoryview, int]:
     """One RPC round-trip: send a frame, read the reply frame.
     Returns (reply_header, reply_payload, bytes_sent)."""
     sent = send_frame(sock, header, payload)
-    reply, buf = recv_frame(sock)
+    reply, buf = recv_frame(sock, buffer)
     if "error" in reply:
         raise RuntimeError(f"peer error for op={header.get('op')!r}: "
                            f"{reply['error']}")

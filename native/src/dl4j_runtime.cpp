@@ -481,7 +481,176 @@ int64_t dl4j_stats_finish(void* h, uint8_t* out, int64_t cap) {
 
 void dl4j_stats_abort(void* h) { delete static_cast<StatsBuilder*>(h); }
 
-int dl4j_runtime_version(void) { return 3; }
+int dl4j_runtime_version(void) { return 4; }
+
+}  // extern "C"
+
+// ------------------------------------------------------------ ingest decode
+// Batched record decoder for the zero-copy host data plane: raw broker/wire
+// record bytes -> float32, either one synchronous call (ctypes releases the
+// GIL for its duration, so Python peers keep running) or a producer-thread
+// pipeline mirroring Loader (submit on the consumer thread, decode happens
+// on the worker, next() hands back finished records) so decode overlaps the
+// training step the way AsyncDataSetIterator overlapped fetch.
+namespace {
+
+// codec ids shared with nativert/__init__.py INGEST_CODECS
+constexpr int kIngestF32 = 0;   // passthrough
+constexpr int kIngestBf16 = 1;  // bf16 -> f32 (bits << 16)
+constexpr int kIngestU8 = 2;    // u8 -> f32 / 255
+
+// -1 on bad codec or a length that is not a whole number of elements
+int64_t ingest_decode_into(const uint8_t* src, int64_t nbytes, int codec,
+                           float* out) {
+  switch (codec) {
+    case kIngestF32: {
+      if (nbytes % 4) return -1;
+      std::memcpy(out, src, size_t(nbytes));
+      return nbytes / 4;
+    }
+    case kIngestBf16: {
+      if (nbytes % 2) return -1;
+      int64_t n = nbytes / 2;
+      for (int64_t i = 0; i < n; i++) {
+        uint32_t bits = uint32_t(src[2 * i] | (uint32_t(src[2 * i + 1]) << 8))
+                        << 16;
+        std::memcpy(out + i, &bits, 4);
+      }
+      return n;
+    }
+    case kIngestU8: {
+      const float scale = 1.0f / 255.0f;
+      for (int64_t i = 0; i < nbytes; i++) out[i] = float(src[i]) * scale;
+      return nbytes;
+    }
+    default:
+      return -1;
+  }
+}
+
+struct IngestRec {
+  std::vector<uint8_t> raw;
+  int codec = 0;
+};
+
+struct Ingest {
+  int capacity = 8;
+  std::deque<IngestRec> inbox;
+  std::deque<std::vector<float>> outbox;
+  std::mutex mu;
+  std::condition_variable cv_work, cv_done;
+  std::atomic<bool> stop{false};
+  bool bad = false;    // a submitted record failed to decode
+  int in_flight = 0;   // popped from inbox, not yet in outbox
+  std::thread worker;
+
+  ~Ingest() { shutdown(); }
+
+  void shutdown() {
+    {
+      std::lock_guard<std::mutex> l(mu);
+      stop.store(true);
+    }
+    cv_work.notify_all();
+    cv_done.notify_all();
+    if (worker.joinable()) worker.join();
+  }
+
+  void run_worker() {
+    while (true) {
+      IngestRec rec;
+      {
+        std::unique_lock<std::mutex> l(mu);
+        cv_work.wait(l, [this] { return stop.load() || !inbox.empty(); });
+        if (stop.load()) return;
+        rec = std::move(inbox.front());
+        inbox.pop_front();
+        in_flight++;
+      }
+      std::vector<float> dec;
+      int64_t n = -1;
+      size_t cap = rec.codec == kIngestU8 ? rec.raw.size()
+                   : rec.codec == kIngestBf16 ? rec.raw.size() / 2
+                                              : rec.raw.size() / 4;
+      dec.resize(cap);
+      n = ingest_decode_into(rec.raw.data(), int64_t(rec.raw.size()),
+                             rec.codec, dec.data());
+      std::lock_guard<std::mutex> l(mu);
+      in_flight--;
+      if (n < 0) {
+        bad = true;
+      } else {
+        dec.resize(size_t(n));
+        outbox.push_back(std::move(dec));
+      }
+      cv_done.notify_all();
+    }
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+// one-shot decode: floats written, or -1 on bad codec / ragged length /
+// insufficient cap. GIL-free for the whole call when invoked via ctypes.
+int64_t dl4j_ingest_decode(const uint8_t* src, int64_t nbytes, int codec,
+                           float* out, int64_t cap) {
+  int64_t need = codec == kIngestU8 ? nbytes
+                 : codec == kIngestBf16 ? nbytes / 2
+                                        : nbytes / 4;
+  if (need > cap) return -1;
+  return ingest_decode_into(src, nbytes, codec, out);
+}
+
+void* dl4j_ingest_create(int capacity) {
+  auto* g = new Ingest();
+  g->capacity = std::max(1, capacity);
+  g->worker = std::thread([g] { g->run_worker(); });
+  return g;
+}
+
+// 0 = queued; -1 = pipeline poisoned by an earlier bad record. Blocks only
+// when `capacity` records are already in flight (bounded staging).
+int dl4j_ingest_submit(void* h, const uint8_t* src, int64_t nbytes,
+                       int codec) {
+  auto* g = static_cast<Ingest*>(h);
+  IngestRec rec;
+  rec.raw.assign(src, src + nbytes);
+  rec.codec = codec;
+  std::unique_lock<std::mutex> l(g->mu);
+  g->cv_done.wait(l, [g] {
+    return g->stop.load() || g->bad ||
+           int(g->inbox.size() + g->outbox.size()) < g->capacity;
+  });
+  if (g->bad || g->stop.load()) return -1;
+  g->inbox.push_back(std::move(rec));
+  g->cv_work.notify_one();
+  return 0;
+}
+
+// floats written for the next finished record; 0 when nothing is in flight
+// (caller submitted everything and drained); -1 on poisoned pipeline or cap
+// too small for the record.
+int64_t dl4j_ingest_next(void* h, float* out, int64_t cap) {
+  auto* g = static_cast<Ingest*>(h);
+  std::unique_lock<std::mutex> l(g->mu);
+  g->cv_done.wait(l, [g] {
+    return g->stop.load() || g->bad || !g->outbox.empty() ||
+           (g->inbox.empty() && g->in_flight == 0);
+  });
+  if (g->bad) return -1;
+  if (g->outbox.empty()) return 0;  // drained (or stopping)
+  std::vector<float> dec = std::move(g->outbox.front());
+  g->outbox.pop_front();
+  g->cv_done.notify_all();
+  l.unlock();
+  if (int64_t(dec.size()) > cap) return -1;
+  std::memcpy(out, dec.data(), dec.size() * sizeof(float));
+  return int64_t(dec.size());
+}
+
+void dl4j_ingest_close(void* h) { delete static_cast<Ingest*>(h); }
 
 }  // extern "C"
 

@@ -38,6 +38,11 @@
 #                                 # broker shard handoff, the slow chaos
 #                                 # SIGKILL+respawn loss-parity run, bench
 #                                 # elastic-axis contract
+#   ./runtests.sh dataplane [args]  # zero-copy host data plane: wire codec
+#                                 # fuzz, shm seqlock rings, SIGKILL orphan
+#                                 # reaper, shm/tcp transport + fit parity,
+#                                 # native ingest decode parity, bench
+#                                 # dataplane-axis contract
 set -e
 cd "$(dirname "$0")"
 
@@ -115,6 +120,21 @@ if [ "${1-}" = "elastic" ]; then
   exec python -m pytest tests/test_elastic.py \
     tests/test_bench_contract.py::test_config_key_elastic_axes \
     tests/test_bench_contract.py::test_grid_row_elastic -q "$@"
+fi
+
+if [ "${1-}" = "dataplane" ]; then
+  shift
+  # includes the slow shm/tcp fit-parity run and the SIGKILL orphan-reaper
+  # chaos test; test_param_server/test_streaming_broker ride along because
+  # the shm transport and the native broker decode share their surfaces
+  PALLAS_AXON_POOL_IPS= \
+  JAX_PLATFORMS=cpu \
+  XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+  exec python -m pytest tests/test_dataplane.py \
+    tests/test_param_server.py \
+    tests/test_streaming_broker.py \
+    tests/test_bench_contract.py::test_config_key_dataplane_axes \
+    tests/test_bench_contract.py::test_grid_row_ingest -q "$@"
 fi
 
 if [ "${1-}" = "health" ]; then

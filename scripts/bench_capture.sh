@@ -90,6 +90,13 @@ run --model ps_async
 # the committed broker offset) ride the row; the same record also lands
 # in scripts/ps_ab.jsonl beside the ps_async straggler record
 run --model elastic
+# host-data-plane rows (ISSUE 14): the shm-transport push-window A/B rides
+# the ps_async row (tcp_/shm_push_windows_per_sec + shm_push_speedup — the
+# >=1.3x shm floor), and the ingest row A/Bs the batched off-GIL native
+# frame decode against the per-record GIL-bound python fallback at
+# sample-sized records; both records also land in scripts/ps_ab.jsonl
+run --model ps_async --ps-transport shm
+run --model ingest
 if [ "$MODE" = full ]; then
     run --model lenet
     run --model lenet --bf16-act

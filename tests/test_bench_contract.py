@@ -573,3 +573,56 @@ def test_grid_row_elastic():
     # respawn (~3s): the recovery-to-90% number must be measurable before
     # the surviving shards drain
     assert iters * ksteps >= 128
+
+
+def test_config_key_dataplane_axes():
+    """The host-data-plane axes (ISSUE 14) are config-distinct: an shm
+    capture must never stand in for the tcp baseline (the A/B the headline
+    compares), an f32 ingest row must never stand in for the default u8
+    one, other models don't grow phantom axes, and the ts-gate strips both
+    on rows that predate the plane — same pattern as the elastic axes."""
+    import bench
+
+    a = bench._config_key("--model ps_async")
+    b = bench._config_key("--model ps_async --ps-transport shm")
+    assert a != b and a["ps_transport"] == "tcp" \
+        and b["ps_transport"] == "shm"
+    e = bench._config_key("--model elastic --ps-transport shm")
+    assert e["ps_transport"] == "shm"
+    i = bench._config_key("--model ingest")
+    j = bench._config_key("--model ingest --ingest-codec f32")
+    assert i != j and i["ingest_codec"] == "u8" \
+        and j["ingest_codec"] == "f32"
+    # non-dataplane models don't grow phantom axes (ingest likewise never
+    # grows a transport axis: it exercises the decoder, not the PS)
+    r = bench._config_key("--model serve")
+    assert r["ps_transport"] is None and r["ingest_codec"] is None
+    assert i["ps_transport"] is None
+    # rows logged before the data plane landed cannot carry its axes
+    old = bench._config_key("--model ps_async --ps-transport shm",
+                            ts="2026-08-06T05:59:59Z")
+    new = bench._config_key("--model ps_async --ps-transport shm",
+                            ts="2026-08-06T06:00:01Z")
+    assert old["ps_transport"] is None and new["ps_transport"] == "shm"
+    ts = bench._DATAPLANE_AXIS_LANDED_TS
+    assert ts.endswith("Z") and ts > bench._ELASTIC_AXIS_LANDED_TS
+
+
+def test_grid_row_ingest():
+    """The ingest decode A/B is wired through the whole bench surface:
+    grid membership, MB/sec unit (it is a decoder-bandwidth row, not a
+    training row), f32 dtype default (no matmuls at all), and neither
+    profile- nor sharding-capable (it never enters the multistep
+    harness)."""
+    import bench
+
+    assert bench._METRICS["ingest"] == "native_ingest_decode_mb_per_sec"
+    assert "ingest" in bench._DEFAULTS and "ingest" in bench._bench_fns()
+    assert bench._UNITS["ingest"] == "MB/sec"
+    assert bench._DTYPE_DEFAULT["ingest"] == "f32"
+    assert "ingest" not in bench._PROFILE_CAPABLE
+    assert "ingest" not in bench._SHARDING_CAPABLE
+    batch, iters, ksteps = bench._DEFAULTS["ingest"]
+    # sample-sized records (the regime where the per-record GIL-bound
+    # fallback's fixed cost shows) and best-of reps for a stable bandwidth
+    assert batch <= 16 and iters >= 2
