@@ -59,10 +59,15 @@ def _xla_flops(jit_fn, *args) -> float:
     K=1..8. Callers that scan K steps per dispatch must multiply by K
     themselves. Round 2's recorded "0.3% MFU" for LeNet understated real
     utilization by exactly K for this reason.
+
+    Shares the tracker's ``cost_analysis_flops`` helper, which reads the
+    analysis off ``lower()`` WITHOUT a second ``compile()`` — the old
+    lower+compile-again path here double-compiled every flagship program
+    just to count its flops.
     """
-    cost = jit_fn.lower(*args).compile().cost_analysis()
-    cost = cost[0] if isinstance(cost, (list, tuple)) else cost
-    return max(0.0, float((cost or {}).get("flops", 0.0)))
+    from deeplearning4j_tpu.observability.compile_tracker import \
+        cost_analysis_flops
+    return max(0.0, cost_analysis_flops(jit_fn, *args))
 
 
 #: armed by _child_main when --xplane-attribution (or the first-healthy
@@ -825,7 +830,8 @@ def bench_fit_lenet(batch: int, iters: int, ksteps: int,
 
 def bench_serve(batch, iters, ksteps, serve_qps=None, serve_latency_ms=None,
                 serve_batching=None, serve_quant=None,
-                serve_replicas=None, serve_sharding=None):
+                serve_replicas=None, serve_sharding=None,
+                compile_cache=None):
     """Micro-batching A/B on the serving engine (ISSUE 9 headline).
 
     Unlike the fit benches this is fully CPU-measurable: the win is
@@ -855,6 +861,14 @@ def bench_serve(batch, iters, ksteps, serve_qps=None, serve_latency_ms=None,
     its own mesh slice (the parent driver forces an 8-device CPU host
     platform for sharded rows, like ps_async). Per-replica steady-state
     health is pinned by recompiles == bucket count PER replica.
+
+    Round 15 adds the TIME-TO-READY section: wall time of one full
+    registration with parallel AOT warmup over every micro-batch bucket up
+    to 16, cold (executable cache off — every bucket is an XLA compile)
+    vs warm (every bucket deserialized from the compile cache). The warm
+    number is what an elastic respawn or replica spawn actually pays; the
+    ``compile_cache`` axis picks which one is the row's headline
+    ``time_to_ready_s``.
     """
     import numpy as np
 
@@ -995,6 +1009,53 @@ def bench_serve(batch, iters, ksteps, serve_qps=None, serve_latency_ms=None,
         "replica_recompiles_match_buckets":
             rrec["recompiles_match_buckets"],
     }
+
+    # time-to-ready section: cold vs warm-start pin with full bucket
+    # warmup. Three pins against a fresh store: cache off (baseline XLA
+    # compiles), cache on (populates the store, untimed headline-wise),
+    # cache on again (the measured warm pin — every bucket resolves via
+    # deserialize_and_load, which is what a respawn/spawn pays).
+    import tempfile
+
+    ready_max_batch = 16
+    compile_cache = compile_cache or "on"
+
+    def _pin_once() -> float:
+        reg = ModelRegistry(warmup_max_batch=ready_max_batch)
+        fresh = MultiLayerNetwork(conf).init()
+        t0 = time.perf_counter()
+        reg.register("ready_mlp", fresh)
+        return time.perf_counter() - t0
+
+    def _with_cache(value, directory, fn):
+        saved = {k: os.environ.get(k)
+                 for k in ("DL4J_COMPILE_CACHE", "DL4J_COMPILE_CACHE_DIR")}
+        os.environ["DL4J_COMPILE_CACHE"] = value
+        os.environ["DL4J_COMPILE_CACHE_DIR"] = directory
+        try:
+            return fn()
+        finally:
+            for k, v in saved.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+
+    with tempfile.TemporaryDirectory(prefix="dl4j-xc-bench-") as xcdir:
+        cold_s = _with_cache("0", xcdir, _pin_once)
+        _with_cache("1", xcdir, _pin_once)   # populate the store
+        warm_s = _with_cache("1", xcdir, _pin_once)
+    ready = {
+        "compile_cache": compile_cache,
+        "warmup_max_batch": ready_max_batch,
+        "warmup_buckets": len(ModelRegistry.warmup_buckets(ready_max_batch)),
+        "time_to_ready_cold_s": round(cold_s, 4),
+        "time_to_ready_warm_s": round(warm_s, 4),
+        "time_to_ready_s": round(
+            cold_s if compile_cache == "off" else warm_s, 4),
+        "time_to_ready_speedup": (round(cold_s / warm_s, 2)
+                                  if warm_s > 0 else None),
+    }
     return {
         "samples_per_sec": batched["achieved_qps"],  # headline: batched QPS
         "offered_qps": qps,
@@ -1013,6 +1074,7 @@ def bench_serve(batch, iters, ksteps, serve_qps=None, serve_latency_ms=None,
         "serve_record": record_path,
         **decode,
         **replica_sec,
+        **ready,
         "api": "keras_server.InferenceServer /v1/predict + /v1/generate",
     }
 
@@ -1244,8 +1306,9 @@ def _append_ps_ab(model: str, record: dict) -> None:
         pass
 
 
-def bench_elastic(batch, iters, ksteps, elastic_workers=None,
-                  elastic_kill=None, ps_transport=None):
+def _bench_elastic_once(batch, iters, ksteps, elastic_workers=None,
+                        elastic_kill=None, ps_transport=None,
+                        compile_cache_label=None):
     """Worker-kill A/B on the elastic trainer (ISSUE 13 headline):
     SIGKILL one of W separate-process workers mid-fit and measure the
     throughput dip plus the recovery time back to 90% of the pre-kill
@@ -1275,9 +1338,7 @@ def bench_elastic(batch, iters, ksteps, elastic_workers=None,
     push_frequency, delay_s = 4, 0.2
     n_batches = iters * ksteps
 
-    # learnable 10-class cluster data on a small dense net: worker
-    # processes must start fast (the respawn latency IS part of the
-    # measured recovery), so no conv stack here
+    # learnable 10-class cluster data so the loss trend stays meaningful
     rng = np.random.default_rng(0)
     means = rng.normal(0.0, 1.0, (10, 64)).astype(np.float32)
     data = []
@@ -1286,12 +1347,23 @@ def bench_elastic(batch, iters, ksteps, elastic_workers=None,
         x = (means[lab] + rng.normal(0, 0.5, (batch, 64))).astype(np.float32)
         data.append(DataSet(x, np.eye(10, dtype=np.float32)[lab]))
 
-    conf = (NeuralNetConfiguration.builder()
-            .seed(12345).learning_rate(0.05).updater("sgd")
-            .list()
-            .layer(DenseLayer(n_in=64, n_out=32, activation="tanh"))
-            .layer(OutputLayer(n_in=32, n_out=10, loss="mcxent",
-                               activation="softmax"))
+    # the worker net is deliberately DEEP (46 dense layers, ~7ms/step):
+    # a respawned replacement's recovery is dominated by its cold XLA
+    # compile of the adam train step (~3s here, minutes for real models)
+    # — exactly the tax the round-15 executable cache removes, so the
+    # cold-vs-warm recovery A/B measures the mechanism and not the noise
+    # floor of a sub-300ms toy compile. He init + adam keep a stack this
+    # deep actually learning; no conv so process start itself stays fast
+    # (it is part of the measured recovery)
+    lb = (NeuralNetConfiguration.builder()
+          .seed(12345).learning_rate(0.001).updater("adam")
+          .weight_init("relu")
+          .list()
+          .layer(DenseLayer(n_in=64, n_out=128, activation="relu")))
+    for _ in range(44):
+        lb = lb.layer(DenseLayer(n_in=128, n_out=128, activation="relu"))
+    conf = (lb.layer(OutputLayer(n_in=128, n_out=10, loss="mcxent",
+                                 activation="softmax"))
             .build())
     net = MultiLayerNetwork(conf).init()
 
@@ -1402,10 +1474,62 @@ def bench_elastic(batch, iters, ksteps, elastic_workers=None,
             np.concatenate([d.features for d in data]),
             np.concatenate([d.labels for d in data]))),
         "ps_transport": transport,
+        "compile_cache": compile_cache_label,
         "batch": batch, "iters": iters, "ksteps": ksteps,
         "api": "parallel.ElasticTrainer",
     }
     _append_ps_ab("elastic", r)
+    return r
+
+
+def bench_elastic(batch, iters, ksteps, elastic_workers=None,
+                  elastic_kill=None, ps_transport=None, compile_cache=None):
+    """Elastic worker-kill A/B, compile-cache-aware (round 15).
+
+    The measured recovery window is compile-bound: the respawned worker
+    process pays a cold XLA compile of the train step before its first
+    push. With the executable cache on (the default), gen-0 workers
+    persist their step executables and the respawn warm-loads from disk
+    — so the run itself exercises the warm path. ``--compile-cache off``
+    measures only the cold world; the default runs BOTH (cold first, in
+    the same fresh store with the cache disabled) and reports the warm
+    run's numbers as the headline with ``recovery_seconds_cold`` riding
+    along for the A/B.
+    """
+    import tempfile
+
+    mode = compile_cache or "on"
+
+    def once(cache_on: str, directory: str, label: str):
+        saved = {k: os.environ.get(k)
+                 for k in ("DL4J_COMPILE_CACHE", "DL4J_COMPILE_CACHE_DIR")}
+        os.environ["DL4J_COMPILE_CACHE"] = cache_on
+        os.environ["DL4J_COMPILE_CACHE_DIR"] = directory
+        try:
+            return _bench_elastic_once(
+                batch, iters, ksteps, elastic_workers=elastic_workers,
+                elastic_kill=elastic_kill, ps_transport=ps_transport,
+                compile_cache_label=label)
+        finally:
+            for k, v in saved.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+
+    if mode == "off":
+        with tempfile.TemporaryDirectory(prefix="dl4j-xc-bench-") as d:
+            return once("0", d, "off")
+    with tempfile.TemporaryDirectory(prefix="dl4j-xc-bench-") as d:
+        cold = once("0", d, "off")
+        warm = once("1", d, "on")
+    r = dict(warm)
+    r["compile_cache"] = "on"
+    r["recovery_seconds_cold"] = cold["recovery_seconds"]
+    r["samples_per_sec_cold"] = cold["samples_per_sec"]
+    if warm.get("recovery_seconds") and cold.get("recovery_seconds"):
+        r["recovery_improvement"] = round(
+            1.0 - warm["recovery_seconds"] / cold["recovery_seconds"], 3)
     return r
 
 
@@ -1632,6 +1756,8 @@ def _child_main(args) -> None:
             kwargs["serve_replicas"] = args.serve_replicas
         if args.serve_sharding:
             kwargs["serve_sharding"] = args.serve_sharding
+        if args.compile_cache:
+            kwargs["compile_cache"] = args.compile_cache
     if args.model == "ps_async":
         if args.ps_workers:
             kwargs["ps_workers"] = args.ps_workers
@@ -1642,6 +1768,8 @@ def _child_main(args) -> None:
             kwargs["elastic_workers"] = args.elastic_workers
         if args.elastic_kill is not None:
             kwargs["elastic_kill"] = args.elastic_kill
+        if args.compile_cache:
+            kwargs["compile_cache"] = args.compile_cache
     if args.model in ("ps_async", "elastic") and args.ps_transport:
         kwargs["ps_transport"] = args.ps_transport
     if args.model == "ingest" and args.ingest_codec:
@@ -1820,6 +1948,12 @@ def main() -> None:
                          "worker when this fraction of the expected push "
                          "windows has landed (config-distinct); default "
                          "0.5, 0 disables the kill")
+    ap.add_argument("--compile-cache", choices=("on", "off"), default=None,
+                    help="serve/elastic: executable-cache mode for the "
+                         "warm-start sections. 'off' measures only the "
+                         "cold world (time_to_ready_s / recovery_seconds "
+                         "are cold numbers); the default 'on' reports the "
+                         "warm numbers with the cold A/B riding along")
     ap.add_argument("--ps-transport", choices=("tcp", "shm"), default=None,
                     help="ps_async/elastic bench PS byte plane: 'tcp' "
                          "loopback frames or 'shm' shared-memory segments "
@@ -2059,6 +2193,11 @@ _ELASTIC_AXIS_LANDED_TS = "2026-08-06T02:00:00Z"
 #: a pre-plane tcp row must not stand in for today's shm capture
 _DATAPLANE_AXIS_LANDED_TS = "2026-08-06T06:00:00Z"
 
+#: when the warm-start compile plane landed (ISSUE 15): rows before this
+#: predate --compile-cache and the time_to_ready / warm-recovery sections;
+#: an all-cold row must not stand in for today's warm-headline capture
+_COMPILE_CACHE_AXIS_LANDED_TS = "2026-08-06T10:00:00Z"
+
 
 def _config_key(args_str: str, ts: str = None) -> dict:
     """The fields that make two bench invocations the SAME config: model,
@@ -2150,6 +2289,12 @@ def _config_key(args_str: str, ts: str = None) -> dict:
     if model == "ingest" and not (ts is not None
                                   and ts < _DATAPLANE_AXIS_LANDED_TS):
         ingest_codec = val("--ingest-codec") or "u8"
+    compile_cache = None
+    if model in ("serve", "elastic") and not (
+            ts is not None and ts < _COMPILE_CACHE_AXIS_LANDED_TS):
+        # defaults are their own config: a cold-only --compile-cache off
+        # capture must never stand in for the warm-headline default row
+        compile_cache = val("--compile-cache") or "on"
     return {"model": model, "batch": val("--batch"),
             "ksteps": val("--ksteps"), "dtype": mode, "rdtype": rdtype,
             "seq": val("--seq"), "vocab": val("--vocab"),
@@ -2162,7 +2307,8 @@ def _config_key(args_str: str, ts: str = None) -> dict:
             "ps_workers": ps_workers, "ps_straggler": ps_straggler,
             "elastic_workers": elastic_workers,
             "elastic_kill": elastic_kill,
-            "ps_transport": ps_transport, "ingest_codec": ingest_codec}
+            "ps_transport": ps_transport, "ingest_codec": ingest_codec,
+            "compile_cache": compile_cache}
 
 
 def _last_healthy_from_log(args_str: str, path: str = None):

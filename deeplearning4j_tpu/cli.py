@@ -169,7 +169,8 @@ def _cmd_serve(args) -> int:
     srv = InferenceServer(
         host=args.host, port=args.port, replicas=args.replicas,
         sharding=args.sharding, max_batch=args.max_batch,
-        max_latency_s=args.max_latency_ms / 1e3, max_queue=args.max_queue)
+        max_latency_s=args.max_latency_ms / 1e3, max_queue=args.max_queue,
+        warmup=args.warmup)
     if srv.replica_set is not None:
         srv.replica_set.load(args.name, args.model, quant=args.quant)
     else:
@@ -286,6 +287,11 @@ def build_parser() -> argparse.ArgumentParser:
                     help="micro-batcher fill-or-deadline coalescing wait")
     sv.add_argument("--max-queue", type=int, default=256,
                     help="admission limit per replica (429 past it)")
+    sv.add_argument("--warmup", action="store_true",
+                    help="pre-build every micro-batch bucket program up to "
+                         "--max-batch (parallel, executable-cache-backed) "
+                         "before the model goes active, so the first real "
+                         "request never pays an XLA compile")
     sv.set_defaults(fn=_cmd_serve)
     return p
 

@@ -41,6 +41,7 @@ is measurable on the returned per-token distributions.
 """
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from collections import deque
@@ -80,6 +81,13 @@ from .admission import RejectedError
 DECODE_PROGRAM_NAME = "decode_step"
 
 DECODE_MODES = ("continuous", "static")
+
+#: occupancy fraction at which the engine starts background-compiling the
+#: NEXT capacity bucket (continuous mode; growth would otherwise compile
+#: synchronously mid-step the moment backlog arrives)
+_PREWARM_OCCUPANCY = 0.75
+
+log = logging.getLogger(__name__)
 
 
 def _copy_tree(tree):
@@ -323,6 +331,11 @@ class DecodeEngine:
         self._g_occupancy = m.gauge(
             _n.SERVE_SLOT_OCCUPANCY,
             "active decode slots / slot capacity of the last step")
+        self._h_growth_stall = m.histogram(
+            _n.SERVE_BUCKET_GROWTH_STALL_SECONDS,
+            "first-step dispatch time of each new capacity bucket (the "
+            "live-traffic stall growth causes; pre-warmed buckets show "
+            "steady-state step time here)")
         self._h_ttft = m.histogram(
             _n.SERVE_TTFT_SECONDS,
             "offered-arrival to first generated token")
@@ -347,6 +360,8 @@ class DecodeEngine:
         self._evicted = 0
         self._occupancy_sum = 0.0
         self._buckets: set = set()
+        #: capacity buckets a background pre-warm has been started for
+        self._warming: set = set()
         self._thread = threading.Thread(
             target=self._loop, name="serve-decode-engine", daemon=True)
         self._thread.start()
@@ -468,6 +483,7 @@ class DecodeEngine:
             fresh = jnp.asarray(self._fresh_h)
             positions = jnp.asarray(self._pos_h)
             blocks = self._blocks
+            growing = cap not in self._buckets
         t0 = time.perf_counter()
         try:
             next_tok, probs, new_blocks = self._step(
@@ -475,6 +491,12 @@ class DecodeEngine:
             next_h = np.asarray(next_tok)  # lint: host-sync-in-hot-loop-ok (the emitted token drives admission/eviction and feeds back as the next input; the sync IS the iteration boundary)
             probs_h = np.asarray(probs) if self.capture_probs else None
         except Exception as e:
+            if growing:
+                # evict-all is not the only signal a failed growth leaves:
+                # this event names the bucket that never came up
+                _flight_recorder().record(
+                    "decode_bucket_growth_failed", cap=cap, mode=self.mode,
+                    error=repr(e))
             _flight_recorder().dump(
                 reason="decode-step-error",
                 extra={"cap": cap, "mode": self.mode, "error": repr(e)})
@@ -483,12 +505,23 @@ class DecodeEngine:
                     self._evict_locked(i, "error")
             raise
         dt = time.perf_counter() - t0
+        if growing:
+            # first step at a new capacity: with a cold cache this dispatch
+            # carries the XLA compile (the stall); warm it is step-sized
+            self._h_growth_stall.labels(bucket=str(cap)).observe(dt)
         now = time.perf_counter()
+        prewarm_cap = None
         with self._cond:
             self._blocks = new_blocks
             self._steps += 1
             self._buckets.add(cap)
             occupancy = len(active) / cap
+            if (self.mode == "continuous" and cap < self.max_slots
+                    and occupancy >= _PREWARM_OCCUPANCY):
+                nxt = min(cap * 2, self.max_slots)
+                if nxt not in self._buckets and nxt not in self._warming:
+                    self._warming.add(nxt)
+                    prewarm_cap = nxt
             self._occupancy_sum += occupancy
             n_steps = self._steps
             for i, sess in active:
@@ -528,7 +561,41 @@ class DecodeEngine:
         _compile_tracker().note_step()
         _profile_note_dispatch(dt)
         _wd_beat(n_steps)
+        if prewarm_cap is not None:
+            threading.Thread(
+                target=self._prewarm, args=(prewarm_cap,),
+                name="serve-decode-prewarm", daemon=True).start()
         return True
+
+    def _prewarm(self, cap: int) -> None:
+        """Background-compile the next capacity bucket's step program so
+        growth under load does not stall live traffic. Resolves the same
+        per-signature entry the pump would, so a concurrent synchronous
+        growth dedups on the program's own lock — never a double compile."""
+        from deeplearning4j_tpu.nn import compile_cache
+
+        t0 = time.perf_counter()
+        try:
+            inputs = (self._params, self._states, self._zero_blocks(cap),
+                      jnp.zeros((cap,), jnp.int32),
+                      jnp.zeros((cap,), bool),
+                      jnp.zeros((cap,), jnp.int32))
+            warm = getattr(self._step, "warm", None)
+            if warm is not None:
+                warm(*jax.tree_util.tree_map(
+                    lambda a: jax.ShapeDtypeStruct(tuple(a.shape), a.dtype)
+                    if hasattr(a, "shape") and hasattr(a, "dtype") else a,
+                    inputs))
+            else:
+                # kill-switch path (plain jit): one zero step at the next
+                # capacity populates jit's own dispatch cache; the donated
+                # blocks are this thread's private zeros
+                self._step(*inputs)
+            compile_cache.observe_warmup("decode", time.perf_counter() - t0)
+        except Exception as e:
+            log.debug("decode pre-warm of bucket %d failed: %r", cap, e)
+            with self._lock:
+                self._warming.discard(cap)
 
     def _loop(self) -> None:
         while True:

@@ -20,6 +20,7 @@ directly from an in-memory network.
 """
 from __future__ import annotations
 
+import functools
 import threading
 import zipfile
 from typing import Dict, List, Optional
@@ -41,6 +42,26 @@ def load_model_file(path: str):
         return KerasModelImport.import_keras_sequential_model_and_weights(path)
     except ValueError:
         return KerasModelImport.import_keras_model_and_weights(path)
+
+
+def _derive_warmup_example(net):
+    """(1, n_in) float32 zeros for plain feedforward stacks; None (skip
+    warmup) for recurrent/conv/graph first layers whose input layout can't
+    be derived from ``n_in`` alone — callers pass ``warmup_example`` for
+    those."""
+    if type(net).__name__ == "ComputationGraph":
+        return None
+    layers = getattr(getattr(net, "conf", None), "layers", None)
+    if not layers:
+        return None
+    first = layers[0]
+    if type(first).__module__.rsplit(".", 1)[-1] != "feedforward":
+        return None
+    n_in = getattr(first, "n_in", None)
+    if not n_in:
+        return None
+    import numpy as np
+    return np.zeros((1, int(n_in)), np.float32)
 
 
 class ModelVersion:
@@ -69,12 +90,22 @@ class ModelVersion:
 
 
 class ModelRegistry:
-    """Thread-safe versioned model store with an atomic active pointer."""
+    """Thread-safe versioned model store with an atomic active pointer.
 
-    def __init__(self, metrics=None):
+    ``warmup_max_batch`` opts registration into parallel AOT warmup: every
+    power-of-two micro-batch bucket program up to that cap is pre-built
+    (thread pool, executable-cache-backed) BEFORE the active pointer moves,
+    so a fresh pin or hot swap serves its first real request without an XLA
+    stall. Off by default — existing compile-count semantics are pinned by
+    tests."""
+
+    def __init__(self, metrics=None, warmup_max_batch: Optional[int] = None,
+                 warmup_workers: int = 4):
         self._lock = threading.RLock()
         self._versions: Dict[str, Dict[str, ModelVersion]] = {}
         self._active: Dict[str, str] = {}
+        self.warmup_max_batch = warmup_max_batch
+        self.warmup_workers = warmup_workers
         self._metrics = metrics or global_registry()
         self._g_models = self._metrics.gauge(
             _n.SERVE_MODELS_LOADED, "model versions held by the registry")
@@ -86,7 +117,8 @@ class ModelRegistry:
                  source: str = "memory",
                  quant: Optional[str] = None,
                  sharding: Optional[str] = None, mesh=None, device=None,
-                 replica: Optional[int] = None) -> ModelVersion:
+                 replica: Optional[int] = None,
+                 warmup_example=None) -> ModelVersion:
         """Pin ``net`` for serving and make it the active version.
 
         The predict program is built (and its parameter snapshot copied)
@@ -108,6 +140,10 @@ class ModelRegistry:
         pf = make_predict_fn(net, version=version, quant=quant,
                              sharding=sharding, mesh=mesh, device=device,
                              replica=replica)
+        if self.warmup_max_batch:
+            # still off the serving path: the old version keeps serving
+            # while every bucket program of the new one is built
+            self._warmup(pf, net, warmup_example)
         with self._lock:
             swapping = name in self._active
             mv = ModelVersion(name, version, net, pf, source=source,
@@ -119,6 +155,45 @@ class ModelRegistry:
             if swapping:
                 self._c_swaps.labels(model=name).inc()
         return mv
+
+    # ------------------------------------------------------------- warmup
+    @staticmethod
+    def warmup_buckets(max_batch: int) -> List[int]:
+        """The micro-batcher's bucket ladder: powers of two capped at
+        ``max_batch`` (log2(max_batch)+1 entries when it is a power of
+        two) — exactly the programs live traffic would compile lazily."""
+        buckets, b = [], 1
+        while b < max_batch:
+            buckets.append(b)
+            b *= 2
+        buckets.append(max_batch)
+        return buckets
+
+    def _warmup(self, pf: PredictFn, net, example=None) -> None:
+        """Pre-build every bucket program for a fresh pin concurrently.
+        ``example`` is one input row batch (array, or tuple of arrays for
+        graphs); when omitted it is derived from the config's first layer.
+        Warmup is best-effort: an underivable input shape skips it."""
+        import numpy as np
+
+        from deeplearning4j_tpu.nn import compile_cache
+
+        if example is None:
+            example = _derive_warmup_example(net)
+            if example is None:
+                return
+        examples = [np.asarray(e) for e in
+                    (example if isinstance(example, (tuple, list))
+                     else (example,))]
+
+        def one(b):
+            pf.warm(*[np.zeros((b,) + tuple(e.shape[1:]), e.dtype)
+                      for e in examples])
+
+        compile_cache.warm_parallel(
+            [functools.partial(one, b)
+             for b in self.warmup_buckets(self.warmup_max_batch)],
+            site="registry", workers=self.warmup_workers)
 
     def load(self, name: str, path: str, version: Optional[str] = None,
              quant: Optional[str] = None) -> ModelVersion:

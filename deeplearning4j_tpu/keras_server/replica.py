@@ -50,7 +50,7 @@ class Replica:
     def __init__(self, index: int, *, device=None, mesh=None,
                  sharding: Optional[str] = None, max_batch: int = 32,
                  max_latency_s: float = 0.002, max_queue: int = 256,
-                 metrics=None):
+                 metrics=None, warmup: bool = False):
         self.index = index
         self.device = device
         self.mesh = mesh
@@ -58,7 +58,11 @@ class Replica:
         #: router-visible: a draining replica takes no NEW requests while
         #: its registry swaps versions (its queued work still completes)
         self.draining = False
-        self.registry = ModelRegistry(metrics=metrics)
+        # warmup pre-builds every bucket program before each register's
+        # pointer swap, so a replica joins the router compile-free
+        self.registry = ModelRegistry(
+            metrics=metrics,
+            warmup_max_batch=max_batch if warmup else None)
         self.batcher = MicroBatcher(
             self.registry, max_batch=max_batch, max_latency_s=max_latency_s,
             max_queue=max_queue, metrics=metrics, replica=index)
@@ -82,7 +86,8 @@ class ReplicaSet:
                  mesh_axes: Optional[Dict[str, int]] = None,
                  devices=None, max_batch: int = 32,
                  max_latency_s: float = 0.002, max_queue: int = 256,
-                 metrics=None, drain_timeout_s: float = 30.0):
+                 metrics=None, drain_timeout_s: float = 30.0,
+                 warmup: bool = False):
         if n_replicas < 1:
             raise ValueError("n_replicas must be >= 1")
         self.sharding = sharding
@@ -100,7 +105,8 @@ class ReplicaSet:
         self._gauge_active: Dict[tuple, str] = {}
         self._replicas = [
             Replica(i, max_batch=max_batch, max_latency_s=max_latency_s,
-                    max_queue=max_queue, metrics=m, **placement)
+                    max_queue=max_queue, metrics=m, warmup=warmup,
+                    **placement)
             for i, placement in enumerate(
                 self._placements(n_replicas, sharding, mesh_axes, devices))]
 

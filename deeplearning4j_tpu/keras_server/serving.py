@@ -251,7 +251,8 @@ class InferenceServer:
                  decode_eos_id: Optional[int] = None,
                  replicas: int = 1, sharding: Optional[str] = None,
                  replica_devices=None,
-                 replica_mesh_axes: Optional[dict] = None):
+                 replica_mesh_axes: Optional[dict] = None,
+                 warmup: bool = False):
         self.replica_set: Optional[ReplicaSet] = None
         if replicas > 1 or sharding is not None:
             if registry is not None:
@@ -261,13 +262,18 @@ class InferenceServer:
             self.replica_set = ReplicaSet(
                 replicas, sharding=sharding, devices=replica_devices,
                 mesh_axes=replica_mesh_axes, max_batch=max_batch,
-                max_latency_s=max_latency_s, max_queue=max_queue)
+                max_latency_s=max_latency_s, max_queue=max_queue,
+                warmup=warmup)
             # replica 0's registry is the front door's catalog (404 check,
             # streaming, decode) — every roll keeps all replicas in sync
             self.registry = self.replica_set.primary_registry
             self.batcher: Optional[MicroBatcher] = None
         else:
             self.registry = registry or global_model_registry()
+            if warmup:
+                # opt THIS server's registrations into AOT bucket warmup
+                # (works for a caller-supplied registry too)
+                self.registry.warmup_max_batch = max_batch
             self.batcher = MicroBatcher(
                 self.registry, max_batch=max_batch,
                 max_latency_s=max_latency_s, max_queue=max_queue)

@@ -809,6 +809,79 @@ class AdhocSharding(Rule):
 
 # ---------------------------------------------------------------------------
 @register
+class AdhocJit(Rule):
+    """No ``jax.jit(`` / ``pjit(`` outside the compile seams
+    (``LazyScore._jit`` in ``nn/multilayer.py``,
+    ``parallel/compile_seam.py``, ``nn/compile_cache.py``).
+
+    A raw jit call site is a program the compile plane can't see: it is
+    not policy-keyed (a dtype flip silently pins the first policy), not
+    compile-tracked (storm detection and MFU go blind), and not warm-
+    startable (the persistent executable cache never learns about it — a
+    respawn or hot swap recompiles it from scratch every time). The seams
+    exist so every program inherits all three. Call sites route through
+    ``net._jit`` / ``compile_seam.compile_step`` /
+    ``compile_cache.build_program``; a site with a genuine reason to stay
+    raw (float64 gradient checks outside every policy) suppresses with
+    that reason spelled out. Jurisdiction: direct calls by from-import,
+    alias, or dotted attribute.
+    """
+
+    name = "adhoc-jit"
+    description = ("jax.jit/pjit called outside nn/multilayer.py "
+                   "(LazyScore._jit) + parallel/compile_seam.py + "
+                   "nn/compile_cache.py (use net._jit / "
+                   "compile_seam.compile_step / "
+                   "compile_cache.build_program)")
+    exclude = ("*/nn/multilayer.py", "*/parallel/compile_seam.py",
+               "*/nn/compile_cache.py")
+
+    _CTORS = ("jit", "pjit")
+    _ORIGINS = ("jax", "jax.experimental.pjit")
+
+    def check(self, ctx: FileContext) -> Iterator[Violation]:
+        tree = ctx.tree
+        if tree is None:
+            return
+        # local names bound by from-import (incl. aliases), and module
+        # aliases that can reach jit/pjit as attributes
+        ctor_names: Dict[str, str] = {}
+        mod_aliases: Set[str] = set()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ImportFrom) \
+                    and node.module in self._ORIGINS:
+                for a in node.names:
+                    if a.name in self._CTORS:
+                        ctor_names[a.asname or a.name] = a.name
+            elif isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.name in ("jax", "jax.experimental",
+                                  "jax.experimental.pjit"):
+                        mod_aliases.add((a.asname or a.name).split(".")[0])
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            kind = None
+            if isinstance(f, ast.Name) and f.id in ctor_names:
+                kind = ctor_names[f.id]
+            else:
+                d = dotted_name(f)
+                if d and "." in d:
+                    head, leaf = d.split(".", 1)[0], d.rsplit(".", 1)[-1]
+                    if leaf in self._CTORS and head in mod_aliases:
+                        kind = leaf
+            if kind:
+                yield self.violation(
+                    ctx, node.lineno,
+                    f"ad-hoc {kind}() — programs compile through the seams "
+                    "(net._jit / compile_seam.compile_step / "
+                    "compile_cache.build_program) so they are policy-keyed, "
+                    "compile-tracked and warm-startable")
+
+
+# ---------------------------------------------------------------------------
+@register
 class HotPathCopy(Rule):
     """No full-buffer copies on the host data plane.
 

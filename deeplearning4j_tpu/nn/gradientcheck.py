@@ -139,7 +139,7 @@ def _fd_check_subtree(score, params_subtree, *, eps, max_rel_error,
         indices = np.random.default_rng(seed).choice(n, subset, replace=False)
     else:
         indices = np.arange(n)
-    score_jit = jax.jit(lambda flat: score(
+    score_jit = jax.jit(lambda flat: score(  # lint: adhoc-jit-ok (float64 finite-difference probe outside every dtype policy; never serves, never warm-starts)
         unflatten_params(params_subtree, flat)))
     fails = 0
     max_err = 0.0
@@ -193,7 +193,7 @@ def _check_gradients_x64(net, x, y, *, eps, max_rel_error, min_abs_error, subset
         else:
             indices = np.arange(n)
 
-        score_jit = jax.jit(lambda flat: score(unflatten_params(params64, flat)))
+        score_jit = jax.jit(lambda flat: score(unflatten_params(params64, flat)))  # lint: adhoc-jit-ok (float64 finite-difference probe outside every dtype policy; never serves, never warm-starts)
 
         max_err = 0.0
         fails = 0

@@ -102,7 +102,8 @@ class PredictFn:
     def __init__(self, net, name: str = PREDICT_PROGRAM_NAME,
                  quant: Optional[str] = None,
                  sharding: Optional[str] = None,
-                 mesh=None, device=None):
+                 mesh=None, device=None,
+                 fingerprint: Optional[str] = None):
         net._require_init()
         if quant not in QUANT_MODES:
             raise ValueError(f"quant must be one of {QUANT_MODES}, "
@@ -113,6 +114,9 @@ class PredictFn:
             raise ValueError("pass sharding+mesh OR device, not both")
         self._net = net
         self._name = name
+        #: executable-cache identity: the undecorated program name (no
+        #: @version / ~replica), so hot swaps and replica spawns warm-hit
+        self._fingerprint = fingerprint or name
         self.quant = quant if quant == "int8" else None
         self.sharding = sharding
         self.mesh = mesh
@@ -142,7 +146,7 @@ class PredictFn:
                 self._params = jax.device_put(self._params, device)
                 self._states = jax.device_put(self._states, device)
             # LazyScore._jit: policy-keyed, compile-tracked, NO donate argnums
-            self._fn = net._jit(name, fn)
+            self._fn = net._jit(name, fn, fingerprint=self._fingerprint)
         self._lock = threading.Lock()
         self.calls = 0  #: dispatches served (host-side, informational)
 
@@ -183,7 +187,9 @@ class PredictFn:
             in_specs=(specs, partition.pspec(), None),
             out_specs=partition.pspec(),
             cache_key=common.effective_policy_key(conf_dtype),
-            params=self._params, param_specs=specs)
+            params=self._params, param_specs=specs,
+            conf=getattr(net, "conf", None),
+            fingerprint=f"{type(net).__name__}.{self._fingerprint}")
         return step
 
     @property
@@ -241,6 +247,24 @@ class PredictFn:
             self.calls += 1
         return out
 
+    def warm(self, *xs) -> None:
+        """Pre-resolve the compiled program for these example inputs
+        (AOT through the executable cache when available — no dispatch;
+        one real dispatch otherwise). Registry/replica warmup calls this
+        per micro-batch bucket before the pin goes live."""
+        if len(xs) != self._n_in:
+            raise ValueError(f"model takes {self._n_in} input(s), "
+                             f"got {len(xs)}")
+        staged = [self._stage(x) for x in xs]
+        inputs = staged if self._graph else staged[0]
+        # CompiledStep (sharded) wraps the program as .fn
+        target = getattr(self._fn, "fn", self._fn)
+        warm = getattr(target, "warm", None)
+        if warm is not None:
+            warm(self._params, self._states, inputs)
+        else:
+            self._fn(self._params, self._states, inputs)
+
 
 def make_predict_fn(net, name: str = PREDICT_PROGRAM_NAME,
                     version: Optional[str] = None,
@@ -259,6 +283,10 @@ def make_predict_fn(net, name: str = PREDICT_PROGRAM_NAME,
     compiles count separately. ``sharding``/``mesh``/``device`` choose the
     pin placement — see :class:`PredictFn`.
     """
+    # cache identity keeps the quant marker (different program) but sheds
+    # version/replica decoration (same program) — that is what lets a hot
+    # swap or replica respawn load the previous pin's executables
+    fingerprint = f"{name}+int8" if quant == "int8" else name
     if version:
         name = f"{name}@{version}"
     if quant == "int8":
@@ -266,4 +294,5 @@ def make_predict_fn(net, name: str = PREDICT_PROGRAM_NAME,
     if replica is not None:
         name = f"{name}~r{replica}"
     return PredictFn(net, name=name, quant=quant,
-                     sharding=sharding, mesh=mesh, device=device)
+                     sharding=sharding, mesh=mesh, device=device,
+                     fingerprint=fingerprint)

@@ -341,12 +341,17 @@ class LazyScore:
         if getattr(self, "params_list", None) is None:
             raise RuntimeError(self.NOT_INITIALIZED_MSG)
 
-    def _jit(self, name, fn, donate=None):
+    def _jit(self, name, fn, donate=None, fingerprint=None):
         """Per-network compiled-program cache, keyed on the program name AND
         the active dtype policy: the policy is read at trace time, so a
         name-only key would silently pin the policy active at first call.
         A config-declared ``dtype`` (GlobalConf.dtype) overrides the global
-        policy for this network's programs."""
+        policy for this network's programs.
+
+        ``fingerprint`` overrides the identity used by the persistent
+        executable cache when ``name`` carries per-instance decoration
+        (serving versions ``@v2``, replica ranks ``~r1``) that must still
+        share warm entries."""
         if not hasattr(self, "_jit_cache"):
             self._jit_cache = {}
         conf_dtype = getattr(getattr(getattr(self, "conf", None),
@@ -362,13 +367,21 @@ class LazyScore:
                 del self._jit_cache[stale]
             jitted = (jax.jit(fn, donate_argnums=donate)
                       if donate else jax.jit(fn))
-            # every cache miss is a (future) compile: the tracker wraps the
-            # fresh jit so its first call per abstract signature is timed and
-            # recorded. A dtype-policy flip re-keys this cache, lands here
-            # again, and thus counts as a new compile of the same name —
-            # which is what the recompile-storm detector watches.
-            self._jit_cache[key] = _compile_tracker().wrap(
-                f"{type(self).__name__}.{name}", jitted, cache_key=key)
+            # every cache miss is a (future) compile: build_program wraps
+            # the fresh jit so its first call per abstract signature is
+            # timed and recorded (and, cache enabled, resolved through the
+            # persistent executable store). A dtype-policy flip re-keys
+            # this cache, lands here again, and thus counts as a new
+            # compile of the same name — which is what the recompile-storm
+            # detector watches.
+            from deeplearning4j_tpu.nn import compile_cache as _cc
+
+            cls = type(self).__name__
+            self._jit_cache[key] = _cc.build_program(
+                f"{cls}.{name}", jitted, cache_key=key,
+                fingerprint=f"{cls}.{fingerprint or name}",
+                conf=getattr(self, "conf", None),
+                extra=("donate", donate) + tuple(pol))
         return self._jit_cache[key]
 
     #: hook: the module-level K-step builder for this network type

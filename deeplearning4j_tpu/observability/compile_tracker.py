@@ -90,6 +90,39 @@ def _policy_key() -> Tuple:
     return common.policy_key()
 
 
+def _normalize_cost(analysis: Any) -> Optional[dict]:
+    """XLA cost_analysis() -> {str: number} (it returns a list on some
+    backends/versions, a mapping on others)."""
+    if isinstance(analysis, (list, tuple)):
+        analysis = analysis[0] if analysis else None
+    if analysis is None:
+        return None
+    return {str(k): v for k, v in dict(analysis).items()
+            if isinstance(v, (int, float))}
+
+
+def cost_analysis_flops(fn: Callable, *args, **kwargs) -> float:
+    """One-dispatch FLOP count of ``fn`` for these (possibly abstract)
+    args, without a second backend compile. Shared by the MFU path and
+    bench.py. Accepts a compile_cache ``CachedProgram`` (reuses its
+    resolved executable), or any lowerable (jitted) fn — the cost comes
+    from ``Lowered.cost_analysis()``; ``.compile()`` only as API-drift
+    fallback. Returns 0.0 when no analysis is available."""
+    try:
+        if hasattr(fn, "cost_flops"):
+            flops = fn.cost_flops(*args, **kwargs)
+            if flops is not None:
+                return max(0.0, float(flops))
+        lowered = fn.lower(*args, **kwargs)
+        try:
+            cost = _normalize_cost(lowered.cost_analysis())
+        except Exception:
+            cost = _normalize_cost(lowered.compile().cost_analysis())
+        return max(0.0, float((cost or {}).get("flops", 0.0)))
+    except Exception:
+        return 0.0
+
+
 class CompileTracker:
     """Records compile events and watches for retrace storms.
 
@@ -116,6 +149,10 @@ class CompileTracker:
         #: fn name -> cost_analysis dict (None caches "analysis unavailable"
         #: so a failing lower is attempted once, not every step)
         self._cost: Dict[str, Optional[dict]] = {}
+        #: fn name -> last Compiled executable noted by an AOT seam
+        #: (compile_cache), so flops_for reads its cost_analysis directly
+        #: instead of re-lowering
+        self._executables: Dict[str, Any] = {}
         #: fn name -> perf_counter of the previous note_step(fn=...) — the
         #: rolling-MFU time base
         self._mfu_last: Dict[str, float] = {}
@@ -183,9 +220,20 @@ class CompileTracker:
                 pass
         return self._backend_peak
 
+    def note_executable(self, name: str, compiled: Any) -> None:
+        """An AOT seam (compile_cache) built or loaded an executable for
+        ``name``: keep it so ``flops_for`` reads its cost analysis directly
+        — no second lowering, no second compile."""
+        with self._lock:
+            self._executables[name] = compiled
+            self._cost.pop(name, None)
+
     def flops_for(self, name: str) -> Optional[float]:
-        """FLOPs of ONE training step of the wrapped program ``name``, from
-        XLA's ``cost_analysis()`` on the signature captured at first call.
+        """FLOPs of ONE training step of the wrapped program ``name``.
+        Preference order: a noted executable's own ``cost_analysis()``
+        (zero extra work), else the lowering captured at first call —
+        ``Lowered.cost_analysis()`` never triggers a second backend
+        compile; ``.compile()`` remains only as an API-drift fallback.
         Computed lazily once per (re)compile and cached; XLA counts a scan
         body once regardless of trip count (pinned by test), so the value is
         per-step even for the K-step fused programs. Returns None when no
@@ -194,18 +242,23 @@ class CompileTracker:
             if name in self._cost:
                 cost = self._cost[name]
                 return None if cost is None else cost.get("flops")
+            exe = self._executables.get(name)
             lowerable = self._lowerable.get(name)
         cost = None
-        if lowerable is not None:
+        if exe is not None:
+            try:
+                cost = _normalize_cost(exe.cost_analysis())
+            except Exception as e:
+                log.debug("executable cost analysis failed for %s: %r",
+                          name, e)
+        if cost is None and lowerable is not None:
             fn, aargs, akwargs = lowerable
             try:
-                analysis = fn.lower(*aargs, **akwargs).compile() \
-                    .cost_analysis()
-                if isinstance(analysis, (list, tuple)):
-                    analysis = analysis[0] if analysis else None
-                if analysis is not None:
-                    cost = {str(k): v for k, v in dict(analysis).items()
-                            if isinstance(v, (int, float))}
+                lowered = fn.lower(*aargs, **akwargs)
+                try:
+                    cost = _normalize_cost(lowered.cost_analysis())
+                except Exception:
+                    cost = _normalize_cost(lowered.compile().cost_analysis())
             except Exception as e:  # non-jit wrappee, API drift: MFU off
                 log.debug("cost analysis unavailable for %s: %r", name, e)
         with self._lock:
@@ -255,9 +308,12 @@ class CompileTracker:
     # ------------------------------------------------------------ tracking
     def record_compile(self, name: str, *, cache_key: Any = None,
                        wall_s: float = 0.0, shapes: Any = None,
-                       policy: Any = None) -> dict:
+                       policy: Any = None, cache_hit: bool = False) -> dict:
         """Record one compile event (the wrap() path calls this; seams that
-        build executables eagerly may call it directly)."""
+        build executables eagerly may call it directly). ``cache_hit=True``
+        marks a warm load from the executable cache: counted and flight-
+        recorded like any compile, but excluded from storm accounting —
+        warm loads are the fix for compile storms, not a symptom of one."""
         total, wall_hist, _, storm_total = self._metrics()
         total.labels(fn=name).inc()
         if wall_s:
@@ -271,19 +327,21 @@ class CompileTracker:
             step = self._step
             event = {"fn": name, "step": step, "wall_s": wall_s,
                      "cache_key": repr(cache_key), "shapes": repr(shapes),
-                     "policy": repr(policy)}
+                     "policy": repr(policy), "cache_hit": cache_hit}
             self.events.append(event)
-            dq = self._compile_steps.setdefault(
-                name, deque(maxlen=max(64, self.storm_threshold * 4)))
-            dq.append(step)
-            lo = step - self.storm_window_steps
-            recent = sum(1 for s in dq if s >= lo)
-            warned = self._last_warned.get(name)
-            storm = (recent >= self.storm_threshold
-                     and (warned is None
-                          or step - warned > self.storm_window_steps))
-            if storm:
-                self._last_warned[name] = step
+            storm = False
+            if not cache_hit:
+                dq = self._compile_steps.setdefault(
+                    name, deque(maxlen=max(64, self.storm_threshold * 4)))
+                dq.append(step)
+                lo = step - self.storm_window_steps
+                recent = sum(1 for s in dq if s >= lo)
+                warned = self._last_warned.get(name)
+                storm = (recent >= self.storm_threshold
+                         and (warned is None
+                              or step - warned > self.storm_window_steps))
+                if storm:
+                    self._last_warned[name] = step
         try:
             from .flight_recorder import global_recorder
 

@@ -125,6 +125,14 @@ SHM_BYTES_TOTAL = "dl4j_shm_bytes_total"
 SHM_REAPED_TOTAL = "dl4j_shm_reaped_total"
 INGEST_DECODE_BYTES_TOTAL = "dl4j_ingest_decode_bytes_total"
 
+# --- warm-start compile plane (nn/compile_cache.py, keras_server/decode.py) -
+COMPILE_CACHE_HITS_TOTAL = "dl4j_compile_cache_hits_total"
+COMPILE_CACHE_MISSES_TOTAL = "dl4j_compile_cache_misses_total"
+COMPILE_CACHE_BYTES = "dl4j_compile_cache_bytes"
+COMPILE_CACHE_LOAD_SECONDS = "dl4j_compile_cache_load_seconds"
+WARMUP_SECONDS = "dl4j_warmup_seconds"
+SERVE_BUCKET_GROWTH_STALL_SECONDS = "dl4j_serve_bucket_growth_stall_seconds"
+
 # --- input pipeline (datasets/prefetch.py) ---------------------------------
 PREFETCH_DEPTH = "dl4j_prefetch_depth"
 PREFETCH_BYTES_TOTAL = "dl4j_prefetch_bytes_total"

@@ -242,7 +242,7 @@ class Solver:
                         loss, _ = loss_fn(net.conf, p, net.state_list, xa, ya, None)
                         return loss
                     return minimize(fl, x0)
-            run = self._jit_runs[shapes] = jax.jit(run_impl)
+            run = self._jit_runs[shapes] = jax.jit(run_impl)  # lint: adhoc-jit-ok (line-search inner loop over closure-captured f64 objective; no conf/policy identity for the seams to key on)
         if isinstance(net, ComputationGraph):
             result = run(flatten_params(template, jnp.float32), xs, ys)
         else:

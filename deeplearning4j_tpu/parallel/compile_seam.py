@@ -68,13 +68,19 @@ def compile_step(name: str, step_fn: Callable, *, mesh, rule_set: str,
                  check_vma: bool = False,
                  donate_argnums: Tuple[int, ...] = (),
                  cache_key: Any = None,
-                 params=None, param_specs=None) -> CompiledStep:
+                 params=None, param_specs=None,
+                 conf=None, fingerprint: Optional[str] = None) -> CompiledStep:
     """Compile ``step_fn`` for ``mesh`` under the given spec trees.
 
     ``cache_key`` flows into CompileTracker.wrap with ``rule_set`` prepended,
     so a recompile storm shows which rule set is churning. ``params`` +
     ``param_specs`` (optional) feed the per-device sharded-param-bytes
     gauge for this rule set.
+
+    ``conf`` (the model configuration, when the caller has one) and
+    ``fingerprint`` (identity override when ``name`` carries per-instance
+    decoration) key the persistent executable cache; sharding strategy,
+    spec trees, and donation are folded in so layout changes invalidate.
     """
     if strategy == "shard_map":
         body = jax_compat.shard_map(step_fn, mesh=mesh, in_specs=tuple(in_specs),
@@ -99,8 +105,21 @@ def compile_step(name: str, step_fn: Callable, *, mesh, rule_set: str,
         partition.record_param_bytes(rule_set, params, param_specs, mesh)
 
     key = cache_key if isinstance(cache_key, tuple) else (cache_key,)
-    tracked = global_tracker().wrap(name, fitted,
-                                    cache_key=(rule_set,) + key)
+    from deeplearning4j_tpu.nn import compile_cache as _cc
+
+    # fingerprint material: NOT the cache_key (callers fold process-local
+    # ids into it for in-memory keying); the global dtype policy stands in
+    # for it — conf-pinned dtypes are covered by the conf hash
+    try:
+        from deeplearning4j_tpu import common
+        policy = common.policy_key()
+    except Exception:
+        policy = None
+    tracked = _cc.build_program(
+        name, fitted, cache_key=(rule_set,) + key,
+        fingerprint=fingerprint or name, conf=conf,
+        extra=(rule_set, strategy, repr(in_specs), repr(out_specs),
+               tuple(donate_argnums), repr(policy)))
     return CompiledStep(fn=tracked, name=name, rule_set=rule_set,
                         strategy=strategy, mesh=mesh, in_specs=in_specs,
                         out_specs=out_specs, check_vma=check_vma)

@@ -300,6 +300,12 @@ class ElasticTrainer:
             os.path.dirname(os.path.abspath(__file__))))
         env["PYTHONPATH"] = (repo_root + os.pathsep
                              + env.get("PYTHONPATH", ""))
+        from deeplearning4j_tpu.nn import compile_cache
+        if compile_cache.enabled():
+            # pin the resolved executable-cache dir so every worker
+            # generation shares it: gen-0 writes the step executable,
+            # a respawned replacement warm-loads it and skips XLA
+            env["DL4J_COMPILE_CACHE_DIR"] = compile_cache.cache_dir()
         self._env_conf = {"env": env, "conf": conf_path}
 
     def _delay(self, shard: int) -> float:

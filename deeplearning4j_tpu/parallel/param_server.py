@@ -513,7 +513,7 @@ def make_compiled_worker_step(net, *, transport: str):
         "ParameterServerParallelWrapper.worker_step",
         make_train_step(net.conf), mesh=data_parallel_mesh(),
         rule_set="ps_async", strategy="jit",
-        cache_key=(transport,))
+        cache_key=(transport,), conf=net.conf)
 
 
 # --------------------------------------------------------------------------

@@ -167,7 +167,8 @@ class PipelineTrainer:
         return compile_step(
             "PipelineTrainer.train_step",
             make_train_step(self.net.conf, loss=self._pipeline_loss),
-            mesh=self.mesh, rule_set="pipeline", strategy="jit")
+            mesh=self.mesh, rule_set="pipeline", strategy="jit",
+            conf=self.net.conf)
 
     #: batches staged + transferred ahead of the dispatch loop (see
     #: MultiLayerNetwork.prefetch_depth); 0 = synchronous staging

@@ -230,7 +230,8 @@ class ParameterAveragingTrainingMaster(TrainingMaster):
             rule_set="dp",
             in_specs=(stacked, stacked, stacked, stacked, stacked, repl, repl),
             out_specs=(stacked, stacked, stacked, repl),
-            strategy="shard_map", check_vma=False, cache_key=key)
+            strategy="shard_map", check_vma=False, cache_key=key,
+            conf=model.conf)
 
         def average(params, states, upd):
             mean_b = lambda a: jnp.broadcast_to(
@@ -243,7 +244,8 @@ class ParameterAveragingTrainingMaster(TrainingMaster):
 
         fns = (local,
                compile_step("TrainingMaster.average", average, mesh=mesh,
-                            rule_set="dp", strategy="jit", cache_key=key))
+                            rule_set="dp", strategy="jit", cache_key=key,
+                            conf=model.conf))
         self._local_fns[key] = fns
         return fns
 
@@ -406,7 +408,8 @@ class DistributedMultiLayer:
                 "DistributedMultiLayer.eval_fwd",
                 common.wrap_with_policy(fwd_py, conf_dtype), mesh=mesh,
                 rule_set="dp", in_specs=(P(), P(), P("data")),
-                strategy="jit", cache_key=eff)
+                strategy="jit", cache_key=eff,
+                conf=getattr(net, "conf", None))
         fwd = self._eval_fwd
         params, states = net.params_list, net.state_list
         e = Evaluation()

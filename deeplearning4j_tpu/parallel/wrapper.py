@@ -416,7 +416,8 @@ class ParallelWrapper:
             in_specs=(par_sp, P(), upd_sp, None, None, P(), P()),
             out_specs=(par_sp, P(), upd_sp, P()),
             strategy="jit", cache_key=self._traced_policy,
-            params=net.params_list, param_specs=par_sp)
+            params=net.params_list, param_specs=par_sp,
+            conf=net.conf)
 
     def _make_sync_multistep(self):
         """K-step scanned train step with the stacked batch axis sharded over
@@ -445,7 +446,7 @@ class ParallelWrapper:
             rule_set=self._rule_label(),
             in_specs=(par_sp, P(), upd_sp, None, None, P(), P()),
             out_specs=(par_sp, P(), upd_sp, P()),
-            strategy="jit", cache_key=self._traced_policy)
+            strategy="jit", cache_key=self._traced_policy, conf=net.conf)
 
     def _stage(self, arr, spec: P):
         """Host batch -> device array laid out for the jit's in_shardings.
@@ -656,7 +657,7 @@ class ParallelWrapper:
                       repl),
             out_specs=(stacked, stacked, stacked, repl),
             strategy="shard_map", check_vma=False,
-            cache_key=self._traced_policy)
+            cache_key=self._traced_policy, conf=net.conf)
 
         def average(params, upd, states):
             from deeplearning4j_tpu import common
@@ -680,7 +681,7 @@ class ParallelWrapper:
         avg_fn = compile_step(
             "ParallelWrapper.average", average, mesh=mesh,
             rule_set=self._rule_label(), strategy="jit",
-            cache_key=self._traced_policy)
+            cache_key=self._traced_policy, conf=net.conf)
         return local, avg_fn
 
     def _fit_local_sgd(self, iterator, epochs: int) -> None:

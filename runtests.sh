@@ -43,6 +43,12 @@
 #                                 # reaper, shm/tcp transport + fit parity,
 #                                 # native ingest decode parity, bench
 #                                 # dataplane-axis contract
+#   ./runtests.sh compile [args]  # warm-start compile plane: cache-hit
+#                                 # bitwise identity (train/predict/decode),
+#                                 # corruption quarantine, cross-process
+#                                 # reuse, warmup-before-swap ordering,
+#                                 # kill switch, bench compile-cache-axis
+#                                 # contract
 set -e
 cd "$(dirname "$0")"
 
@@ -135,6 +141,15 @@ if [ "${1-}" = "dataplane" ]; then
     tests/test_streaming_broker.py \
     tests/test_bench_contract.py::test_config_key_dataplane_axes \
     tests/test_bench_contract.py::test_grid_row_ingest -q "$@"
+fi
+
+if [ "${1-}" = "compile" ]; then
+  shift
+  PALLAS_AXON_POOL_IPS= \
+  JAX_PLATFORMS=cpu \
+  XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+  exec python -m pytest tests/test_compile_cache.py \
+    tests/test_bench_contract.py::test_config_key_compile_cache_axes -q "$@"
 fi
 
 if [ "${1-}" = "health" ]; then

@@ -97,6 +97,13 @@ run --model elastic
 # sample-sized records; both records also land in scripts/ps_ab.jsonl
 run --model ps_async --ps-transport shm
 run --model ingest
+# warm-start compile plane row (ISSUE 15): the default serve and elastic
+# rows above already headline the WARM numbers (time_to_ready_s from a
+# cache-backed pin, recovery_seconds with the respawned worker loading its
+# step executable from disk) with the cold A/B riding along; this cold-only
+# row pins the cache-off world as its own config so a warm capture can
+# never stand in for the cold baseline after an outage
+run --model serve --compile-cache off
 if [ "$MODE" = full ]; then
     run --model lenet
     run --model lenet --bf16-act

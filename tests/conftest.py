@@ -33,3 +33,12 @@ def pytest_configure(config):
 @pytest.fixture(scope="session")
 def devices():
     return jax.devices()
+
+
+@pytest.fixture(autouse=True)
+def _compile_cache_isolation(tmp_path, monkeypatch):
+    """Point the executable cache at a per-test tmp dir. Without this a
+    warm entry from one test (or a previous run) would turn another test's
+    expected cold compile into a disk hit — the compile-storm tests in
+    particular pin that recompiles really happen."""
+    monkeypatch.setenv("DL4J_COMPILE_CACHE_DIR", str(tmp_path / "xcache"))

@@ -626,3 +626,32 @@ def test_grid_row_ingest():
     # sample-sized records (the regime where the per-record GIL-bound
     # fallback's fixed cost shows) and best-of reps for a stable bandwidth
     assert batch <= 16 and iters >= 2
+
+
+def test_config_key_compile_cache_axes():
+    """The warm-start compile plane's axis (ISSUE 15) is config-distinct
+    on BOTH models that report warm numbers: a cold-only --compile-cache
+    off capture must never stand in for the warm-headline default serve or
+    elastic row; other models don't grow the axis; and the ts-gate strips
+    it on rows that predate the plane."""
+    import bench
+
+    a = bench._config_key("--model serve")
+    b = bench._config_key("--model serve --compile-cache off")
+    assert a != b and a["compile_cache"] == "on" \
+        and b["compile_cache"] == "off"
+    c = bench._config_key("--model elastic")
+    d = bench._config_key("--model elastic --compile-cache off")
+    assert c != d and c["compile_cache"] == "on" \
+        and d["compile_cache"] == "off"
+    # no phantom axis on models without a warm-start section
+    assert bench._config_key("--model ps_async")["compile_cache"] is None
+    assert bench._config_key("--model resnet50")["compile_cache"] is None
+    # rows logged before the plane landed cannot carry the axis
+    gate = bench._COMPILE_CACHE_AXIS_LANDED_TS
+    old = bench._config_key("--model serve --compile-cache off",
+                            ts="2026-08-06T09:59:59Z")
+    new = bench._config_key("--model serve --compile-cache off",
+                            ts="2026-08-06T10:00:01Z")
+    assert old["compile_cache"] is None and new["compile_cache"] == "off"
+    assert gate.endswith("Z") and gate > bench._DATAPLANE_AXIS_LANDED_TS
