@@ -831,7 +831,8 @@ def bench_fit_lenet(batch: int, iters: int, ksteps: int,
 def bench_serve(batch, iters, ksteps, serve_qps=None, serve_latency_ms=None,
                 serve_batching=None, serve_quant=None,
                 serve_replicas=None, serve_sharding=None,
-                compile_cache=None):
+                compile_cache=None, decode_kv=None, decode_page_size=None,
+                decode_spec_draft=None):
     """Micro-batching A/B on the serving engine (ISSUE 9 headline).
 
     Unlike the fit benches this is fully CPU-measurable: the win is
@@ -953,6 +954,55 @@ def bench_serve(batch, iters, ksteps, serve_qps=None, serve_latency_ms=None,
         "int8_param_bytes_ratio": drec["int8_vs_dense"]["param_bytes_ratio"],
     }
 
+    # paged KV memory plane + speculative decode section (ISSUE 16): the
+    # dense-vs-paged A/B runs at EQUAL device state bytes (the pool is
+    # sized to the dense engine's KV block, minus the trash page), so
+    # sessions_ratio is the sessions-per-chip headline, and the spec A/B
+    # measures the draft-verify speedup at whatever acceptance the tiny
+    # draft earns — both streams pinned bitwise against the dense/greedy
+    # oracle inside the harness itself
+    from deeplearning4j_tpu.keras_server.loadgen import (run_paged_ab,
+                                                         run_spec_ab)
+    from deeplearning4j_tpu.models.transformer import transformer_lm
+    decode_kv = decode_kv or "paged"
+    page_size = int(decode_page_size or 16)
+    spec_draft = decode_spec_draft or "tiny"
+    tf_net = MultiLayerNetwork(transformer_lm(
+        vocab_size=32, width=32, n_layers=2, n_heads=2, max_len=128,
+        seed=5)).init()
+    prec = run_paged_ab(tf_net, model="bench_serve_paged", dense_slots=4,
+                        max_context=128, page_size=page_size,
+                        n_sessions=24, max_new_tokens=16,
+                        record_path=record_path)
+    paged_sec = {
+        "decode_kv": decode_kv,
+        "decode_page_size": page_size,
+        "decode_spec_draft": spec_draft,
+        "paged_sessions_ratio": prec["sessions_ratio"],
+        "paged_state_bytes": prec["paged"]["state_bytes"],
+        "dense_state_bytes": prec["dense"]["state_bytes"],
+        "paged_bitwise_equal": prec["bitwise_equal"],
+        "paged_tokens_per_sec": prec[decode_kv]["tokens_per_sec"],
+        "paged_prefix_share_ratio": prec["paged"]["prefix_share_ratio"],
+        "spec_tokens_per_sec": None,
+        "spec_speedup": None,
+        "spec_acceptance": None,
+        "spec_bitwise_equal": None,
+    }
+    if spec_draft != "none":
+        draft_net = MultiLayerNetwork(transformer_lm(
+            vocab_size=32, width=16, n_layers=1, n_heads=2, max_len=128,
+            seed=9)).init()
+        srec = run_spec_ab(tf_net, draft_net, model="bench_serve_spec",
+                           slots=4, max_context=128, n_sessions=12,
+                           max_new_tokens=16, record_path=record_path)
+        paged_sec.update({
+            "spec_tokens_per_sec": srec["spec"]["tokens_per_sec"],
+            "spec_speedup": srec["tokens_per_sec_ratio"],
+            "spec_acceptance": srec["acceptance"],
+            "spec_bitwise_equal": srec["bitwise_equal"],
+        })
+
     # replica scaling section: N pinned programs behind the least-queue
     # router. Wider than the dispatch-bound A/B model on purpose — replica
     # scale-out multiplies DEVICE capacity, so the scaled resource must be
@@ -1073,6 +1123,7 @@ def bench_serve(batch, iters, ksteps, serve_qps=None, serve_latency_ms=None,
         "max_batch": batch,
         "serve_record": record_path,
         **decode,
+        **paged_sec,
         **replica_sec,
         **ready,
         "api": "keras_server.InferenceServer /v1/predict + /v1/generate",
@@ -1758,6 +1809,12 @@ def _child_main(args) -> None:
             kwargs["serve_sharding"] = args.serve_sharding
         if args.compile_cache:
             kwargs["compile_cache"] = args.compile_cache
+        if args.decode_kv:
+            kwargs["decode_kv"] = args.decode_kv
+        if args.decode_page_size:
+            kwargs["decode_page_size"] = args.decode_page_size
+        if args.decode_spec_draft:
+            kwargs["decode_spec_draft"] = args.decode_spec_draft
     if args.model == "ps_async":
         if args.ps_workers:
             kwargs["ps_workers"] = args.ps_workers
@@ -1932,6 +1989,23 @@ def main() -> None:
                          "serving, forced onto an 8-device CPU host "
                          "platform (NOT the fit path's --sharding axis: "
                          "serve rows never take --sharding)")
+    ap.add_argument("--decode-kv", default=None, choices=("paged", "dense"),
+                    help="serve bench decode KV layout for the row's "
+                         "paged_tokens_per_sec (config-distinct); default "
+                         "paged — page-table pool + CoW prefix sharing vs "
+                         "dense per-slot [cap, max_context] blocks; both "
+                         "phases always run (the A/B pins bitwise "
+                         "equality), the axis picks the headline phase")
+    ap.add_argument("--decode-page-size", type=int, default=None,
+                    help="serve bench paged-decode physical page size in "
+                         "tokens (config-distinct); default 16")
+    ap.add_argument("--decode-spec-draft", default=None,
+                    choices=("tiny", "none"),
+                    help="serve bench speculative-decode draft model "
+                         "(config-distinct); default tiny (a 1-layer "
+                         "width-16 transformer proposing 3 tokens/round); "
+                         "'none' skips the spec section (its fields "
+                         "report null)")
     ap.add_argument("--ps-workers", type=int, default=None,
                     help="ps_async bench worker count for the straggler A/B "
                          "(config-distinct); default 4")
@@ -2198,6 +2272,13 @@ _DATAPLANE_AXIS_LANDED_TS = "2026-08-06T06:00:00Z"
 #: an all-cold row must not stand in for today's warm-headline capture
 _COMPILE_CACHE_AXIS_LANDED_TS = "2026-08-06T10:00:00Z"
 
+#: when the paged decode memory plane landed (ISSUE 16): serve rows before
+#: this predate --decode-kv / --decode-page-size / --decode-spec-draft
+#: (all decode traffic ran dense KV, no draft model existed), so an old
+#: dense capture must never stand in for today's paged-headline row, and a
+#: no-draft capture must never stand in for the spec-decode speedup row
+_PAGED_DECODE_AXIS_LANDED_TS = "2026-08-07T08:00:00Z"
+
 
 def _config_key(args_str: str, ts: str = None) -> dict:
     """The fields that make two bench invocations the SAME config: model,
@@ -2295,6 +2376,14 @@ def _config_key(args_str: str, ts: str = None) -> dict:
         # defaults are their own config: a cold-only --compile-cache off
         # capture must never stand in for the warm-headline default row
         compile_cache = val("--compile-cache") or "on"
+    decode_kv = decode_page_size = decode_spec_draft = None
+    if model == "serve" and not (
+            ts is not None and ts < _PAGED_DECODE_AXIS_LANDED_TS):
+        # defaults are their own config: a dense-KV or no-draft capture
+        # must never stand in for the paged + spec-decode headline row
+        decode_kv = val("--decode-kv") or "paged"
+        decode_page_size = val("--decode-page-size") or "16"
+        decode_spec_draft = val("--decode-spec-draft") or "tiny"
     return {"model": model, "batch": val("--batch"),
             "ksteps": val("--ksteps"), "dtype": mode, "rdtype": rdtype,
             "seq": val("--seq"), "vocab": val("--vocab"),
@@ -2308,7 +2397,9 @@ def _config_key(args_str: str, ts: str = None) -> dict:
             "elastic_workers": elastic_workers,
             "elastic_kill": elastic_kill,
             "ps_transport": ps_transport, "ingest_codec": ingest_codec,
-            "compile_cache": compile_cache}
+            "compile_cache": compile_cache, "decode_kv": decode_kv,
+            "decode_page_size": decode_page_size,
+            "decode_spec_draft": decode_spec_draft}
 
 
 def _last_healthy_from_log(args_str: str, path: str = None):

@@ -604,6 +604,137 @@ def run_decode_ab(net, *, model: str = "decode", slots: int = 8,
     return rec
 
 
+def run_paged_ab(net, *, model: str = "decode_paged",
+                 dense_slots: int = 4, max_context: int = 128,
+                 page_size: int = 16, n_sessions: int = 32,
+                 prompt_len: int = 4, max_new_tokens: int = 24,
+                 eos_id: Optional[int] = None, seed: int = 0,
+                 record_path: Optional[str] = None) -> dict:
+    """Dense vs paged KV decode at EQUAL device state bytes.
+
+    The dense engine is pinned at ``dense_slots`` (its HBM ceiling:
+    ``slots x max_context`` KV rows whether written or not). The paged
+    engine gets a pool of ``dense_slots * max_context / page_size - 1``
+    pages — exactly the dense engine's KV bytes including the trash page —
+    but ``2 x dense_slots`` slot capacity, so the A/B measures how many
+    MORE concurrent sessions the same bytes admit when slots only consume
+    pages for tokens they have written. Token streams must be bitwise
+    identical (the dense program is the oracle); the headline fields are
+    ``sessions_ratio`` (peak concurrent paged / dense capacity) and the
+    state-bytes pair that proves the comparison was fair.
+    """
+    from .decode import DecodeEngine
+    prompts, budgets = _decode_workload(
+        n_sessions, _decode_vocab(net), prompt_len, max_new_tokens, seed)
+    n_pages = dense_slots * (max_context // page_size) - 1
+
+    def phase(kv: str, slots: int, n_pages=None) -> Tuple[dict, list, int]:
+        eng = DecodeEngine(net.clone(), min_slots=slots, max_slots=slots,
+                           eos_id=eos_id, max_context=max_context,
+                           kv=kv, page_size=page_size, n_pages=n_pages)
+        try:
+            _decode_warmup(eng)
+            t0, sessions = _offer_sessions(eng, prompts, budgets, 1e6)
+            for s in sessions:
+                s.result(timeout=600.0)
+            res = _summarize_sessions(sessions, t0)
+            st = eng.stats()
+            bytes_ = eng.state_bytes()
+        finally:
+            eng.close()
+        res.update({
+            "kv": kv, "slots": slots,
+            "state_bytes": bytes_,
+            "peak_active": st["peak_active"],
+            "mean_occupancy": round(st["mean_occupancy"], 4),
+        })
+        if kv == "paged":
+            res.update({
+                "pool_pages": st["pool_pages"],
+                "prefix_share_ratio": round(st["prefix_share_ratio"], 4),
+            })
+        return res, sessions, bytes_
+
+    dense, dsess, dbytes = phase("dense", dense_slots)
+    paged, psess, pbytes = phase("paged", 2 * dense_slots, n_pages=n_pages)
+    bitwise = all(a.tokens == b.tokens for a, b in zip(dsess, psess))
+    rec = {
+        "harness": "keras_server.loadgen.run_paged_ab",
+        "model": model, "n_sessions": n_sessions,
+        "max_context": max_context, "page_size": page_size,
+        "dense": dense, "paged": paged,
+        "bitwise_equal": bitwise,
+        "state_bytes_ratio": round(pbytes / max(dbytes, 1), 4),
+        "sessions_ratio": round(
+            paged["peak_active"] / max(dense_slots, 1), 3),
+        "tokens_per_sec_ratio": round(
+            paged["tokens_per_sec"] / max(dense["tokens_per_sec"], 1e-9),
+            3),
+    }
+    if record_path:
+        os.makedirs(os.path.dirname(os.path.abspath(record_path)),
+                    exist_ok=True)
+        with open(record_path, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+    return rec
+
+
+def run_spec_ab(net, draft_net, *, model: str = "decode_spec",
+                slots: int = 4, max_context: int = 128,
+                spec_tokens: int = 3, n_sessions: int = 16,
+                prompt_len: int = 4, max_new_tokens: int = 24,
+                eos_id: Optional[int] = None, seed: int = 0,
+                record_path: Optional[str] = None) -> dict:
+    """Plain greedy vs speculative decode with ``draft_net`` proposing.
+
+    Identical session mix through both engines; the emitted streams must
+    be bitwise equal at ANY acceptance rate (greedy argmax verify is
+    exact, acceptance only moves the speed). Headline fields:
+    ``tokens_per_sec_ratio`` (the spec speedup — on CPU this mostly
+    tracks dispatch amortization) at the measured ``acceptance`` rate.
+    """
+    from .decode import DecodeEngine
+    prompts, budgets = _decode_workload(
+        n_sessions, _decode_vocab(net), prompt_len, max_new_tokens, seed)
+
+    def phase(draft) -> Tuple[dict, list, dict]:
+        eng = DecodeEngine(net.clone(), min_slots=slots, max_slots=slots,
+                           eos_id=eos_id, max_context=max_context,
+                           draft_net=draft, spec_tokens=spec_tokens)
+        try:
+            _decode_warmup(eng)
+            t0, sessions = _offer_sessions(eng, prompts, budgets, 1e6)
+            for s in sessions:
+                s.result(timeout=600.0)
+            res = _summarize_sessions(sessions, t0)
+            st = eng.stats()
+        finally:
+            eng.close()
+        return res, sessions, st
+
+    greedy, gsess, _ = phase(None)
+    spec, ssess, st = phase(draft_net.clone())
+    bitwise = all(a.tokens == b.tokens for a, b in zip(gsess, ssess))
+    rec = {
+        "harness": "keras_server.loadgen.run_spec_ab",
+        "model": model, "n_sessions": n_sessions, "slots": slots,
+        "spec_tokens": spec_tokens,
+        "greedy": greedy, "spec": spec,
+        "bitwise_equal": bitwise,
+        "acceptance": round(st["spec_acceptance"], 4),
+        "proposed": st["spec_proposed"],
+        "tokens_per_sec_ratio": round(
+            spec["tokens_per_sec"] / max(greedy["tokens_per_sec"], 1e-9),
+            3),
+    }
+    if record_path:
+        os.makedirs(os.path.dirname(os.path.abspath(record_path)),
+                    exist_ok=True)
+        with open(record_path, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+    return rec
+
+
 def _decode_vocab(net) -> int:
     return int(net.conf.layers[-1].n_out)
 

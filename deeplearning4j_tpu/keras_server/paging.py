@@ -1,0 +1,171 @@
+"""The decode memory plane: a refcounted physical page pool for KV state.
+
+PR 11's decode engine preallocates dense per-slot KV blocks
+``[cap, max_context, H, D]`` — HBM cost scales with capacity × context
+ceiling whether or not a slot has written a single token, and that product
+is the hard limit on concurrent sessions per chip. This module is the
+host half of the paged replacement (the PagedAttention layout, Kwon et
+al. SOSP 2023): the device holds ONE fixed physical pool
+``[n_pages + 1, page_size, H, D]`` per transformer layer, and each slot
+owns only a **page table** row of physical page ids. A slot consumes
+pages for tokens it has actually written; eviction returns them to the
+free list.
+
+Page id 0 is the **trash page**: never allocated, never mapped into a
+live table entry, the scatter target for slots that must not write this
+step (inactive slots, pool-exhaustion parking, positions clamped past the
+context ceiling). Its contents are garbage by design and unreachable by
+design — the ``j <= position`` attention mask never selects an unmapped
+page's rows, the same invariant that lets the dense engine skip cache
+zeroing on slot reuse.
+
+**Copy-on-write prefix sharing.** Completed *prompt* pages are published
+in a prefix registry keyed by the exact token prefix they cover; a
+session admitted with a matching prompt maps the same physical pages and
+bumps their refcount, skipping that much prefill outright. Any write into
+a page with refcount > 1 forks first (device-side page copy inside the
+compiled step), so sharers can never observe each other's divergence.
+Registry entries die with their page: refcount 0 frees the page AND
+drops its keys, so a recycled page can never serve a stale prefix.
+
+All methods assume the caller holds the engine lock (one pump thread plus
+admission); the pool itself is deliberately lock-free.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+import jax.numpy as jnp
+
+#: physical page 0 — the reserved scatter target for suppressed writes;
+#: never in the free list, never refcounted, never mapped by a live slot
+TRASH_PAGE = 0
+
+
+class PagePool:
+    """Host-side allocator for one engine's physical page pool.
+
+    Tracks the free list, per-page refcounts, and the prompt-prefix
+    registry. Device arrays are NOT held here — the engine owns them (they
+    ride the compiled step's donated blocks); the pool is pure
+    bookkeeping, which is what makes the refcount-leak test cheap.
+    """
+
+    def __init__(self, n_pages: int, page_size: int):
+        if n_pages < 1:
+            raise ValueError("page pool needs at least one page")
+        if page_size < 1:
+            raise ValueError("page_size must be >= 1")
+        self.n_pages = int(n_pages)
+        self.page_size = int(page_size)
+        # LIFO free list: a just-freed (hot) page is reused first
+        self._free: List[int] = list(range(self.n_pages, 0, -1))
+        self._ref: Dict[int, int] = {}
+        self._prefix: Dict[Tuple[int, ...], int] = {}
+        self._keys: Dict[int, Set[Tuple[int, ...]]] = {}
+
+    # ------------------------------------------------------------- allocation
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    @property
+    def pages_in_use(self) -> int:
+        return self.n_pages - len(self._free)
+
+    def alloc(self) -> Optional[int]:
+        """One exclusively-owned page, or ``None`` on exhaustion (the
+        caller parks or rejects — an exhausted pool is an admission
+        decision, never an OOM)."""
+        if not self._free:
+            return None
+        pid = self._free.pop()
+        self._ref[pid] = 1
+        return pid
+
+    def incref(self, pid: int) -> None:
+        self._ref[pid] += 1
+
+    def refcount(self, pid: int) -> int:
+        return self._ref.get(pid, 0)
+
+    def decref(self, pid: int) -> bool:
+        """Drop one reference; True when this freed the page. Freeing
+        drops the page's prefix-registry keys — a recycled page must never
+        be reachable under the tokens a previous tenant wrote."""
+        n = self._ref[pid] - 1
+        if n > 0:
+            self._ref[pid] = n
+            return False
+        del self._ref[pid]
+        for key in self._keys.pop(pid, ()):
+            if self._prefix.get(key) == pid:
+                del self._prefix[key]
+        self._free.append(pid)
+        return True
+
+    # -------------------------------------------------------- prefix sharing
+    def register(self, prefix: Sequence[int], pid: int) -> None:
+        """Publish ``pid`` as holding the KV rows for exactly the prompt
+        ``prefix`` (the page covers tokens ``[k*page_size, len(prefix))``
+        of it). First writer wins: an equal prefix is already backed by an
+        equivalent page, and bitwise-equal KV at that (attention state at
+        position j is a pure function of tokens[0..j])."""
+        key = tuple(int(t) for t in prefix)
+        if key in self._prefix:
+            return
+        self._prefix[key] = pid
+        self._keys.setdefault(pid, set()).add(key)
+
+    def match_prompt(self, prompt: Sequence[int]) -> Tuple[List[int], int]:
+        """Longest registered prefix of ``prompt``: full pages first, then
+        the longest partial tail inside the next page. Returns the page
+        chain and the number of prompt tokens it covers; refcounts are NOT
+        touched (the caller increfs if it actually maps the chain)."""
+        ps = self.page_size
+        prompt = [int(t) for t in prompt]
+        pids: List[int] = []
+        covered = 0
+        for k in range(len(prompt) // ps):
+            pid = self._prefix.get(tuple(prompt[:(k + 1) * ps]))
+            if pid is None:
+                break
+            pids.append(pid)
+            covered = (k + 1) * ps
+        tail: Optional[Tuple[int, int]] = None
+        for m in range(covered + 1, min(len(prompt), covered + ps) + 1):
+            pid = self._prefix.get(tuple(prompt[:m]))
+            if pid is not None:
+                tail = (pid, m)
+        if tail is not None:
+            pids.append(tail[0])
+            covered = tail[1]
+        return pids, covered
+
+    @property
+    def prefix_entries(self) -> int:
+        return len(self._prefix)
+
+
+# ----------------------------------------------------------- device allocation
+# THE home of raw KV allocation: the dense-kv-alloc lint rule flags
+# max_context-sized jnp.zeros anywhere else under keras_server/, so every
+# byte of decode state is accounted to one of these two layouts.
+
+def alloc_dense_kv(cap: int, max_context: int, n_heads: int, head_dim: int):
+    """One dense per-slot KV block ``[cap, max_context, H, D]`` (k and v)
+    — the PR 11 layout, kept as the paged plane's bitwise oracle."""
+    shape = (cap, max_context, n_heads, head_dim)
+    return {"k": jnp.zeros(shape, jnp.float32),
+            "v": jnp.zeros(shape, jnp.float32)}
+
+
+def alloc_page_pool(n_pages: int, page_size: int, n_heads: int,
+                    head_dim: int):
+    """One physical page pool ``[n_pages + 1, page_size, H, D]`` (k and
+    v); row 0 is the trash page. Allocated ONCE per engine — capacity
+    growth never touches it, which is exactly the dense layout's copy cost
+    this plane deletes."""
+    shape = (n_pages + 1, page_size, n_heads, head_dim)
+    return {"k": jnp.zeros(shape, jnp.float32),
+            "v": jnp.zeros(shape, jnp.float32)}

@@ -104,6 +104,10 @@ class ModelRegistry:
         self._lock = threading.RLock()
         self._versions: Dict[str, Dict[str, ModelVersion]] = {}
         self._active: Dict[str, str] = {}
+        #: target model name -> draft model name (speculative decoding):
+        #: the draft is a REGULAR registered model — versioned, hot-
+        #: swappable, visible in status() — the link only names it
+        self._drafts: Dict[str, str] = {}
         self.warmup_max_batch = warmup_max_batch
         self.warmup_workers = warmup_workers
         self._metrics = metrics or global_registry()
@@ -118,7 +122,8 @@ class ModelRegistry:
                  quant: Optional[str] = None,
                  sharding: Optional[str] = None, mesh=None, device=None,
                  replica: Optional[int] = None,
-                 warmup_example=None) -> ModelVersion:
+                 warmup_example=None,
+                 draft_for: Optional[str] = None) -> ModelVersion:
         """Pin ``net`` for serving and make it the active version.
 
         The predict program is built (and its parameter snapshot copied)
@@ -130,6 +135,8 @@ class ModelRegistry:
         ``sharding``/``mesh``/``device``/``replica`` choose the pin
         placement (see :class:`nn.inference.PredictFn`) — the ReplicaSet
         passes its per-replica mesh or device through here.
+        ``draft_for`` additionally links this model as the speculative-
+        decode draft of the named target model (see :meth:`link_draft`).
         """
         with self._lock:
             version = version or f"v{len(self._versions.get(name, {})) + 1}"
@@ -154,7 +161,30 @@ class ModelRegistry:
                 sum(len(v) for v in self._versions.values()))
             if swapping:
                 self._c_swaps.labels(model=name).inc()
+        if draft_for is not None:
+            self.link_draft(draft_for, name)
         return mv
+
+    # ------------------------------------------------- speculative drafts
+    def link_draft(self, name: str, draft_name: str) -> None:
+        """Name ``draft_name`` as the speculative-decode draft model of
+        ``name``. The draft is an ordinary registered model (its active
+        version resolves per-decode-engine, so hot-swapping the draft
+        retires engines exactly like hot-swapping the target)."""
+        with self._lock:
+            if draft_name not in self._versions:
+                raise KeyError(
+                    f"draft model {draft_name!r} is not registered "
+                    f"(loaded: {sorted(self._versions)})")
+            if draft_name == name:
+                raise ValueError(
+                    f"model {name!r} cannot be its own spec-decode draft")
+            self._drafts[name] = draft_name
+
+    def draft_of(self, name: str) -> Optional[str]:
+        """The linked draft model name for ``name``, or None."""
+        with self._lock:
+            return self._drafts.get(name)
 
     # ------------------------------------------------------------- warmup
     @staticmethod
@@ -241,6 +271,7 @@ class ModelRegistry:
                             for v, mv in sorted(versions.items())},
                     }
                     for name, versions in sorted(self._versions.items())},
+                "drafts": dict(sorted(self._drafts.items())),
             }
 
 

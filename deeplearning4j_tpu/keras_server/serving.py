@@ -249,6 +249,10 @@ class InferenceServer:
                  stream_ttl_s: float = 300.0, decode_min_slots: int = 2,
                  decode_max_slots: int = 16, decode_max_context: int = 256,
                  decode_eos_id: Optional[int] = None,
+                 decode_kv: str = "dense", decode_page_size: int = 16,
+                 decode_pool_pages: Optional[int] = None,
+                 decode_spec_draft: Optional[str] = None,
+                 decode_spec_tokens: int = 3,
                  replicas: int = 1, sharding: Optional[str] = None,
                  replica_devices=None,
                  replica_mesh_axes: Optional[dict] = None,
@@ -281,7 +285,12 @@ class InferenceServer:
         self.request_timeout_s = float(request_timeout_s)
         self._decode_opts = dict(
             min_slots=decode_min_slots, max_slots=decode_max_slots,
-            max_context=decode_max_context, eos_id=decode_eos_id)
+            max_context=decode_max_context, eos_id=decode_eos_id,
+            kv=decode_kv, page_size=decode_page_size,
+            n_pages=decode_pool_pages, spec_tokens=decode_spec_tokens)
+        #: explicit draft-model name for every decoder; None falls back to
+        #: the registry's per-target link (registry.draft_of)
+        self._decode_spec_draft = decode_spec_draft
         self._decoders: dict = {}
         self._dec_lock = threading.Lock()
         self._h_request = global_registry().histogram(
@@ -324,21 +333,31 @@ class InferenceServer:
         """The continuous-batching decode engine for ``model``'s active
         version, created lazily and shared by every /v1/generate request —
         the slot tensor IS the cross-request batch. A version inherits its
-        int8 serving DtypePolicy from how it was registered."""
+        int8 serving DtypePolicy from how it was registered. When a draft
+        model is linked (server option or registry.link_draft), the engine
+        decodes speculatively against the draft's active version — the key
+        carries both versions, so hot-swapping EITHER retires the engine."""
         mv = self.registry.active(model)
-        key = (mv.name, mv.version)
+        draft_name = self._decode_spec_draft \
+            or self.registry.draft_of(model)
+        draft_mv = (self.registry.active(draft_name)
+                    if draft_name is not None else None)
+        key = (mv.name, mv.version,
+               None if draft_mv is None else draft_mv.version)
         with self._dec_lock:
             eng = self._decoders.get(key)
             if eng is None:
                 eng = self._decoders[key] = DecodeEngine(
-                    mv.net, quant=mv.quant, **self._decode_opts)
+                    mv.net, quant=mv.quant,
+                    draft_net=None if draft_mv is None else draft_mv.net,
+                    **self._decode_opts)
             # hot swap moved the active pointer: retire this model's
             # stale-version engines once they have nothing in flight (their
             # pinned params + slot state are dead weight after a roll)
-            for (n0, v0), stale in list(self._decoders.items()):
-                if n0 == mv.name and v0 != mv.version and stale.idle():
+            for k0, stale in list(self._decoders.items()):
+                if k0[0] == mv.name and k0 != key and stale.idle():
                     stale.close()
-                    del self._decoders[(n0, v0)]
+                    del self._decoders[k0]
             return eng
 
     def stop(self) -> None:
@@ -357,8 +376,13 @@ class InferenceServer:
     def status(self) -> dict:
         """Everything /serve/status (here and on the training UI) shows."""
         with self._dec_lock:
-            decode = {f"{name}@{version}": eng.stats()
-                      for (name, version), eng in sorted(self._decoders.items())}
+            decode = {
+                f"{name}@{version}"
+                + (f"+draft@{dv}" if dv is not None else ""): eng.stats()
+                for (name, version, dv), eng
+                in sorted(self._decoders.items(),
+                          key=lambda kv: (kv[0][0], kv[0][1],
+                                          kv[0][2] or ""))}
         st = {
             **self.registry.status(),
             "queue": (self.batcher.stats() if self.batcher is not None

@@ -936,6 +936,65 @@ class HotPathCopy(Rule):
                         "lifetime reason the copy is required")
 
 
+# ---------------------------------------------------------------------------
+@register
+class DenseKvAlloc(Rule):
+    """No raw dense KV allocation outside the page allocator.
+
+    The paged memory plane works only if ``keras_server/paging.py`` is the
+    ONE place that sizes decode KV memory: a stray
+    ``jnp.zeros(..., max_context, ...)`` anywhere else in ``keras_server/``
+    silently re-introduces the per-slot dense preallocation the plane
+    deleted — it compiles, it is bitwise-correct, and it quietly halves the
+    session count per byte. Jurisdiction is ``keras_server/`` only (training
+    code allocates sequence-length buffers legitimately); the allocator
+    module itself is scoped out. Host scheduling arrays (``np.zeros`` with
+    no context dimension) are not flagged.
+    """
+
+    name = "dense-kv-alloc"
+    description = ("jnp.zeros sized by max_context under keras_server/ — "
+                   "decode KV memory is allocated ONLY by "
+                   "keras_server/paging.py (alloc_dense_kv / "
+                   "alloc_page_pool)")
+    exclude = ("*/keras_server/paging.py",)
+
+    _JURISDICTION = ("*/keras_server/*.py",)
+
+    def _in_jurisdiction(self, ctx: FileContext) -> bool:
+        paths = (ctx.rel, ctx.path.as_posix())
+        return any(fnmatch.fnmatch(p, pat)
+                   for p in paths for pat in self._JURISDICTION)
+
+    @staticmethod
+    def _mentions_max_context(node: ast.AST) -> bool:
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Name) and sub.id == "max_context":
+                return True
+            if isinstance(sub, ast.Attribute) and sub.attr == "max_context":
+                return True
+        return False
+
+    def check(self, ctx: FileContext) -> Iterator[Violation]:
+        tree = ctx.tree
+        if tree is None or not self._in_jurisdiction(ctx):
+            return
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted_name(node.func) or ""
+            if not name.endswith(".zeros") or name.startswith("np."):
+                continue
+            if any(self._mentions_max_context(a)
+                   for a in list(node.args)
+                   + [kw.value for kw in node.keywords]):
+                yield self.violation(
+                    ctx, node.lineno,
+                    "raw dense KV alloc (jnp.zeros sized by max_context) — "
+                    "route through keras_server/paging.py so the paged "
+                    "plane stays the only decode memory owner")
+
+
 def default_rules() -> List[Rule]:
     """Fresh instances of every registered rule, in registration order."""
     return [cls() for cls in REGISTRY.values()]

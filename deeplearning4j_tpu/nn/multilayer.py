@@ -341,7 +341,7 @@ class LazyScore:
         if getattr(self, "params_list", None) is None:
             raise RuntimeError(self.NOT_INITIALIZED_MSG)
 
-    def _jit(self, name, fn, donate=None, fingerprint=None):
+    def _jit(self, name, fn, donate=None, fingerprint=None, extra=()):
         """Per-network compiled-program cache, keyed on the program name AND
         the active dtype policy: the policy is read at trace time, so a
         name-only key would silently pin the policy active at first call.
@@ -351,19 +351,23 @@ class LazyScore:
         ``fingerprint`` overrides the identity used by the persistent
         executable cache when ``name`` carries per-instance decoration
         (serving versions ``@v2``, replica ranks ``~r1``) that must still
-        share warm entries."""
+        share warm entries. ``extra`` is a flat tuple of additional
+        program-geometry axes (e.g. the decode plane's page_size / pool
+        size) folded into both this cache's key and the persistent
+        executable fingerprint — same name, different geometry must never
+        resolve to the same traced program."""
         if not hasattr(self, "_jit_cache"):
             self._jit_cache = {}
         conf_dtype = getattr(getattr(getattr(self, "conf", None),
                                      "global_conf", None), "dtype", None)
         fn = common.wrap_with_policy(fn, conf_dtype)
         pol = common.effective_policy_key(conf_dtype)
-        key = (name,) + pol
+        key = (name, tuple(extra)) + pol
         if key not in self._jit_cache:
             # evict programs traced under a different policy — repeatedly
             # switching the global dtype policy must not grow the cache
             # without bound (each entry pins a compiled XLA program)
-            for stale in [k for k in self._jit_cache if k[1:] != pol]:
+            for stale in [k for k in self._jit_cache if k[2:] != pol]:
                 del self._jit_cache[stale]
             jitted = (jax.jit(fn, donate_argnums=donate)
                       if donate else jax.jit(fn))
@@ -381,7 +385,7 @@ class LazyScore:
                 f"{cls}.{name}", jitted, cache_key=key,
                 fingerprint=f"{cls}.{fingerprint or name}",
                 conf=getattr(self, "conf", None),
-                extra=("donate", donate) + tuple(pol))
+                extra=("donate", donate) + tuple(extra) + tuple(pol))
         return self._jit_cache[key]
 
     #: hook: the module-level K-step builder for this network type

@@ -96,6 +96,14 @@ SERVE_TTFT_SECONDS = "dl4j_serve_ttft_seconds"
 SERVE_TOKENS_TOTAL = "dl4j_serve_tokens_total"
 SERVE_EVICTIONS_TOTAL = "dl4j_serve_evictions_total"
 
+# --- paged decode memory plane + spec decoding (keras_server/paging.py,
+# keras_server/decode.py) ---------------------------------------------------
+DECODE_PAGES_IN_USE = "dl4j_decode_page_in_use"
+DECODE_PREFIX_SHARE_RATIO = "dl4j_decode_page_prefix_share_ratio"
+DECODE_SPEC_ACCEPTANCE = "dl4j_decode_spec_acceptance_ratio"
+DECODE_SPEC_TOKENS_TOTAL = "dl4j_decode_spec_tokens_total"
+DECODE_STATE_COPY_BYTES_TOTAL = "dl4j_decode_state_copy_bytes_total"
+
 # --- async parameter server (parallel/{param_server,ps_transport}.py) ------
 PS_PUSHES_TOTAL = "dl4j_ps_pushes_total"
 PS_PULLS_TOTAL = "dl4j_ps_pulls_total"

@@ -27,6 +27,12 @@
 #                                 # mid-decode admission/eviction, int8
 #                                 # drift bounds, compile-per-bucket, the
 #                                 # streaming churn regression, /v1/generate
+#   ./runtests.sh paged [args]    # paged KV memory plane + speculative
+#                                 # decoding: paged-vs-dense bitwise at
+#                                 # every bucket, CoW forks, refcount
+#                                 # churn, spec-vs-greedy bitwise, pool
+#                                 # 429s, the 2x-sessions ratio, bench
+#                                 # decode-kv-axis contract
 #   ./runtests.sh serve-shard [args]  # sharded multi-replica serving:
 #                                 # dp_tp bitwise-vs-single-device, rolling
 #                                 # hot swap zero-loss, least-queue router,
@@ -106,6 +112,16 @@ if [ "${1-}" = "decode" ]; then
   XLA_FLAGS="--xla_force_host_platform_device_count=8" \
   exec python -m pytest tests/test_decode.py \
     tests/test_bench_contract.py::test_config_key_serve_decode_axes -q "$@"
+fi
+
+if [ "${1-}" = "paged" ]; then
+  shift
+  PALLAS_AXON_POOL_IPS= \
+  JAX_PLATFORMS=cpu \
+  XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+  exec python -m pytest tests/test_paged_decode.py \
+    tests/test_decode.py \
+    tests/test_bench_contract.py::test_config_key_decode_kv_axes -q "$@"
 fi
 
 if [ "${1-}" = "serve-shard" ]; then

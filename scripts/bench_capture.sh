@@ -104,6 +104,13 @@ run --model ingest
 # row pins the cache-off world as its own config so a warm capture can
 # never stand in for the cold baseline after an outage
 run --model serve --compile-cache off
+# paged decode memory plane row (ISSUE 16): the default serve row above
+# already headlines the PAGED numbers (paged_sessions_ratio at equal state
+# bytes, paged_bitwise_equal, spec_speedup at the tiny draft's measured
+# acceptance); this dense-KV no-draft row pins the old decode world as its
+# own config so a paged/spec capture can never stand in for the dense
+# baseline after an outage
+run --model serve --decode-kv dense --decode-spec-draft none
 if [ "$MODE" = full ]; then
     run --model lenet
     run --model lenet --bf16-act

@@ -655,3 +655,41 @@ def test_config_key_compile_cache_axes():
                             ts="2026-08-06T10:00:01Z")
     assert old["compile_cache"] is None and new["compile_cache"] == "off"
     assert gate.endswith("Z") and gate > bench._DATAPLANE_AXIS_LANDED_TS
+
+
+def test_config_key_decode_kv_axes():
+    """The paged decode memory plane's axes (ISSUE 16) are config-distinct
+    serve axes: a dense-KV, odd-page-size, or no-draft capture must never
+    stand in for the paged + tiny-draft headline row (they measure
+    different decode engines); other models don't grow the axes; and the
+    ts-gate strips them on rows that predate the plane — those rows ran
+    dense KV with no draft model in the repo at all."""
+    import bench
+
+    a = bench._config_key("--model serve")
+    b = bench._config_key("--model serve --decode-kv dense")
+    c = bench._config_key("--model serve --decode-page-size 32")
+    d = bench._config_key("--model serve --decode-spec-draft none")
+    assert a != b and a["decode_kv"] == "paged" \
+        and b["decode_kv"] == "dense"
+    assert a != c and a["decode_page_size"] == "16" \
+        and c["decode_page_size"] == "32"
+    assert a != d and a["decode_spec_draft"] == "tiny" \
+        and d["decode_spec_draft"] == "none"
+    # no phantom axes on models without a decode section
+    for model in ("resnet50", "ps_async", "elastic"):
+        r = bench._config_key(f"--model {model}")
+        assert r["decode_kv"] is None and r["decode_page_size"] is None \
+            and r["decode_spec_draft"] is None
+    # rows logged before the plane landed cannot carry the axes
+    gate = bench._PAGED_DECODE_AXIS_LANDED_TS
+    old = bench._config_key("--model serve --decode-kv dense",
+                            ts="2026-08-07T07:59:59Z")
+    new = bench._config_key("--model serve --decode-kv dense",
+                            ts="2026-08-07T08:00:01Z")
+    assert old["decode_kv"] is None and old["decode_page_size"] is None \
+        and old["decode_spec_draft"] is None
+    assert new["decode_kv"] == "dense" and new["decode_page_size"] == "16"
+    assert old != bench._config_key("--model serve --decode-kv dense")
+    assert gate.endswith("Z") \
+        and gate > bench._COMPILE_CACHE_AXIS_LANDED_TS

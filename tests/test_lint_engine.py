@@ -522,6 +522,83 @@ def test_syntax_error_is_reported_not_crash(tmp_path):
     assert len(res.errors) == 1
 
 
+# ------------------------------------------------------------ dense-kv-alloc
+def _kv_fixture(tmp_path, source, name="decode_x.py"):
+    d = tmp_path / "keras_server"
+    d.mkdir(exist_ok=True)
+    f = d / name
+    f.write_text(textwrap.dedent(source))
+    return lint.run_paths([f], ["dense-kv-alloc"])
+
+
+def test_dense_kv_alloc_positive(tmp_path):
+    res = _kv_fixture(tmp_path, """\
+        import jax.numpy as jnp
+
+        def make_blocks(cap, max_context, n_heads, head_dim):
+            return jnp.zeros((cap, max_context, n_heads, head_dim),
+                             jnp.float32)
+        """)
+    assert rules_of(res) == ["dense-kv-alloc"]
+    assert res.violations[0].line == 4
+
+
+def test_dense_kv_alloc_attribute_dim_positive(tmp_path):
+    res = _kv_fixture(tmp_path, """\
+        import jax.numpy as jnp
+
+        class Engine:
+            def _blocks(self, cap, h, d):
+                return {"k": jnp.zeros((cap, self.max_context, h, d))}
+        """)
+    assert rules_of(res) == ["dense-kv-alloc"]
+
+
+def test_dense_kv_alloc_negative(tmp_path):
+    res = _kv_fixture(tmp_path, """\
+        import jax.numpy as jnp
+        import numpy as np
+
+        def other(cap, h, max_context):
+            hidden = jnp.zeros((cap, h))          # no context dimension
+            pos = np.zeros((max_context,), np.int32)  # host array, not KV
+            limit = max_context + 1               # bare use is fine
+            return hidden, pos, limit
+        """)
+    assert res.violations == []
+
+
+def test_dense_kv_alloc_paging_module_scoped_out(tmp_path):
+    res = _kv_fixture(tmp_path, """\
+        import jax.numpy as jnp
+
+        def alloc_dense_kv(cap, max_context, n_heads, head_dim):
+            return jnp.zeros((cap, max_context, n_heads, head_dim))
+        """, name="paging.py")
+    assert res.violations == []
+
+
+def test_dense_kv_alloc_outside_keras_server_scoped_out(tmp_path):
+    res = lint_src(tmp_path, """\
+        import jax.numpy as jnp
+
+        def scores(batch, max_context):
+            return jnp.zeros((batch, max_context))
+        """, rules=["dense-kv-alloc"])
+    assert res.violations == []
+
+
+def test_dense_kv_alloc_suppressed(tmp_path):
+    res = _kv_fixture(tmp_path, """\
+        import jax.numpy as jnp
+
+        def oracle(cap, max_context, n_heads, head_dim):
+            return jnp.zeros((cap, max_context, n_heads, head_dim))  # lint: dense-kv-alloc-ok (test-only dense oracle)
+        """)
+    assert res.violations == []
+    assert [v.rule for v in res.suppressed] == ["dense-kv-alloc"]
+
+
 # -------------------------------------------------------------- CLI contract
 def test_cli_registry_lists_all_rules(capsys):
     assert set(rule_names()) == set(REGISTRY) and len(REGISTRY) >= 6
