@@ -832,7 +832,7 @@ def bench_serve(batch, iters, ksteps, serve_qps=None, serve_latency_ms=None,
                 serve_batching=None, serve_quant=None,
                 serve_replicas=None, serve_sharding=None,
                 compile_cache=None, decode_kv=None, decode_page_size=None,
-                decode_spec_draft=None):
+                decode_spec_draft=None, serve_tracing=None):
     """Micro-batching A/B on the serving engine (ISSUE 9 headline).
 
     Unlike the fit benches this is fully CPU-measurable: the win is
@@ -870,6 +870,14 @@ def bench_serve(batch, iters, ksteps, serve_qps=None, serve_latency_ms=None,
     number is what an elastic respawn or replica spawn actually pays; the
     ``compile_cache`` axis picks which one is the row's headline
     ``time_to_ready_s``.
+
+    Round 17 adds the TRACING OVERHEAD section: the same warm MicroBatcher
+    submit loop timed with the trace store disabled (every span a no-op
+    singleton) vs enabled at 100% sampling, reported as
+    ``trace_overhead_pct`` — the serve-path cost of always-on request
+    tracing, budgeted at <= 2% by the tier-1 contract test. The
+    ``serve_tracing`` axis is config-distinct (an untraced capture never
+    stands in for the tracing-on default row).
     """
     import numpy as np
 
@@ -1106,6 +1114,64 @@ def bench_serve(batch, iters, ksteps, serve_qps=None, serve_latency_ms=None,
         "time_to_ready_speedup": (round(cold_s / warm_s, 2)
                                   if warm_s > 0 else None),
     }
+    # tracing overhead section: A/B the in-process submit path (registry +
+    # MicroBatcher, no HTTP — socket jitter would swamp a 2% signal) with
+    # the trace store disabled vs enabled at 100% sampling. Warm first so
+    # neither phase pays the bucket compile.
+    from deeplearning4j_tpu.observability.tracing import (
+        TraceStore, global_trace_store, set_global_trace_store, trace_span)
+
+    serve_tracing = serve_tracing or "on"
+    tr_registry = ModelRegistry()
+    tr_registry.register("trace_mlp", MultiLayerNetwork(conf).init())
+    tr_example = np.random.default_rng(3).normal(
+        size=(1, n_in)).astype(np.float32)
+    from deeplearning4j_tpu.keras_server.batcher import MicroBatcher
+    tr_batcher = MicroBatcher(tr_registry, max_batch=8,
+                              max_latency_s=0.0005, max_queue=1024)
+    tr_requests = 400
+
+    def _trace_phase() -> float:
+        # each submit runs under a per-request root span, mirroring the
+        # HTTP handler's `http /v1/predict` root (admission + batch.queue
+        # become children, not root traces of their own); with the store
+        # disabled trace_span returns the no-op singleton so the off
+        # phase pays nothing
+        for f in [tr_batcher.submit("trace_mlp", tr_example)
+                  for _ in range(32)]:
+            f.result(timeout=30)  # warm: compile + settle the dispatcher
+        t0 = time.perf_counter()
+        for _ in range(tr_requests // 8):
+            futs = []
+            for _ in range(8):
+                with trace_span("bench.request"):
+                    futs.append(tr_batcher.submit("trace_mlp", tr_example))
+            for f in futs:
+                f.result(timeout=30)
+        return time.perf_counter() - t0
+
+    saved_store = global_trace_store()
+    try:
+        set_global_trace_store(TraceStore(enabled=False))
+        trace_off_s = _trace_phase()
+        set_global_trace_store(
+            TraceStore(enabled=True, sample=1.0, capacity=256))
+        trace_on_s = _trace_phase()
+    finally:
+        set_global_trace_store(saved_store)
+        tr_batcher.close()
+    # the in-process A/B isolates the absolute tracing cost per request
+    # (HTTP jitter would swamp it); the pct expresses that cost against
+    # the REAL serve-path request latency from the batched phase above
+    trace_us = max(0.0, (trace_on_s - trace_off_s) / tr_requests * 1e6)
+    tr_p50_us = batched["p50_ms"] * 1e3
+    trace_sec = {
+        "serve_tracing": serve_tracing,
+        "trace_cost_us_per_request": round(trace_us, 1),
+        "trace_overhead_pct": (round(trace_us / tr_p50_us * 100.0, 2)
+                               if tr_p50_us > 0 else None),
+    }
+
     return {
         "samples_per_sec": batched["achieved_qps"],  # headline: batched QPS
         "offered_qps": qps,
@@ -1126,6 +1192,7 @@ def bench_serve(batch, iters, ksteps, serve_qps=None, serve_latency_ms=None,
         **paged_sec,
         **replica_sec,
         **ready,
+        **trace_sec,
         "api": "keras_server.InferenceServer /v1/predict + /v1/generate",
     }
 
@@ -1815,6 +1882,8 @@ def _child_main(args) -> None:
             kwargs["decode_page_size"] = args.decode_page_size
         if args.decode_spec_draft:
             kwargs["decode_spec_draft"] = args.decode_spec_draft
+        if args.serve_tracing:
+            kwargs["serve_tracing"] = args.serve_tracing
     if args.model == "ps_async":
         if args.ps_workers:
             kwargs["ps_workers"] = args.ps_workers
@@ -2006,6 +2075,12 @@ def main() -> None:
                          "width-16 transformer proposing 3 tokens/round); "
                          "'none' skips the spec section (its fields "
                          "report null)")
+    ap.add_argument("--serve-tracing", default=None, choices=("on", "off"),
+                    help="serve bench request-tracing axis (config-"
+                         "distinct); default on — the overhead A/B always "
+                         "runs both phases and trace_overhead_pct reports "
+                         "the serve-path cost of 100%%-sampled tracing "
+                         "(budget <= 2%%, pinned by test_bench_contract)")
     ap.add_argument("--ps-workers", type=int, default=None,
                     help="ps_async bench worker count for the straggler A/B "
                          "(config-distinct); default 4")
@@ -2279,6 +2354,12 @@ _COMPILE_CACHE_AXIS_LANDED_TS = "2026-08-06T10:00:00Z"
 #: no-draft capture must never stand in for the spec-decode speedup row
 _PAGED_DECODE_AXIS_LANDED_TS = "2026-08-07T08:00:00Z"
 
+#: when the request-tracing plane landed (ISSUE 17): serve rows before
+#: this predate --serve-tracing and the trace_overhead_pct field (requests
+#: ran untraced), so an untraced capture must never stand in for today's
+#: tracing-on default row whose headline carries the overhead budget
+_SERVE_TRACING_AXIS_LANDED_TS = "2026-08-07T12:00:00Z"
+
 
 def _config_key(args_str: str, ts: str = None) -> dict:
     """The fields that make two bench invocations the SAME config: model,
@@ -2384,6 +2465,12 @@ def _config_key(args_str: str, ts: str = None) -> dict:
         decode_kv = val("--decode-kv") or "paged"
         decode_page_size = val("--decode-page-size") or "16"
         decode_spec_draft = val("--decode-spec-draft") or "tiny"
+    serve_tracing = None
+    if model == "serve" and not (
+            ts is not None and ts < _SERVE_TRACING_AXIS_LANDED_TS):
+        # default-on is its own config: an untraced capture must never
+        # stand in for the tracing-on row (and vice versa)
+        serve_tracing = val("--serve-tracing") or "on"
     return {"model": model, "batch": val("--batch"),
             "ksteps": val("--ksteps"), "dtype": mode, "rdtype": rdtype,
             "seq": val("--seq"), "vocab": val("--vocab"),
@@ -2399,7 +2486,8 @@ def _config_key(args_str: str, ts: str = None) -> dict:
             "ps_transport": ps_transport, "ingest_codec": ingest_codec,
             "compile_cache": compile_cache, "decode_kv": decode_kv,
             "decode_page_size": decode_page_size,
-            "decode_spec_draft": decode_spec_draft}
+            "decode_spec_draft": decode_spec_draft,
+            "serve_tracing": serve_tracing}
 
 
 def _last_healthy_from_log(args_str: str, path: str = None):

@@ -165,7 +165,11 @@ def _cmd_keras_server(args) -> int:
 
 def _cmd_serve(args) -> int:
     from deeplearning4j_tpu.keras_server import InferenceServer
+    from deeplearning4j_tpu.observability import tracing
 
+    tracing.configure(enabled=not args.no_tracing,
+                      sample=args.trace_sample,
+                      base_dir=args.trace_dir)
     srv = InferenceServer(
         host=args.host, port=args.port, replicas=args.replicas,
         sharding=args.sharding, max_batch=args.max_batch,
@@ -178,8 +182,11 @@ def _cmd_serve(args) -> int:
     srv.start()
     mode = (f"{args.replicas} replica(s)"
             + (f", {args.sharding}-sharded" if args.sharding else ""))
+    trace = ("off" if args.no_tracing
+             else f"on, sample={args.trace_sample:g}")
     print(f"inference server listening on http://{args.host}:{srv.port} "
-          f"({mode}; POST /v1/predict, GET /serve/status)")
+          f"({mode}; POST /v1/predict, GET /serve/status; "
+          f"tracing {trace} — GET /serve/traces, /serve/slo)")
     try:
         while True:
             time.sleep(3600)
@@ -292,6 +299,15 @@ def build_parser() -> argparse.ArgumentParser:
                          "--max-batch (parallel, executable-cache-backed) "
                          "before the model goes active, so the first real "
                          "request never pays an XLA compile")
+    sv.add_argument("--no-tracing", action="store_true",
+                    help="disable request tracing (spans become process-"
+                         "wide no-ops; /serve/traces serves empty)")
+    sv.add_argument("--trace-sample", type=float, default=1.0,
+                    help="tail-sampling keep probability for ORDINARY "
+                         "traces; errors/429s/p99-exceeders always keep")
+    sv.add_argument("--trace-dir", default=None,
+                    help="persist kept traces (traces.jsonl + "
+                         "trace_index.db) under this directory")
     sv.set_defaults(fn=_cmd_serve)
     return p
 

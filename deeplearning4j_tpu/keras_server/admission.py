@@ -14,6 +14,7 @@ import threading
 
 from deeplearning4j_tpu.observability import names as _n
 from deeplearning4j_tpu.observability.metrics import global_registry
+from deeplearning4j_tpu.observability.tracing import trace_span
 
 
 class RejectedError(RuntimeError):
@@ -52,17 +53,26 @@ class AdmissionController:
             return self._pending
 
     def admit(self, n: int = 1) -> None:
-        """Admit ``n`` requests or raise :class:`RejectedError`."""
-        with self._lock:
-            if self._pending + n > self.max_pending:
-                self.rejected += n
-                self._c_rejected.inc(n)
-                # crude but honest: a full queue drains one expected-latency
-                # per slot; clients treat it as a floor, not a promise
-                raise RejectedError(self._pending, self.max_pending,
-                                    self.expected_latency_s)
-            self._pending += n
-            self._g_depth.set(self._pending)
+        """Admit ``n`` requests or raise :class:`RejectedError`. The
+        decision is a trace span: accepted requests record the depth they
+        entered at, rejects stamp ``status="rejected"`` — the tail sampler
+        always keeps rejected traces."""
+        with trace_span("admission") as sp:
+            with self._lock:
+                if self._pending + n > self.max_pending:
+                    self.rejected += n
+                    self._c_rejected.inc(n)
+                    sp.set_status("rejected")
+                    sp.set_attr(pending=self._pending,
+                                limit=self.max_pending)
+                    # crude but honest: a full queue drains one expected-
+                    # latency per slot; clients treat it as a floor, not a
+                    # promise
+                    raise RejectedError(self._pending, self.max_pending,
+                                        self.expected_latency_s)
+                self._pending += n
+                self._g_depth.set(self._pending)
+                sp.set_attr(pending=self._pending, limit=self.max_pending)
 
     def release(self, n: int = 1) -> None:
         with self._lock:

@@ -48,6 +48,7 @@ from deeplearning4j_tpu.observability.metrics import global_registry
 from deeplearning4j_tpu.observability.profiler import (
     note_dispatch as _profile_note_dispatch,
 )
+from deeplearning4j_tpu.observability.tracing import start_span
 from deeplearning4j_tpu.observability.watchdog import beat as _wd_beat
 
 from .admission import AdmissionController, RejectedError  # noqa: F401
@@ -65,7 +66,7 @@ def batch_bucket(n: int, max_batch: int) -> int:
 
 
 class _Request:
-    __slots__ = ("model", "xs", "n", "key", "future", "t_enqueue")
+    __slots__ = ("model", "xs", "n", "key", "future", "t_enqueue", "span")
 
     def __init__(self, model: str, xs: Tuple[np.ndarray, ...], key: Tuple,
                  t_enqueue: float):
@@ -75,6 +76,11 @@ class _Request:
         self.key = key
         self.future: Future = Future()
         self.t_enqueue = t_enqueue
+        # queue-wait span, started on the submitting thread (where the
+        # request's trace context is ambient) and finished by the
+        # dispatcher — contextvars don't cross threads, the slot does
+        self.span = start_span("batch.queue", model=model,
+                               rows=self.n)
 
 
 class MicroBatcher:
@@ -166,6 +172,7 @@ class MicroBatcher:
         with self._cond:
             if self._closed:
                 self.admission.release()
+                req.span.set_status("error").finish()
                 raise RuntimeError("MicroBatcher is closed")
             self._queue.append(req)
             self._cond.notify()
@@ -209,11 +216,36 @@ class MicroBatcher:
     def _dispatch(self, group: List[_Request]) -> None:
         rows = sum(r.n for r in group)
         bucket = batch_bucket(rows, self.max_batch)
+        # close each member's queue-wait span at the group cut, then open
+        # ONE dispatch span on its own trace that *links* the N member
+        # traces (OTel batch-consumer fan-in: no single parent is honest)
+        links = []
+        for r in group:
+            r.span.set_attr(bucket=bucket)
+            ref = r.span.ref()
+            if ref is not None:
+                links.append(ref)
+            r.span.finish()
+        dspan = start_span("batch.dispatch", links=tuple(links),
+                           model=group[0].model, rows=rows, bucket=bucket,
+                           requests=len(group))
+        if self.replica is not None:
+            dspan.set_attr(replica=self.replica)
+        try:
+            self._dispatch_inner(group, rows, bucket, dspan)
+        finally:
+            dspan.finish()
+
+    def _dispatch_inner(self, group: List[_Request], rows: int,
+                        bucket: int, dspan) -> None:
         try:
             # (replica, version) resolve HERE, at dispatch time: the atomic
             # active pointer means a group enqueued against version N can
             # legally dispatch against N+1 — each is internally consistent
             mv = self.registry.active(group[0].model)
+            dspan.set_attr(
+                version=mv.version,
+                compile_cache_hit=getattr(mv.predict_fn, "cache_hit", None))
             n_inputs = len(group[0].xs)
             xs = []
             for j in range(n_inputs):
@@ -232,6 +264,7 @@ class MicroBatcher:
             dt = time.perf_counter() - t0
         except Exception as e:
             self._c_errors.inc(len(group))
+            dspan.set_status("error").set_attr(error=repr(e))
             _flight_recorder().dump(
                 reason="serve-dispatch-error",
                 extra={"model": group[0].model, "rows": rows,
@@ -245,6 +278,7 @@ class MicroBatcher:
                 self._g_replica_queue.labels(
                     replica=str(self.replica)).set(self.admission.pending)
         occupancy = rows / bucket
+        dspan.set_attr(dispatch_s=round(dt, 6), occupancy=round(occupancy, 4))
         # a serve dispatch advances the step clock like a fit dispatch, so
         # the recompile-storm window is measured in dispatches (bucket
         # warm-up compiles are expected; steady-state compiles are the bug)

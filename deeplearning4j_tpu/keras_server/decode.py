@@ -91,6 +91,9 @@ from deeplearning4j_tpu.observability.metrics import global_registry
 from deeplearning4j_tpu.observability.profiler import (
     note_dispatch as _profile_note_dispatch,
 )
+from deeplearning4j_tpu.observability.tracing import (
+    NOOP_SPAN, global_trace_store, start_span,
+)
 from deeplearning4j_tpu.observability.watchdog import beat as _wd_beat
 from deeplearning4j_tpu.ops.paged_attention import paged_gather
 from deeplearning4j_tpu.ops.quant import (
@@ -166,6 +169,15 @@ class DecodeSession:
         #: spec-decode stream history: every input token the target has
         #: consumed or will consume next (prompt + accepted emissions)
         self._hist: List[int] = []
+        # request-trace spans, owned across threads via the session object
+        # (contextvars do not follow the pump thread); all no-ops when
+        # tracing is disabled
+        self._span = NOOP_SPAN   #: decode.queue — submit -> admit
+        self._span_phase = None  #: decode.prefill, then decode.decode
+        self._span_park = None   #: open page-starvation episode
+        #: per-session spec-decode tallies (stamped on the decode span)
+        self._spec_proposed = 0
+        self._spec_accepted = 0
 
     @property
     def ttft_s(self) -> Optional[float]:
@@ -718,28 +730,42 @@ class DecodeEngine:
         """Queue one generation session; returns immediately."""
         sess = DecodeSession(prompt, max_new_tokens, t_sched=t_sched,
                              stream=stream)
-        bad = [t for t in sess.prompt if not 0 <= t < self.vocab]
-        if bad:
-            raise ValueError(f"prompt token ids {bad} outside vocab "
-                             f"[0, {self.vocab})")
-        if self._pool is not None:
-            span = min(len(sess.prompt) + sess.max_new_tokens,
-                       self.max_context)
-            worst = -(-span // self.page_size)
-            if worst > self._n_pages:
-                # the session can NEVER fit this pool — fail fast with the
-                # 429 the HTTP layer already maps, not a mid-decode OOM
-                raise RejectedError(worst, self._n_pages, 60.0)
-        with self._cond:
-            if self._closed:
-                raise RuntimeError("DecodeEngine is closed")
-            if len(self._queue) >= self.max_queue:
-                # Retry-After: the backlog drains roughly a session per
-                # slot per active session's remaining budget; 1s is the
-                # honest coarse answer at this layer
-                raise RejectedError(len(self._queue), self.max_queue, 1.0)
-            self._queue.append(sess)
-            self._cond.notify()
+        # parented under the ambient span (the HTTP handler's root) on
+        # THIS thread; the pump finishes it cross-thread via the session
+        sess._span = start_span("decode.queue", sid=sess.sid,
+                                prompt_len=len(sess.prompt),
+                                max_new=sess.max_new_tokens)
+        try:
+            bad = [t for t in sess.prompt if not 0 <= t < self.vocab]
+            if bad:
+                raise ValueError(f"prompt token ids {bad} outside vocab "
+                                 f"[0, {self.vocab})")
+            if self._pool is not None:
+                span = min(len(sess.prompt) + sess.max_new_tokens,
+                           self.max_context)
+                worst = -(-span // self.page_size)
+                if worst > self._n_pages:
+                    # the session can NEVER fit this pool — fail fast with
+                    # the 429 the HTTP layer already maps, not a
+                    # mid-decode OOM
+                    raise RejectedError(worst, self._n_pages, 60.0)
+            with self._cond:
+                if self._closed:
+                    raise RuntimeError("DecodeEngine is closed")
+                if len(self._queue) >= self.max_queue:
+                    # Retry-After: the backlog drains roughly a session
+                    # per slot per active session's remaining budget; 1s
+                    # is the honest coarse answer at this layer
+                    raise RejectedError(len(self._queue), self.max_queue,
+                                        1.0)
+                self._queue.append(sess)
+                self._cond.notify()
+        except RejectedError:
+            sess._span.set_status("rejected").finish()
+            raise
+        except Exception:
+            sess._span.set_status("error").finish()
+            raise
         return sess
 
     # ----------------------------------------------------------------- pump
@@ -787,6 +813,11 @@ class DecodeEngine:
             self._pos_h[i] = skip
             self._fresh_h[i] = True
             sess._prompt_idx = skip
+            sess._span.set_attr(slot=i, skip=skip)
+            sess._span.finish()
+            sess._span_phase = start_span(
+                "decode.prefill", parent=self._span_parent(sess),
+                sid=sess.sid, prompt_len=len(sess.prompt), skip=skip)
             if self._spec_draft is not None:
                 sess._hist = list(sess.prompt)
                 self._dpos_h[i] = 0
@@ -801,6 +832,36 @@ class DecodeEngine:
             self._pool.decref(pid)
         row[:] = TRASH_PAGE
 
+    @staticmethod
+    def _span_parent(sess):
+        """The session's queue span as a parent, or None so a real span
+        never parents under the no-op singleton's empty trace id."""
+        return sess._span if sess._span is not NOOP_SPAN else None
+
+    def _trace_evict_locked(self, sess, reason: str) -> None:
+        """Close the session's open spans at eviction: preemption emits an
+        instant ``decode.preempt`` span so the victim's trace names why it
+        ended mid-stream, step errors flip the phase span's status (the
+        tail sampler then always keeps the trace)."""
+        if sess._span_park is not None:
+            sess._span_park.set_attr(evicted=True)
+            sess._span_park.finish()
+            sess._span_park = None
+        if reason == "pool_exhausted":
+            start_span("decode.preempt", parent=self._span_parent(sess),
+                       sid=sess.sid).finish()
+        sp = sess._span_phase
+        if sp is not None:
+            if reason == "error":
+                sp.set_status("error")
+            sp.set_attr(reason=reason, tokens=len(sess.tokens))
+            if sess._spec_proposed:
+                sp.set_attr(spec_proposed=sess._spec_proposed,
+                            spec_accepted=sess._spec_accepted)
+            sp.finish()
+            sess._span_phase = None
+        sess._span.finish()  # idempotent; covers never-admitted paths
+
     def _evict_locked(self, i: int, reason: str) -> None:
         sess = self._slots[i]
         self._slots[i] = None
@@ -808,6 +869,7 @@ class DecodeEngine:
             self._release_pages_locked(i)
         self._evicted += 1
         self._c_evictions.labels(reason=reason).inc()
+        self._trace_evict_locked(sess, reason)
         sess.evict_reason = reason
         sess.t_done = time.perf_counter()
         sess.done.set()
@@ -877,6 +939,20 @@ class DecodeEngine:
             pending = [i for i in still if i != victim]
             if not pending:
                 break
+        # park-episode spans: one span per contiguous starved stretch, so
+        # a trace shows exactly when pool pressure stalled the session
+        for i in range(self._cap):
+            sess = self._slots[i]
+            if sess is None:
+                continue
+            if self._park_h[i]:
+                if sess._span_park is None:
+                    sess._span_park = start_span(
+                        "decode.park", parent=self._span_parent(sess),
+                        sid=sess.sid, reason="pool_exhausted")
+            elif sess._span_park is not None:
+                sess._span_park.finish()
+                sess._span_park = None
         self._g_pages.set(self._pool.pages_in_use)
 
     def _register_prefix_locked(self, i: int, sess, lo: int,
@@ -889,6 +965,20 @@ class DecodeEngine:
         for q in range(lo, min(hi, len(sess.prompt))):
             self._pool.register(sess.prompt[:q + 1],
                                 int(self._table_h[i, q // ps]))
+
+    def _note_first_token(self, sess, ttft: float) -> None:
+        """Prefill -> decode phase flip on the session's trace, plus the
+        TTFT exemplar so a burning TTFT SLO can name this trace."""
+        sp = sess._span_phase
+        if sp is None:
+            return
+        sp.set_attr(ttft_s=round(ttft, 6))
+        sp.finish()
+        sess._span_phase = start_span(
+            "decode.decode", parent=self._span_parent(sess), sid=sess.sid)
+        if sp.trace_id:
+            global_trace_store().put_exemplar(
+                _n.SERVE_TTFT_SECONDS, ttft, sp.trace_id)
 
     def _pump_once(self) -> bool:
         """One admit/step/bookkeep iteration; False when idle-and-closed."""
@@ -990,6 +1080,7 @@ class DecodeEngine:
                     if sess.t_first is None:
                         sess.t_first = now
                         self._h_ttft.observe(now - sess.t_sched)
+                        self._note_first_token(sess, now - sess.t_sched)
                     self._generated += 1
                     self._c_tokens.inc()
                     if sess.stream is not None:
@@ -1171,6 +1262,7 @@ class DecodeEngine:
                     if s.t_first is None:
                         s.t_first = now
                         self._h_ttft.observe(now - s.t_sched)
+                        self._note_first_token(s, now - s.t_sched)
                     self._generated += 1
                     self._c_tokens.inc()
                     if s.stream is not None:
@@ -1186,6 +1278,8 @@ class DecodeEngine:
                 new_p = p + n_ok
                 self._spec_proposed += proposed
                 self._spec_accepted += accepted
+                s._spec_proposed += proposed
+                s._spec_accepted += accepted
                 if proposed:
                     self._c_spec.labels(outcome="proposed").inc(proposed)
                     self._c_spec.labels(outcome="accepted").inc(accepted)

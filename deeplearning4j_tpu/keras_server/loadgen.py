@@ -38,6 +38,7 @@ from __future__ import annotations
 import http.client
 import json
 import os
+import random
 import threading
 import time
 from typing import Callable, List, Optional, Tuple
@@ -100,10 +101,25 @@ def _connect(host: str, port: int,
     return conn
 
 
+#: trace-id RNG: an instance, not the hidden global — ids must be unique,
+#: never reproducible, and must not perturb seeded workload generation
+_trace_rand = random.Random()
+
+
+def _mint_traceparent() -> str:
+    """A fresh client-side W3C trace context per request, so server-side
+    span trees parent under the load client's ids (exactly what a fronting
+    gateway would send) and a slow request is findable by the id the
+    response echoes back."""
+    return (f"00-{_trace_rand.getrandbits(128):032x}"
+            f"-{_trace_rand.getrandbits(64):016x}-01")
+
+
 def _post_predict(conn: http.client.HTTPConnection, model: str,
                   payload: bytes) -> int:
     conn.request("POST", "/v1/predict", body=payload,
-                 headers={"Content-Type": "application/json"})
+                 headers={"Content-Type": "application/json",
+                          "traceparent": _mint_traceparent()})
     resp = conn.getresponse()
     resp.read()
     return resp.status

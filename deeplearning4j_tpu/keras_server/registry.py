@@ -28,6 +28,7 @@ from typing import Dict, List, Optional
 from deeplearning4j_tpu.nn.inference import PredictFn, make_predict_fn
 from deeplearning4j_tpu.observability import names as _n
 from deeplearning4j_tpu.observability.metrics import global_registry
+from deeplearning4j_tpu.observability.tracing import trace_span
 
 
 def load_model_file(path: str):
@@ -144,23 +145,26 @@ class ModelRegistry:
                 raise ValueError(
                     f"model {name!r} already has version {version!r}; "
                     "versions are immutable — register a new one")
-        pf = make_predict_fn(net, version=version, quant=quant,
-                             sharding=sharding, mesh=mesh, device=device,
-                             replica=replica)
-        if self.warmup_max_batch:
-            # still off the serving path: the old version keeps serving
-            # while every bucket program of the new one is built
-            self._warmup(pf, net, warmup_example)
-        with self._lock:
-            swapping = name in self._active
-            mv = ModelVersion(name, version, net, pf, source=source,
-                              quant=pf.quant)
-            self._versions.setdefault(name, {})[version] = mv
-            self._active[name] = version
-            self._g_models.set(
-                sum(len(v) for v in self._versions.values()))
-            if swapping:
-                self._c_swaps.labels(model=name).inc()
+        with trace_span("registry.register", model=name, version=version,
+                        warmup=bool(self.warmup_max_batch)) as rsp:
+            pf = make_predict_fn(net, version=version, quant=quant,
+                                 sharding=sharding, mesh=mesh, device=device,
+                                 replica=replica)
+            if self.warmup_max_batch:
+                # still off the serving path: the old version keeps serving
+                # while every bucket program of the new one is built
+                self._warmup(pf, net, warmup_example)
+            with self._lock:
+                swapping = name in self._active
+                mv = ModelVersion(name, version, net, pf, source=source,
+                                  quant=pf.quant)
+                self._versions.setdefault(name, {})[version] = mv
+                self._active[name] = version
+                self._g_models.set(
+                    sum(len(v) for v in self._versions.values()))
+                if swapping:
+                    self._c_swaps.labels(model=name).inc()
+            rsp.set_attr(hot_swap=swapping)
         if draft_for is not None:
             self.link_draft(draft_for, name)
         return mv

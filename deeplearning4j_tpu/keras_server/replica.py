@@ -38,6 +38,7 @@ from typing import Dict, List, Optional
 
 from deeplearning4j_tpu.observability import names as _n
 from deeplearning4j_tpu.observability.metrics import global_registry
+from deeplearning4j_tpu.observability.tracing import trace_span
 
 from .admission import RejectedError
 from .batcher import MicroBatcher
@@ -225,19 +226,25 @@ class ReplicaSet:
         candidates = [r for r in self._replicas if not r.draining] \
             or list(self._replicas)
         last: Optional[RejectedError] = None
-        for r in sorted(candidates, key=lambda r: (r.queue_depth(),
-                                                   r.index)):
-            try:
-                fut = r.batcher.submit(model, x)
-            except RejectedError as e:
-                last = e
-                continue
-            self._c_routed.labels(replica=str(r.index)).inc()
-            with self._lock:
-                self._routed[r.index] += 1
-            return fut
-        assert last is not None
-        raise last
+        with trace_span("replica.route", model=model) as sp:
+            tried = 0
+            for r in sorted(candidates, key=lambda r: (r.queue_depth(),
+                                                       r.index)):
+                tried += 1
+                try:
+                    fut = r.batcher.submit(model, x)
+                except RejectedError as e:
+                    last = e
+                    continue
+                self._c_routed.labels(replica=str(r.index)).inc()
+                sp.set_attr(replica=r.index, tried=tried)
+                with self._lock:
+                    self._routed[r.index] += 1
+                return fut
+            sp.set_status("rejected")
+            sp.set_attr(tried=tried)
+            assert last is not None
+            raise last
 
     # ------------------------------------------------------------- control
     def queue_stats(self) -> dict:

@@ -24,10 +24,17 @@ Routes:
   request shares slot capacity with every other in-flight generation.
 - ``GET /serve/status`` — models/versions, queue depth, bucket occupancy
   (the same payload the training UI proxies).
+- ``GET /serve/traces`` / ``GET /serve/traces/<id>`` — tail-sampled trace
+  summaries / one full span tree (observability/tracing.py).
+- ``GET /serve/slo`` — SLO burn-rate evaluation with trace exemplars
+  (observability/slo.py).
 - ``GET /metrics`` — Prometheus text (standalone deployments; the UI
   server exposes the same registry).
 
 Per-route latency lands in ``dl4j_serve_request_seconds{route=...}``.
+Every POST extracts (or mints) a W3C ``traceparent``, makes it the
+handler thread's ambient trace context, and echoes the root span's id
+back in the response headers.
 """
 from __future__ import annotations
 
@@ -44,6 +51,10 @@ import numpy as np
 
 from deeplearning4j_tpu.observability import names as _n
 from deeplearning4j_tpu.observability.metrics import global_registry
+from deeplearning4j_tpu.observability.slo import SLOEngine
+from deeplearning4j_tpu.observability.tracing import (
+    TRACEPARENT_HEADER, global_trace_store, parse_traceparent, trace_span,
+)
 
 from .admission import RejectedError
 from .batcher import MicroBatcher
@@ -78,6 +89,9 @@ class _ServeHandler(BaseHTTPRequestHandler):
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
+        tp = getattr(self, "_traceparent", "")
+        if tp:
+            self.send_header(TRACEPARENT_HEADER, tp)
         for k, v in headers:
             self.send_header(k, v)
         self.end_headers()
@@ -96,8 +110,20 @@ class _ServeHandler(BaseHTTPRequestHandler):
     # -------------------------------------------------------------- routes
     def do_GET(self):
         path = urlparse(self.path).path
+        self._traceparent = ""
         if path == "/serve/status":
             self._json(self.engine.status())
+        elif path == "/serve/traces":
+            self._json({"traces": global_trace_store().list()})
+        elif path.startswith("/serve/traces/"):
+            trace_id = path.rsplit("/", 1)[1]
+            rec = global_trace_store().get(trace_id)
+            if rec is None:
+                self._json({"error": f"unknown trace {trace_id}"}, code=404)
+            else:
+                self._json(rec)
+        elif path == "/serve/slo":
+            self._json({"slo": self.engine.slo.evaluate()})
         elif path == "/metrics":
             body = global_registry().prometheus_text().encode()
             self.send_response(200)
@@ -111,34 +137,58 @@ class _ServeHandler(BaseHTTPRequestHandler):
     def do_POST(self):
         path = urlparse(self.path).path
         t0 = time.perf_counter()
+        # extract the caller's trace context (or mint a fresh trace), make
+        # it ambient for everything this handler thread does, and echo the
+        # ROOT span's traceparent on every response so the client can fetch
+        # /serve/traces/<id> afterwards
+        parent = parse_traceparent(self.headers.get(TRACEPARENT_HEADER))
+        root = trace_span(f"http {path}", parent=parent, route=path)
+        self._trace = root
+        self._traceparent = root.traceparent()
         try:
-            if path == "/v1/predict":
-                self._predict()
-            elif path == "/v1/stream":
-                self._stream()
-            elif path == "/v1/generate":
-                self._generate()
-            elif path == "/v1/stream/reset":
-                req = self._body()
-                existed = self.engine.sessions.reset(
-                    str(req.get("model", "")), str(req.get("session", "")))
-                self._json({"reset": existed})
-            else:
-                self._json({"error": f"unknown route {path}"}, code=404)
-        except RejectedError as e:
-            self._json(
-                {"error": str(e), "pending": e.pending, "limit": e.limit},
-                code=429,
-                headers=(("Retry-After", f"{max(e.retry_after_s, 0.001):.3f}"),))
-        except KeyError as e:
-            self._json({"error": f"unknown model: {e}"}, code=404)
-        except (ValueError, json.JSONDecodeError) as e:
-            self._json({"error": str(e)}, code=400)
-        except TimeoutError as e:
-            self._json({"error": f"dispatch timed out: {e}"}, code=503)
+            with root:
+                try:
+                    if path == "/v1/predict":
+                        self._predict()
+                    elif path == "/v1/stream":
+                        self._stream()
+                    elif path == "/v1/generate":
+                        self._generate()
+                    elif path == "/v1/stream/reset":
+                        req = self._body()
+                        existed = self.engine.sessions.reset(
+                            str(req.get("model", "")),
+                            str(req.get("session", "")))
+                        self._json({"reset": existed})
+                    else:
+                        self._json({"error": f"unknown route {path}"},
+                                   code=404)
+                except RejectedError as e:
+                    root.set_status("rejected")
+                    root.set_attr(http_status=429)
+                    self._json(
+                        {"error": str(e), "pending": e.pending,
+                         "limit": e.limit},
+                        code=429,
+                        headers=(("Retry-After",
+                                  f"{max(e.retry_after_s, 0.001):.3f}"),))
+                except KeyError as e:
+                    root.set_attr(http_status=404)
+                    self._json({"error": f"unknown model: {e}"}, code=404)
+                except (ValueError, json.JSONDecodeError) as e:
+                    root.set_attr(http_status=400)
+                    self._json({"error": str(e)}, code=400)
+                except TimeoutError as e:
+                    root.set_status("error")
+                    root.set_attr(http_status=503)
+                    self._json({"error": f"dispatch timed out: {e}"},
+                               code=503)
         finally:
-            self.engine._h_request.labels(route=path).observe(
-                time.perf_counter() - t0)
+            dt = time.perf_counter() - t0
+            self.engine._h_request.labels(route=path).observe(dt)
+            if root.trace_id:
+                global_trace_store().put_exemplar(
+                    _n.SERVE_REQUEST_SECONDS, dt, root.trace_id)
 
     @staticmethod
     def _inputs(req: dict) -> np.ndarray:
@@ -160,6 +210,9 @@ class _ServeHandler(BaseHTTPRequestHandler):
             raise TimeoutError(
                 f"no dispatch within {self.engine.request_timeout_s}s")
         except Exception as e:
+            tr = getattr(self, "_trace", None)
+            if tr is not None:
+                tr.set_status("error").set_attr(http_status=500)
             self._json({"error": f"{type(e).__name__}: {e}"}, code=500)
             return
         payload = {
@@ -183,6 +236,8 @@ class _ServeHandler(BaseHTTPRequestHandler):
         self.send_response(200)
         self.send_header("Content-Type", "application/x-ndjson")
         self.send_header("Transfer-Encoding", "chunked")
+        if getattr(self, "_traceparent", ""):
+            self.send_header(TRACEPARENT_HEADER, self._traceparent)
         self.end_headers()
 
         def chunk(obj: dict) -> None:
@@ -212,6 +267,8 @@ class _ServeHandler(BaseHTTPRequestHandler):
         self.send_response(200)
         self.send_header("Content-Type", "application/x-ndjson")
         self.send_header("Transfer-Encoding", "chunked")
+        if getattr(self, "_traceparent", ""):
+            self.send_header(TRACEPARENT_HEADER, self._traceparent)
         self.end_headers()
 
         def chunk(obj: dict) -> None:
@@ -295,6 +352,10 @@ class InferenceServer:
         self._dec_lock = threading.Lock()
         self._h_request = global_registry().histogram(
             _n.SERVE_REQUEST_SECONDS, "HTTP request latency per route")
+        #: the error-budget engine over this process's serve metrics;
+        #: /serve/slo evaluates on demand, start() spins the ticker so
+        #: burn alerts fire (and dump flight-recorder bundles) unscraped
+        self.slo = SLOEngine()
         handler = type("BoundServeHandler", (_ServeHandler,),
                        {"engine": self})
         self._httpd = ThreadingHTTPServer((host, port), handler)
@@ -309,6 +370,7 @@ class InferenceServer:
         self._thread = threading.Thread(
             target=self._httpd.serve_forever, name="serve-http", daemon=True)
         self._thread.start()
+        self.slo.start()
         _set_active_server(self)
         return self
 
@@ -361,6 +423,7 @@ class InferenceServer:
             return eng
 
     def stop(self) -> None:
+        self.slo.stop()
         self._httpd.shutdown()
         self._httpd.server_close()
         if self.batcher is not None:
@@ -423,3 +486,14 @@ def serve_status() -> dict:
     if srv is not None:
         return srv.status()
     return {**global_model_registry().status(), "queue": None, "streams": {}}
+
+
+def serve_slo() -> dict:
+    """Current SLO burn-rate evaluation (the UI's /serve/slo payload):
+    the live server's engine when one is running — so alert state and
+    cooldowns are the real ones — else a fresh evaluation over the same
+    process-global histograms."""
+    srv = active_server()
+    if srv is not None:
+        return {"slo": srv.slo.evaluate()}
+    return {"slo": SLOEngine().evaluate()}

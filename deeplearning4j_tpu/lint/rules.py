@@ -995,6 +995,180 @@ class DenseKvAlloc(Rule):
                     "plane stays the only decode memory owner")
 
 
+# ---------------------------------------------------------------------------
+@register
+class OrphanSpan(Rule):
+    """Every manually-started trace span must be finishable on ALL exits.
+
+    ``start_span()`` (observability/tracing.py) exists for cross-thread
+    spans whose owner finishes them later — which is exactly how spans
+    leak: a local span finished only on the happy path pins its whole
+    trace in the store's live table until the leak guard evicts it, and
+    the trace is lost. Jurisdiction is the request-path packages
+    (``keras_server/``, ``nn/``, ``observability/``); the rule flags:
+
+    - a BARE ``start_span(...)`` statement, or a method chain on it not
+      ending in ``.finish()`` — the span is unreachable forever (chain
+      ``.finish()`` for an instant span);
+    - ``sp = start_span(...)`` into a plain local where ``sp.finish()``
+      never appears inside a ``finally`` block of the same function and
+      ``sp`` is not returned — a conditional/early-exit path leaks it;
+    - a flight-recorder ``record("span_enter", ...)`` with no
+      ``record("span_exit", ...)`` anywhere in the same function — the
+      pairing ``span()`` guarantees would silently break in crash bundles.
+
+    Assigning to an attribute (``req.span = start_span(...)``) is exempt:
+    ownership escapes to the object and its lifecycle (the batcher's
+    dispatcher, the decode pump's evict path) finishes it. ``with
+    start_span(...)`` is exempt (``__exit__`` finishes). ``tracing.py``
+    (the factory) and ``spans.py`` (the pairing owner) are scoped out.
+    """
+
+    name = "orphan-span"
+    description = ("start_span()/span_enter without a guaranteed "
+                   "finish/span_exit on all exits (leaked trace span)")
+    exclude = ("*/observability/tracing.py",
+               "*/observability/spans.py")
+
+    _JURISDICTION = ("*/keras_server/*.py", "*/nn/*.py",
+                     "*/observability/*.py")
+
+    def _in_jurisdiction(self, ctx: FileContext) -> bool:
+        paths = (ctx.rel, ctx.path.as_posix())
+        return any(fnmatch.fnmatch(p, pat)
+                   for p in paths for pat in self._JURISDICTION)
+
+    @staticmethod
+    def _own_nodes(fn: ast.AST) -> Iterator[ast.AST]:
+        """Nodes of ``fn`` excluding nested function bodies (a closure's
+        spans are the closure's problem)."""
+        stack = list(ast.iter_child_nodes(fn))
+        while stack:
+            node = stack.pop()
+            yield node
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                     ast.Lambda)):
+                stack.extend(ast.iter_child_nodes(node))
+
+    @staticmethod
+    def _chain_root_tail(call: ast.Call) -> Tuple[ast.Call, Optional[str]]:
+        """For ``start_span(...).set_status(...).finish()`` return the
+        innermost call and the OUTERMOST chained method name (None when
+        the call is unchained)."""
+        tail: Optional[str] = None
+        node = call
+        while isinstance(node.func, ast.Attribute) \
+                and isinstance(node.func.value, ast.Call):
+            if tail is None:
+                tail = node.func.attr
+            node = node.func.value
+        return node, tail
+
+    @staticmethod
+    def _is_start_span(call: ast.Call) -> bool:
+        name = dotted_name(call.func) or ""
+        return name == "start_span" or name.endswith(".start_span")
+
+    @staticmethod
+    def _record_event(call: ast.Call) -> Optional[str]:
+        name = dotted_name(call.func) or ""
+        if not (name == "record" or name.endswith(".record")):
+            return None
+        if call.args and isinstance(call.args[0], ast.Constant) \
+                and isinstance(call.args[0].value, str):
+            return call.args[0].value
+        return None
+
+    def check(self, ctx: FileContext) -> Iterator[Violation]:
+        tree = ctx.tree
+        if tree is None or not self._in_jurisdiction(ctx):
+            return
+        for fn in walk_functions(tree):
+            nodes = list(self._own_nodes(fn))
+            with_exprs = set()
+            for node in nodes:
+                if isinstance(node, (ast.With, ast.AsyncWith)):
+                    for item in node.items:
+                        with_exprs.add(id(item.context_expr))
+            finished_in_finally: Set[str] = set()
+            for node in nodes:
+                if not isinstance(node, ast.Try):
+                    continue
+                for stmt in node.finalbody:
+                    for sub in ast.walk(stmt):
+                        if isinstance(sub, ast.Call) \
+                                and isinstance(sub.func, ast.Attribute) \
+                                and sub.func.attr == "finish" \
+                                and isinstance(sub.func.value, ast.Name):
+                            finished_in_finally.add(sub.func.value.id)
+            returned: Set[str] = {
+                node.value.id for node in nodes
+                if isinstance(node, ast.Return)
+                and isinstance(node.value, ast.Name)}
+            enter_lines: List[int] = []
+            has_exit = False
+            for node in nodes:
+                if not isinstance(node, ast.Call):
+                    continue
+                ev = self._record_event(node)
+                if ev == "span_enter":
+                    enter_lines.append(node.lineno)
+                elif ev == "span_exit":
+                    has_exit = True
+                if not self._is_start_span(node) or id(node) in with_exprs:
+                    continue
+                sink = self._span_sink(nodes, node)
+                if sink in ("attribute", "escapes", "finish-chain"):
+                    continue
+                if sink is None:
+                    yield self.violation(
+                        ctx, node.lineno,
+                        "start_span() result discarded — the span can "
+                        "never finish; chain .finish() or own it on an "
+                        "object/local")
+                    continue
+                if sink in finished_in_finally or sink in returned:
+                    continue
+                yield self.violation(
+                    ctx, node.lineno,
+                    f"span {sink!r} from start_span() has no "
+                    f"{sink}.finish() in a finally block (and is not "
+                    "returned) — an exception path leaks the trace")
+            for line in enter_lines if not has_exit else ():
+                yield self.violation(
+                    ctx, line,
+                    'record("span_enter") without a matching '
+                    'record("span_exit") in this function — the flight-'
+                    "recorder span timeline would dangle")
+
+    @staticmethod
+    def _span_sink(nodes: List[ast.AST],
+                   call: ast.Call) -> Optional[str]:
+        """Where the span value lands: a local name, ``'attribute'`` /
+        ``'escapes'`` for exempt sinks, ``'finish-chain'`` when a method
+        chain on the call ends in ``.finish()``, None when discarded.
+        Assignment/return sinks win over intermediate chain calls (the
+        node list is unordered DFS output)."""
+        for node in nodes:
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                v = node.value
+                root = OrphanSpan._chain_root_tail(v)[0] \
+                    if isinstance(v, ast.Call) else None
+                if v is call or root is call:
+                    t = node.targets[0]
+                    return t.id if isinstance(t, ast.Name) else "attribute"
+            if isinstance(node, ast.Return) and node.value is call:
+                return "escapes"
+        for node in nodes:
+            if isinstance(node, ast.Call) and node is not call:
+                inner, tail = OrphanSpan._chain_root_tail(node)
+                if inner is call and tail == "finish":
+                    # the OUTERMOST chained call reports the final method;
+                    # any chain ending .finish() lands here
+                    return "finish-chain"
+        return None
+
+
 def default_rules() -> List[Rule]:
     """Fresh instances of every registered rule, in registration order."""
     return [cls() for cls in REGISTRY.values()]

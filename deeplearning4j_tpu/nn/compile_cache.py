@@ -342,6 +342,9 @@ class CachedProgram:
         self._lock = threading.Lock()
         self._sig_locks: Dict[Tuple, threading.Lock] = {}
         self._fallback: Optional[Callable] = None
+        #: whether the LAST executable resolve was a persistent-cache hit
+        #: (None until a signature resolves); dispatch trace spans read it
+        self.cache_hit: Optional[bool] = None
         self.__name__ = getattr(jitted, "__name__", name)
         self.__wrapped__ = jitted
 
@@ -433,6 +436,7 @@ class CachedProgram:
                         self._name, cache_key=self._cache_key, wall_s=load_s,
                         shapes=sig[0], cache_hit=True)
                     tracker.note_executable(self._name, compiled)
+                    self.cache_hit = True
                     return compiled
                 except Exception as e:
                     store.quarantine(fp, name=self._name,
@@ -461,6 +465,7 @@ class CachedProgram:
         tracker.record_compile(self._name, cache_key=self._cache_key,
                                wall_s=wall, shapes=sig[0], cache_hit=False)
         tracker.note_executable(self._name, compiled)
+        self.cache_hit = False
         if fp is not None:
             try:
                 from jax.experimental import serialize_executable as se

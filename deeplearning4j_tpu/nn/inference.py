@@ -197,6 +197,16 @@ class PredictFn:
         return self._name
 
     @property
+    def cache_hit(self) -> Optional[bool]:
+        """Whether this program's LAST executable resolve came from the
+        persistent compile cache (None before any resolve, or with the
+        cache disabled). The batcher stamps it on dispatch trace spans so
+        a slow first request is attributable to a cold compile."""
+        # CompiledStep (sharded) wraps the CachedProgram as .fn
+        target = getattr(self._fn, "fn", self._fn)
+        return getattr(target, "cache_hit", None)
+
+    @property
     def n_inputs(self) -> int:
         """Positional input arrays one call takes (1 for sequential nets)."""
         return self._n_in

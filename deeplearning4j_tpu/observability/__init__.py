@@ -14,6 +14,11 @@ from .metrics import (MetricsRegistry, global_registry, DEFAULT_BUCKETS,
                       tree_nbytes)
 from .compile_tracker import CompileTracker, global_tracker
 from .spans import span
+from .tracing import (TraceStore, Span, SpanRef, trace_span, start_span,
+                      current_span, parse_traceparent, format_traceparent,
+                      global_trace_store, set_global_trace_store,
+                      TRACEPARENT_HEADER)
+from .slo import SLO, SLOEngine, default_serve_objectives
 from .listener import TelemetryListener, record_hbm_gauges
 from .flight_recorder import (FlightRecorder, global_recorder,
                               dump_on_unhandled, install_signal_handlers,
@@ -31,6 +36,10 @@ __all__ = [
     "MetricsRegistry", "global_registry", "DEFAULT_BUCKETS", "tree_nbytes",
     "CompileTracker", "global_tracker",
     "span", "names",
+    "TraceStore", "Span", "SpanRef", "trace_span", "start_span",
+    "current_span", "parse_traceparent", "format_traceparent",
+    "global_trace_store", "set_global_trace_store", "TRACEPARENT_HEADER",
+    "SLO", "SLOEngine", "default_serve_objectives",
     "TelemetryListener", "record_hbm_gauges",
     "FlightRecorder", "global_recorder", "dump_on_unhandled",
     "install_signal_handlers", "uninstall_signal_handlers",

@@ -24,10 +24,16 @@ Design constraints (why this is not just a dict of floats):
 from __future__ import annotations
 
 import json
+import logging
 import math
+import os
 import threading
 import time
 from typing import Dict, List, Optional, Sequence, Tuple
+
+from . import names as _names
+
+log = logging.getLogger(__name__)
 
 #: default histogram buckets (seconds): 100us .. ~100s, log-ish spacing —
 #: covers everything from a listener callback to a cold XLA compile
@@ -35,6 +41,19 @@ DEFAULT_BUCKETS = (1e-4, 5e-4, 1e-3, 5e-3, 1e-2, 5e-2, 0.1, 0.5, 1.0, 5.0,
                    10.0, 60.0, 120.0)
 
 _VALID_TYPES = ("counter", "gauge", "histogram")
+
+#: max distinct labelsets one family will register; past it, labels() hands
+#: back a detached overflow series (mutations work, exposition skips it) so
+#: an unbounded label — a trace id, a session id — can never OOM the registry
+LABELSET_CAP_ENV = "DL4J_METRICS_MAX_LABELSETS"
+DEFAULT_MAX_LABELSETS = 256
+
+
+def _labelset_cap() -> int:
+    try:
+        return int(os.environ.get(LABELSET_CAP_ENV, DEFAULT_MAX_LABELSETS))
+    except (TypeError, ValueError):
+        return DEFAULT_MAX_LABELSETS
 
 
 class _Series:
@@ -113,16 +132,35 @@ class _Family:
         self.type = type
         self.buckets = tuple(buckets) if type == "histogram" else ()
         self._series: Dict[Tuple[Tuple[str, str], ...], _Series] = {}
+        self._overflow: Optional[_Series] = None
 
     def labels(self, **labels: str) -> _Series:
         """Resolve (and memoize) the series for this labelset. Do this ONCE
-        per call site, not per step — the returned handle is the hot path."""
+        per call site, not per step — the returned handle is the hot path.
+
+        Cardinality guard: once a family holds ``DL4J_METRICS_MAX_LABELSETS``
+        distinct labelsets (default 256), unseen labelsets resolve to one
+        shared detached series — writable but never exported — and each such
+        call counts into ``dl4j_metrics_dropped_labelsets_total``."""
         key = tuple(sorted((k, str(v)) for k, v in labels.items()))
-        with self.registry._lock:
+        reg = self.registry
+        dropped = False
+        with reg._lock:
             s = self._series.get(key)
             if s is None:
-                s = self._series[key] = _Series(self, key)
-            return s
+                if (len(self._series) >= reg._max_labelsets
+                        and self.name !=
+                        _names.METRICS_DROPPED_LABELSETS_TOTAL):
+                    if self._overflow is None:
+                        self._overflow = _Series(
+                            self, (("overflow", "true"),))
+                    s = self._overflow
+                    dropped = True
+                else:
+                    s = self._series[key] = _Series(self, key)
+        if dropped:
+            reg._note_dropped_labelset(self.name)
+        return s
 
     # label-less convenience: family acts as its own default series
     def inc(self, amount: float = 1.0) -> None:
@@ -147,6 +185,25 @@ class MetricsRegistry:
         self._lock = threading.Lock()
         self._families: Dict[str, _Family] = {}
         self._enabled = True
+        self._max_labelsets = _labelset_cap()
+        self._warned_families: Dict[str, float] = {}
+
+    def _note_dropped_labelset(self, family: str) -> None:
+        """Called (outside the lock) when a family refused a new labelset:
+        count it, and warn at most once a minute per family."""
+        self.counter(
+            _names.METRICS_DROPPED_LABELSETS_TOTAL,
+            "labels() calls refused a new series by the cardinality cap"
+        ).labels(family=family).inc()
+        now = time.time()
+        last = self._warned_families.get(family)
+        if last is None or now - last >= 60.0:
+            self._warned_families[family] = now
+            log.warning(
+                "metric family %s hit the labelset cap (%d); further "
+                "labelsets collapse into an unexported overflow series "
+                "(raise %s to widen)", family, self._max_labelsets,
+                LABELSET_CAP_ENV)
 
     # ------------------------------------------------------------- creation
     def _family(self, name: str, help: str, type: str,
@@ -189,6 +246,7 @@ class MetricsRegistry:
         with self._lock:
             for fam in self._families.values():
                 fam._series.clear()
+                fam._overflow = None
 
     # ----------------------------------------------------------- exposition
     @staticmethod

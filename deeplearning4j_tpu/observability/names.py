@@ -141,6 +141,21 @@ COMPILE_CACHE_LOAD_SECONDS = "dl4j_compile_cache_load_seconds"
 WARMUP_SECONDS = "dl4j_warmup_seconds"
 SERVE_BUCKET_GROWTH_STALL_SECONDS = "dl4j_serve_bucket_growth_stall_seconds"
 
+# --- request tracing plane (observability/tracing.py) ----------------------
+TRACE_SPANS_TOTAL = "dl4j_trace_spans_total"
+TRACE_TRACES_KEPT_TOTAL = "dl4j_trace_traces_kept_total"
+TRACE_TRACES_DROPPED_TOTAL = "dl4j_trace_traces_dropped_total"
+TRACE_LIVE_TRACES = "dl4j_trace_live_traces"
+
+# --- SLO / error-budget engine (observability/slo.py) ----------------------
+SLO_BURN_RATE = "dl4j_slo_burn_rate"
+SLO_BUDGET_REMAINING = "dl4j_slo_budget_remaining"
+SLO_ALERTING = "dl4j_slo_alerting"
+SLO_ALERTS_TOTAL = "dl4j_slo_alerts_total"
+
+# --- metrics registry self-protection (observability/metrics.py) -----------
+METRICS_DROPPED_LABELSETS_TOTAL = "dl4j_metrics_dropped_labelsets_total"
+
 # --- input pipeline (datasets/prefetch.py) ---------------------------------
 PREFETCH_DEPTH = "dl4j_prefetch_depth"
 PREFETCH_BYTES_TOTAL = "dl4j_prefetch_bytes_total"

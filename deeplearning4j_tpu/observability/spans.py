@@ -14,6 +14,12 @@ Span names are hierarchical-by-convention (``"epoch/3/stage"``); the
 registry series is labeled with the name verbatim, so high-cardinality
 names (per-step indices) belong in the annotation half only — pass
 ``metric_name`` to collapse them for the histogram.
+
+Since the request-tracing plane landed (observability/tracing.py), a
+``span()`` additionally opens a REAL trace span under the thread's ambient
+trace context: training phases called inside a traced request show up in
+its ``/serve/traces/<id>`` tree, and outside any trace the span costs one
+no-op context manager. No call site outside observability/ changed.
 """
 from __future__ import annotations
 
@@ -24,6 +30,7 @@ from typing import Optional
 from .flight_recorder import global_recorder
 from .metrics import global_registry
 from .names import SPAN_SECONDS
+from .tracing import NOOP_SPAN, current_span, trace_span
 
 
 @contextlib.contextmanager
@@ -31,7 +38,8 @@ def span(name: str, metric_name: Optional[str] = None, registry=None,
          recorder=None):
     """Annotate a phase in XPlane traces AND record its wall time in the
     registry histogram ``dl4j_span_seconds{name=...}`` AND leave
-    ``span_enter``/``span_exit`` events in the flight-recorder ring.
+    ``span_enter``/``span_exit`` events in the flight-recorder ring AND
+    open a trace span under the ambient trace context (tracing.py).
 
     ``metric_name`` overrides the histogram label (use it to collapse
     per-index names like ``epoch/3`` into a bounded series like ``epoch``).
@@ -48,8 +56,12 @@ def span(name: str, metric_name: Optional[str] = None, registry=None,
     except Exception:  # pragma: no cover - profiler API absent
         ann = contextlib.nullcontext()
     rec.record("span_enter", name=name)
+    # a real trace span only under an ambient trace — a bare training
+    # phase must not mint root traces into the ring
+    tspan = trace_span(metric_name or name) if current_span() is not None \
+        else NOOP_SPAN
     t0 = time.perf_counter()
-    with ann:
+    with ann, tspan:
         try:
             yield
         finally:

@@ -364,6 +364,20 @@ class _Handler(BaseHTTPRequestHandler):
             # serving engine pane: models/versions, queue depth, bucket
             # occupancy — same payload the InferenceServer exposes itself
             self._json(self.ui.serve_status_data())
+        elif path == "/serve/traces":
+            # tail-sampled request traces (newest first; ?trace= resolves
+            # one full span tree) — same payload as the InferenceServer's
+            q = parse_qs(urlparse(self.path).query)
+            trace_id = q.get("trace", [None])[0]
+            if trace_id:
+                tree = self.ui.serve_trace(trace_id)
+                self._json(tree if tree is not None
+                           else {"error": "not found"},
+                           200 if tree is not None else 404)
+            else:
+                self._json(self.ui.serve_traces())
+        elif path == "/serve/slo":
+            self._json(self.ui.serve_slo())
         elif path == "/train/health/bundles":
             self._json(self.ui.health_bundles())
         elif path == "/train/profiles":
@@ -549,6 +563,29 @@ class UIServer:
         from deeplearning4j_tpu.keras_server.serving import serve_status
 
         return serve_status()
+
+    def serve_traces(self) -> dict:
+        """Newest-first kept-trace summaries for ``/serve/traces``."""
+        from deeplearning4j_tpu.observability.tracing import \
+            global_trace_store
+
+        return {"traces": global_trace_store().list()}
+
+    def serve_trace(self, trace_id: str):
+        """One full span tree by id, or None."""
+        from deeplearning4j_tpu.observability.tracing import \
+            global_trace_store
+
+        return global_trace_store().get(trace_id)
+
+    def serve_slo(self) -> dict:
+        """Current SLO burn-rate evaluation for ``/serve/slo`` (runs the
+        engine attached to a live InferenceServer when one exists; a
+        standalone UI evaluates a fresh engine over the same process-global
+        histograms, so the pane works either way)."""
+        from deeplearning4j_tpu.keras_server.serving import serve_slo
+
+        return serve_slo()
 
     def telemetry_data(self) -> dict:
         """JSON registry snapshot + recent compile events for

@@ -55,6 +55,12 @@
 #                                 # reuse, warmup-before-swap ordering,
 #                                 # kill switch, bench compile-cache-axis
 #                                 # contract
+#   ./runtests.sh trace [args]    # request tracing + SLO engine: traceparent
+#                                 # propagation through HTTP/batcher/decode/
+#                                 # replica, tail sampling (429 always kept),
+#                                 # burn-rate math + alert actions, cardinality
+#                                 # guard, orphan-span lint rule, the <=2%
+#                                 # tracing overhead budget, bench axis contract
 set -e
 cd "$(dirname "$0")"
 
@@ -166,6 +172,15 @@ if [ "${1-}" = "compile" ]; then
   XLA_FLAGS="--xla_force_host_platform_device_count=8" \
   exec python -m pytest tests/test_compile_cache.py \
     tests/test_bench_contract.py::test_config_key_compile_cache_axes -q "$@"
+fi
+
+if [ "${1-}" = "trace" ]; then
+  shift
+  PALLAS_AXON_POOL_IPS= \
+  JAX_PLATFORMS=cpu \
+  XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+  exec python -m pytest tests/test_tracing.py \
+    tests/test_bench_contract.py::test_config_key_serve_tracing_axis -q "$@"
 fi
 
 if [ "${1-}" = "health" ]; then

@@ -693,3 +693,28 @@ def test_config_key_decode_kv_axes():
     assert old != bench._config_key("--model serve --decode-kv dense")
     assert gate.endswith("Z") \
         and gate > bench._COMPILE_CACHE_AXIS_LANDED_TS
+
+
+def test_config_key_serve_tracing_axis():
+    """--serve-tracing (ISSUE 17) is a config-distinct serve axis: an
+    untraced capture must never stand in for the tracing-on default row
+    (whose headline carries trace_overhead_pct, the <=2% always-on
+    tracing budget); other models don't grow the axis; and the ts-gate
+    strips it from rows that predate the tracing plane — those requests
+    ran with no tracing code in the repo at all."""
+    import bench
+
+    a = bench._config_key("--model serve")
+    b = bench._config_key("--model serve --serve-tracing off")
+    assert a != b and a["serve_tracing"] == "on" \
+        and b["serve_tracing"] == "off"
+    # no phantom axis on models without a serve section
+    for model in ("resnet50", "ps_async", "char_rnn"):
+        assert bench._config_key(f"--model {model}")["serve_tracing"] is None
+    # rows logged before the plane landed cannot carry the axis
+    gate = bench._SERVE_TRACING_AXIS_LANDED_TS
+    old = bench._config_key("--model serve", ts="2026-08-07T11:59:59Z")
+    new = bench._config_key("--model serve", ts="2026-08-07T12:00:01Z")
+    assert old["serve_tracing"] is None and new["serve_tracing"] == "on"
+    assert old != bench._config_key("--model serve")
+    assert gate.endswith("Z") and gate > bench._PAGED_DECODE_AXIS_LANDED_TS

@@ -1,0 +1,685 @@
+"""End-to-end request tracing + SLO burn-rate engine (ISSUE 17).
+
+What is pinned here and why:
+
+- W3C ``traceparent`` roundtrip: a caller-minted id threads through the
+  real HTTP stack, comes back on the response, and resolves to a stored
+  span tree whose parent/child ids and monotonic timestamps describe the
+  actual request path (HTTP -> admission -> batch.queue), with the
+  batch.dispatch span linking every coalesced request's trace.
+- Tail-based sampling: a 429'd request is ALWAYS kept even at sample=0.0
+  — the traces you need during an incident are exactly the ones head
+  sampling throws away.
+- The decode plane: one session's trace spans queue -> prefill -> decode,
+  and a page-starved engine leaves park/preempt evidence in some trace.
+- SLO burn-rate math on synthetic histogram windows with an injected
+  clock: the multi-window AND-guard, the gauge flip, the flight-recorder
+  bundle on the alert transition, and the histogram->trace exemplar that
+  names a stored trace.
+- The MetricsRegistry label-cardinality guard and the graftlint
+  orphan-span rule that polices the cross-thread ``start_span`` idiom.
+"""
+import json
+import pathlib
+import textwrap
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import deeplearning4j_tpu.lint as lint
+from deeplearning4j_tpu.keras_server import InferenceServer, ModelRegistry
+from deeplearning4j_tpu.keras_server.batcher import MicroBatcher
+from deeplearning4j_tpu.keras_server.replica import ReplicaSet
+from deeplearning4j_tpu.nn.conf.builders import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.observability import names as _n
+from deeplearning4j_tpu.observability.flight_recorder import FlightRecorder
+from deeplearning4j_tpu.observability.metrics import MetricsRegistry
+from deeplearning4j_tpu.observability.slo import SLO, SLOEngine
+from deeplearning4j_tpu.observability.tracing import (
+    NOOP_SPAN, TRACEPARENT_HEADER, TraceStore, format_traceparent,
+    global_trace_store, parse_traceparent, set_global_trace_store,
+    start_span, trace_span,
+)
+
+N_IN, N_OUT = 12, 3
+
+
+def _mlp(seed=7):
+    conf = (NeuralNetConfiguration.builder()
+            .seed(seed).learning_rate(0.1).updater("adam")
+            .weight_init("xavier")
+            .list()
+            .layer(DenseLayer(n_in=N_IN, n_out=24, activation="relu"))
+            .layer(OutputLayer(n_in=24, n_out=N_OUT, loss="mcxent",
+                               activation="softmax"))
+            .build())
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    return MultiLayerNetwork(conf).init()
+
+
+def _post(port, path, obj, headers=None, timeout=30):
+    import http.client
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    try:
+        h = {"Content-Type": "application/json"}
+        h.update(headers or {})
+        conn.request("POST", path, body=json.dumps(obj), headers=h)
+        resp = conn.getresponse()
+        return resp.status, dict(resp.getheaders()), resp.read()
+    finally:
+        conn.close()
+
+
+def _get(port, path):
+    import http.client
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+    try:
+        conn.request("GET", path)
+        resp = conn.getresponse()
+        return resp.status, resp.read()
+    finally:
+        conn.close()
+
+
+@pytest.fixture()
+def store():
+    """Fresh 100%-sampled store swapped in as the process global; the
+    previous store is restored on teardown so suite order can't leak."""
+    prev = global_trace_store()
+    st = TraceStore(enabled=True, sample=1.0, capacity=256,
+                    registry=MetricsRegistry())
+    set_global_trace_store(st)
+    yield st
+    set_global_trace_store(prev)
+
+
+def _spans_by_name(record):
+    out = {}
+    for s in record["spans"]:
+        out.setdefault(s["name"], []).append(s)
+    return out
+
+
+# ------------------------------------------------------------- traceparent
+
+def test_traceparent_roundtrip_and_malformed():
+    tid, sid = "a" * 32, "b" * 16
+    header = format_traceparent(tid, sid)
+    assert header == f"00-{tid}-{sid}-01"
+    ref = parse_traceparent(header)
+    assert ref.trace_id == tid and ref.span_id == sid
+    for bad in (None, "", "junk", "00-short-b-01",
+                f"00-{'0' * 32}-{sid}-01",      # all-zero trace id
+                f"00-{tid}-{'0' * 16}-01",      # all-zero span id
+                f"zz-{tid}-{sid}-01",           # bad version
+                f"00-{'g' * 32}-{sid}-01"):     # non-hex
+        assert parse_traceparent(bad) is None, bad
+
+
+# ------------------------------------------------- span trees in the store
+
+def test_span_tree_parents_and_monotonic_timestamps(store):
+    with trace_span("root", kind="test") as root:
+        with trace_span("child_a") as a:
+            with trace_span("leaf") as leaf:
+                pass
+        with trace_span("child_b"):
+            pass
+    rec = store.get(root.trace_id)
+    assert rec is not None and rec["n_spans"] == 4
+    by = {s["name"]: s for s in rec["spans"]}
+    assert by["root"]["parent_id"] is None
+    assert by["child_a"]["parent_id"] == by["root"]["span_id"]
+    assert by["leaf"]["parent_id"] == by["child_a"]["span_id"]
+    assert by["child_b"]["parent_id"] == by["root"]["span_id"]
+    assert leaf.trace_id == a.trace_id == root.trace_id
+    # finalized span list is sorted by start mono; starts are monotonic
+    monos = [s["mono"] for s in rec["spans"]]
+    assert monos == sorted(monos)
+    # a child starts after its parent and fits inside its duration
+    assert by["child_a"]["mono"] >= by["root"]["mono"]
+    assert (by["leaf"]["mono"] + by["leaf"]["dur_s"]
+            <= by["child_a"]["mono"] + by["child_a"]["dur_s"] + 1e-6)
+
+
+def test_disabled_store_returns_the_noop_singleton():
+    prev = global_trace_store()
+    try:
+        set_global_trace_store(TraceStore(enabled=False,
+                                          registry=MetricsRegistry()))
+        sp = trace_span("anything")
+        assert sp is NOOP_SPAN and sp.traceparent() == ""
+        assert start_span("other") is NOOP_SPAN
+        with sp:
+            pass  # usable as a context manager, records nothing
+    finally:
+        set_global_trace_store(prev)
+
+
+# ------------------------------------------------------ HTTP end to end
+
+def test_http_request_traces_end_to_end(store):
+    registry = ModelRegistry()
+    registry.register("mlp", _mlp(), version="v1")
+    srv = InferenceServer(registry, max_batch=8, max_latency_s=0.002,
+                          max_queue=64).start()
+    try:
+        caller = format_traceparent("c" * 32, "d" * 16)
+        status, headers, _ = _post(
+            srv.port, "/v1/predict",
+            {"model": "mlp", "inputs": [[0.0] * N_IN]},
+            headers={TRACEPARENT_HEADER: caller})
+        assert status == 200
+        echoed = parse_traceparent(headers.get(TRACEPARENT_HEADER.title())
+                                   or headers.get(TRACEPARENT_HEADER))
+        # the response names the caller's trace, with the server root span
+        assert echoed is not None and echoed.trace_id == "c" * 32
+        assert echoed.span_id != "d" * 16
+
+        # concurrent load: every request's tree has the full path with
+        # consistent parent/child ids and monotonic timestamps
+        ids, lock = [], threading.Lock()
+
+        def client():
+            s, h, _ = _post(srv.port, "/v1/predict",
+                            {"model": "mlp", "inputs": [[0.0] * N_IN]})
+            ref = parse_traceparent(h.get(TRACEPARENT_HEADER.title())
+                                    or h.get(TRACEPARENT_HEADER))
+            with lock:
+                ids.append((s, ref))
+        threads = [threading.Thread(target=client) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        assert all(s == 200 and r is not None for s, r in ids)
+        deadline = time.time() + 10
+        while time.time() < deadline and any(
+                store.get(r.trace_id) is None for _, r in ids):
+            time.sleep(0.01)  # queue spans finish on the dispatcher thread
+        for _, ref in ids:
+            rec = store.get(ref.trace_id)
+            assert rec is not None, ref.trace_id
+            by = _spans_by_name(rec)
+            root = by["http /v1/predict"][0]
+            assert root["parent_id"] is None
+            admission = by["admission"][0]
+            queue = by["batch.queue"][0]
+            assert admission["parent_id"] == root["span_id"]
+            assert queue["parent_id"] == root["span_id"]
+            assert root["mono"] <= admission["mono"] <= queue["mono"]
+            assert queue["attrs"]["model"] == "mlp"
+
+        # the trace is fetchable over the wire, and /serve/traces lists it
+        s, body = _get(srv.port, f"/serve/traces/{ids[0][1].trace_id}")
+        assert s == 200
+        assert json.loads(body)["trace_id"] == ids[0][1].trace_id
+        s, body = _get(srv.port, "/serve/traces")
+        listed = {t["trace_id"] for t in json.loads(body)["traces"]}
+        assert ids[0][1].trace_id in listed
+        s, body = _get(srv.port, "/serve/slo")
+        assert s == 200 and {o["name"] for o in json.loads(body)["slo"]} \
+            >= {"request_p99", "availability"}
+    finally:
+        srv.stop()
+
+
+def test_batched_dispatch_links_every_request_trace(store):
+    """N coalesced requests produce ONE batch.dispatch span whose links
+    name all N parent request traces (the OTel batch-consumer shape)."""
+    registry = ModelRegistry()
+    registry.register("mlp", _mlp(), version="v1")
+    # generous latency window so one group collects every submit
+    batcher = MicroBatcher(registry, max_batch=8, max_latency_s=0.25,
+                           max_queue=64)
+    try:
+        x = np.zeros((1, N_IN), np.float32)
+        roots, futs = [], []
+        for _ in range(4):
+            with trace_span("test.request") as sp:
+                futs.append(batcher.submit("mlp", x))
+                roots.append(sp)
+        for f in futs:
+            f.result(timeout=30)
+        assert batcher.stats()["dispatches"] == 1
+    finally:
+        batcher.close()
+    dispatch = None
+    for summary in store.list():
+        rec = store.get(summary["trace_id"])
+        names = _spans_by_name(rec)
+        if "batch.dispatch" in names:
+            assert dispatch is None, "more than one dispatch span"
+            dispatch = names["batch.dispatch"][0]
+    assert dispatch is not None
+    linked = {parse_traceparent(tp).trace_id for tp in dispatch["links"]}
+    assert linked == {r.trace_id for r in roots} and len(linked) == 4
+    assert dispatch["attrs"]["rows"] == 4
+    assert dispatch["attrs"]["compile_cache_hit"] in (True, False, None)
+
+
+def test_429_is_always_kept_even_at_sample_zero():
+    prev = global_trace_store()
+    st = TraceStore(enabled=True, sample=0.0, capacity=64,
+                    registry=MetricsRegistry())
+    set_global_trace_store(st)
+    registry = ModelRegistry()
+    mv = registry.register("mlp", _mlp(seed=9), version="v1")
+    release = threading.Event()
+    real_pf = mv.predict_fn
+
+    class _Blocking:
+        def __call__(self, x):
+            release.wait(timeout=30)
+            return real_pf(x)
+
+    srv = InferenceServer(registry, max_batch=1, max_latency_s=0.0,
+                          max_queue=2).start()
+    mv.predict_fn = _Blocking()
+    results, lock = [], threading.Lock()
+
+    def client():
+        s, h, _ = _post(srv.port, "/v1/predict",
+                        {"model": "mlp", "inputs": [[0.0] * N_IN]})
+        ref = parse_traceparent(h.get(TRACEPARENT_HEADER.title())
+                                or h.get(TRACEPARENT_HEADER))
+        with lock:
+            results.append((s, ref))
+    try:
+        threads = [threading.Thread(target=client) for _ in range(8)]
+        for t in threads:
+            t.start()
+        deadline = time.time() + 10
+        while srv.batcher.admission.rejected == 0 \
+                and time.time() < deadline:
+            time.sleep(0.005)
+        release.set()
+        for t in threads:
+            t.join(timeout=30)
+        deadline = time.time() + 10
+        while time.time() < deadline and not any(
+                st.get(r.trace_id) for s, r in results if s == 429):
+            time.sleep(0.01)
+    finally:
+        release.set()
+        srv.stop()
+        set_global_trace_store(prev)
+    rejected = [(s, r) for s, r in results if s == 429]
+    assert rejected, "backpressure never tripped"
+    for _, ref in rejected:
+        rec = st.get(ref.trace_id)
+        assert rec is not None, "429 trace was sampled away"
+        assert rec["status"] == "error"
+        assert rec["keep_reason"] == "error"
+        root = rec["spans"][0]
+        assert root["attrs"]["http_status"] == 429
+    # at sample=0.0 the successful requests' traces were dropped
+    kept_ok = [r for s, r in results if s == 200 and st.get(r.trace_id)]
+    assert len(kept_ok) < len([1 for s, _ in results if s == 200]) + 1
+
+
+# ------------------------------------------------------------ decode plane
+
+def test_decode_session_trace_spans_queue_prefill_decode(store):
+    from deeplearning4j_tpu.keras_server.decode import DecodeEngine
+    from deeplearning4j_tpu.models.transformer import transformer_lm
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    net = MultiLayerNetwork(
+        transformer_lm(vocab_size=24, width=32, n_layers=1, n_heads=2,
+                       max_len=32, seed=5)).init()
+    eng = DecodeEngine(net, max_context=32, min_slots=2, max_slots=2)
+    try:
+        sess = eng.submit([1, 2, 3], max_new_tokens=4)
+        sess.result(timeout=300)
+    finally:
+        eng.close()
+    rec = store.get(sess._span.trace_id)
+    assert rec is not None
+    by = _spans_by_name(rec)
+    queue = by["decode.queue"][0]
+    prefill = by["decode.prefill"][0]
+    decode = by["decode.decode"][0]
+    assert queue["parent_id"] is None
+    assert prefill["parent_id"] == queue["span_id"]
+    assert decode["parent_id"] == queue["span_id"]
+    assert queue["attrs"]["prompt_len"] == 3
+    assert prefill["attrs"]["ttft_s"] > 0
+    assert decode["attrs"]["reason"] == "max_tokens"
+    assert decode["attrs"]["tokens"] == 4
+    assert prefill["mono"] <= decode["mono"]
+
+
+def test_decode_pool_starvation_leaves_park_or_preempt_spans(store):
+    """Oversubscribed paged pool: every session still finishes, and the
+    starvation episodes are visible as decode.park / decode.preempt spans
+    parented under the affected sessions' traces."""
+    from deeplearning4j_tpu.keras_server.decode import DecodeEngine
+    from deeplearning4j_tpu.models.transformer import transformer_lm
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    net = MultiLayerNetwork(
+        transformer_lm(vocab_size=24, width=32, n_layers=2, n_heads=2,
+                       max_len=64, seed=5)).init()
+    # four active sessions want 4 x ceil(23/8) = 12 pages against a 6-page
+    # pool: page planning MUST park or preempt to make progress
+    eng = DecodeEngine(net, max_context=64, min_slots=4, max_slots=4,
+                       kv="paged", page_size=8, n_pages=6)
+    rng = np.random.default_rng(3)
+    prompts = [list(map(int, rng.integers(0, 24, size=3)))
+               for _ in range(12)]
+    try:
+        sessions = [eng.submit(p, max_new_tokens=20) for p in prompts]
+        for s in sessions:
+            s.result(timeout=300)
+        pages_in_use = eng.stats()["pages_in_use"]
+    finally:
+        eng.close()
+    assert pages_in_use == 0
+    assert all(s.done.is_set() for s in sessions)
+    park = preempt = 0
+    for s in sessions:
+        rec = store.get(s._span.trace_id)
+        assert rec is not None
+        by = _spans_by_name(rec)
+        queue_id = by["decode.queue"][0]["span_id"]
+        for name in ("decode.park", "decode.preempt"):
+            for sp in by.get(name, ()):
+                assert sp["parent_id"] == queue_id
+        park += len(by.get("decode.park", ()))
+        preempt += len(by.get("decode.preempt", ()))
+    assert park + preempt > 0, "pool never starved: workload too small"
+
+
+# --------------------------------------------------------- replica routing
+
+def test_replica_router_propagates_request_trace(store):
+    rs = ReplicaSet(2, max_latency_s=0.001)
+    try:
+        rs.register("m", _mlp(), version="v1")
+        x = np.zeros((1, N_IN), np.float32)
+        with trace_span("test.request") as root:
+            fut = rs.submit("m", x)
+        res = fut.result(timeout=60)
+        assert res["replica"] in (0, 1)
+    finally:
+        rs.close()
+    deadline = time.time() + 10
+    rec = None
+    while time.time() < deadline:
+        rec = store.get(root.trace_id)
+        if rec is not None and "batch.queue" in _spans_by_name(rec):
+            break
+        time.sleep(0.01)
+    by = _spans_by_name(rec)
+    route = by["replica.route"][0]
+    queue = by["batch.queue"][0]
+    assert route["parent_id"] == by["test.request"][0]["span_id"]
+    # the queue span lives on the chosen replica's batcher but still
+    # belongs to the caller's trace, under the routing span
+    assert queue["parent_id"] == route["span_id"]
+    assert route["attrs"]["replica"] == res["replica"]
+
+
+# ------------------------------------------------------------ SLO engine
+
+def _ttft_slo(threshold_s=0.5):
+    return SLO("ttft_p99", kind="latency", metric=_n.SERVE_TTFT_SECONDS,
+               threshold_s=threshold_s, target=0.99)
+
+
+def test_slo_burn_rate_math_on_synthetic_windows(tmp_path):
+    reg = MetricsRegistry()
+    hist = reg.histogram(_n.SERVE_TTFT_SECONDS)
+    store = TraceStore(enabled=True, sample=1.0, registry=MetricsRegistry())
+    rec = FlightRecorder(capacity=16, dump_dir=str(tmp_path), registry=reg)
+    now = [1000.0]
+    eng = SLOEngine([_ttft_slo()], registry=reg, store=store,
+                    recorder=rec, clock=lambda: now[0])
+
+    # a stored trace supplies the exemplar a burning SLO must name
+    prev = global_trace_store()
+    set_global_trace_store(store)
+    try:
+        with trace_span("http /v1/generate") as sp:
+            tid = sp.trace_id
+    finally:
+        set_global_trace_store(prev)
+    store.put_exemplar(_n.SERVE_TTFT_SECONDS, 5.0, tid)
+
+    # burn below both thresholds: 10% bad over a 1% budget = 10x — above
+    # the 1h threshold (6) but below the 5m threshold (14.4): NOT firing
+    for _ in range(90):
+        hist.observe(0.01)
+    for _ in range(10):
+        hist.observe(5.0)
+    now[0] += 60.0
+    (entry,) = eng.evaluate()
+    short, long_ = entry["windows"]
+    assert short["total"] == 100 and short["bad"] == 10
+    assert short["burn_rate"] == pytest.approx(10.0)
+    assert long_["burn_rate"] == pytest.approx(10.0)
+    assert entry["alerting"] is False
+    alerts_fam = reg.snapshot().get(_n.SLO_ALERTS_TOTAL, {"series": []})
+    assert all(s["value"] == 0 for s in alerts_fam["series"])
+    assert not list(tmp_path.iterdir()), "no alert -> no dump"
+
+    # inject a TTFT breach: the fresh window is 50% bad = 50x burn,
+    # exceeding EVERY window's threshold -> alert fires once
+    for _ in range(50):
+        hist.observe(0.01)
+    for _ in range(50):
+        hist.observe(5.0)
+    now[0] += 60.0
+    (entry,) = eng.evaluate()
+    assert entry["alerting"] is True
+    assert entry["windows"][0]["burn_rate"] > 14.4
+    # the gauge flipped above the page threshold
+    burn_series = reg.snapshot()[_n.SLO_BURN_RATE]["series"]
+    short_gauge = [s for s in burn_series
+                   if s["labels"].get("window") == "300s"]
+    assert short_gauge and short_gauge[0]["value"] > 14.4
+    alerting = [s for s in reg.snapshot()[_n.SLO_ALERTING]["series"]
+                if s["labels"].get("slo") == "ttft_p99"]
+    assert alerting[0]["value"] == 1.0
+    # budget is visibly spent
+    assert entry["budget_remaining"] == 0.0
+    # the flight-recorder bundle dumped, tagged with the objective
+    bundles = [p for p in tmp_path.iterdir() if "slo-burn-ttft_p99" in p.name]
+    assert len(bundles) == 1
+    extra = json.loads((bundles[0] / "extra.json").read_text())
+    assert extra["slo"]["name"] == "ttft_p99"
+    # the exemplar names the stored trace, and it resolves
+    assert entry["exemplar"]["trace_id"] == tid
+    assert store.get(tid) is not None
+
+    # still firing on the next evaluation: no re-dump (transition-edge +
+    # cooldown), no double alert count
+    now[0] += 30.0
+    (entry,) = eng.evaluate()
+    assert entry["alerting"] is True
+    assert len(list(tmp_path.iterdir())) == 1
+    alerts = [s for s in reg.snapshot()[_n.SLO_ALERTS_TOTAL]["series"]
+              if s["labels"].get("slo") == "ttft_p99"]
+    assert alerts[0]["value"] == 1.0
+
+
+def test_slo_availability_objective_counts_errors():
+    reg = MetricsRegistry()
+    total = reg.counter(_n.SERVE_REQUESTS_TOTAL)
+    bad = reg.counter(_n.SERVE_ERRORS_TOTAL)
+    now = [0.0]
+    slo = SLO("availability", kind="availability",
+              total_metric=_n.SERVE_REQUESTS_TOTAL,
+              bad_metric=_n.SERVE_ERRORS_TOTAL, target=0.999)
+    eng = SLOEngine([slo], registry=reg, store=None, recorder=FlightRecorder(
+        capacity=4, registry=reg), clock=lambda: now[0])
+    for _ in range(1000):
+        total.inc()
+    for _ in range(20):
+        bad.inc()
+    now[0] += 60.0
+    (entry,) = eng.evaluate()
+    # 2% errors over a 0.1% budget = 20x burn on every window -> firing
+    assert entry["windows"][0]["burn_rate"] == pytest.approx(20.0)
+    assert entry["alerting"] is True
+
+
+# ------------------------------------------------- metrics cardinality cap
+
+def test_metrics_label_cardinality_guard(monkeypatch):
+    monkeypatch.setenv("DL4J_METRICS_MAX_LABELSETS", "4")
+    reg = MetricsRegistry()
+    fam = reg.counter("dl4j_test_guarded_total")
+    for i in range(4):
+        fam.labels(k=f"v{i}").inc()
+    # the 5th labelset lands on the shared overflow series, never exported
+    fam.labels(k="v4").inc()
+    fam.labels(k="v5").inc(2.0)
+    snap = reg.snapshot()
+    series = snap["dl4j_test_guarded_total"]["series"]
+    assert len(series) == 4
+    assert {s["labels"]["k"] for s in series} == {f"v{i}" for i in range(4)}
+    dropped = snap[_n.METRICS_DROPPED_LABELSETS_TOTAL]["series"]
+    assert sum(s["value"] for s in dropped) == 2
+    assert dropped[0]["labels"]["family"] == "dl4j_test_guarded_total"
+    # existing labelsets keep working at the cap
+    fam.labels(k="v0").inc()
+    snap = reg.snapshot()
+    v0 = [s for s in snap["dl4j_test_guarded_total"]["series"]
+          if s["labels"]["k"] == "v0"]
+    assert v0[0]["value"] == 2
+
+
+# ------------------------------------------------------- orphan-span lint
+
+def _lint_serving_fixture(tmp_path, source):
+    d = tmp_path / "keras_server"
+    d.mkdir(exist_ok=True)
+    f = d / "fixture.py"
+    f.write_text(textwrap.dedent(source))
+    return lint.run_paths([f], ["orphan-span"])
+
+
+def test_orphan_span_rule_positive(tmp_path):
+    res = _lint_serving_fixture(tmp_path, """\
+        from deeplearning4j_tpu.observability.tracing import start_span
+
+        def leak_discarded(x):
+            start_span("dropped")      # result thrown away: never finished
+            return x
+
+        def leak_no_finally(x):
+            sp = start_span("queue")
+            do_work(x)                 # an exception here leaks the span
+            sp.finish()
+            return x
+        """)
+    assert [v.rule for v in res.violations] == ["orphan-span"] * 2
+    assert res.violations[0].line == 4
+
+
+def test_orphan_span_rule_negative(tmp_path):
+    res = _lint_serving_fixture(tmp_path, """\
+        from deeplearning4j_tpu.observability.tracing import (
+            start_span, trace_span)
+
+        def with_block(x):
+            with trace_span("scoped"):
+                return x
+
+        def finally_finished(x):
+            sp = start_span("queue")
+            try:
+                return work(x)
+            finally:
+                sp.finish()
+
+        def owned_by_object(self, x):
+            self.span = start_span("queue")   # ownership transferred
+
+        def escapes(x):
+            return start_span("handed-off")
+
+        def finish_chain(x):
+            start_span("instant", sid=x).set_status("ok").finish()
+        """)
+    assert res.violations == []
+
+
+def test_orphan_span_rule_out_of_jurisdiction(tmp_path):
+    # the cross-thread ownership idiom is only policed where it's used;
+    # unrelated trees (examples, tests) are not
+    f = tmp_path / "example.py"
+    f.write_text("def f():\n    start_span('x')\n")
+    assert lint.run_paths([f], ["orphan-span"]).violations == []
+
+
+# -------------------------------------------------------- overhead budget
+
+def test_tracing_overhead_budget():
+    """Tracing at 100% sampling must cost <=2% of a serve request.
+    Budget-style like test_telemetry_overhead_budget (a wall-clock A/B
+    flakes on shared hosts): measure the real per-request latency of the
+    traced HTTP serve path, count the spans + exemplar writes one request
+    issues, microbenchmark those primitives, and require
+    ops_per_request * per_op_cost <= 2% of the request time."""
+    prev = global_trace_store()
+    st = TraceStore(enabled=True, sample=1.0, capacity=256,
+                    registry=MetricsRegistry())
+    set_global_trace_store(st)
+    registry = ModelRegistry()
+    registry.register("mlp", _mlp(), version="v1")
+    srv = InferenceServer(registry, max_batch=8, max_latency_s=0.001,
+                          max_queue=256).start()
+    try:
+        for _ in range(30):   # warm: compile + connection path
+            _post(srv.port, "/v1/predict",
+                  {"model": "mlp", "inputs": [[0.0] * N_IN]})
+        spans_before = len(st._ring)
+        n_req = 100
+        t0 = time.perf_counter()
+        for _ in range(n_req):
+            _post(srv.port, "/v1/predict",
+                  {"model": "mlp", "inputs": [[0.0] * N_IN]})
+        request_s = (time.perf_counter() - t0) / n_req
+        assert len(st._ring) > spans_before  # the loop really was traced
+    finally:
+        srv.stop()
+        set_global_trace_store(prev)
+
+    # ops per request on the predict path: one root trace finalize (the
+    # HTTP span), two child spans (admission + batch.queue), the dispatch
+    # span amortized over its group (worst case: group of 1 -> one more
+    # root), and one exemplar write
+    probe = TraceStore(enabled=True, sample=1.0, capacity=256,
+                       registry=MetricsRegistry())
+    prev = global_trace_store()
+    set_global_trace_store(probe)
+    try:
+        n_probe = 3000
+        t0 = time.perf_counter()
+        for _ in range(n_probe):
+            with trace_span("probe.root"):
+                pass
+        root_s = (time.perf_counter() - t0) / n_probe
+        with trace_span("probe.parent") as parent:
+            t0 = time.perf_counter()
+            for _ in range(n_probe):
+                with trace_span("probe.child", parent=parent):
+                    pass
+            child_s = (time.perf_counter() - t0) / n_probe
+        t0 = time.perf_counter()
+        for _ in range(n_probe):
+            probe.put_exemplar("probe_metric", 0.001, "f" * 32)
+        exemplar_s = (time.perf_counter() - t0) / n_probe
+    finally:
+        set_global_trace_store(prev)
+
+    overhead = 2 * root_s + 2 * child_s + exemplar_s
+    assert overhead <= 0.02 * request_s, (
+        f"tracing budget blown: 2x{root_s * 1e6:.1f}us root + "
+        f"2x{child_s * 1e6:.1f}us child + {exemplar_s * 1e6:.1f}us "
+        f"exemplar = {overhead * 1e6:.1f}us vs request "
+        f"{request_s * 1e3:.2f}ms")
