@@ -832,7 +832,8 @@ def bench_serve(batch, iters, ksteps, serve_qps=None, serve_latency_ms=None,
                 serve_batching=None, serve_quant=None,
                 serve_replicas=None, serve_sharding=None,
                 compile_cache=None, decode_kv=None, decode_page_size=None,
-                decode_spec_draft=None, serve_tracing=None):
+                decode_spec_draft=None, serve_tracing=None,
+                serve_autoscale=None):
     """Micro-batching A/B on the serving engine (ISSUE 9 headline).
 
     Unlike the fit benches this is fully CPU-measurable: the win is
@@ -878,6 +879,15 @@ def bench_serve(batch, iters, ksteps, serve_qps=None, serve_latency_ms=None,
     tracing, budgeted at <= 2% by the tier-1 contract test. The
     ``serve_tracing`` axis is config-distinct (an untraced capture never
     stands in for the tracing-on default row).
+
+    Round 18 adds the AUTOSCALE section (``serve_autoscale="on"``): the
+    open-loop ramp A/B (``run_ramp_ab``) — a 10x offered-load swing
+    against the SLO-driven autoscaled fleet vs a static fleet sized to
+    the autoscaled run's time-weighted average replica count. The row
+    carries ``ramp_slo_violation_seconds_auto/static`` (the acceptance
+    floor), ``ramp_lost_requests`` (drain-without-loss scale-in) and
+    ``ramp_scale_out_latency_s`` (warm-path decision-to-routable). Off
+    by default: the ramp costs ~15s of wall clock.
     """
     import numpy as np
 
@@ -1172,6 +1182,35 @@ def bench_serve(batch, iters, ksteps, serve_qps=None, serve_latency_ms=None,
                                if tr_p50_us > 0 else None),
     }
 
+    # autoscale ramp section: only when armed — the three-segment ramp
+    # plus the static control is the most expensive serve phase by far
+    serve_autoscale = serve_autoscale or "off"
+    autoscale_sec = {"serve_autoscale": serve_autoscale}
+    if serve_autoscale == "on":
+        from deeplearning4j_tpu.keras_server.loadgen import run_ramp_ab
+        ramp_low = max(5.0, round(0.15 * unbatched_peak, 1))
+        ramp = run_ramp_ab(
+            net, model="ramp_mlp", qps_low=ramp_low,
+            qps_high=10.0 * ramp_low, segment_s=2.0,
+            slo_ms=float(os.environ.get("DL4J_SLO_P99_MS", "250")),
+            min_replicas=1, max_replicas=4, cooldown_s=1.0,
+            interval_s=0.2, max_batch=batch, max_queue=64,
+            example=example, workers=16, record_path=record_path)
+        autoscale_sec.update({
+            "ramp_qps_low": ramp["qps_low"],
+            "ramp_qps_high": ramp["qps_high"],
+            "ramp_avg_replicas_auto": ramp["avg_replicas_auto"],
+            "ramp_static_replicas": ramp["static_replicas"],
+            "ramp_slo_violation_seconds_auto":
+                ramp["slo_violation_seconds_auto"],
+            "ramp_slo_violation_seconds_static":
+                ramp["slo_violation_seconds_static"],
+            "ramp_lost_requests": ramp["lost_requests"],
+            "ramp_scale_out_latency_s": ramp["scale_out_latency_s"],
+            "ramp_scale_events": ramp["scale_events"],
+            "ramp_auto_beats_static": ramp["auto_beats_static"],
+        })
+
     return {
         "samples_per_sec": batched["achieved_qps"],  # headline: batched QPS
         "offered_qps": qps,
@@ -1193,6 +1232,7 @@ def bench_serve(batch, iters, ksteps, serve_qps=None, serve_latency_ms=None,
         **replica_sec,
         **ready,
         **trace_sec,
+        **autoscale_sec,
         "api": "keras_server.InferenceServer /v1/predict + /v1/generate",
     }
 
@@ -1884,6 +1924,8 @@ def _child_main(args) -> None:
             kwargs["decode_spec_draft"] = args.decode_spec_draft
         if args.serve_tracing:
             kwargs["serve_tracing"] = args.serve_tracing
+        if args.serve_autoscale:
+            kwargs["serve_autoscale"] = args.serve_autoscale
     if args.model == "ps_async":
         if args.ps_workers:
             kwargs["ps_workers"] = args.ps_workers
@@ -2081,6 +2123,14 @@ def main() -> None:
                          "runs both phases and trace_overhead_pct reports "
                          "the serve-path cost of 100%%-sampled tracing "
                          "(budget <= 2%%, pinned by test_bench_contract)")
+    ap.add_argument("--serve-autoscale", default=None,
+                    choices=("on", "off"),
+                    help="serve bench autoscaling ramp axis (config-"
+                         "distinct); default off. 'on' runs the open-loop "
+                         "ramp A/B: SLO-driven autoscaled fleet vs a "
+                         "static fleet at the same average replica count "
+                         "(ramp_slo_violation_seconds_auto/static, "
+                         "ramp_lost_requests, ramp_scale_out_latency_s)")
     ap.add_argument("--ps-workers", type=int, default=None,
                     help="ps_async bench worker count for the straggler A/B "
                          "(config-distinct); default 4")
@@ -2360,6 +2410,12 @@ _PAGED_DECODE_AXIS_LANDED_TS = "2026-08-07T08:00:00Z"
 #: tracing-on default row whose headline carries the overhead budget
 _SERVE_TRACING_AXIS_LANDED_TS = "2026-08-07T12:00:00Z"
 
+#: when the autoscaling serving fleet landed (ISSUE 18): serve rows before
+#: this predate --serve-autoscale and the ramp A/B section (fleets were a
+#: fixed --serve-replicas guess), so a static-fleet capture must never
+#: stand in for the autoscaled ramp row and vice versa
+_SERVE_AUTOSCALE_AXIS_LANDED_TS = "2026-08-07T16:00:00Z"
+
 
 def _config_key(args_str: str, ts: str = None) -> dict:
     """The fields that make two bench invocations the SAME config: model,
@@ -2471,6 +2527,12 @@ def _config_key(args_str: str, ts: str = None) -> dict:
         # default-on is its own config: an untraced capture must never
         # stand in for the tracing-on row (and vice versa)
         serve_tracing = val("--serve-tracing") or "on"
+    serve_autoscale = None
+    if model == "serve" and not (
+            ts is not None and ts < _SERVE_AUTOSCALE_AXIS_LANDED_TS):
+        # default-off is its own config: a row without the ramp A/B must
+        # never stand in for the autoscaled capture (and vice versa)
+        serve_autoscale = val("--serve-autoscale") or "off"
     return {"model": model, "batch": val("--batch"),
             "ksteps": val("--ksteps"), "dtype": mode, "rdtype": rdtype,
             "seq": val("--seq"), "vocab": val("--vocab"),
@@ -2487,7 +2549,8 @@ def _config_key(args_str: str, ts: str = None) -> dict:
             "compile_cache": compile_cache, "decode_kv": decode_kv,
             "decode_page_size": decode_page_size,
             "decode_spec_draft": decode_spec_draft,
-            "serve_tracing": serve_tracing}
+            "serve_tracing": serve_tracing,
+            "serve_autoscale": serve_autoscale}
 
 
 def _last_healthy_from_log(args_str: str, path: str = None):

@@ -174,14 +174,19 @@ def _cmd_serve(args) -> int:
         host=args.host, port=args.port, replicas=args.replicas,
         sharding=args.sharding, max_batch=args.max_batch,
         max_latency_s=args.max_latency_ms / 1e3, max_queue=args.max_queue,
-        warmup=args.warmup)
+        warmup=args.warmup, autoscale=args.autoscale,
+        min_replicas=args.min_replicas, max_replicas=args.max_replicas,
+        autoscale_cooldown_s=args.autoscale_cooldown_s)
     if srv.replica_set is not None:
         srv.replica_set.load(args.name, args.model, quant=args.quant)
     else:
         srv.registry.load(args.name, args.model, quant=args.quant)
     srv.start()
     mode = (f"{args.replicas} replica(s)"
-            + (f", {args.sharding}-sharded" if args.sharding else ""))
+            + (f", {args.sharding}-sharded" if args.sharding else "")
+            + (f", autoscaled [{args.min_replicas or 1}.."
+               f"{args.max_replicas or max(args.replicas, 8)}]"
+               if args.autoscale else ""))
     trace = ("off" if args.no_tracing
              else f"on, sample={args.trace_sample:g}")
     print(f"inference server listening on http://{args.host}:{srv.port} "
@@ -299,6 +304,18 @@ def build_parser() -> argparse.ArgumentParser:
                          "--max-batch (parallel, executable-cache-backed) "
                          "before the model goes active, so the first real "
                          "request never pays an XLA compile")
+    sv.add_argument("--autoscale", action="store_true",
+                    help="SLO-driven fleet sizing: a control loop grows/"
+                         "shrinks --replicas between --min-replicas and "
+                         "--max-replicas from error-budget burn and queue "
+                         "pressure (warm scale-out, drain-without-loss "
+                         "scale-in, lease-fenced membership)")
+    sv.add_argument("--min-replicas", type=int, default=None,
+                    help="autoscaler floor (default: 1)")
+    sv.add_argument("--max-replicas", type=int, default=None,
+                    help="autoscaler ceiling (default: max(--replicas, 8))")
+    sv.add_argument("--autoscale-cooldown-s", type=float, default=30.0,
+                    help="minimum seconds between scale events (hysteresis)")
     sv.add_argument("--no-tracing", action="store_true",
                     help="disable request tracing (spans become process-"
                          "wide no-ops; /serve/traces serves empty)")

@@ -306,10 +306,18 @@ class MembershipOracle(TpuProvisioner):
 
     ``clock`` is injectable (default ``time.monotonic``) so lease math is
     unit-testable with a fake clock.
+
+    ``role`` names what kind of member the oracle fences — ``"worker"``
+    for training (the default, and the historical behaviour) or
+    ``"replica"`` for the serving fleet (``keras_server/autoscaler.py``).
+    It only affects default member names and flight-recorder event names
+    (``{role}_join`` / ``{role}_leave`` / ``{role}_lost``); the lease and
+    epoch fencing semantics are identical for both.
     """
 
     lease_timeout_s: float = 15.0
     clock: Callable[[], float] = time.monotonic
+    role: str = "worker"
 
     def __post_init__(self) -> None:
         self._lock = threading.Lock()
@@ -324,14 +332,14 @@ class MembershipOracle(TpuProvisioner):
             self._epoch += 1
             lease = WorkerLease(
                 member=self._epoch, epoch=self._epoch, shard=int(shard),
-                name=worker or f"worker-{self._epoch}",
+                name=worker or f"{self.role}-{self._epoch}",
                 deadline=self.clock() + self.lease_timeout_s)
             self._members[lease.member] = lease
             self.joins += 1
             _joins.inc()
             self._update_gauge_locked()
         _flight_recorder().record(
-            "worker_join", member=lease.member, epoch=lease.epoch,
+            f"{self.role}_join", member=lease.member, epoch=lease.epoch,
             shard=lease.shard, worker=lease.name)
         return lease
 
@@ -361,7 +369,7 @@ class MembershipOracle(TpuProvisioner):
             lease.reason = reason
             self._update_gauge_locked()
         _flight_recorder().record(
-            "worker_leave", member=lease.member, shard=lease.shard,
+            f"{self.role}_leave", member=lease.member, shard=lease.shard,
             reason=reason)
         return True
 
@@ -402,7 +410,7 @@ class MembershipOracle(TpuProvisioner):
             lease.reason = reason
             self._update_gauge_locked()
         _flight_recorder().record(
-            "worker_lost", member=lease.member, shard=lease.shard,
+            f"{self.role}_lost", member=lease.member, shard=lease.shard,
             reason=reason)
         return True
 
@@ -434,7 +442,7 @@ class MembershipOracle(TpuProvisioner):
         _lease_expiries.inc()
         self._update_gauge_locked()
         _flight_recorder().record(
-            "worker_lost", member=lease.member, shard=lease.shard,
+            f"{self.role}_lost", member=lease.member, shard=lease.shard,
             reason=reason)
 
     def _update_gauge_locked(self) -> None:

@@ -223,13 +223,15 @@ from deeplearning4j_tpu.keras_server.decode import (  # noqa: E402
     DecodeEngine, DecodeSession)
 from deeplearning4j_tpu.keras_server.replica import (  # noqa: E402
     Replica, ReplicaSet)
+from deeplearning4j_tpu.keras_server.autoscaler import (  # noqa: E402
+    Autoscaler)
 from deeplearning4j_tpu.keras_server.streaming import (  # noqa: E402
     StreamSessions)
 from deeplearning4j_tpu.keras_server.serving import (  # noqa: E402
     InferenceServer, active_server, serve_status)
 from deeplearning4j_tpu.keras_server.loadgen import (  # noqa: E402
     run_ab, run_closed_loop, run_decode_ab, run_open_loop,
-    run_replica_ab, run_token_stream_load)
+    run_ramp_ab, run_replica_ab, run_token_stream_load)
 
 __all__ = [
     "HDF5MiniBatchDataSetIterator", "DeepLearning4jEntryPoint", "Server",
@@ -239,8 +241,8 @@ __all__ = [
     "set_global_model_registry",
     "MicroBatcher", "batch_bucket", "StreamSessions",
     "DecodeEngine", "DecodeSession",
-    "Replica", "ReplicaSet",
+    "Replica", "ReplicaSet", "Autoscaler",
     "InferenceServer", "active_server", "serve_status",
     "run_ab", "run_closed_loop", "run_decode_ab", "run_open_loop",
-    "run_replica_ab", "run_token_stream_load",
+    "run_ramp_ab", "run_replica_ab", "run_token_stream_load",
 ]

@@ -7,6 +7,17 @@ raises :class:`RejectedError` immediately and the HTTP layer maps it to
 ``429 Too Many Requests`` with a ``Retry-After`` hint. The queue-depth
 gauge (``dl4j_serve_queue_depth``) is updated on BOTH edges so the metric
 always agrees with what a 429 claims (pinned by tests/test_serving.py).
+
+**Priority-aware shedding.** Requests may carry a ``priority`` tag
+(``low`` < ``normal`` < ``high``); each priority sees a *fraction* of the
+pending budget (:data:`PRIORITY_FLOORS`). When the queue fills past a
+priority's floor, that priority is refused while higher priorities keep
+admitting — under saturation the fleet sheds low-priority tenants first
+and a high-priority request only ever sees a 429 when the queue is
+genuinely full. Untagged traffic defaults to ``high`` so legacy callers
+keep the full budget. Priority sheds (refusals below the hard cap) are
+accounted per tenant in ``dl4j_serve_shed_total{tenant,priority}`` on top
+of the blanket ``dl4j_serve_rejected_total``.
 """
 from __future__ import annotations
 
@@ -16,17 +27,38 @@ from deeplearning4j_tpu.observability import names as _n
 from deeplearning4j_tpu.observability.metrics import global_registry
 from deeplearning4j_tpu.observability.tracing import trace_span
 
+#: recognized priority tags, lowest first (shed order under saturation)
+PRIORITY_LEVELS = ("low", "normal", "high")
+
+#: fraction of ``max_pending`` each priority may fill before it is shed;
+#: ``high`` owns the whole budget, so a high-priority 429 means the queue
+#: is hard-full, not priority-shed
+PRIORITY_FLOORS = {"low": 0.5, "normal": 0.75, "high": 1.0}
+
+
+def normalize_priority(priority) -> str:
+    """Map an untrusted tag (HTTP header) onto a known level; unknown or
+    missing tags get the full budget (``high``) — shedding is opt-in."""
+    p = str(priority).strip().lower() if priority else "high"
+    return p if p in PRIORITY_FLOORS else "high"
+
 
 class RejectedError(RuntimeError):
     """Request refused at admission (maps to HTTP 429)."""
 
-    def __init__(self, pending: int, limit: int, retry_after_s: float):
+    def __init__(self, pending: int, limit: int, retry_after_s: float,
+                 priority: str = "high", shed: bool = False):
         super().__init__(
             f"serving queue full ({pending}/{limit} pending); "
             f"retry in ~{retry_after_s:.3f}s")
         self.pending = pending
         self.limit = limit
         self.retry_after_s = retry_after_s
+        #: priority the refused request carried
+        self.priority = priority
+        #: True when the refusal was a priority shed (queue had room above
+        #: this priority's floor), False when the queue was hard-full
+        self.shed = shed
 
 
 class AdmissionController:
@@ -41,38 +73,55 @@ class AdmissionController:
         self._lock = threading.Lock()
         self._pending = 0
         self.rejected = 0
+        self.shed = 0
         m = metrics or global_registry()
         self._g_depth = m.gauge(
             _n.SERVE_QUEUE_DEPTH, "admitted-but-unfinished serve requests")
         self._c_rejected = m.counter(
             _n.SERVE_REJECTED_TOTAL, "requests refused at admission (429)")
+        self._c_shed = m.counter(
+            _n.SERVE_SHED_TOTAL,
+            "requests priority-shed at admission, by tenant and priority")
 
     @property
     def pending(self) -> int:
         with self._lock:
             return self._pending
 
-    def admit(self, n: int = 1) -> None:
+    def limit_for(self, priority: str) -> int:
+        """The pending budget ``priority`` may fill before it is shed."""
+        floor = PRIORITY_FLOORS.get(priority, 1.0)
+        return max(1, int(self.max_pending * floor))
+
+    def admit(self, n: int = 1, priority: str = "high",
+              tenant: str = "-") -> None:
         """Admit ``n`` requests or raise :class:`RejectedError`. The
         decision is a trace span: accepted requests record the depth they
         entered at, rejects stamp ``status="rejected"`` — the tail sampler
         always keeps rejected traces."""
+        limit = self.limit_for(priority)
         with trace_span("admission") as sp:
             with self._lock:
-                if self._pending + n > self.max_pending:
+                if self._pending + n > limit:
+                    shed = limit < self.max_pending
                     self.rejected += n
                     self._c_rejected.inc(n)
+                    if shed:
+                        self.shed += n
+                        self._c_shed.labels(
+                            tenant=tenant, priority=priority).inc(n)
                     sp.set_status("rejected")
-                    sp.set_attr(pending=self._pending,
-                                limit=self.max_pending)
+                    sp.set_attr(pending=self._pending, limit=limit,
+                                priority=priority)
                     # crude but honest: a full queue drains one expected-
                     # latency per slot; clients treat it as a floor, not a
                     # promise
-                    raise RejectedError(self._pending, self.max_pending,
-                                        self.expected_latency_s)
+                    raise RejectedError(self._pending, limit,
+                                        self.expected_latency_s,
+                                        priority=priority, shed=shed)
                 self._pending += n
                 self._g_depth.set(self._pending)
-                sp.set_attr(pending=self._pending, limit=self.max_pending)
+                sp.set_attr(pending=self._pending, limit=limit)
 
     def release(self, n: int = 1) -> None:
         with self._lock:

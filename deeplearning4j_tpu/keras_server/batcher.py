@@ -141,11 +141,14 @@ class MicroBatcher:
     def _group_key(model: str, xs: Tuple[np.ndarray, ...]) -> Tuple:
         return (model,) + tuple((x.shape[1:], str(x.dtype)) for x in xs)
 
-    def submit(self, model: str, x) -> Future:
+    def submit(self, model: str, x, *, priority: str = "high",
+               tenant: str = "-") -> Future:
         """Queue one request (``x`` carries a leading batch axis; a single
         example must arrive as shape ``[1, ...]``; a multi-input graph
         takes a list/tuple of arrays sharing the leading axis). Raises
-        :class:`RejectedError` when admission refuses (HTTP 429)."""
+        :class:`RejectedError` when admission refuses (HTTP 429).
+        ``priority``/``tenant`` flow to admission: under saturation, low
+        priorities are shed before high ones (see ``admission.py``)."""
         if isinstance(x, (list, tuple)):
             xs = tuple(np.asarray(a) for a in x)
             if not xs:
@@ -165,7 +168,7 @@ class MicroBatcher:
             raise ValueError(
                 f"request batch {xs[0].shape[0]} exceeds max_batch "
                 f"{self.max_batch}; split it client-side")
-        self.admission.admit()
+        self.admission.admit(priority=priority, tenant=tenant)
         self._c_requests.labels(model=model).inc()
         req = _Request(model, xs, self._group_key(model, xs),
                        time.perf_counter())

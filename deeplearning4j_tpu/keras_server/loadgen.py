@@ -41,7 +41,7 @@ import os
 import random
 import threading
 import time
-from typing import Callable, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -447,6 +447,200 @@ def run_replica_ab(net, *, model: str = "model", replicas: int = 2,
         "recompiles_match_buckets": all(
             p["recompiles_match_buckets"]
             for p in phases["scaled"].get("per_replica", [])),
+    }
+    if record_path:
+        os.makedirs(os.path.dirname(os.path.abspath(record_path)),
+                    exist_ok=True)
+        with open(record_path, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+    return rec
+
+
+def _run_ramp_phase(port: int, model: str, example, *,
+                    segments, workers: int = 16,
+                    host: str = "127.0.0.1") -> List[tuple]:
+    """Open-loop ramp client: ``segments`` is a sequence of
+    ``(qps, seconds)`` steps played back to back. Send times are fixed by
+    the schedule (latency measured from the SCHEDULED instant — no
+    coordinated omission, same contract as :func:`run_open_loop`).
+    Returns per-request ``(t_sched_s, status, latency_ms)`` samples."""
+    bodies = _worker_bodies(model, example)
+    offsets: List[float] = []
+    t = 0.0
+    for qps, seg_s in segments:
+        n = max(1, int(qps * seg_s))
+        offsets.extend(t + i / qps for i in range(n))
+        t += seg_s
+    samples: List[tuple] = []
+    lock = threading.Lock()
+    next_i = [0]
+    t0 = time.perf_counter()
+
+    def worker():
+        conn = _connect(host, port)
+        try:
+            while True:
+                with lock:
+                    i = next_i[0]
+                    if i >= len(offsets):
+                        return
+                    next_i[0] += 1
+                sched = offsets[i]
+                delay = t0 + sched - time.perf_counter()
+                if delay > 0:
+                    time.sleep(delay)
+                try:
+                    status = _post_predict(conn, model, bodies(i))
+                except Exception:
+                    status = -1
+                    conn.close()
+                    conn = _connect(host, port)
+                lat_ms = (time.perf_counter() - (t0 + sched)) * 1e3
+                with lock:
+                    samples.append((sched, status, lat_ms))
+        finally:
+            conn.close()
+
+    threads = [threading.Thread(target=worker, daemon=True)
+               for _ in range(workers)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    return samples
+
+
+def _ramp_summary(samples: List[tuple], slo_ms: float) -> dict:
+    """Fold ramp samples into SLO-violation-seconds: a wall-clock second
+    is in violation when its p99 exceeds ``slo_ms`` or any request in it
+    was rejected or errored. ``lost`` counts admitted-but-failed requests
+    (a 429 is an explicit reject, not a loss)."""
+    by_second: Dict[int, List[tuple]] = {}
+    for sched, status, lat_ms in samples:
+        by_second.setdefault(int(sched), []).append((status, lat_ms))
+    violation_s = 0
+    for sec in sorted(by_second):
+        rows = by_second[sec]
+        lat = sorted(l for s, l in rows if s == 200)
+        bad = any(s != 200 for s, _ in rows)
+        if bad or (lat and percentile(lat, 0.99) > slo_ms):
+            violation_s += 1
+    lat_all = sorted(l for _, s, l in samples if s == 200)
+    return {
+        "requests": len(samples),
+        "ok": sum(1 for _, s, _ in samples if s == 200),
+        "rejected": sum(1 for _, s, _ in samples if s == 429),
+        "lost": sum(1 for _, s, _ in samples if s not in (200, 429)),
+        "p50_ms": round(percentile(lat_all, 0.50), 3),
+        "p99_ms": round(percentile(lat_all, 0.99), 3),
+        "slo_violation_seconds": violation_s,
+    }
+
+
+def run_ramp_ab(net, *, model: str = "model", qps_low: float = 20.0,
+                qps_high: Optional[float] = None, segment_s: float = 2.0,
+                slo_ms: float = 250.0, min_replicas: int = 1,
+                max_replicas: int = 4, cooldown_s: float = 1.0,
+                interval_s: float = 0.2, max_batch: int = 32,
+                max_latency_s: float = 0.004, max_queue: int = 64,
+                example=None, workers: int = 16,
+                warmup_requests: int = 8,
+                record_path: Optional[str] = None) -> dict:
+    """The autoscaling headline A/B: an open-loop ramp (low → high → low,
+    default 10x swing) against (a) an autoscaled fleet and (b) a static
+    fleet sized to the autoscaled run's time-weighted AVERAGE replica
+    count — same average hardware, different placement in time. The
+    record carries ``slo_violation_seconds_auto/static`` (the acceptance
+    floor: auto strictly below static), ``lost_requests`` (must be zero:
+    scale-in drains without loss) and ``scale_out_latency_s`` (decision →
+    routable, warm-path bounded)."""
+    from .registry import ModelRegistry
+    from .serving import InferenceServer
+    if example is None:
+        raise ValueError("pass example= (one input row, shape [1, ...])")
+    example = np.asarray(example)
+    qps_high = qps_high if qps_high is not None else 10.0 * qps_low
+    segments = ((qps_low, segment_s), (qps_high, segment_s),
+                (qps_low, segment_s))
+
+    # ---- phase 1: autoscaled fleet, fleet-size sampler alongside
+    server = InferenceServer(
+        replicas=min_replicas, autoscale=True, min_replicas=min_replicas,
+        max_replicas=max_replicas, autoscale_cooldown_s=cooldown_s,
+        autoscale_interval_s=interval_s, max_batch=max_batch,
+        max_latency_s=max_latency_s, max_queue=max_queue, warmup=True)
+    server.register(model, net.clone(), version="v1")
+    fleet_samples: List[tuple] = []
+    stop = threading.Event()
+
+    def sampler():
+        while not stop.wait(0.05):
+            fleet_samples.append(
+                (time.perf_counter(), server.replica_set.n_replicas))
+
+    server.start()
+    sth = threading.Thread(target=sampler, daemon=True)
+    sth.start()
+    try:
+        run_closed_loop(server.port, model, example, workers=2,
+                        requests_per_worker=warmup_requests)
+        auto_samples = _run_ramp_phase(
+            server.port, model, example, segments=segments,
+            workers=workers)
+        scaler = server.autoscaler.status()
+    finally:
+        stop.set()
+        sth.join(2.0)
+        server.stop()
+    auto = _ramp_summary(auto_samples, slo_ms)
+    if len(fleet_samples) > 1:
+        weighted = sum(
+            n * (fleet_samples[i + 1][0] - fleet_samples[i][0])
+            for i, (_, n) in enumerate(fleet_samples[:-1]))
+        span = fleet_samples[-1][0] - fleet_samples[0][0]
+        avg_replicas = weighted / span if span > 0 else float(min_replicas)
+    else:
+        avg_replicas = float(min_replicas)
+
+    # ---- phase 2: static fleet at the SAME average replica count
+    static_n = max(1, round(avg_replicas))
+    if static_n > 1:
+        server = InferenceServer(
+            replicas=static_n, max_batch=max_batch,
+            max_latency_s=max_latency_s, max_queue=max_queue, warmup=True)
+        server.register(model, net.clone(), version="v1")
+    else:
+        registry = ModelRegistry()
+        registry.register(model, net.clone(), version="v1")
+        server = InferenceServer(
+            registry, max_batch=max_batch, max_latency_s=max_latency_s,
+            max_queue=max_queue)
+    server.start()
+    try:
+        run_closed_loop(server.port, model, example, workers=2,
+                        requests_per_worker=warmup_requests)
+        static_samples = _run_ramp_phase(
+            server.port, model, example, segments=segments,
+            workers=workers)
+    finally:
+        server.stop()
+    static = _ramp_summary(static_samples, slo_ms)
+
+    rec = {
+        "harness": "keras_server.loadgen.run_ramp_ab",
+        "model": model, "qps_low": qps_low, "qps_high": qps_high,
+        "segment_s": segment_s, "slo_ms": slo_ms,
+        "min_replicas": min_replicas, "max_replicas": max_replicas,
+        "avg_replicas_auto": round(avg_replicas, 3),
+        "static_replicas": static_n,
+        "auto": auto, "static": static,
+        "slo_violation_seconds_auto": auto["slo_violation_seconds"],
+        "slo_violation_seconds_static": static["slo_violation_seconds"],
+        "lost_requests": auto["lost"],
+        "scale_out_latency_s": scaler.get("last_scale_out_latency_s"),
+        "scale_events": len(scaler.get("events", [])),
+        "auto_beats_static": (auto["slo_violation_seconds"]
+                              < static["slo_violation_seconds"]),
     }
     if record_path:
         os.makedirs(os.path.dirname(os.path.abspath(record_path)),

@@ -28,6 +28,21 @@ requests always complete against the version they resolved at dispatch —
 zero request loss across a full fleet upgrade (pinned by
 tests/test_serving_replica.py) — and the fleet serves at N-1 capacity
 during the roll instead of pausing.
+
+**Elastic fleet.** ``add_replica()`` / ``remove_replica()`` mutate the set
+at runtime (the autoscaler's actuators; see ``autoscaler.py``). Replica
+indices are allocated monotonically and never reused, so router math,
+per-replica metric series and ``~r<i>`` program names stay stable while
+the set churns. A new replica spins up through the warm path: it
+pre-registers the fleet's whole model catalog (every bucket program built,
+warm-hitting the PR 15 compile cache because the executable fingerprint
+sheds the ``~r<i>`` decoration) and only THEN becomes visible to the
+router. Removal is the drain-without-loss idiom: mark draining, wait for
+the queue to empty, unlink, then close — no in-flight request is lost
+across a scale-down. With a ``cloud.MembershipOracle`` attached, each
+replica holds a lease and the router skips any replica whose ``(member,
+epoch)`` no longer validates — a zombie replica is fenced out of the
+dispatch path exactly like a zombie PS worker.
 """
 from __future__ import annotations
 
@@ -59,6 +74,9 @@ class Replica:
         #: router-visible: a draining replica takes no NEW requests while
         #: its registry swaps versions (its queued work still completes)
         self.draining = False
+        #: cloud.WorkerLease when the set runs with a MembershipOracle —
+        #: the router validates it per dispatch (zombie fencing)
+        self.lease = None
         # warmup pre-builds every bucket program before each register's
         # pointer swap, so a replica joins the router compile-free
         self.registry = ModelRegistry(
@@ -88,60 +106,96 @@ class ReplicaSet:
                  devices=None, max_batch: int = 32,
                  max_latency_s: float = 0.002, max_queue: int = 256,
                  metrics=None, drain_timeout_s: float = 30.0,
-                 warmup: bool = False):
+                 warmup: bool = False, membership=None):
         if n_replicas < 1:
             raise ValueError("n_replicas must be >= 1")
         self.sharding = sharding
         self.drain_timeout_s = float(drain_timeout_s)
         m = metrics or global_registry()
+        self._m = m
+        self._max_batch = max_batch
+        self._max_latency_s = max_latency_s
+        self._max_queue = max_queue
+        self._warmup = warmup
+        self._mesh_axes = mesh_axes
+        self._devices = list(devices) if devices is not None else None
+        self._membership = membership
         self._c_routed = m.counter(
             _n.SERVE_REPLICA_ROUTED_TOTAL,
             "requests routed per replica (least-queue-depth dispatch)")
         self._g_active_version = m.gauge(
             _n.SERVE_REPLICA_ACTIVE_VERSION,
             "1 on the (replica, model, version) series currently active")
+        self._g_fleet = m.gauge(
+            _n.SERVE_FLEET_SIZE, "live serving replicas in the set")
+        self._c_scale = m.counter(
+            _n.SERVE_SCALE_EVENTS_TOTAL,
+            "fleet size changes, by direction (out/in) and reason")
         self._lock = threading.Lock()
+        # serializes fleet mutations (register roll, add/remove) against
+        # each other so a replica added mid-roll can't miss a version;
+        # the router's submit() never takes it
+        self._mutate_lock = threading.RLock()
         self._versions: Dict[str, List[str]] = {}
+        #: name -> (version, net, source, quant) of the ACTIVE version —
+        #: what a newly added replica must pre-register before joining
+        self._catalog: Dict[str, tuple] = {}
         self._routed: Dict[int, int] = {i: 0 for i in range(n_replicas)}
         self._gauge_active: Dict[tuple, str] = {}
+        #: monotonic index allocator — indices are never reused, so metric
+        #: series and program names stay unambiguous across churn
+        self._next_index = n_replicas
         self._replicas = [
             Replica(i, max_batch=max_batch, max_latency_s=max_latency_s,
                     max_queue=max_queue, metrics=m, warmup=warmup,
-                    **placement)
-            for i, placement in enumerate(
-                self._placements(n_replicas, sharding, mesh_axes, devices))]
+                    **self._placement_for(i, n_total=n_replicas))
+            for i in range(n_replicas)]
+        if membership is not None:
+            for r in self._replicas:
+                r.lease = membership.register(
+                    shard=r.index, worker=f"replica-{r.index}")
+        self._g_fleet.set(len(self._replicas))
 
-    @staticmethod
-    def _placements(n: int, sharding: Optional[str],
-                    mesh_axes: Optional[Dict[str, int]],
-                    devices) -> List[dict]:
+    def _placement_for(self, i: int, n_total: Optional[int] = None) -> dict:
+        """Placement for replica index ``i``. ``n_total`` sizes the mesh
+        slices at construction; afterwards the slice width is fixed, so a
+        sharded scale-out only succeeds while unclaimed slices remain."""
         import jax
-        devs = list(devices) if devices is not None else list(jax.devices())
-        if sharding is None:
+        devs = list(self._devices) if self._devices is not None \
+            else list(jax.devices())
+        if self.sharding is None:
             # round-robin: more replicas than devices is legal (CPU scale
             # tests; oversubscribed chips are the operator's call)
-            return [{"device": devs[i % len(devs)]} for i in range(n)]
+            return {"device": devs[i % len(devs)]}
         from deeplearning4j_tpu.parallel.mesh import build_mesh
-        per = len(devs) // n
-        if per < 1:
-            raise ValueError(
-                f"{n} sharded replicas need >= {n} devices, "
-                f"have {len(devs)}")
-        if mesh_axes is None:
-            # default slice shape: give the model axis the factor of two
-            # when available — dp_tp with model=1 would be sharding theater
-            model = 2 if per % 2 == 0 else 1
-            mesh_axes = {"data": per // model, "model": model}
+        if n_total is not None:
+            per = len(devs) // n_total
+            if per < 1:
+                raise ValueError(
+                    f"{n_total} sharded replicas need >= {n_total} devices, "
+                    f"have {len(devs)}")
+            if self._mesh_axes is None:
+                # default slice shape: give the model axis the factor of two
+                # when available — dp_tp with model=1 would be sharding
+                # theater
+                model = 2 if per % 2 == 0 else 1
+                self._mesh_axes = {"data": per // model, "model": model}
+            self._slice_per = per
+        per = self._slice_per
         need = 1
-        for v in mesh_axes.values():
+        for v in self._mesh_axes.values():
             need *= v
         if need > per:
             raise ValueError(
-                f"mesh_axes {mesh_axes} needs {need} devices per replica "
-                f"but only {per} are available for each of {n} replicas")
-        return [{"mesh": build_mesh(mesh_axes, devices=devs[i * per:
-                                                           i * per + need]),
-                 "sharding": sharding} for i in range(n)]
+                f"mesh_axes {self._mesh_axes} needs {need} devices per "
+                f"replica but only {per} are available for each replica")
+        if i * per + need > len(devs):
+            raise ValueError(
+                f"no free device slice for sharded replica {i}: "
+                f"{len(devs)} devices at {per} per replica")
+        return {"mesh": build_mesh(self._mesh_axes,
+                                   devices=devs[i * per: i * per + need]),
+                "sharding": self.sharding}
 
     # ------------------------------------------------------------ registry
     @property
@@ -150,13 +204,15 @@ class ReplicaSet:
 
     @property
     def replicas(self) -> List[Replica]:
-        return list(self._replicas)
+        with self._lock:
+            return list(self._replicas)
 
     @property
     def primary_registry(self) -> ModelRegistry:
         """Replica 0's registry — the front door's model lookup (404s,
         streaming, decode) reads this; all replicas hold the same
-        (name, version) catalog after every ``register()``."""
+        (name, version) catalog after every ``register()``. The primary
+        replica is pinned: ``remove_replica`` never takes it."""
         return self._replicas[0].registry
 
     def _wait_drained(self, replica: Replica) -> bool:
@@ -178,39 +234,51 @@ class ReplicaSet:
         old version — a fleet-wide upgrade never drops below N-1 live
         replicas and loses zero in-flight requests.
         """
-        with self._lock:
-            versions = self._versions.setdefault(name, [])
-            version = version or f"v{len(versions) + 1}"
-            if version in versions:
-                raise ValueError(
-                    f"model {name!r} already has version {version!r}; "
-                    "versions are immutable — register a new one")
-            versions.append(version)
-        first: Optional[ModelVersion] = None
-        for r in self._replicas:
-            # drain only when a sibling can absorb the traffic — a lone
-            # replica swaps atomically under load instead of pausing
-            drain = any(not o.draining for o in self._replicas if o is not r)
-            r.draining = drain
-            try:
-                if drain:
-                    self._wait_drained(r)
-                mv = r.registry.register(
-                    name, net, version=version, source=source, quant=quant,
-                    sharding=r.sharding, mesh=r.mesh, device=r.device,
-                    replica=r.index)
-            finally:
-                r.draining = False
-            prev = self._gauge_active.get((r.index, name))
-            if prev is not None:
-                self._g_active_version.labels(
-                    replica=str(r.index), model=name, version=prev).set(0)
+        with self._mutate_lock:
+            with self._lock:
+                versions = self._versions.setdefault(name, [])
+                version = version or f"v{len(versions) + 1}"
+                if version in versions:
+                    raise ValueError(
+                        f"model {name!r} already has version {version!r}; "
+                        "versions are immutable — register a new one")
+                versions.append(version)
+                fleet = list(self._replicas)
+            first: Optional[ModelVersion] = None
+            for r in fleet:
+                # drain only when a sibling can absorb the traffic — a lone
+                # replica swaps atomically under load instead of pausing
+                drain = any(not o.draining for o in fleet if o is not r)
+                r.draining = drain
+                try:
+                    if drain:
+                        self._wait_drained(r)
+                    mv = self._register_on(r, name, net, version, source,
+                                           quant)
+                finally:
+                    r.draining = False
+                if first is None:
+                    first = mv
+            with self._lock:
+                self._catalog[name] = (version, net, source, quant)
+            return first
+
+    def _register_on(self, r: Replica, name: str, net, version: str,
+                     source: str, quant: Optional[str]) -> ModelVersion:
+        """Pin one (model, version) on one replica and flip its
+        active-version gauge series."""
+        mv = r.registry.register(
+            name, net, version=version, source=source, quant=quant,
+            sharding=r.sharding, mesh=r.mesh, device=r.device,
+            replica=r.index)
+        prev = self._gauge_active.get((r.index, name))
+        if prev is not None:
             self._g_active_version.labels(
-                replica=str(r.index), model=name, version=version).set(1)
-            self._gauge_active[(r.index, name)] = version
-            if first is None:
-                first = mv
-        return first
+                replica=str(r.index), model=name, version=prev).set(0)
+        self._g_active_version.labels(
+            replica=str(r.index), model=name, version=version).set(1)
+        self._gauge_active[(r.index, name)] = version
+        return mv
 
     def load(self, name: str, path: str, version: Optional[str] = None,
              quant: Optional[str] = None) -> ModelVersion:
@@ -218,13 +286,117 @@ class ReplicaSet:
         return self.register(name, load_model_file(path), version=version,
                              source=path, quant=quant)
 
+    # ------------------------------------------------------- fleet scaling
+    def add_replica(self, reason: str = "manual") -> Replica:
+        """Grow the fleet by one, atomically from the router's view.
+
+        The new replica is built on the next free placement with warmup
+        forced on, then pre-registers the active version of every model in
+        the catalog — every bucket program is compiled (warm-hitting the
+        persistent executable cache, whose fingerprint ignores the
+        ``~r<i>`` replica decoration) BEFORE the replica is appended to the
+        routable list. The router never sees a cold replica.
+        """
+        with self._mutate_lock:
+            with self._lock:
+                idx = self._next_index
+                self._next_index += 1
+                catalog = dict(self._catalog)
+            r = Replica(idx, max_batch=self._max_batch,
+                        max_latency_s=self._max_latency_s,
+                        max_queue=self._max_queue, metrics=self._m,
+                        warmup=True, **self._placement_for(idx))
+            for name, (version, net, source, quant) in catalog.items():
+                self._register_on(r, name, net, version, source, quant)
+            if self._membership is not None:
+                r.lease = self._membership.register(
+                    shard=idx, worker=f"replica-{idx}")
+            with self._lock:
+                self._replicas.append(r)
+                self._routed[idx] = 0
+                self._g_fleet.set(len(self._replicas))
+            self._c_scale.labels(direction="out", reason=reason).inc()
+            return r
+
+    def remove_replica(self, index: Optional[int] = None,
+                       reason: str = "manual") -> bool:
+        """Shrink the fleet by one with the drain-without-loss idiom:
+        mark draining (the router stops sending new work), wait for the
+        queue to empty, unlink from the routable list, then close the
+        dispatcher — every admitted request completes.
+
+        Defaults to the highest-index replica. The primary replica
+        (``_replicas[0]``, whose registry is the front door) is pinned and
+        cannot be removed; the last replica cannot be removed either.
+        """
+        with self._mutate_lock:
+            with self._lock:
+                if len(self._replicas) <= 1:
+                    raise ValueError("cannot remove the last replica")
+                primary = self._replicas[0]
+                if index is None:
+                    r = max(self._replicas[1:], key=lambda o: o.index)
+                else:
+                    found = [o for o in self._replicas
+                             if o.index == int(index)]
+                    if not found:
+                        return False
+                    r = found[0]
+                    if r is primary:
+                        raise ValueError(
+                            "cannot remove the primary replica (its "
+                            "registry is the front door)")
+            r.draining = True
+            self._wait_drained(r)
+            with self._lock:
+                self._replicas = [o for o in self._replicas if o is not r]
+                self._g_fleet.set(len(self._replicas))
+            # close() drains anything that slipped in before the unlink —
+            # admitted work still completes, new work can no longer arrive
+            r.batcher.close(self.drain_timeout_s)
+            if self._membership is not None and r.lease is not None:
+                self._membership.deregister(
+                    r.lease.member, r.lease.epoch, reason=reason)
+            for name in r.registry.names():
+                prev = self._gauge_active.pop((r.index, name), None)
+                if prev is not None:
+                    self._g_active_version.labels(
+                        replica=str(r.index), model=name,
+                        version=prev).set(0)
+            self._c_scale.labels(direction="in", reason=reason).inc()
+            return True
+
+    def heartbeat(self) -> None:
+        """Renew the lease of every in-set replica (they share our
+        process: being in the routable list is liveness). Evicted or
+        superseded leases stay dead — heartbeat cannot resurrect them."""
+        if self._membership is None:
+            return
+        for r in self.replicas:
+            if r.lease is not None:
+                self._membership.heartbeat(r.lease.member, r.lease.epoch)
+
+    def _lease_ok(self, r: Replica) -> bool:
+        if self._membership is None or r.lease is None:
+            return True
+        return self._membership.validate(r.lease.member, r.lease.epoch)
+
+    def fenced_replicas(self) -> List[Replica]:
+        """Replicas whose lease no longer validates (the autoscaler's
+        zombie sweep reads this to evict-and-replace)."""
+        return [r for r in self.replicas if not self._lease_ok(r)]
+
     # -------------------------------------------------------------- router
-    def submit(self, model: str, x) -> Future:
+    def submit(self, model: str, x, *, priority: str = "high",
+               tenant: str = "-") -> Future:
         """Route one request to the least-loaded non-draining replica,
         falling through to the next on admission rejection; raises the
-        last :class:`RejectedError` only when every replica refused."""
-        candidates = [r for r in self._replicas if not r.draining] \
-            or list(self._replicas)
+        last :class:`RejectedError` only when every replica refused.
+        Replicas with a lapsed membership lease are fenced out entirely."""
+        with self._lock:
+            fleet = list(self._replicas)
+        live = [r for r in fleet if self._lease_ok(r)] or fleet
+        candidates = [r for r in live if not r.draining] or live
         last: Optional[RejectedError] = None
         with trace_span("replica.route", model=model) as sp:
             tried = 0
@@ -232,14 +404,16 @@ class ReplicaSet:
                                                        r.index)):
                 tried += 1
                 try:
-                    fut = r.batcher.submit(model, x)
+                    fut = r.batcher.submit(model, x, priority=priority,
+                                           tenant=tenant)
                 except RejectedError as e:
                     last = e
                     continue
                 self._c_routed.labels(replica=str(r.index)).inc()
                 sp.set_attr(replica=r.index, tried=tried)
                 with self._lock:
-                    self._routed[r.index] += 1
+                    self._routed[r.index] = \
+                        self._routed.get(r.index, 0) + 1
                 return fut
             sp.set_status("rejected")
             sp.set_attr(tried=tried)
@@ -250,7 +424,7 @@ class ReplicaSet:
     def queue_stats(self) -> dict:
         """Aggregate stats in the single-batcher shape (the /serve/status
         "queue" block keeps its schema in replica mode)."""
-        per = [r.batcher.stats() for r in self._replicas]
+        per = [r.batcher.stats() for r in self.replicas]
         dispatches = sum(s["dispatches"] for s in per)
         return {
             "queue_depth": sum(s["queue_depth"] for s in per),
@@ -271,14 +445,16 @@ class ReplicaSet:
         """Per-replica detail for /serve/status's "replicas" block."""
         with self._lock:
             routed = dict(self._routed)
+            fleet = list(self._replicas)
         reps = []
-        for r in self._replicas:
+        for r in fleet:
             s = r.batcher.stats()
             reps.append({
                 "replica": r.index,
                 "draining": r.draining,
+                "fenced": not self._lease_ok(r),
                 "queue_depth": r.queue_depth(),
-                "routed": routed[r.index],
+                "routed": routed.get(r.index, 0),
                 "dispatches": s["dispatches"],
                 "mean_occupancy": s["mean_occupancy"],
                 "bucket_count": s["bucket_count"],
@@ -288,9 +464,9 @@ class ReplicaSet:
                 "active": {name: r.registry.active(name).version
                            for name in r.registry.names()},
             })
-        return {"n_replicas": len(self._replicas),
+        return {"n_replicas": len(fleet),
                 "sharding": self.sharding, "replicas": reps}
 
     def close(self, timeout_s: float = 5.0) -> None:
-        for r in self._replicas:
+        for r in self.replicas:
             r.batcher.close(timeout_s)

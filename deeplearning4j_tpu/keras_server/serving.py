@@ -56,12 +56,17 @@ from deeplearning4j_tpu.observability.tracing import (
     TRACEPARENT_HEADER, global_trace_store, parse_traceparent, trace_span,
 )
 
-from .admission import RejectedError
+from .admission import RejectedError, normalize_priority
+from .autoscaler import Autoscaler
 from .batcher import MicroBatcher
 from .decode import DecodeEngine
 from .registry import ModelRegistry, global_model_registry
 from .replica import ReplicaSet
 from .streaming import StreamSessions
+
+#: request tags for priority-aware shedding under saturation
+PRIORITY_HEADER = "X-DL4J-Priority"
+TENANT_HEADER = "X-DL4J-Tenant"
 
 
 class _ServeHandler(BaseHTTPRequestHandler):
@@ -203,7 +208,12 @@ class _ServeHandler(BaseHTTPRequestHandler):
         if x.ndim == 1:
             x = x[None, :]
         self.engine.registry.active(model)  # 404 before queueing
-        fut = self.engine.submit_predict(model, x)
+        # priority/tenant headers feed saturation shedding (admission.py);
+        # untagged requests default to the full budget ("high")
+        priority = normalize_priority(self.headers.get(PRIORITY_HEADER))
+        tenant = str(self.headers.get(TENANT_HEADER) or "-")
+        fut = self.engine.submit_predict(model, x, priority=priority,
+                                         tenant=tenant)
         try:
             res = fut.result(timeout=self.engine.request_timeout_s)
         except (_FutureTimeout, TimeoutError):
@@ -313,18 +323,29 @@ class InferenceServer:
                  replicas: int = 1, sharding: Optional[str] = None,
                  replica_devices=None,
                  replica_mesh_axes: Optional[dict] = None,
-                 warmup: bool = False):
+                 warmup: bool = False, autoscale: bool = False,
+                 min_replicas: Optional[int] = None,
+                 max_replicas: Optional[int] = None,
+                 autoscale_cooldown_s: float = 30.0,
+                 autoscale_interval_s: float = 2.0):
         self.replica_set: Optional[ReplicaSet] = None
-        if replicas > 1 or sharding is not None:
+        self.autoscaler = None
+        self._membership = None
+        if replicas > 1 or sharding is not None or autoscale:
             if registry is not None:
                 raise ValueError(
                     "replica mode owns its per-replica registries; pass "
                     "registry=None and register through server.register()")
+            if autoscale:
+                # serving replicas are fenced members exactly like elastic
+                # training workers: lease lapse = out of the router
+                from deeplearning4j_tpu.cloud import MembershipOracle
+                self._membership = MembershipOracle(role="replica")
             self.replica_set = ReplicaSet(
                 replicas, sharding=sharding, devices=replica_devices,
                 mesh_axes=replica_mesh_axes, max_batch=max_batch,
                 max_latency_s=max_latency_s, max_queue=max_queue,
-                warmup=warmup)
+                warmup=warmup, membership=self._membership)
             # replica 0's registry is the front door's catalog (404 check,
             # streaming, decode) — every roll keeps all replicas in sync
             self.registry = self.replica_set.primary_registry
@@ -356,6 +377,13 @@ class InferenceServer:
         #: /serve/slo evaluates on demand, start() spins the ticker so
         #: burn alerts fire (and dump flight-recorder bundles) unscraped
         self.slo = SLOEngine()
+        if autoscale:
+            self.autoscaler = Autoscaler(
+                self.replica_set, slo_engine=self.slo,
+                min_replicas=min_replicas or 1,
+                max_replicas=max_replicas or max(replicas, 8),
+                cooldown_s=autoscale_cooldown_s,
+                interval_s=autoscale_interval_s)
         handler = type("BoundServeHandler", (_ServeHandler,),
                        {"engine": self})
         self._httpd = ThreadingHTTPServer((host, port), handler)
@@ -371,6 +399,8 @@ class InferenceServer:
             target=self._httpd.serve_forever, name="serve-http", daemon=True)
         self._thread.start()
         self.slo.start()
+        if self.autoscaler is not None:
+            self.autoscaler.start()
         _set_active_server(self)
         return self
 
@@ -384,12 +414,16 @@ class InferenceServer:
         return self.registry.register(name, net, version=version,
                                       quant=quant)
 
-    def submit_predict(self, model: str, x):
+    def submit_predict(self, model: str, x, *, priority: str = "high",
+                       tenant: str = "-"):
         """The handler's dispatch seam: least-queue-depth routing across
-        the ReplicaSet, or the single micro-batcher."""
+        the ReplicaSet, or the single micro-batcher. ``priority``/
+        ``tenant`` flow to admission for saturation shedding."""
         if self.replica_set is not None:
-            return self.replica_set.submit(model, x)
-        return self.batcher.submit(model, x)
+            return self.replica_set.submit(model, x, priority=priority,
+                                           tenant=tenant)
+        return self.batcher.submit(model, x, priority=priority,
+                                   tenant=tenant)
 
     def decoder(self, model: str) -> DecodeEngine:
         """The continuous-batching decode engine for ``model``'s active
@@ -423,6 +457,8 @@ class InferenceServer:
             return eng
 
     def stop(self) -> None:
+        if self.autoscaler is not None:
+            self.autoscaler.stop()
         self.slo.stop()
         self._httpd.shutdown()
         self._httpd.server_close()
@@ -455,6 +491,8 @@ class InferenceServer:
         }
         if self.replica_set is not None:
             st["replicas"] = self.replica_set.stats()
+        if self.autoscaler is not None:
+            st["autoscaler"] = self.autoscaler.status()
         return st
 
 

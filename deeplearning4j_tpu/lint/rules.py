@@ -1169,6 +1169,47 @@ class OrphanSpan(Rule):
         return None
 
 
+# ---------------------------------------------------------------------------
+@register
+class ReplicaLifecycle(Rule):
+    """Replica lifecycle mutations only through the ReplicaSet public API.
+
+    The autoscaling fleet's invariants — a replica is routable only after
+    its warmup completes, removal drains without loss, indices are never
+    reused, the fleet gauge and scale-event counters stay truthful, leases
+    are registered/deregistered in step — all live inside
+    ``ReplicaSet.add_replica()`` / ``remove_replica()`` /
+    ``register()``. Direct surgery on ``ReplicaSet._replicas`` from
+    anywhere else (an append, a ``del``, even a read that is then
+    mutated) silently bypasses every one of them: the router can see a
+    cold replica, a drain can be skipped, a zombie's lease outlives its
+    process. Any ``._replicas`` attribute access outside ``replica.py``
+    is flagged — readers have the ``replicas`` property and
+    ``n_replicas``; mutators have the lifecycle API.
+    """
+
+    name = "replica-lifecycle"
+    description = ("direct ReplicaSet._replicas access outside "
+                   "keras_server/replica.py — use the replicas property "
+                   "to read and add_replica()/remove_replica() to mutate")
+    exclude = ("*/keras_server/replica.py",)
+
+    def check(self, ctx: FileContext) -> Iterator[Violation]:
+        tree = ctx.tree
+        if tree is None:
+            return
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Attribute) \
+                    and node.attr == "_replicas":
+                yield self.violation(
+                    ctx, node.lineno,
+                    "ReplicaSet._replicas touched outside replica.py — "
+                    "read via the replicas property, mutate via "
+                    "add_replica()/remove_replica() so warmup-before-"
+                    "routable, drain-without-loss and lease accounting "
+                    "hold")
+
+
 def default_rules() -> List[Rule]:
     """Fresh instances of every registered rule, in registration order."""
     return [cls() for cls in REGISTRY.values()]

@@ -90,6 +90,12 @@ SERVE_REPLICA_OCCUPANCY = "dl4j_serve_replica_occupancy"
 SERVE_REPLICA_ACTIVE_VERSION = "dl4j_serve_replica_active_version"
 SERVE_REPLICA_ROUTED_TOTAL = "dl4j_serve_replica_routed_total"
 
+# --- autoscaling serving fleet (keras_server/{autoscaler,replica,admission}
+# .py) -----------------------------------------------------------------------
+SERVE_FLEET_SIZE = "dl4j_serve_fleet_size"
+SERVE_SCALE_EVENTS_TOTAL = "dl4j_serve_scale_events_total"
+SERVE_SHED_TOTAL = "dl4j_serve_shed_total"
+
 # --- continuous-batching decode engine (keras_server/{decode,streaming}.py) -
 SERVE_SLOT_OCCUPANCY = "dl4j_serve_slot_occupancy"
 SERVE_TTFT_SECONDS = "dl4j_serve_ttft_seconds"

@@ -55,6 +55,12 @@
 #                                 # reuse, warmup-before-swap ordering,
 #                                 # kill switch, bench compile-cache-axis
 #                                 # contract
+#   ./runtests.sh autoscale [args]  # SLO-driven autoscaling fleet:
+#                                 # add/remove replica atomicity, scale-in
+#                                 # drain zero-loss, zombie lease fencing,
+#                                 # hysteresis (≤1 event per cooldown),
+#                                 # priority shedding order, warm scale-up
+#                                 # no-fresh-compile pin, bench axis contract
 #   ./runtests.sh trace [args]    # request tracing + SLO engine: traceparent
 #                                 # propagation through HTTP/batcher/decode/
 #                                 # replica, tail sampling (429 always kept),
@@ -172,6 +178,15 @@ if [ "${1-}" = "compile" ]; then
   XLA_FLAGS="--xla_force_host_platform_device_count=8" \
   exec python -m pytest tests/test_compile_cache.py \
     tests/test_bench_contract.py::test_config_key_compile_cache_axes -q "$@"
+fi
+
+if [ "${1-}" = "autoscale" ]; then
+  shift
+  PALLAS_AXON_POOL_IPS= \
+  JAX_PLATFORMS=cpu \
+  XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+  exec python -m pytest tests/test_autoscale.py \
+    tests/test_bench_contract.py::test_config_key_serve_autoscale_axis -q "$@"
 fi
 
 if [ "${1-}" = "trace" ]; then

@@ -111,6 +111,13 @@ run --model serve --compile-cache off
 # own config so a paged/spec capture can never stand in for the dense
 # baseline after an outage
 run --model serve --decode-kv dense --decode-spec-draft none
+# autoscaling fleet row (ISSUE 18): the open-loop ramp A/B — SLO-driven
+# autoscaled fleet vs a static fleet at the same time-weighted average
+# replica count under a 10x offered-load swing; the row carries the
+# acceptance floor (ramp_slo_violation_seconds_auto < _static), the
+# zero-loss scale-in count and the warm-path scale-out latency. Its own
+# config: the default (off) row never stands in for the ramp capture
+run --model serve --serve-autoscale on
 if [ "$MODE" = full ]; then
     run --model lenet
     run --model lenet --bf16-act

@@ -718,3 +718,28 @@ def test_config_key_serve_tracing_axis():
     assert old["serve_tracing"] is None and new["serve_tracing"] == "on"
     assert old != bench._config_key("--model serve")
     assert gate.endswith("Z") and gate > bench._PAGED_DECODE_AXIS_LANDED_TS
+
+def test_config_key_serve_autoscale_axis():
+    """--serve-autoscale (ISSUE 18) is a config-distinct serve axis: the
+    static default row must never stand in for the open-loop ramp A/B
+    capture (whose headline carries ramp_slo_violation_seconds_auto/
+    static, the zero-loss count and the warm scale-out latency); other
+    models don't grow the axis; and the ts-gate strips it from rows that
+    predate the autoscaling fleet."""
+    import bench
+
+    a = bench._config_key("--model serve")
+    b = bench._config_key("--model serve --serve-autoscale on")
+    assert a != b and a["serve_autoscale"] == "off" \
+        and b["serve_autoscale"] == "on"
+    # no phantom axis on models without a serve section
+    for model in ("resnet50", "ps_async", "char_rnn"):
+        assert bench._config_key(
+            f"--model {model}")["serve_autoscale"] is None
+    # rows logged before the plane landed cannot carry the axis
+    gate = bench._SERVE_AUTOSCALE_AXIS_LANDED_TS
+    old = bench._config_key("--model serve", ts="2026-08-07T15:59:59Z")
+    new = bench._config_key("--model serve", ts="2026-08-07T16:00:01Z")
+    assert old["serve_autoscale"] is None and new["serve_autoscale"] == "off"
+    assert old != bench._config_key("--model serve")
+    assert gate.endswith("Z") and gate > bench._SERVE_TRACING_AXIS_LANDED_TS
