@@ -185,6 +185,7 @@ class MicroBatcher:
         return req.future
 
     # ------------------------------------------------------------ dispatcher
+    #: requires-lock: _cond
     def _take_group(self) -> Optional[List[_Request]]:
         """Under the lock: wait for work, honor the fill-or-deadline policy,
         then cut one shape-compatible group from the queue."""

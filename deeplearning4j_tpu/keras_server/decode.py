@@ -682,6 +682,7 @@ class DecodeEngine:
                 blocks.append({})
         return blocks
 
+    #: requires-lock: _cond
     def _grow_to(self, cap: int) -> None:
         """Move to a larger capacity bucket. Dense blocks move with ONE
         device-side scatter per leaf (``.at[:old].set`` — never a host
@@ -772,6 +773,7 @@ class DecodeEngine:
     def _active_count(self) -> int:
         return sum(1 for s in self._slots if s is not None)
 
+    #: requires-lock: _cond
     def _admit_locked(self) -> None:
         """Under the lock: move queued sessions into free slots.
 
@@ -826,6 +828,7 @@ class DecodeEngine:
             self._g_share.set(self._shared_tokens / self._prompt_tokens)
         self._peak_active = max(self._peak_active, active)
 
+    #: requires-lock: _cond
     def _release_pages_locked(self, i: int) -> None:
         row = self._table_h[i]
         for pid in {int(x) for x in row.tolist()} - {TRASH_PAGE}:
@@ -838,6 +841,7 @@ class DecodeEngine:
         never parents under the no-op singleton's empty trace id."""
         return sess._span if sess._span is not NOOP_SPAN else None
 
+    #: requires-lock: _cond
     def _trace_evict_locked(self, sess, reason: str) -> None:
         """Close the session's open spans at eviction: preemption emits an
         instant ``decode.preempt`` span so the victim's trace names why it
@@ -862,6 +866,7 @@ class DecodeEngine:
             sess._span_phase = None
         sess._span.finish()  # idempotent; covers never-admitted paths
 
+    #: requires-lock: _cond
     def _evict_locked(self, i: int, reason: str) -> None:
         sess = self._slots[i]
         self._slots[i] = None
@@ -875,6 +880,7 @@ class DecodeEngine:
         sess.done.set()
 
     # -------------------------------------------------------- page planning
+    #: requires-lock: _cond
     def _map_window_locked(self, i: int, window: int) -> bool:
         """Ensure slot ``i`` owns pages for its next ``window`` write
         positions: allocate unmapped pages, copy-on-write-fork shared
@@ -913,6 +919,7 @@ class DecodeEngine:
                 self._table_h[i, k] = npid
         return True
 
+    #: requires-lock: _cond
     def _plan_pages_locked(self, window: int) -> None:
         """Map every active slot's write window; on total exhaustion (no
         slot can move) preempt the YOUNGEST tenant so the rest make
@@ -955,6 +962,7 @@ class DecodeEngine:
                 sess._span_park = None
         self._g_pages.set(self._pool.pages_in_use)
 
+    #: requires-lock: _cond
     def _register_prefix_locked(self, i: int, sess, lo: int,
                                 hi: int) -> None:
         """Publish the prompt pages slot ``i`` finished writing in
@@ -1160,6 +1168,7 @@ class DecodeEngine:
                     dins[i].append(tok)
                 dpos = dcur.copy()
                 dpos[parked] = self.max_context
+                # lint: lockguard-ok (KV blocks are pump-thread-confined: only the single pump thread touches them; _grow_to's locked writes run on that same thread)
                 dout, _, self._draft_blocks = self._draft_step(
                     self._draft_params, self._draft_states,
                     self._draft_blocks, jnp.asarray(dtok), zeros_b,
@@ -1192,6 +1201,7 @@ class DecodeEngine:
                 trusted[i] = row
             vpos = base_pos.copy()
             vpos[parked] = self.max_context
+            # lint: lockguard-ok (KV blocks are pump-thread-confined: only the single pump thread touches them; _grow_to's locked writes run on that same thread)
             outs, vprobs, self._blocks = self._verify_step(
                 self._params, self._states, self._blocks,
                 jnp.asarray(vtok), fresh, jnp.asarray(vpos), *paged_args)

@@ -145,6 +145,7 @@ class ReplicaSet:
         #: monotonic index allocator — indices are never reused, so metric
         #: series and program names stay unambiguous across churn
         self._next_index = n_replicas
+        #: guarded-by: _lock
         self._replicas = [
             Replica(i, max_batch=max_batch, max_latency_s=max_latency_s,
                     max_queue=max_queue, metrics=m, warmup=warmup,
@@ -200,7 +201,10 @@ class ReplicaSet:
     # ------------------------------------------------------------ registry
     @property
     def n_replicas(self) -> int:
-        return len(self._replicas)
+        # remove_replica() rebinds the list under _lock; an unlocked len()
+        # here could see the pre-swap list arbitrarily late
+        with self._lock:
+            return len(self._replicas)
 
     @property
     def replicas(self) -> List[Replica]:
@@ -213,7 +217,8 @@ class ReplicaSet:
         streaming, decode) reads this; all replicas hold the same
         (name, version) catalog after every ``register()``. The primary
         replica is pinned: ``remove_replica`` never takes it."""
-        return self._replicas[0].registry
+        with self._lock:
+            return self._replicas[0].registry
 
     def _wait_drained(self, replica: Replica) -> bool:
         deadline = time.monotonic() + self.drain_timeout_s
@@ -252,6 +257,7 @@ class ReplicaSet:
                 r.draining = drain
                 try:
                     if drain:
+                        # lint: blocking-under-lock-ok (drain-before-swap holds the cold _mutate_lock by design; the router path (submit) only ever takes _lock)
                         self._wait_drained(r)
                     mv = self._register_on(r, name, net, version, source,
                                            quant)
@@ -263,10 +269,12 @@ class ReplicaSet:
                 self._catalog[name] = (version, net, source, quant)
             return first
 
+    #: requires-lock: _mutate_lock
     def _register_on(self, r: Replica, name: str, net, version: str,
                      source: str, quant: Optional[str]) -> ModelVersion:
         """Pin one (model, version) on one replica and flip its
-        active-version gauge series."""
+        active-version gauge series (register()/add_replica() call this
+        inside the mutation critical section)."""
         mv = r.registry.register(
             name, net, version=version, source=source, quant=quant,
             sharding=r.sharding, mesh=r.mesh, device=r.device,
@@ -347,12 +355,14 @@ class ReplicaSet:
                             "cannot remove the primary replica (its "
                             "registry is the front door)")
             r.draining = True
+            # lint: blocking-under-lock-ok (scale-in drain holds the cold _mutate_lock by design; the router path (submit) only ever takes _lock)
             self._wait_drained(r)
             with self._lock:
                 self._replicas = [o for o in self._replicas if o is not r]
                 self._g_fleet.set(len(self._replicas))
             # close() drains anything that slipped in before the unlink —
             # admitted work still completes, new work can no longer arrive
+            # lint: blocking-under-lock-ok (dispatcher join during scale-in holds the cold _mutate_lock; mutations serialize, the router never waits on it)
             r.batcher.close(self.drain_timeout_s)
             if self._membership is not None and r.lease is not None:
                 self._membership.deregister(

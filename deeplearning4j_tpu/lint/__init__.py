@@ -23,19 +23,41 @@ from typing import Iterable, Optional, Sequence
 from .engine import (BAD_SUPPRESSION, FileContext, LintResult, Rule,
                      Suppression, Violation, run)
 from .rules import REGISTRY, default_rules, rule_names
+from . import concurrency as _concurrency  # noqa: F401 - registers rules
 
 __all__ = [
     "BAD_SUPPRESSION", "FileContext", "LintResult", "Rule", "Suppression",
-    "Violation", "REGISTRY", "default_rules", "rule_names", "run",
-    "run_paths",
+    "Violation", "REGISTRY", "default_rules", "rule_names", "rule_version",
+    "rule_versions", "run", "run_paths",
 ]
 
 
-def run_paths(paths: Sequence, rule_subset: Optional[Iterable[str]] = None
-              ) -> LintResult:
+def rule_version(name: str) -> str:
+    """Short content hash of a rule's implementation source.
+
+    Baseline suppressions record the version of the rule they silence;
+    when a rule's code changes, its hash changes, and the gate forces a
+    re-review of every suppression keyed to the old version — editing a
+    rule must not leave stale suppressions silently trusted."""
+    import hashlib
+    import inspect
+
+    src = inspect.getsource(REGISTRY[name])
+    return hashlib.sha1(src.encode()).hexdigest()[:12]
+
+
+def rule_versions() -> dict:
+    """{rule name -> implementation hash} for the whole registry."""
+    return {name: rule_version(name) for name in rule_names()}
+
+
+def run_paths(paths: Sequence, rule_subset: Optional[Iterable[str]] = None,
+              jobs: int = 1) -> LintResult:
     """Lint ``paths`` (files or package dirs) with the full registry, or
     with ``rule_subset`` names. Unknown names in the subset raise — a gate
-    script must not silently run fewer checks than it was asked for."""
+    script must not silently run fewer checks than it was asked for.
+    ``jobs`` > 1 fans the per-file check phase across worker processes
+    (deterministic output at any N; see ``engine.run``)."""
     if rule_subset is None:
         rules = default_rules()
     else:
@@ -45,4 +67,4 @@ def run_paths(paths: Sequence, rule_subset: Optional[Iterable[str]] = None
                 f"unknown rule(s) {unknown}; known: {rule_names()}")
         rules = [REGISTRY[n]() for n in rule_subset]
     return run([pathlib.Path(p) for p in paths], rules,
-               known_rule_names=rule_names())
+               known_rule_names=rule_names(), jobs=jobs)

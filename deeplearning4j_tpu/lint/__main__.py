@@ -9,11 +9,12 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import pathlib
 import sys
 from typing import List, Optional
 
-from . import REGISTRY, rule_names, run_paths
+from . import REGISTRY, rule_names, rule_versions, run_paths
 
 
 def _build_parser() -> argparse.ArgumentParser:
@@ -33,6 +34,11 @@ def _build_parser() -> argparse.ArgumentParser:
     p.add_argument("--show-suppressed", action="store_true",
                    help="also print suppressed findings (human mode; JSON "
                         "always includes them)")
+    p.add_argument("--jobs", type=int, default=os.cpu_count() or 1,
+                   metavar="N",
+                   help="worker processes for the per-file check phase "
+                        "(default: all cores; output is deterministic "
+                        "at any N; 1 disables forking)")
     return p
 
 
@@ -49,13 +55,17 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.rules:
         subset = [r.strip() for r in args.rules.split(",") if r.strip()]
     try:
-        result = run_paths(paths, subset)
+        result = run_paths(paths, subset, jobs=max(1, args.jobs))
     except ValueError as e:
         print(str(e), file=sys.stderr)
         return 2
 
     if args.json:
-        print(json.dumps(result.to_json(), indent=2, sort_keys=True))
+        payload = result.to_json()
+        # implementation hash per rule: the baseline records these so a
+        # rule edit invalidates its old suppressions (see lint_gate.sh)
+        payload["rule_versions"] = rule_versions()
+        print(json.dumps(payload, indent=2, sort_keys=True))
     else:
         for err in result.errors:
             print(f"ERROR {err}")

@@ -1,0 +1,1062 @@
+"""graftlint concurrency plane: lockset inference, lock-order cycles,
+blocking-under-lock, and thread lifecycle.
+
+Four whole-scan rules over the package's threading discipline, in the
+spirit of Eraser's lockset algorithm (Savage et al., 1997) and RacerX's
+static lock-order pass (Engler & Ashcraft, 2003), scaled down to the
+idioms this codebase actually uses: ``threading.Lock/RLock/Condition``
+attributes created in ``__init__``, ``with self._lock:`` critical
+sections, and worker threads started from class methods.
+
+The rules share one package model built in ``prepare()`` (the engine's
+cross-file hook): per-class locksets, a resolved intra-package call
+graph, and the static lock-acquisition graph. Call resolution is
+deliberately modest — ``self.m()`` within a class, bare names within a
+module, and one level of attribute typing from ``self.x = ClassName()``
+constructor assignments — because every resolved edge must be right:
+precision beats recall, a concurrency lint that cries wolf gets
+suppressed wholesale.
+
+Annotation grammar (sphinx-style ``#:`` comments, so they double as
+attribute docs):
+
+``#: guarded-by: _lock`` — trailing on the ``self.attr = ...`` line in
+``__init__`` (or standalone on the line above). Declares the guard;
+bare writes AND bare reads of the attribute are then flagged, not just
+writes that contradict an observed locked write.
+
+``#: requires-lock: _lock`` — standalone on the line above a ``def``
+(or trailing on the def line). Declares a lock the CALLER must hold;
+the body is analysed as if the lock were held. This is how helper
+methods like "take from the queue, caller holds the condition" state
+their contract instead of tripping the lockset inference.
+
+Static only, like every graftlint rule: nothing here imports the code
+under analysis. The runtime counterpart (``lint/witness.py``) is the
+dynamic cross-check: a patched Lock wrapper that records the actual
+acquisition-order graph under the threaded suites and asserts it is
+acyclic, so a disputed static cycle gets a reasoned suppression backed
+by witness evidence.
+"""
+from __future__ import annotations
+
+import ast
+import re
+import token
+from typing import Dict, FrozenSet, Iterator, List, Optional, Sequence, Set, Tuple
+
+from .engine import FileContext, Rule, Violation, dotted_name
+from .rules import register
+
+#: one annotation: kind + comma-separated lock attribute names
+_ANNOT = re.compile(
+    r"#:\s*(?P<kind>guarded-by|requires-lock):\s*"
+    r"(?P<names>[A-Za-z_]\w*(?:\s*,\s*[A-Za-z_]\w*)*)")
+
+_LOCK_CTORS = {"Lock": "lock", "RLock": "rlock", "Condition": "condition"}
+
+#: method calls that mutate their receiver in place (lockset inference
+#: treats ``self.x.append(...)`` as a write of ``x``)
+_MUTATORS = frozenset({
+    "append", "appendleft", "extend", "insert", "add", "update",
+    "setdefault", "pop", "popitem", "popleft", "remove", "discard",
+    "clear", "sort", "reverse",
+})
+
+#: dotted callables that block the calling thread outright
+_BLOCK_DOTTED = {
+    "time.sleep": "time.sleep()",
+    "subprocess.run": "subprocess.run()",
+    "subprocess.call": "subprocess.call()",
+    "subprocess.check_call": "subprocess.check_call()",
+    "subprocess.check_output": "subprocess.check_output()",
+    "urllib.request.urlopen": "urlopen() network I/O",
+    "socket.create_connection": "socket connect",
+}
+
+#: attribute-call names that are socket/network waits regardless of the
+#: receiver (the names are specific enough not to collide in this tree)
+_BLOCK_SOCKET = frozenset({"accept", "recv", "recv_into", "sendall",
+                           "connect"})
+
+#: compile seams: resolving one of these under a lock serializes every
+#: other thread behind an XLA compile
+_BLOCK_COMPILE = frozenset({"compile_step", "build_program"})
+
+
+def _ctor_kind(value: ast.AST) -> Optional[str]:
+    """'lock' / 'rlock' / 'condition' when ``value`` constructs one."""
+    if not isinstance(value, ast.Call):
+        return None
+    d = dotted_name(value.func)
+    if not d:
+        return None
+    last = d.rsplit(".", 1)[-1]
+    if last in _LOCK_CTORS and d in (last, "threading." + last):
+        return _LOCK_CTORS[last]
+    return None
+
+
+def _is_ctor(value: ast.AST, name: str) -> bool:
+    if not isinstance(value, ast.Call):
+        return False
+    d = dotted_name(value.func)
+    return d in (name, "threading." + name)
+
+
+def _annotations(ctx: FileContext) -> Dict[int, List[Tuple[str, List[str]]]]:
+    """line -> [(kind, names)]; standalone ``#:`` comments apply to the
+    next code line (same scoping as suppressions)."""
+    out: Dict[int, List[Tuple[str, List[str]]]] = {}
+    try:
+        toks = ctx.tokens
+    except (SyntaxError, IndentationError):
+        return out
+    for i, t in enumerate(toks):
+        if t.type != token.COMMENT:
+            continue
+        m = _ANNOT.search(t.string)
+        if m is None:
+            continue
+        applies = t.start[0]
+        if t.line.lstrip().startswith("#"):
+            nxt = next((n for n in toks[i + 1:]
+                        if n.type not in (token.NL, token.NEWLINE,
+                                          token.COMMENT, token.INDENT,
+                                          token.DEDENT)), None)
+            if nxt is not None:
+                applies = nxt.start[0]
+        names = [s.strip() for s in m.group("names").split(",")]
+        out.setdefault(applies, []).append((m.group("kind"), names))
+    return out
+
+
+class _ClassModel:
+    __slots__ = ("name", "rel", "modname", "locks", "alias", "guarded",
+                 "requires", "methods", "attr_ctors", "thread_attrs",
+                 "event_attrs", "thread_targets")
+
+    def __init__(self, name: str, rel: str, modname: str):
+        self.name = name
+        self.rel = rel
+        self.modname = modname
+        self.locks: Dict[str, str] = {}        # attr -> lock kind
+        self.alias: Dict[str, str] = {}        # condition attr -> wrapped attr
+        self.guarded: Dict[str, str] = {}      # attr -> declared lock attr
+        self.requires: Dict[str, Tuple[str, ...]] = {}
+        self.methods: Dict[str, ast.AST] = {}
+        self.attr_ctors: Dict[str, str] = {}   # attr -> ctor class name
+        self.thread_attrs: Set[str] = set()
+        self.event_attrs: Set[str] = set()
+        self.thread_targets: Set[str] = set()
+
+    def node_for(self, attr: str, module: "_ModuleModel") -> str:
+        """Canonical graph node for a lock attribute. A Condition built
+        over an explicit lock IS that lock — holding either is holding
+        both — so both names collapse to the wrapped attribute."""
+        a = self.alias.get(attr, attr)
+        if a not in self.locks and attr not in self.locks \
+                and a in module.locks:
+            return f"{module.modname}.{a}"
+        return f"{self.modname}.{self.name}.{a}"
+
+    def reentrant(self, attr: str) -> bool:
+        a = self.alias.get(attr, attr)
+        kind = self.locks.get(a)
+        if kind == "condition":
+            # Condition() with no explicit lock wraps a fresh RLock
+            return True
+        return kind == "rlock"
+
+
+class _ModuleModel:
+    __slots__ = ("rel", "modname", "classes", "locks", "functions")
+
+    def __init__(self, rel: str):
+        self.rel = rel
+        self.modname = rel[:-3].replace("/", ".") if rel.endswith(".py") \
+            else rel.replace("/", ".")
+        self.classes: Dict[str, _ClassModel] = {}
+        self.locks: Dict[str, str] = {}        # module-global locks
+        self.functions: Dict[str, ast.AST] = {}
+
+
+class _FnFacts:
+    """Everything the rules need about one function: events with the
+    statically-held lockset at each, plus resolution inputs."""
+
+    __slots__ = ("key", "rel", "module", "cls", "fname", "events",
+                 "local_ctors", "local_threads")
+
+    def __init__(self, key, rel, module, cls, fname):
+        self.key = key
+        self.rel = rel
+        self.module = module
+        self.cls = cls
+        self.fname = fname
+        #: ("acq", node, line, held, is_self_attr)
+        #: ("call", dotted, line, held)
+        #: ("block", desc, line, held)
+        #: ("write", attr, line, held) / ("read", attr, line, held)
+        self.events: List[tuple] = []
+        self.local_ctors: Dict[str, str] = {}
+        self.local_threads: Set[str] = set()
+
+
+def _iter_expr(node: ast.AST) -> Iterator[ast.AST]:
+    """ast.walk over an expression, skipping Lambda bodies (they run at
+    some later time, under an unknowable lockset)."""
+    stack = [node]
+    while stack:
+        n = stack.pop()
+        yield n
+        for child in ast.iter_child_nodes(n):
+            if isinstance(child, ast.Lambda):
+                continue
+            stack.append(child)
+
+
+class _Walker:
+    """One pass over a function body tracking the statically-held
+    lockset: ``with self._lock:`` nesting plus statement-level
+    ``.acquire()``/``.release()`` pairs, seeded from any
+    ``#: requires-lock:`` contract."""
+
+    def __init__(self, model: "_PackageModel", mm: _ModuleModel,
+                 cm: Optional[_ClassModel], fname: str, fn: ast.AST):
+        self.model = model
+        self.mm = mm
+        self.cm = cm
+        key = (mm.rel, cm.name if cm else None, fname)
+        self.facts = _FnFacts(key, mm.rel, mm, cm, fname)
+        for sub in ast.walk(fn):
+            if isinstance(sub, ast.Assign) and len(sub.targets) == 1 \
+                    and isinstance(sub.targets[0], ast.Name) \
+                    and isinstance(sub.value, ast.Call):
+                d = dotted_name(sub.value.func)
+                if not d:
+                    continue
+                name = sub.targets[0].id
+                if d in ("Thread", "threading.Thread"):
+                    self.facts.local_threads.add(name)
+                else:
+                    self.facts.local_ctors[name] = d.rsplit(".", 1)[-1]
+        held0: Set[str] = set()
+        if cm is not None:
+            for a in cm.requires.get(fname, ()):
+                held0.add(cm.node_for(a, mm))
+        self._stmts(fn.body, frozenset(held0))
+
+    # ------------------------------------------------------------ plumbing
+    def _lock_node(self, expr: ast.AST) -> Tuple[Optional[str], bool]:
+        """(graph node, is-self-attribute) for a lock expression."""
+        d = dotted_name(expr)
+        if d is None:
+            return None, False
+        parts = d.split(".")
+        if parts[0] == "self" and len(parts) == 2 and self.cm is not None \
+                and self.cm.alias.get(parts[1], parts[1]) in self.cm.locks:
+            return self.cm.node_for(parts[1], self.mm), True
+        if len(parts) == 1 and d in self.mm.locks:
+            return f"{self.mm.modname}.{d}", False
+        return None, False
+
+    def _ev(self, *tup) -> None:
+        self.facts.events.append(tup)
+
+    # ------------------------------------------------------------ statements
+    def _stmts(self, body: Sequence[ast.stmt], held: FrozenSet[str]) -> None:
+        extra: List[str] = []
+        for st in body:
+            self._stmt(st, held | frozenset(extra), extra)
+
+    def _stmt(self, st: ast.stmt, held: FrozenSet[str],
+              extra: List[str]) -> None:
+        if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # nested def: runs later (often as a thread target) — analyse
+            # the body with an empty lockset, attributed to this method
+            self._stmts(st.body, frozenset())
+            return
+        if isinstance(st, ast.ClassDef):
+            return
+        if isinstance(st, (ast.With, ast.AsyncWith)):
+            acquired: List[str] = []
+            for item in st.items:
+                node, is_self = self._lock_node(item.context_expr)
+                h = held | frozenset(acquired)
+                if node is not None:
+                    self._ev("acq", node, item.context_expr.lineno, h,
+                             is_self)
+                    acquired.append(node)
+                else:
+                    self._expr(item.context_expr, h)
+            self._stmts(st.body, held | frozenset(acquired))
+            return
+        if isinstance(st, ast.Expr) and isinstance(st.value, ast.Call):
+            call = st.value
+            f = call.func
+            if isinstance(f, ast.Attribute) and f.attr in ("acquire",
+                                                           "release"):
+                node, is_self = self._lock_node(f.value)
+                if node is not None:
+                    if f.attr == "acquire":
+                        self._ev("acq", node, st.lineno, held, is_self)
+                        extra.append(node)
+                    elif node in extra:
+                        extra.remove(node)
+                    return
+            self._expr(call, held)
+            return
+        if isinstance(st, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = st.targets if isinstance(st, ast.Assign) \
+                else [st.target]
+            for t in targets:
+                self._write_target(t, st.lineno, held)
+            if getattr(st, "value", None) is not None:
+                self._expr(st.value, held)
+            return
+        if isinstance(st, ast.Delete):
+            for t in st.targets:
+                self._write_target(t, st.lineno, held)
+            return
+        for _field, val in ast.iter_fields(st):
+            if isinstance(val, list):
+                if val and isinstance(val[0], ast.stmt):
+                    self._stmts(val, held)
+                else:
+                    for v in val:
+                        if isinstance(v, ast.expr):
+                            self._expr(v, held)
+                        elif hasattr(v, "body") and \
+                                isinstance(getattr(v, "body"), list):
+                            # excepthandler / match_case arms
+                            self._stmts(v.body, held)
+            elif isinstance(val, ast.expr):
+                self._expr(val, held)
+            elif isinstance(val, ast.stmt):
+                self._stmt(val, held, extra)
+
+    def _write_target(self, t: ast.AST, line: int,
+                      held: FrozenSet[str]) -> None:
+        if isinstance(t, (ast.Tuple, ast.List)):
+            for e in t.elts:
+                self._write_target(e, line, held)
+            return
+        base = t
+        while isinstance(base, ast.Subscript):
+            if isinstance(base.slice, ast.expr):
+                self._expr(base.slice, held)
+            base = base.value
+        d = dotted_name(base)
+        if d and d.startswith("self.") and self.cm is not None:
+            self._ev("write", d.split(".")[1], line, held)
+
+    # ------------------------------------------------------------ expressions
+    def _expr(self, node: ast.AST, held: FrozenSet[str]) -> None:
+        for n in _iter_expr(node):
+            if isinstance(n, ast.Call):
+                self._call(n, held)
+            elif isinstance(n, ast.Attribute) and isinstance(n.ctx, ast.Load)\
+                    and isinstance(n.value, ast.Name) \
+                    and n.value.id == "self" and self.cm is not None:
+                self._ev("read", n.attr, n.lineno, held)
+
+    def _call(self, call: ast.Call, held: FrozenSet[str]) -> None:
+        d = dotted_name(call.func)
+        if isinstance(call.func, ast.Attribute):
+            m = call.func.attr
+            recv = dotted_name(call.func.value)
+            if m in _MUTATORS and recv and recv.startswith("self.") \
+                    and self.cm is not None:
+                self._ev("write", recv.split(".")[1], call.lineno, held)
+        desc = self._blocking_desc(call, held)
+        if desc is not None:
+            self._ev("block", desc, call.lineno, held)
+        if d is not None:
+            self._ev("call", d, call.lineno, held)
+
+    def _blocking_desc(self, call: ast.Call,
+                       held: FrozenSet[str]) -> Optional[str]:
+        d = dotted_name(call.func)
+        if d in _BLOCK_DOTTED:
+            return _BLOCK_DOTTED[d]
+        if d in _BLOCK_COMPILE:
+            return f"compile seam {d}()"
+        if not isinstance(call.func, ast.Attribute):
+            return None
+        m = call.func.attr
+        recv = dotted_name(call.func.value)
+        if m == "block_until_ready":
+            return "device sync (.block_until_ready())"
+        if m == "item" and not call.args and not call.keywords:
+            return "device sync (.item())"
+        if m in _BLOCK_COMPILE:
+            return f"compile seam .{m}()"
+        if m in _BLOCK_SOCKET:
+            return f"socket .{m}() I/O"
+        if m == "result":
+            return "future .result() wait"
+        if m == "join":
+            attr = None
+            if recv and recv.startswith("self.") and len(recv.split(".")) == 2:
+                attr = recv.split(".")[1]
+            if (attr and self.cm is not None
+                    and attr in self.cm.thread_attrs) \
+                    or (recv in self.facts.local_threads):
+                return "thread .join() wait"
+            return None
+        if m == "wait":
+            if recv and recv.startswith("self.") and self.cm is not None:
+                parts = recv.split(".")
+                if len(parts) == 2:
+                    attr = parts[1]
+                    if self.cm.locks.get(attr) == "condition":
+                        # waiting on a condition whose lock you hold is
+                        # THE condition idiom, not a finding; waiting on
+                        # one you don't hold raises at runtime anyway
+                        return None
+                    if attr in self.cm.event_attrs:
+                        return "Event .wait()"
+            return "blocking .wait()"
+        if m == "get" and any(kw.arg in ("timeout", "block")
+                              for kw in call.keywords):
+            return "queue .get() wait"
+        return None
+
+
+class _PackageModel:
+    """Cross-file model shared by the four rules (built once per run,
+    cached on the first FileContext)."""
+
+    def __init__(self, ctxs: Sequence[FileContext]):
+        self.modules: Dict[str, _ModuleModel] = {}
+        self.classes: Dict[str, _ClassModel] = {}
+        self.attr_types: Dict[str, str] = {}
+        self.fn_facts: Dict[tuple, _FnFacts] = {}
+        self.edges: Dict[Tuple[str, str], Tuple[str, int]] = {}
+        self.node_kinds: Dict[str, str] = {}
+        self.self_deadlocks: List[Tuple[str, int, str]] = []
+        self._build(ctxs)
+
+    # ------------------------------------------------------------ phase 1+2
+    def _build(self, ctxs: Sequence[FileContext]) -> None:
+        parsed: List[Tuple[FileContext, ast.Module]] = []
+        for ctx in sorted(ctxs, key=lambda c: c.rel):
+            tree = ctx.tree
+            if tree is None:
+                continue
+            parsed.append((ctx, tree))
+            self.modules[ctx.rel] = self._module(ctx, tree)
+        ambiguous: Set[str] = set()
+        for mm in self.modules.values():
+            for cname, cm in mm.classes.items():
+                if cname in self.classes:
+                    ambiguous.add(cname)
+                else:
+                    self.classes[cname] = cm
+        for a in ambiguous:
+            self.classes.pop(a, None)
+        attr_amb: Set[str] = set()
+        for mm in self.modules.values():
+            for cm in mm.classes.values():
+                for attr, ctor in cm.attr_ctors.items():
+                    if ctor not in self.classes:
+                        continue
+                    prev = self.attr_types.get(attr)
+                    if prev is not None and prev != ctor:
+                        attr_amb.add(attr)
+                    self.attr_types[attr] = ctor
+        for a in attr_amb:
+            self.attr_types.pop(a, None)
+        for mm in self.modules.values():
+            for name, kind in mm.locks.items():
+                self.node_kinds[f"{mm.modname}.{name}"] = kind
+            for cm in mm.classes.values():
+                for attr, kind in cm.locks.items():
+                    self.node_kinds[cm.node_for(attr, mm)] = \
+                        cm.locks.get(cm.alias.get(attr, attr), kind)
+        # phase 3: walk every function
+        for ctx, tree in parsed:
+            mm = self.modules[ctx.rel]
+            for item in tree.body:
+                if isinstance(item, ast.ClassDef) \
+                        and item.name in mm.classes:
+                    cm = mm.classes[item.name]
+                    for name, fn in cm.methods.items():
+                        w = _Walker(self, mm, cm, name, fn)
+                        self.fn_facts[w.facts.key] = w.facts
+                elif isinstance(item, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef)):
+                    w = _Walker(self, mm, None, item.name, item)
+                    self.fn_facts[w.facts.key] = w.facts
+        self._link()
+
+    def _module(self, ctx: FileContext, tree: ast.Module) -> _ModuleModel:
+        mm = _ModuleModel(ctx.rel)
+        annots = _annotations(ctx)
+        for item in tree.body:
+            if isinstance(item, ast.ClassDef):
+                mm.classes[item.name] = self._class(item, mm, annots)
+            elif isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                mm.functions[item.name] = item
+            elif isinstance(item, ast.Assign) and len(item.targets) == 1 \
+                    and isinstance(item.targets[0], ast.Name):
+                kind = _ctor_kind(item.value)
+                if kind is not None:
+                    mm.locks[item.targets[0].id] = kind
+        return mm
+
+    def _class(self, node: ast.ClassDef, mm: _ModuleModel,
+               annots: Dict[int, List[Tuple[str, List[str]]]]) -> _ClassModel:
+        cm = _ClassModel(node.name, mm.rel, mm.modname)
+        for item in node.body:
+            if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                cm.methods[item.name] = item
+        for name, fn in cm.methods.items():
+            lines = {fn.lineno} | {d.lineno for d in fn.decorator_list}
+            for ln in lines:
+                for kind, names in annots.get(ln, []):
+                    if kind == "requires-lock":
+                        cm.requires[name] = tuple(names)
+        for fn in cm.methods.values():
+            for sub in ast.walk(fn):
+                if isinstance(sub, ast.Assign) and len(sub.targets) == 1:
+                    t = sub.targets[0]
+                    if isinstance(t, ast.Attribute) \
+                            and isinstance(t.value, ast.Name) \
+                            and t.value.id == "self":
+                        self._class_attr(cm, t.attr, sub, annots)
+                if isinstance(sub, ast.Call) \
+                        and dotted_name(sub.func) in ("Thread",
+                                                      "threading.Thread"):
+                    for kw in sub.keywords:
+                        if kw.arg != "target":
+                            continue
+                        d = dotted_name(kw.value)
+                        if d and d.startswith("self.") \
+                                and len(d.split(".")) == 2:
+                            cm.thread_targets.add(d.split(".")[1])
+        return cm
+
+    def _class_attr(self, cm: _ClassModel, attr: str, assign: ast.Assign,
+                    annots: Dict[int, List[Tuple[str, List[str]]]]) -> None:
+        v = assign.value
+        kind = _ctor_kind(v)
+        if kind is not None:
+            cm.locks[attr] = kind
+            if kind == "condition" and isinstance(v, ast.Call) and v.args:
+                w = dotted_name(v.args[0])
+                if w and w.startswith("self.") and len(w.split(".")) == 2:
+                    cm.alias[attr] = w.split(".")[1]
+        elif _is_ctor(v, "Thread"):
+            cm.thread_attrs.add(attr)
+        elif _is_ctor(v, "Event"):
+            cm.event_attrs.add(attr)
+        elif isinstance(v, ast.Call):
+            d = dotted_name(v.func)
+            if d:
+                cm.attr_ctors.setdefault(attr, d.rsplit(".", 1)[-1])
+        for kind_a, names in annots.get(assign.lineno, []):
+            if kind_a == "guarded-by" and names:
+                cm.guarded[attr] = names[0]
+
+    # ------------------------------------------------------------ phase 4
+    def resolve_call(self, facts: _FnFacts, dotted: str) -> Optional[tuple]:
+        parts = dotted.split(".")
+        if parts[0] == "self":
+            if facts.cls is None:
+                return None
+            if len(parts) == 2:
+                if parts[1] in facts.cls.methods:
+                    return (facts.rel, facts.cls.name, parts[1])
+                return None
+            return self._by_attr(parts[-2], parts[-1])
+        if len(parts) == 1:
+            if parts[0] in facts.module.functions:
+                return (facts.rel, None, parts[0])
+            return None
+        if len(parts) == 2 and parts[0] in facts.local_ctors:
+            return self._method_of(facts.local_ctors[parts[0]], parts[1])
+        return self._by_attr(parts[-2], parts[-1])
+
+    def _by_attr(self, attr: str, meth: str) -> Optional[tuple]:
+        cname = self.attr_types.get(attr)
+        return self._method_of(cname, meth) if cname else None
+
+    def _method_of(self, cname: str, meth: str) -> Optional[tuple]:
+        cm = self.classes.get(cname)
+        if cm is not None and meth in cm.methods:
+            return (cm.rel, cm.name, meth)
+        return None
+
+    def _link(self) -> None:
+        """Resolve calls, run the transitive-acquisition fixpoint, and
+        materialise the lock-order edge set."""
+        resolved: Dict[tuple, List[Tuple[tuple, int, FrozenSet[str]]]] = {}
+        direct_acq: Dict[tuple, Set[str]] = {}
+        self.direct_blocking: Dict[tuple, Tuple[str, int]] = {}
+        for key in sorted(self.fn_facts, key=str):
+            f = self.fn_facts[key]
+            direct_acq[key] = set()
+            resolved[key] = []
+            for ev in f.events:
+                if ev[0] == "acq":
+                    direct_acq[key].add(ev[1])
+                elif ev[0] == "call":
+                    c = self.resolve_call(f, ev[1])
+                    if c is not None and c in self.fn_facts:
+                        resolved[key].append(
+                            (c, ev[2], ev[3], ev[1].startswith("self.")))
+                elif ev[0] == "block" and key not in self.direct_blocking:
+                    self.direct_blocking[key] = (ev[1], ev[2])
+        self.resolved_calls = resolved
+        trans: Dict[tuple, Set[str]] = {k: set(v)
+                                        for k, v in direct_acq.items()}
+        for _ in range(30):
+            changed = False
+            for key, calls in resolved.items():
+                acc = trans[key]
+                for c, _line, _held, _via_self in calls:
+                    extra = trans.get(c, ())
+                    if not set(extra) <= acc:
+                        acc |= set(extra)
+                        changed = True
+            if not changed:
+                break
+        self.trans_acq = trans
+        for key in sorted(self.fn_facts, key=str):
+            f = self.fn_facts[key]
+            for ev in f.events:
+                if ev[0] == "acq":
+                    _t, node, line, held, is_self = ev
+                    for h in sorted(held):
+                        if h == node:
+                            if is_self and \
+                                    self.node_kinds.get(node) == "lock":
+                                self.self_deadlocks.append(
+                                    (f.rel, line, node))
+                        else:
+                            self.edges.setdefault((h, node), (f.rel, line))
+            for c, line, held, via_self in resolved[key]:
+                if not held:
+                    continue
+                for n in sorted(self.trans_acq.get(c, ())):
+                    for h in sorted(held):
+                        if h != n:
+                            self.edges.setdefault((h, n), (f.rel, line))
+                        elif via_self and \
+                                self.node_kinds.get(n) == "lock":
+                            # self.m() re-acquiring a plain Lock the
+                            # caller already holds: same instance, so
+                            # this is a guaranteed self-deadlock (other
+                            # receivers share the node but may be a
+                            # different instance - skip those)
+                            self.self_deadlocks.append((f.rel, line, n))
+
+
+def _model_for(ctxs: Sequence[FileContext]) -> _PackageModel:
+    if not ctxs:
+        return _PackageModel([])
+    cached = getattr(ctxs[0], "_graftlint_concurrency", None)
+    if cached is not None and cached[0] == len(ctxs):
+        return cached[1]
+    model = _PackageModel(ctxs)
+    try:
+        ctxs[0]._graftlint_concurrency = (len(ctxs), model)
+    except Exception:  # lint: swallowed-exception-ok (cache attach is best-effort; a slotted/frozen ctx just rebuilds the model per rule)
+        pass
+    return model
+
+
+def _disp(node: str) -> str:
+    """Strip the package prefix off a graph node for messages."""
+    return node[len("deeplearning4j_tpu."):] \
+        if node.startswith("deeplearning4j_tpu.") else node
+
+
+# ---------------------------------------------------------------------------
+@register
+class LockGuard(Rule):
+    """Eraser-style per-class lockset inference.
+
+    An attribute written under ``with self._lock:`` in some methods of a
+    class but mutated bare in others (``__init__`` excepted — the object
+    is not shared yet) violates the inferred discipline; a bare mutation
+    from a ``Thread`` target method is called out as such. A
+    ``#: guarded-by: _lock`` annotation pins the guard explicitly and
+    tightens the check to bare READS as well; ``#: requires-lock:`` on a
+    helper method declares the caller-holds-the-lock contract instead of
+    tripping the inference.
+    """
+
+    name = "lockguard"
+    description = ("class attribute written under a lock in one method "
+                   "but mutated bare in another (lockset inference; "
+                   "'#: guarded-by:' pins intent)")
+
+    def prepare(self, ctxs: Sequence[FileContext]) -> None:
+        self._by_file: Dict[str, List[Tuple[int, str]]] = {}
+        model = _model_for(ctxs)
+        for mm in model.modules.values():
+            for cm in mm.classes.values():
+                self._check_class(model, mm, cm)
+
+    def _check_class(self, model: _PackageModel, mm: _ModuleModel,
+                     cm: _ClassModel) -> None:
+        writes: Dict[str, List[tuple]] = {}
+        reads: Dict[str, List[tuple]] = {}
+        for fname in cm.methods:
+            # construction runs before the object is shared — dataclass
+            # __post_init__ included
+            if fname in ("__init__", "__new__", "__post_init__"):
+                continue
+            facts = model.fn_facts.get((mm.rel, cm.name, fname))
+            if facts is None:
+                continue
+            for ev in facts.events:
+                if ev[0] == "write":
+                    writes.setdefault(ev[1], []).append(
+                        (ev[2], ev[3], fname))
+                elif ev[0] == "read":
+                    reads.setdefault(ev[1], []).append(
+                        (ev[2], ev[3], fname))
+        own_nodes = {cm.node_for(a, mm) for a in cm.locks}
+        for attr in sorted(set(writes) | set(cm.guarded)):
+            if attr in cm.locks:
+                continue
+            ann = cm.guarded.get(attr)
+            if ann is not None:
+                guards = {cm.node_for(ann, mm)}
+            else:
+                guards = set()
+                for (_l, held, _f) in writes.get(attr, []):
+                    guards |= (held & own_nodes)
+            if not guards:
+                continue
+            locked_in = sorted({f for (_l, held, f) in writes.get(attr, [])
+                                if held & guards})
+            disp = "/".join(sorted(_disp(g) for g in guards))
+            for (line, held, fname) in writes.get(attr, []):
+                if held & guards:
+                    continue
+                tt = " (a Thread target)" if fname in cm.thread_targets \
+                    else ""
+                if ann is not None:
+                    msg = (f"self.{attr} is '#: guarded-by: {ann}' but "
+                           f"mutated in {fname}(){tt} without holding it")
+                else:
+                    where = f" (locked writes in {', '.join(locked_in)})" \
+                        if locked_in else ""
+                    msg = (f"self.{attr} is written under {disp} elsewhere "
+                           f"in {cm.name} but mutated bare in "
+                           f"{fname}(){tt}{where}")
+                self._by_file.setdefault(mm.rel, []).append((line, msg))
+            if ann is not None:
+                flagged = {line for (line, held, _f) in writes.get(attr, [])
+                           if not (held & guards)}
+                for (line, held, fname) in reads.get(attr, []):
+                    if held & guards or line in flagged:
+                        continue
+                    self._by_file.setdefault(mm.rel, []).append(
+                        (line, f"self.{attr} is '#: guarded-by: {ann}' but "
+                               f"read in {fname}() without holding it"))
+
+    def check(self, ctx: FileContext) -> Iterator[Violation]:
+        if ctx.tree is None:
+            return
+        for line, msg in sorted(self._by_file.get(ctx.rel, [])):
+            yield self.violation(ctx, line, msg)
+
+
+# ---------------------------------------------------------------------------
+@register
+class LockOrder(Rule):
+    """RacerX-style static lock-order analysis.
+
+    Builds the interprocedural lock-acquisition graph — ``with`` blocks
+    and ``.acquire()`` calls, with method calls resolved within the
+    package — and flags cycles as potential deadlocks, plus direct
+    re-acquisition of a non-reentrant lock (self-deadlock). Nodes are
+    per-class lock attributes (all instances collapse to one node, so a
+    consistent hierarchy between peers is assumed); a Condition built
+    over an explicit lock shares that lock's node. The runtime witness
+    (``lint/witness.py``) records the same graph dynamically under the
+    threaded suites — a disputed static cycle gets a suppression citing
+    witness evidence.
+    """
+
+    name = "lock-order"
+    description = ("cycle in the interprocedural lock-acquisition graph "
+                   "(potential ABBA deadlock), or re-acquisition of a "
+                   "non-reentrant lock")
+
+    def prepare(self, ctxs: Sequence[FileContext]) -> None:
+        self._by_file: Dict[str, List[Tuple[int, str]]] = {}
+        model = _model_for(ctxs)
+        for rel, line, node in model.self_deadlocks:
+            self._by_file.setdefault(rel, []).append(
+                (line, f"non-reentrant lock {_disp(node)} acquired while "
+                       "already held on this path — self-deadlock"))
+        for cycle in self._cycles(model):
+            path = " -> ".join(_disp(n) for n in cycle + (cycle[0],))
+            hops = []
+            for a, b in zip(cycle, cycle[1:] + (cycle[0],)):
+                rel, line = model.edges[(a, b)]
+                hops.append(f"{_disp(a)}->{_disp(b)} at {rel}:{line}")
+            rel0, line0 = model.edges[(cycle[0], cycle[1])]
+            self._by_file.setdefault(rel0, []).append(
+                (line0, f"potential deadlock: lock-order cycle {path} "
+                        f"({'; '.join(hops)})"))
+
+    def _cycles(self, model: _PackageModel) -> List[tuple]:
+        adj: Dict[str, List[str]] = {}
+        for (a, b) in model.edges:
+            adj.setdefault(a, []).append(b)
+            adj.setdefault(b, [])
+        for nbrs in adj.values():
+            nbrs.sort()
+        sccs = _tarjan(adj)
+        out = []
+        for scc in sccs:
+            if len(scc) < 2:
+                continue
+            scc_set = set(scc)
+            start = min(scc)
+            path = self._find_cycle(adj, scc_set, start)
+            if path:
+                out.append(tuple(path))
+        out.sort()
+        return out
+
+    def _find_cycle(self, adj, scc_set, start):
+        """Deterministic cycle through ``start`` inside one SCC."""
+        stack = [(start, [start])]
+        seen = set()
+        while stack:
+            node, path = stack.pop()
+            for nxt in reversed(adj.get(node, [])):
+                if nxt == start and len(path) > 1:
+                    return path
+                if nxt in scc_set and nxt not in seen:
+                    seen.add(nxt)
+                    stack.append((nxt, path + [nxt]))
+        return None
+
+    def check(self, ctx: FileContext) -> Iterator[Violation]:
+        if ctx.tree is None:
+            return
+        for line, msg in sorted(self._by_file.get(ctx.rel, [])):
+            yield self.violation(ctx, line, msg)
+
+
+# ---------------------------------------------------------------------------
+@register
+class BlockingUnderLock(Rule):
+    """No unbounded waits inside a critical section.
+
+    Device syncs (``block_until_ready``, trusted ``.item()`` reads),
+    compile seams, socket I/O, ``time.sleep``, thread joins, future
+    results and queue waits while statically holding a lock stall every
+    thread that contends on it — on the serving hot path (batcher, PS,
+    replica set, tracing) that is a fleet-wide latency cliff. One level
+    of call resolution: a call under a lock to a package function whose
+    body directly blocks is flagged at the call site. ``Condition.wait``
+    on the held condition's own lock is the idiom, not a finding.
+    """
+
+    name = "blocking-under-lock"
+    description = ("blocking call (device sync, compile seam, socket, "
+                   "sleep, join, queue wait) while holding a lock")
+    #: the UI plane serves a browser over HTTP from its own threads —
+    #: socket writes under its session locks are its whole job
+    exclude = ("*/deeplearning4j_tpu/ui/*",)
+
+    def prepare(self, ctxs: Sequence[FileContext]) -> None:
+        self._by_file: Dict[str, List[Tuple[int, str]]] = {}
+        model = _model_for(ctxs)
+        for key in sorted(model.fn_facts, key=str):
+            f = model.fn_facts[key]
+            for ev in f.events:
+                if ev[0] == "block" and ev[3]:
+                    locks = "/".join(sorted(_disp(h) for h in ev[3]))
+                    self._by_file.setdefault(f.rel, []).append(
+                        (ev[2], f"{ev[1]} while holding {locks}"))
+            for c, line, held, _via_self in model.resolved_calls.get(key, ()):
+                if not held:
+                    continue
+                blk = model.direct_blocking.get(c)
+                if blk is None:
+                    continue
+                locks = "/".join(sorted(_disp(h) for h in held))
+                cname = f"{c[1]}.{c[2]}" if c[1] else c[2]
+                self._by_file.setdefault(f.rel, []).append(
+                    (line, f"call to {cname}() ({blk[0]} at {c[0]}:{blk[1]}) "
+                           f"while holding {locks}"))
+
+    def check(self, ctx: FileContext) -> Iterator[Violation]:
+        if ctx.tree is None:
+            return
+        for line, msg in sorted(self._by_file.get(ctx.rel, [])):
+            yield self.violation(ctx, line, msg)
+
+
+# ---------------------------------------------------------------------------
+@register
+class ThreadLifecycle(Rule):
+    """Every worker thread needs an owner.
+
+    ``threading.Thread(...)`` without ``daemon=True`` and without a
+    reachable ``join()``/``.daemon = True`` on its handle leaks a
+    non-daemon thread that blocks interpreter shutdown — the
+    stop-seam-less worker is exactly the zombie the elastic plane
+    fences. Handles stored on ``self`` are searched class-wide for a
+    join; locals are searched within the creating function; anonymous
+    ``Thread(...).start()`` chains need a join somewhere in the same
+    scope (the ``for t in threads: t.join()`` idiom) to pass.
+    """
+
+    name = "thread-lifecycle"
+    description = ("Thread started without daemon=True or a reachable "
+                   "join()/stop seam")
+
+    def check(self, ctx: FileContext) -> Iterator[Violation]:
+        tree = ctx.tree
+        if tree is None:
+            return
+        cls_of: Dict[int, ast.ClassDef] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ClassDef):
+                for item in node.body:
+                    if isinstance(item, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                        cls_of[id(item)] = node
+        scopes: List[Tuple[ast.AST, Optional[ast.ClassDef]]] = [(tree, None)]
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                scopes.append((node, cls_of.get(id(node))))
+        for scope, cls in scopes:
+            for call, target in self._thread_ctors(scope):
+                if self._has_daemon_kwarg(call):
+                    continue
+                if self._owned(ctx, tree, scope, cls, call, target):
+                    continue
+                yield self.violation(
+                    ctx, call.lineno,
+                    "Thread without an owner: pass daemon=True, or keep "
+                    "the handle and join() it from a close()/stop() seam")
+
+    def _thread_ctors(self, scope: ast.AST):
+        """(ctor call, assignment target dotted name or None) for Thread
+        constructions directly in this scope (nested defs excluded —
+        they are their own scope)."""
+        out = []
+        targeted: Set[int] = set()
+
+        def visit(node):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                      ast.ClassDef)):
+                    continue  # nested scopes report their own threads
+                if isinstance(child, ast.Assign) and len(child.targets) == 1\
+                        and isinstance(child.value, ast.Call) \
+                        and dotted_name(child.value.func) in (
+                            "Thread", "threading.Thread"):
+                    out.append((child.value, dotted_name(child.targets[0])))
+                    targeted.add(id(child.value))
+                elif isinstance(child, ast.Call) \
+                        and dotted_name(child.func) in ("Thread",
+                                                        "threading.Thread") \
+                        and id(child) not in targeted:
+                    out.append((child, None))
+                visit(child)
+        visit(scope)
+        return [(c, t) for c, t in out
+                if t is not None or id(c) not in targeted]
+
+    def _has_daemon_kwarg(self, call: ast.Call) -> bool:
+        for kw in call.keywords:
+            if kw.arg == "daemon":
+                return not (isinstance(kw.value, ast.Constant)
+                            and kw.value.value is False)
+        return False
+
+    def _owned(self, ctx, tree, scope, cls, call, target) -> bool:
+        if target is None:
+            search: ast.AST = scope
+            suffix = None
+        elif target.startswith("self."):
+            search = cls if cls is not None else tree
+            suffix = target.split(".", 1)[1]
+        else:
+            search = scope
+            suffix = target
+        for node in ast.walk(search):
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "join":
+                recv = dotted_name(node.func.value)
+                if suffix is None:
+                    return True
+                if recv is not None and (recv == suffix
+                                         or recv.endswith("." + suffix)):
+                    return True
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                d = dotted_name(node.targets[0])
+                if d and d.endswith(".daemon") \
+                        and isinstance(node.value, ast.Constant) \
+                        and node.value.value is True:
+                    stem = d[:-len(".daemon")]
+                    if suffix is None or stem == suffix \
+                            or stem.endswith("." + suffix):
+                        return True
+        return False
+
+
+def _tarjan(adj: Dict[str, List[str]]) -> List[List[str]]:
+    """Iterative Tarjan SCC (the lock graph is small, but recursion
+    depth must not depend on it)."""
+    index: Dict[str, int] = {}
+    low: Dict[str, int] = {}
+    on_stack: Set[str] = set()
+    stack: List[str] = []
+    sccs: List[List[str]] = []
+    counter = [0]
+
+    for root in sorted(adj):
+        if root in index:
+            continue
+        work = [(root, iter(adj.get(root, [])))]
+        index[root] = low[root] = counter[0]
+        counter[0] += 1
+        stack.append(root)
+        on_stack.add(root)
+        while work:
+            node, it = work[-1]
+            advanced = False
+            for nxt in it:
+                if nxt not in index:
+                    index[nxt] = low[nxt] = counter[0]
+                    counter[0] += 1
+                    stack.append(nxt)
+                    on_stack.add(nxt)
+                    work.append((nxt, iter(adj.get(nxt, []))))
+                    advanced = True
+                    break
+                elif nxt in on_stack:
+                    low[node] = min(low[node], index[nxt])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+            if low[node] == index[node]:
+                scc = []
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    scc.append(w)
+                    if w == node:
+                        break
+                sccs.append(sorted(scc))
+    return sccs

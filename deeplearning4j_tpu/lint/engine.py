@@ -244,12 +244,103 @@ def iter_py_files(paths: Sequence[pathlib.Path]) -> List[Tuple[pathlib.Path, str
     return sorted(out.items(), key=lambda kv: kv[1])
 
 
+def _check_ctx(ctx: FileContext, rules: Sequence[Rule],
+               known: set) -> Tuple[List[Violation], List[Violation],
+                                    List[str]]:
+    """Run every rule over ONE file and resolve its suppressions.
+
+    Pure per-file work — no shared mutable state — which is what lets the
+    runner fan files out across worker processes (``jobs``)."""
+    open_v: List[Violation] = []
+    suppressed: List[Violation] = []
+    errors: List[str] = []
+    seen: set = set()
+    for rule in rules:
+        if not rule.applies_to(ctx):
+            continue
+        try:
+            found = list(rule.check(ctx))
+        except (SyntaxError, tokenize.TokenError, IndentationError):
+            if ctx.tree_error and ctx.tree_error not in errors:
+                errors.append(ctx.tree_error)
+            continue
+        for v in found:
+            if v.key() in seen:
+                continue
+            seen.add(v.key())
+            sup = ctx.suppression_for(v.rule, v.line)
+            if sup is not None:
+                suppressed.append(dataclasses.replace(
+                    v, suppressed=True, reason=sup.reason))
+            else:
+                open_v.append(v)
+    if ctx.tree_error and ctx.tree_error not in errors:
+        errors.append(ctx.tree_error)
+    # engine-level: malformed suppressions + unknown rule names
+    for sup in ctx.malformed:
+        what = (f"suppression {sup.rule!r}-ok is missing its required "
+                "(reason)" if sup.rule else
+                "'# lint:' comment with no parseable '<rule>-ok' marker")
+        open_v.append(Violation(BAD_SUPPRESSION, ctx.rel, sup.line, what,
+                                snippet=ctx.line_at(sup.line)))
+    for by_rule in ctx.suppressions.values():
+        for sup in by_rule.values():
+            if sup.rule not in known and sup.rule != BAD_SUPPRESSION:
+                open_v.append(Violation(
+                    BAD_SUPPRESSION, ctx.rel, sup.line,
+                    f"suppression names unknown rule {sup.rule!r} "
+                    "(typo? see --list-rules)",
+                    snippet=ctx.line_at(sup.line)))
+    return open_v, suppressed, errors
+
+
+#: (ctxs, rules, known) snapshot the forked pool workers inherit — set
+#: immediately before the fork, cleared right after. Fork (not spawn) is
+#: load-bearing: prepared cross-file rule state and parsed FileContexts
+#: travel to the children by address-space copy, and only the picklable
+#: Violation lists travel back.
+_pool_state: Optional[tuple] = None
+
+
+def _pool_check(i: int):
+    ctxs, rules, known = _pool_state
+    return _check_ctx(ctxs[i], rules, known)
+
+
+def _fan_out(ctxs: Sequence[FileContext], rules: Sequence[Rule],
+             known: set, jobs: int) -> Optional[List[tuple]]:
+    """Per-file results in file order via a fork pool, or None when the
+    platform can't fork (the caller falls back to the sequential path)."""
+    import multiprocessing
+
+    global _pool_state
+    try:
+        mp = multiprocessing.get_context("fork")
+    except ValueError:  # pragma: no cover - no fork on this platform
+        return None
+    _pool_state = (ctxs, rules, known)
+    try:
+        with mp.Pool(min(jobs, len(ctxs))) as pool:
+            # map (not imap_unordered) pins result order to file order, so
+            # parallel output is byte-identical to sequential output
+            return pool.map(_pool_check, range(len(ctxs)),
+                            chunksize=max(1, len(ctxs) // (4 * jobs)))
+    finally:
+        _pool_state = None
+
+
 def run(paths: Sequence[pathlib.Path], rules: Sequence[Rule],
-        known_rule_names: Optional[Iterable[str]] = None) -> LintResult:
+        known_rule_names: Optional[Iterable[str]] = None,
+        jobs: int = 1) -> LintResult:
     """Run ``rules`` over every .py under ``paths``; resolve suppressions.
 
     ``known_rule_names``: full registry (suppressions may name a rule that
-    exists but isn't selected this run — that is not a typo)."""
+    exists but isn't selected this run — that is not a typo).
+
+    ``jobs``: worker processes for the per-file check phase. File parsing
+    and ``prepare`` (the cross-file hooks) stay sequential in the parent —
+    they build shared state — then the independent per-file checks fan out
+    and merge back in file order, so results are deterministic at any N."""
     known = set(known_rule_names or ()) | {r.name for r in rules}
     files = iter_py_files(paths)
     ctxs: List[FileContext] = []
@@ -263,46 +354,20 @@ def run(paths: Sequence[pathlib.Path], rules: Sequence[Rule],
     for rule in rules:
         rule.prepare(ctxs)
 
+    per_file: Optional[List[tuple]] = None
+    if jobs and jobs > 1 and len(ctxs) > 1:
+        per_file = _fan_out(ctxs, rules, known, jobs)
+    if per_file is None:
+        per_file = [_check_ctx(ctx, rules, known) for ctx in ctxs]
+
     open_v: List[Violation] = []
     suppressed: List[Violation] = []
-    for ctx in ctxs:
-        seen: set = set()
-        for rule in rules:
-            if not rule.applies_to(ctx):
-                continue
-            try:
-                found = list(rule.check(ctx))
-            except (SyntaxError, tokenize.TokenError, IndentationError):
-                if ctx.tree_error and ctx.tree_error not in errors:
-                    errors.append(ctx.tree_error)
-                continue
-            for v in found:
-                if v.key() in seen:
-                    continue
-                seen.add(v.key())
-                sup = ctx.suppression_for(v.rule, v.line)
-                if sup is not None:
-                    suppressed.append(dataclasses.replace(
-                        v, suppressed=True, reason=sup.reason))
-                else:
-                    open_v.append(v)
-        if ctx.tree_error and ctx.tree_error not in errors:
-            errors.append(ctx.tree_error)
-        # engine-level: malformed suppressions + unknown rule names
-        for sup in ctx.malformed:
-            what = (f"suppression {sup.rule!r}-ok is missing its required "
-                    "(reason)" if sup.rule else
-                    "'# lint:' comment with no parseable '<rule>-ok' marker")
-            open_v.append(Violation(BAD_SUPPRESSION, ctx.rel, sup.line, what,
-                                    snippet=ctx.line_at(sup.line)))
-        for by_rule in ctx.suppressions.values():
-            for sup in by_rule.values():
-                if sup.rule not in known and sup.rule != BAD_SUPPRESSION:
-                    open_v.append(Violation(
-                        BAD_SUPPRESSION, ctx.rel, sup.line,
-                        f"suppression names unknown rule {sup.rule!r} "
-                        "(typo? see --list-rules)",
-                        snippet=ctx.line_at(sup.line)))
+    for f_open, f_sup, f_err in per_file:
+        open_v.extend(f_open)
+        suppressed.extend(f_sup)
+        for e in f_err:
+            if e not in errors:
+                errors.append(e)
 
     open_v.sort(key=lambda v: v.key())
     suppressed.sort(key=lambda v: v.key())

@@ -1,0 +1,289 @@
+"""Runtime lock-order witness: the dynamic half of the concurrency plane.
+
+The static ``lock-order`` rule (``lint/concurrency.py``) proves the
+*declared* acquisition graph acyclic — every nesting it can see in the
+source. This module witnesses the *executed* graph: patch
+``threading.Lock``/``threading.RLock`` with recording wrappers, run the
+real threaded suites (serve, autoscale, dataplane, ps), and assert at
+teardown that no two locks were ever taken in both orders. A cycle here
+is a deadlock that needs only the right interleaving — the witness turns
+"we never happened to deadlock in CI" into "no run ever acquired locks
+in conflicting order".
+
+Opt-in and test-only by design: ``install()`` swaps the factories,
+``uninstall()`` restores them, and the pytest session fixture in
+``tests/conftest.py`` gates the whole thing behind ``DL4J_LOCK_WITNESS=1``
+so production code paths never pay the bookkeeping. Lock identity is the
+**creation site** (``file:line`` of the ``Lock()`` call), which collapses
+every instance of a class onto one node — the same granularity the static
+rule uses, so the two graphs can be compared edge-for-edge.
+
+Protocol notes: the wrappers implement the full ``Condition`` protocol
+(``_is_owned`` / ``_release_save`` / ``_acquire_restore``) so
+``threading.Condition(wrapped)`` — and bare ``Condition()``, whose
+default lock comes from the patched ``RLock`` factory — keep working.
+Re-acquiring a held RLock records no edge (reentrancy is not an
+ordering), and the re-acquire inside ``Condition.wait`` records no edge
+either (waking from a wait is a resume, not a new nesting decision).
+"""
+from __future__ import annotations
+
+import os
+import sys
+import threading
+from typing import Dict, List, Optional, Tuple
+
+# Real factories, captured at import time so the witness's own
+# bookkeeping lock keeps working while the module-level names are
+# patched out from under everyone else.
+_REAL_LOCK = threading.Lock
+_REAL_RLOCK = threading.RLock
+
+_graph_lock = _REAL_LOCK()
+#: (from_node, to_node) -> (thread_name, acquire_site) of the FIRST
+#: witnessed nesting, so failure messages point at a real stack line.
+_edges: Dict[Tuple[str, str], Tuple[str, str]] = {}
+_held = threading.local()  # per-thread stack of currently held nodes
+_installed = False
+
+_PKG_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_REPO_ROOT = os.path.dirname(_PKG_ROOT)
+
+
+def _site(depth_hint: int = 2) -> str:
+    """``file:line`` of the nearest caller frame outside this module."""
+    frame = sys._getframe(depth_hint)
+    while frame is not None and frame.f_code.co_filename == __file__:
+        frame = frame.f_back
+    if frame is None:  # pragma: no cover - interpreter teardown only
+        return "<unknown>:0"
+    fname = frame.f_code.co_filename
+    try:
+        rel = os.path.relpath(fname, _REPO_ROOT)
+    except ValueError:  # pragma: no cover - different drive on win32
+        rel = fname
+    if rel.startswith(".."):
+        rel = os.path.basename(fname)
+    return "%s:%d" % (rel.replace(os.sep, "/"), frame.f_lineno)
+
+
+def _stack() -> List[str]:
+    st = getattr(_held, "stack", None)
+    if st is None:
+        st = _held.stack = []
+    return st
+
+
+def _record(node: str) -> None:
+    """Witness an acquisition of ``node`` with the current held set."""
+    st = _stack()
+    site = _site(3)
+    if st:
+        with _graph_lock:
+            for outer in st:
+                if outer != node and (outer, node) not in _edges:
+                    _edges[(outer, node)] = (
+                        threading.current_thread().name, site)
+    st.append(node)
+
+
+def _forget(node: str) -> None:
+    st = _stack()
+    # release order need not mirror acquire order; drop the most
+    # recent occurrence so nested re-acquisitions unwind correctly
+    for i in range(len(st) - 1, -1, -1):
+        if st[i] == node:
+            del st[i]
+            return
+
+
+class _WitnessLock:
+    """Recording proxy over a real ``Lock``/``RLock``.
+
+    One class serves both: ``reentrant`` switches the inner primitive
+    and whether repeated acquisition by the owner is an ordering event.
+    """
+
+    __slots__ = ("_inner", "_node", "_reentrant", "_owner", "_count")
+
+    def __init__(self, reentrant: bool, node: Optional[str] = None):
+        self._inner = _REAL_RLOCK() if reentrant else _REAL_LOCK()
+        self._node = node or _site()
+        self._reentrant = reentrant
+        self._owner: Optional[int] = None
+        self._count = 0
+
+    # -- core lock protocol -------------------------------------------
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        me = threading.get_ident()
+        if self._reentrant and self._owner == me:
+            self._inner.acquire(blocking, timeout)
+            self._count += 1
+            return True
+        got = self._inner.acquire(blocking, timeout)
+        if got:
+            self._owner = me
+            self._count = 1
+            _record(self._node)
+        return got
+
+    def release(self) -> None:
+        if self._owner != threading.get_ident():
+            # let the real primitive raise its canonical error
+            self._inner.release()
+            return
+        self._count -= 1
+        if self._count == 0:
+            self._owner = None
+            _forget(self._node)
+        self._inner.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def locked(self) -> bool:
+        return self._count > 0
+
+    def _at_fork_reinit(self) -> None:
+        # fork-safety protocol (concurrent.futures registers this with
+        # os.register_at_fork): the child gets a fresh, unheld lock
+        self._inner._at_fork_reinit()
+        self._owner = None
+        self._count = 0
+
+    # -- Condition protocol -------------------------------------------
+    def _is_owned(self) -> bool:
+        return self._owner == threading.get_ident()
+
+    def _release_save(self):
+        # Condition.wait: fully release (all recursion levels) and
+        # remember how deep we were. The lock leaves the held stack.
+        count, self._count = self._count, 0
+        self._owner = None
+        _forget(self._node)
+        if self._reentrant:
+            for _ in range(count):
+                self._inner.release()
+        else:
+            self._inner.release()
+        return count
+
+    def _acquire_restore(self, count) -> None:
+        # Re-acquiring after a wait is a resume, not a nesting decision:
+        # restore the held stack without recording edges.
+        if self._reentrant:
+            for _ in range(count):
+                self._inner.acquire()
+        else:
+            self._inner.acquire()
+        self._owner = threading.get_ident()
+        self._count = count
+        _stack().append(self._node)
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return "<witness %s %s held=%d>" % (
+            "rlock" if self._reentrant else "lock", self._node, self._count)
+
+
+def _make_lock():
+    return _WitnessLock(reentrant=False)
+
+
+def _make_rlock():
+    return _WitnessLock(reentrant=True)
+
+
+# -- public API -------------------------------------------------------
+
+def install() -> None:
+    """Patch ``threading.Lock``/``RLock`` to the recording wrappers.
+
+    Locks created BEFORE install (module-level singletons, the test
+    harness's own plumbing) stay unwrapped and invisible — the witness
+    only sees locks born during the instrumented window, which is
+    exactly the application locks the suites construct.
+    """
+    global _installed
+    if _installed:
+        return
+    threading.Lock = _make_lock
+    threading.RLock = _make_rlock
+    _installed = True
+
+
+def uninstall() -> None:
+    """Restore the real factories (wrapped locks keep working)."""
+    global _installed
+    threading.Lock = _REAL_LOCK
+    threading.RLock = _REAL_RLOCK
+    _installed = False
+
+
+def reset() -> None:
+    """Drop the witnessed graph (between independent test sessions)."""
+    with _graph_lock:
+        _edges.clear()
+
+
+def edges() -> Dict[Tuple[str, str], Tuple[str, str]]:
+    """Snapshot of the witnessed graph: (outer, inner) -> (thread, site)."""
+    with _graph_lock:
+        return dict(_edges)
+
+
+def cycles() -> List[List[str]]:
+    """Cycles in the witnessed acquisition graph (deterministic order)."""
+    snap = edges()
+    adj: Dict[str, List[str]] = {}
+    for (a, b) in snap:
+        adj.setdefault(a, []).append(b)
+        adj.setdefault(b, [])
+    for v in adj.values():
+        v.sort()
+    found: List[List[str]] = []
+    seen_keys = set()
+    color: Dict[str, int] = {}  # 0 unvisited / 1 on stack / 2 done
+    path: List[str] = []
+
+    def visit(n: str) -> None:
+        color[n] = 1
+        path.append(n)
+        for m in adj[n]:
+            c = color.get(m, 0)
+            if c == 0:
+                visit(m)
+            elif c == 1:
+                cyc = path[path.index(m):] + [m]
+                start = min(range(len(cyc) - 1), key=lambda i: cyc[i])
+                norm = cyc[start:-1] + cyc[:start] + [cyc[start]]
+                key = tuple(norm)
+                if key not in seen_keys:
+                    seen_keys.add(key)
+                    found.append(norm)
+        path.pop()
+        color[n] = 2
+
+    for n in sorted(adj):
+        if color.get(n, 0) == 0:
+            visit(n)
+    return found
+
+
+def assert_acyclic() -> None:
+    """Raise ``AssertionError`` naming the cycle if any order inverted."""
+    bad = cycles()
+    if not bad:
+        return
+    snap = edges()
+    lines = ["lock-order witness: cyclic acquisition order observed"]
+    for cyc in bad:
+        lines.append("  cycle: " + " -> ".join(cyc))
+        for a, b in zip(cyc, cyc[1:]):
+            thread, site = snap[(a, b)]
+            lines.append(
+                "    %s then %s  [thread %s at %s]" % (a, b, thread, site))
+    raise AssertionError("\n".join(lines))

@@ -160,6 +160,7 @@ def get_runtime() -> Optional[ctypes.CDLL]:
         _load_attempted = True
         stale = (_LIB_PATH.exists() and _SRC_PATH.exists()
                  and _SRC_PATH.stat().st_mtime > _LIB_PATH.stat().st_mtime)
+        # lint: blocking-under-lock-ok (one-time lazy native build; the module lock exists precisely to serialize first-use compilation)
         if (not _LIB_PATH.exists() or stale) and not _build():
             if not _LIB_PATH.exists():
                 return None

@@ -403,6 +403,7 @@ class CachedProgram:
             entry = self._ready.get(key)
             if entry is None:
                 entry = self._build(sig, key[1], args, kwargs)
+                # lint: lockguard-ok (one writer per key under its per-signature lock; the dict store is GIL-atomic and the lock-free fast path tolerates a miss)
                 self._ready[key] = entry
         return key, entry
 

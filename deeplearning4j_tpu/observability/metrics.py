@@ -186,7 +186,7 @@ class MetricsRegistry:
         self._families: Dict[str, _Family] = {}
         self._enabled = True
         self._max_labelsets = _labelset_cap()
-        self._warned_families: Dict[str, float] = {}
+        self._warned_families: Dict[str, float] = {}  #: guarded-by: _lock
 
     def _note_dropped_labelset(self, family: str) -> None:
         """Called (outside the lock) when a family refused a new labelset:
@@ -196,9 +196,16 @@ class MetricsRegistry:
             "labels() calls refused a new series by the cardinality cap"
         ).labels(family=family).inc()
         now = time.time()
-        last = self._warned_families.get(family)
-        if last is None or now - last >= 60.0:
-            self._warned_families[family] = now
+        # check-then-set on the rate-limit map must be atomic: two request
+        # threads hitting the cap together both read a stale `last` and
+        # both warn. The counter above already released self._lock, so
+        # taking it here cannot deadlock.
+        with self._lock:
+            last = self._warned_families.get(family)
+            warn = last is None or now - last >= 60.0
+            if warn:
+                self._warned_families[family] = now
+        if warn:
             log.warning(
                 "metric family %s hit the labelset cap (%d); further "
                 "labelsets collapse into an unexported overflow series "

@@ -180,7 +180,12 @@ class TraceSession:
                 "trace capture requests refused because one was live"
             ).labels(trigger=trigger).inc()
             return None
-        self._current["logdir"] = sub
+        # the collision guard means one live capture, but stop() hands
+        # _current off under the lock — mutate it under the same lock so
+        # a concurrent stop never sees a half-written record
+        with self._lock:
+            if self._current is not None:
+                self._current["logdir"] = sub
         self._reg().gauge(PROFILE_ACTIVE,
                           "1 while a profiler trace is being captured").set(1)
         rec = self._rec()

@@ -344,13 +344,13 @@ class TraceStore:
         #: trace_id -> finalized record (insertion-ordered ring)
         self._ring: "collections.OrderedDict[str, dict]" = \
             collections.OrderedDict()
-        self._durs: collections.deque = collections.deque(maxlen=512)
+        self._durs: collections.deque = collections.deque(maxlen=512)  #: guarded-by: _lock
         #: cached rolling p99 — re-sorting 512 floats on EVERY finalize is
         #: the single biggest cost on the serve hot path, and a tail
         #: threshold that lags by <32 traces samples identically in
         #: practice
-        self._p99_cache: Optional[float] = None
-        self._p99_stale = 0
+        self._p99_cache: Optional[float] = None  #: guarded-by: _lock
+        self._p99_stale = 0  #: guarded-by: _lock
         #: metric name -> [(value, trace_id, ts), ...] worst-first, <=8
         self._exemplars: Dict[str, List[Tuple[float, str, float]]] = {}
         if registry is None:
@@ -420,6 +420,7 @@ class TraceStore:
             self._finalize(span.trace_id, done["spans"])
 
     # -- tail sampling --------------------------------------------------
+    #: requires-lock: _lock
     def _p99(self) -> Optional[float]:
         if len(self._durs) < 20:
             return None
@@ -434,9 +435,13 @@ class TraceStore:
         root = next((s for s in spans if s["parent_id"] is None), spans[0])
         dur = root["dur_s"]
         bad = any(s["status"] != "ok" for s in spans)
-        p99 = self._p99()
-        self._durs.append(dur)
-        self._p99_stale += 1
+        # tail-sampler state is shared by every finishing request thread:
+        # unlocked, two finalizes race the p99 cache refresh, and
+        # sorted(_durs) can see the deque mutate mid-iteration
+        with self._lock:
+            p99 = self._p99()
+            self._durs.append(dur)
+            self._p99_stale += 1
         if bad:
             reason = "error"
         elif p99 is not None and dur > p99:

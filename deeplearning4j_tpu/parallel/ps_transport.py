@@ -410,6 +410,7 @@ class TcpTransport(Transport):
     # ------------------------------------------------------------- core API
     def pull(self) -> Tuple[int, np.ndarray]:
         with self._lock:
+            # lint: blocking-under-lock-ok (the transport lock IS the RPC serializer: one in-flight request per connection, and reconnect backoff must hold it)
             reply, payload, _ = self._rpc({"op": "pull"})
         self._rx.inc(len(payload))
         vec = wire.decode_array(reply["array"], payload)
@@ -424,6 +425,7 @@ class TcpTransport(Transport):
         if ident is not None:
             header["member"], header["epoch"] = ident
         with self._lock:
+            # lint: blocking-under-lock-ok (the transport lock IS the RPC serializer: one in-flight request per connection, and reconnect backoff must hold it)
             reply, buf, sent = self._rpc(header, payload)
         self._tx.inc(sent)
         params = wire.decode_array(reply["array"], buf)
@@ -436,6 +438,7 @@ class TcpTransport(Transport):
     # ------------------------------------------------- membership (elastic)
     def register(self, shard: int, worker: str = "") -> dict:
         with self._lock:
+            # lint: blocking-under-lock-ok (the transport lock IS the RPC serializer: one in-flight request per connection, and reconnect backoff must hold it)
             reply, _, _ = self._rpc(
                 {"op": "register", "shard": int(shard), "worker": worker})
         return reply
@@ -445,6 +448,7 @@ class TcpTransport(Transport):
         if ident is None:
             return False
         with self._lock:
+            # lint: blocking-under-lock-ok (the transport lock IS the RPC serializer: one in-flight request per connection, and reconnect backoff must hold it)
             reply, _, _ = self._rpc(
                 {"op": "heartbeat", "member": ident[0], "epoch": ident[1]})
         return bool(reply.get("ok"))
@@ -454,6 +458,7 @@ class TcpTransport(Transport):
         if ident is None:
             return False
         with self._lock:
+            # lint: blocking-under-lock-ok (the transport lock IS the RPC serializer: one in-flight request per connection, and reconnect backoff must hold it)
             reply, _, _ = self._rpc(
                 {"op": "deregister", "member": ident[0],
                  "epoch": ident[1], "reason": reason})

@@ -61,6 +61,12 @@
 #                                 # hysteresis (≤1 event per cooldown),
 #                                 # priority shedding order, warm scale-up
 #                                 # no-fresh-compile pin, bench axis contract
+#   ./runtests.sh lock [args]     # concurrency plane: the four lock rules
+#                                 # over the package + their fixture suite,
+#                                 # then the threaded serve/autoscale/replica
+#                                 # suites under the runtime lock-order
+#                                 # witness (DL4J_LOCK_WITNESS=1) asserting
+#                                 # the executed acquisition graph acyclic
 #   ./runtests.sh trace [args]    # request tracing + SLO engine: traceparent
 #                                 # propagation through HTTP/batcher/decode/
 #                                 # replica, tail sampling (429 always kept),
@@ -196,6 +202,29 @@ if [ "${1-}" = "trace" ]; then
   XLA_FLAGS="--xla_force_host_platform_device_count=8" \
   exec python -m pytest tests/test_tracing.py \
     tests/test_bench_contract.py::test_config_key_serve_tracing_axis -q "$@"
+fi
+
+if [ "${1-}" = "lock" ]; then
+  shift
+  # phase 1: static — the four concurrency rules over the real tree must
+  # be clean, and their fixture/witness unit suite must pass
+  PALLAS_AXON_POOL_IPS= \
+  JAX_PLATFORMS=cpu \
+  python -m deeplearning4j_tpu.lint deeplearning4j_tpu \
+    --rules lockguard,lock-order,blocking-under-lock,thread-lifecycle
+  PALLAS_AXON_POOL_IPS= \
+  JAX_PLATFORMS=cpu \
+  XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+  python -m pytest tests/test_lint_concurrency.py -q "$@"
+  # phase 2: dynamic — the threaded suites under the witness; the
+  # session-teardown fixture in conftest.py asserts the lock graph the
+  # run actually executed is acyclic
+  PALLAS_AXON_POOL_IPS= \
+  JAX_PLATFORMS=cpu \
+  XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+  DL4J_LOCK_WITNESS=1 \
+  exec python -m pytest tests/test_serving.py tests/test_serving_http.py \
+    tests/test_serving_replica.py tests/test_autoscale.py -q "$@"
 fi
 
 if [ "${1-}" = "health" ]; then

@@ -35,6 +35,29 @@ def devices():
     return jax.devices()
 
 
+@pytest.fixture(scope="session", autouse=True)
+def _lock_order_witness():
+    """Opt-in runtime lock-order witness (DL4J_LOCK_WITNESS=1).
+
+    Patches threading.Lock/RLock for the whole session so every lock the
+    suites construct records its acquisition order, then asserts at
+    teardown that no two locks were ever taken in both orders — the
+    dynamic complement to the static lock-order rule. Off by default:
+    ./runtests.sh lock turns it on for the threaded serving suites.
+    """
+    if os.environ.get("DL4J_LOCK_WITNESS") != "1":
+        yield
+        return
+    from deeplearning4j_tpu.lint import witness
+    witness.reset()
+    witness.install()
+    try:
+        yield
+    finally:
+        witness.uninstall()
+        witness.assert_acyclic()
+
+
 @pytest.fixture(autouse=True)
 def _compile_cache_isolation(tmp_path, monkeypatch):
     """Point the executable cache at a per-test tmp dir. Without this a

@@ -1,0 +1,570 @@
+"""graftlint concurrency plane: rule fixtures + runtime witness units.
+
+Each of the four rules (lockguard, lock-order, blocking-under-lock,
+thread-lifecycle) gets a true-positive fixture — including the seeded
+race and the two-lock deadlock the plane exists to catch — a negative
+fixture, and a suppressed fixture. The annotation grammar
+(``#: guarded-by:`` / ``#: requires-lock:``) and the parallel runner's
+determinism are covered below; the whole-package clean gate lives in
+test_lint_engine.py and picks these rules up through the registry.
+"""
+import pathlib
+import textwrap
+import threading
+
+import pytest
+
+import deeplearning4j_tpu.lint as lint
+from deeplearning4j_tpu.lint import witness
+
+PKG = pathlib.Path(lint.__file__).resolve().parents[1]
+
+CONCURRENCY_RULES = ["lockguard", "lock-order", "blocking-under-lock",
+                     "thread-lifecycle"]
+
+
+def lint_src(tmp_path, source, name="fixture.py", rules=CONCURRENCY_RULES):
+    f = tmp_path / name
+    f.write_text(textwrap.dedent(source))
+    return lint.run_paths([f], rules)
+
+
+def rules_of(result):
+    return [v.rule for v in result.violations]
+
+
+# ------------------------------------------------------------------ lockguard
+def test_lockguard_seeded_race_flagged(tmp_path):
+    """The seeded race: an attribute the class itself locks in one method,
+    mutated bare in another — and from a Thread target, the worst case."""
+    res = lint_src(tmp_path, """\
+        import threading
+
+        class Counter:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._n = 0
+                t = threading.Thread(target=self._work, daemon=True)
+                t.start()
+
+            def bump(self):
+                with self._lock:
+                    self._n += 1
+
+            def _work(self):
+                self._n += 1
+        """)
+    assert rules_of(res) == ["lockguard"]
+    v = res.violations[0]
+    assert v.line == 15
+    assert "_n" in v.message and "Thread target" in v.message
+
+
+def test_lockguard_negative_consistent_and_init_exempt(tmp_path):
+    res = lint_src(tmp_path, """\
+        import threading
+
+        class Counter:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._n = 0      # construction precedes sharing: exempt
+
+            def bump(self):
+                with self._lock:
+                    self._n += 1
+
+            def reset(self):
+                with self._lock:
+                    self._n = 0
+        """)
+    assert res.violations == []
+
+
+def test_lockguard_guarded_by_annotation_flags_bare_read(tmp_path):
+    res = lint_src(tmp_path, """\
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+                #: guarded-by: _lock
+                self._items = []
+
+            def add(self, x):
+                with self._lock:
+                    self._items.append(x)
+
+            def peek(self):
+                return self._items[-1]
+        """)
+    assert rules_of(res) == ["lockguard"]
+    assert res.violations[0].line == 14
+
+
+def test_lockguard_requires_lock_annotation_negative(tmp_path):
+    """A helper declared ``requires-lock`` is analysed with the lock held:
+    its writes are locked writes, not bare ones."""
+    res = lint_src(tmp_path, """\
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._n = 0
+
+            def bump(self):
+                with self._lock:
+                    self._inc()
+
+            #: requires-lock: _lock
+            def _inc(self):
+                self._n += 1
+        """)
+    assert res.violations == []
+
+
+def test_lockguard_suppressed(tmp_path):
+    res = lint_src(tmp_path, """\
+        import threading
+
+        class Stat:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._hits = 0
+
+            def note(self):
+                with self._lock:
+                    self._hits += 1
+
+            def roughly(self):
+                self._hits += 1  # lint: lockguard-ok (stat is advisory; torn increments tolerated)
+        """)
+    assert res.violations == []
+    assert [v.rule for v in res.suppressed] == ["lockguard"]
+
+
+# ----------------------------------------------------------------- lock-order
+def test_lock_order_two_lock_cycle_flagged(tmp_path):
+    """The seeded deadlock: the same two locks nested in both orders."""
+    res = lint_src(tmp_path, """\
+        import threading
+
+        class Pair:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def fwd(self):
+                with self._a:
+                    with self._b:
+                        pass
+
+            def rev(self):
+                with self._b:
+                    with self._a:
+                        pass
+        """)
+    assert rules_of(res) == ["lock-order"]
+    assert "_a" in res.violations[0].message
+    assert "_b" in res.violations[0].message
+
+
+def test_lock_order_cycle_through_method_call_flagged(tmp_path):
+    """Interprocedural: the inner acquisition hides in a callee."""
+    res = lint_src(tmp_path, """\
+        import threading
+
+        class Pair:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def fwd(self):
+                with self._a:
+                    self._take_b()
+
+            def _take_b(self):
+                with self._b:
+                    pass
+
+            def rev(self):
+                with self._b:
+                    with self._a:
+                        pass
+        """)
+    assert rules_of(res) == ["lock-order"]
+
+
+def test_lock_order_negative_consistent_nesting(tmp_path):
+    res = lint_src(tmp_path, """\
+        import threading
+
+        class Pair:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def one(self):
+                with self._a:
+                    with self._b:
+                        pass
+
+            def two(self):
+                with self._a:
+                    with self._b:
+                        pass
+        """)
+    assert res.violations == []
+
+
+def test_lock_order_self_deadlock_on_plain_lock(tmp_path):
+    """Re-acquiring a non-reentrant Lock you already hold blocks forever."""
+    res = lint_src(tmp_path, """\
+        import threading
+
+        class Oops:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def outer(self):
+                with self._lock:
+                    self.inner()
+
+            def inner(self):
+                with self._lock:
+                    pass
+        """)
+    assert "lock-order" in rules_of(res)
+
+
+def test_lock_order_rlock_reentry_negative(tmp_path):
+    res = lint_src(tmp_path, """\
+        import threading
+
+        class Fine:
+            def __init__(self):
+                self._lock = threading.RLock()
+
+            def outer(self):
+                with self._lock:
+                    self.inner()
+
+            def inner(self):
+                with self._lock:
+                    pass
+        """)
+    assert res.violations == []
+
+
+def test_lock_order_suppressed(tmp_path):
+    res = lint_src(tmp_path, """\
+        import threading
+
+        class Pair:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def fwd(self):
+                with self._a:
+                    # lint: lock-order-ok (rev only runs in the single-threaded teardown path)
+                    with self._b:
+                        pass
+
+            def rev(self):
+                with self._b:
+                    with self._a:
+                        pass
+        """)
+    assert res.violations == []
+    assert [v.rule for v in res.suppressed] == ["lock-order"]
+
+
+# -------------------------------------------------------- blocking-under-lock
+def test_blocking_under_lock_positive(tmp_path):
+    res = lint_src(tmp_path, """\
+        import threading
+        import time
+
+        class Slow:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._q = None
+
+            def nap(self):
+                with self._lock:
+                    time.sleep(0.5)
+
+            def drain(self):
+                with self._lock:
+                    return self._q.get(timeout=1.0)
+        """)
+    assert rules_of(res) == ["blocking-under-lock"] * 2
+    assert {v.line for v in res.violations} == {11, 15}
+
+
+def test_blocking_under_lock_callee_positive(tmp_path):
+    """Depth-1 interprocedural: the sleep hides one call down."""
+    res = lint_src(tmp_path, """\
+        import threading
+        import time
+
+        class Slow:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def nap(self):
+                with self._lock:
+                    self._backoff()
+
+            def _backoff(self):
+                time.sleep(0.5)
+        """)
+    assert rules_of(res) == ["blocking-under-lock"]
+
+
+def test_blocking_under_lock_negative_wait_and_unlocked_sleep(tmp_path):
+    """Condition.wait on your own condition releases the lock — that is
+    the one blocking call that belongs under it. Sleeping outside any
+    lock is also fine."""
+    res = lint_src(tmp_path, """\
+        import threading
+        import time
+
+        class Waiter:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._cond = threading.Condition(self._lock)
+
+            def park(self):
+                with self._cond:
+                    self._cond.wait(timeout=1.0)
+
+            def backoff(self):
+                time.sleep(0.5)
+        """)
+    assert res.violations == []
+
+
+def test_blocking_under_lock_suppressed(tmp_path):
+    res = lint_src(tmp_path, """\
+        import threading
+        import time
+
+        class Slow:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def nap(self):
+                with self._lock:
+                    time.sleep(0.5)  # lint: blocking-under-lock-ok (cold init path, lock is the init serializer)
+        """)
+    assert res.violations == []
+    assert [v.rule for v in res.suppressed] == ["blocking-under-lock"]
+
+
+# ----------------------------------------------------------- thread-lifecycle
+def test_thread_lifecycle_unjoined_undeclared_flagged(tmp_path):
+    res = lint_src(tmp_path, """\
+        import threading
+
+        def spawn(work):
+            t = threading.Thread(target=work)
+            t.start()
+        """)
+    assert rules_of(res) == ["thread-lifecycle"]
+
+
+def test_thread_lifecycle_negatives(tmp_path):
+    res = lint_src(tmp_path, """\
+        import threading
+
+        def daemon_kwarg(work):
+            t = threading.Thread(target=work, daemon=True)
+            t.start()
+
+        def daemon_attr(work):
+            t = threading.Thread(target=work)
+            t.daemon = True
+            t.start()
+
+        def joined(work):
+            t = threading.Thread(target=work)
+            t.start()
+            t.join()
+
+        class Owner:
+            def __init__(self, work):
+                self._t = threading.Thread(target=work)
+                self._t.start()
+
+            def close(self):
+                self._t.join()
+        """)
+    assert res.violations == []
+
+
+def test_thread_lifecycle_suppressed(tmp_path):
+    res = lint_src(tmp_path, """\
+        import threading
+
+        def fire_and_forget(work):
+            # lint: thread-lifecycle-ok (process-lifetime worker; dies with the interpreter by design)
+            t = threading.Thread(target=work)
+            t.start()
+        """)
+    assert res.violations == []
+    assert [v.rule for v in res.suppressed] == ["thread-lifecycle"]
+
+
+# ------------------------------------------------------------ parallel runner
+def test_jobs_output_is_deterministic(tmp_path):
+    """--jobs N must be byte-equivalent to sequential: same violations,
+    same order, same suppressed set, whatever the worker count."""
+    pkg = tmp_path / "fixpkg"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    for i in range(6):
+        (pkg / f"mod{i}.py").write_text(textwrap.dedent(f"""\
+            import threading
+
+            class C{i}:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._n = 0
+
+                def locked(self):
+                    with self._lock:
+                        self._n += 1
+
+                def bare(self):
+                    self._n += {i + 1}
+            """))
+    seq = lint.run_paths([pkg], CONCURRENCY_RULES, jobs=1)
+    par = lint.run_paths([pkg], CONCURRENCY_RULES, jobs=3)
+    assert [v.to_json() for v in seq.violations] \
+        == [v.to_json() for v in par.violations]
+    assert len(seq.violations) == 6
+    assert seq.files_scanned == par.files_scanned == 7
+    assert seq.errors == par.errors == []
+
+
+def test_rule_versions_change_with_rule_source():
+    """The baseline keys suppressions to these hashes — they must be
+    stable within a run and present for every registered rule."""
+    vers = lint.rule_versions()
+    assert set(vers) == set(lint.rule_names())
+    assert all(len(h) == 12 for h in vers.values())
+    assert vers == lint.rule_versions()  # deterministic
+    # distinct rules hash distinctly (sha1 of distinct sources)
+    assert len(set(vers.values())) == len(vers)
+
+
+# ---------------------------------------------------------- runtime witness
+@pytest.fixture()
+def fresh_witness():
+    witness.reset()
+    witness.install()
+    try:
+        yield witness
+    finally:
+        witness.uninstall()
+        witness.reset()
+
+
+def test_witness_records_order_and_passes_when_acyclic(fresh_witness):
+    a = threading.Lock()
+    b = threading.RLock()
+    with a:
+        with b:
+            pass
+    with a:  # same order again: still one edge
+        with b:
+            pass
+    assert len(fresh_witness.edges()) == 1
+    fresh_witness.assert_acyclic()
+
+
+def test_witness_detects_inverted_order(fresh_witness):
+    a = threading.Lock()
+    b = threading.Lock()
+    with a:
+        with b:
+            pass
+    with b:
+        with a:
+            pass
+    assert len(fresh_witness.cycles()) == 1
+    with pytest.raises(AssertionError) as ei:
+        fresh_witness.assert_acyclic()
+    assert "cyclic acquisition order" in str(ei.value)
+
+
+def test_witness_rlock_reentry_is_not_an_edge(fresh_witness):
+    r = threading.RLock()
+    with r:
+        with r:
+            pass
+    assert fresh_witness.edges() == {}
+    fresh_witness.assert_acyclic()
+
+
+def test_witness_condition_wait_roundtrip(fresh_witness):
+    """Condition over a witnessed lock: wait() fully releases (the lock
+    leaves the held stack) and the re-acquire on wake records no edge."""
+    outer = threading.Lock()
+    cond = threading.Condition()  # default RLock comes from the patched factory
+
+    def waker():
+        with cond:
+            cond.notify_all()
+
+    with cond:
+        t = threading.Thread(target=waker)
+        t.start()
+        cond.wait(timeout=5.0)
+        t.join()
+    with outer:  # after the roundtrip the stack must be clean
+        pass
+    assert all(n not in e for e in fresh_witness.edges()
+               for n in ("outer",))
+    fresh_witness.assert_acyclic()
+
+
+def test_witness_cross_thread_edges_merge(fresh_witness):
+    """Edges from different threads land in one graph: thread 1 takes
+    a->b, thread 2 takes b->a, and only the union shows the deadlock."""
+    a = threading.Lock()
+    b = threading.Lock()
+    done = threading.Barrier(2)
+
+    def t1():
+        with a:
+            done.wait()  # hold a until t2 holds b: real lock juggling,
+        done.wait()      # sequenced so the test itself cannot deadlock
+        with a:
+            with b:
+                pass
+
+    def t2():
+        with b:
+            done.wait()
+        done.wait()
+        with b:
+            with a:
+                pass
+
+    th1 = threading.Thread(target=t1)
+    th2 = threading.Thread(target=t2)
+    th1.start(); th2.start()
+    th1.join(); th2.join()
+    assert len(fresh_witness.cycles()) == 1
+
+
+def test_witness_uninstall_restores_real_factories():
+    real_lock, real_rlock = threading.Lock, threading.RLock
+    witness.install()
+    assert threading.Lock is not real_lock
+    witness.uninstall()
+    assert threading.Lock is real_lock
+    assert threading.RLock is real_rlock

@@ -362,3 +362,43 @@ def test_cli_serve_parser():
     assert args.replicas == 4 and args.sharding == "dp_tp"
     assert args.quant == "int8" and args.max_batch == 32
     assert args.name == "default" and args.max_latency_ms == 2.0
+
+
+def test_fleet_reads_race_free_under_churn():
+    """Regression: n_replicas and primary_registry read _replicas bare
+    while remove_replica rebinds the list under _lock. Readers could see
+    a mid-rebind list (or index an empty snapshot during construction of
+    the rebound one). Hammer both read paths while the fleet churns; the
+    primary (index 0) is never removable, so primary_registry must stay
+    valid through every mutation."""
+    rs = ReplicaSet(2, max_batch=4, max_latency_s=0.001, max_queue=8)
+    stop = threading.Event()
+    errors = []
+
+    def reader():
+        try:
+            while not stop.is_set():
+                n = rs.n_replicas
+                assert n >= 1
+                assert rs.primary_registry is rs.replicas[0].registry
+        except Exception as e:  # pragma: no cover - the regression itself
+            errors.append(e)
+
+    readers = [threading.Thread(target=reader) for _ in range(4)]
+    try:
+        for t in readers:
+            t.start()
+        # churn: grow to 4, shrink back to 2, five times over. The empty
+        # catalog keeps add_replica cheap (no programs to warm).
+        for _ in range(5):
+            rs.add_replica(reason="t-churn")
+            rs.add_replica(reason="t-churn")
+            assert rs.remove_replica(reason="t-churn") is True
+            assert rs.remove_replica(reason="t-churn") is True
+    finally:
+        stop.set()
+        for t in readers:
+            t.join()
+        rs.close()
+    assert errors == []
+    assert rs.n_replicas == 2

@@ -683,3 +683,76 @@ def test_tracing_overhead_budget():
         f"2x{child_s * 1e6:.1f}us child + {exemplar_s * 1e6:.1f}us "
         f"exemplar = {overhead * 1e6:.1f}us vs request "
         f"{request_s * 1e3:.2f}ms")
+
+
+# --------------------------------------------- tail-sampler thread safety
+
+def test_tail_sampler_survives_concurrent_finalize(store):
+    """Regression: _finalize used to append to _durs and refresh the p99
+    cache OUTSIDE the store lock. Two request threads finishing together
+    could interleave the check-then-sort-then-cache sequence — and
+    sorted() over a deque that another thread is appending to raises
+    RuntimeError mid-iteration. Hammer enough roots through concurrent
+    threads that the p99 refresh (every 32 finalizes) overlaps appends."""
+    n_threads, n_each = 8, 100
+    start = threading.Barrier(n_threads)
+    errors = []
+
+    def worker():
+        start.wait()
+        try:
+            for _ in range(n_each):
+                with trace_span("hammer.root"):
+                    pass
+        except Exception as e:  # pragma: no cover - the regression itself
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert errors == []
+    # every finalize landed: the duration window saturated its maxlen and
+    # the ring holds exactly its capacity of most-recent traces
+    assert len(store._durs) == store._durs.maxlen
+    assert len(store) == store.capacity
+    assert isinstance(store._p99(), float)
+
+
+def test_labelset_cap_warns_once_under_concurrent_overflow(
+        monkeypatch, caplog):
+    """Regression: the once-a-minute cap warning was a check-then-set on
+    _warned_families outside the registry lock, so N threads hitting the
+    cap together all read `last is None` and all warned. The RMW is now
+    atomic: one warning per family per window, however many racers."""
+    import logging
+
+    monkeypatch.setenv("DL4J_METRICS_MAX_LABELSETS", "1")
+    reg = MetricsRegistry()
+    fam = reg.counter("dl4j_test_warn_once_total")
+    fam.labels(k="keeper").inc()  # occupy the single allowed labelset
+    n_threads = 16
+    start = threading.Barrier(n_threads)
+
+    def overflow(i):
+        start.wait()
+        fam.labels(k=f"spill{i}").inc()
+
+    with caplog.at_level(
+            logging.WARNING,
+            logger="deeplearning4j_tpu.observability.metrics"):
+        threads = [threading.Thread(target=overflow, args=(i,))
+                   for i in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    warned = [r for r in caplog.records
+              if "hit the labelset cap" in r.getMessage()]
+    assert len(warned) == 1
+    # and every overflow was still counted on the drop counter
+    dropped = reg.snapshot()[_n.METRICS_DROPPED_LABELSETS_TOTAL]["series"]
+    mine = [s for s in dropped
+            if s["labels"]["family"] == "dl4j_test_warn_once_total"]
+    assert sum(s["value"] for s in mine) == n_threads
