@@ -52,6 +52,9 @@ from concurrent.futures import Future
 from typing import Dict, List, Optional
 
 from deeplearning4j_tpu.observability import names as _n
+from deeplearning4j_tpu.observability.federation import (
+    global_federation as _global_federation,
+)
 from deeplearning4j_tpu.observability.metrics import global_registry
 from deeplearning4j_tpu.observability.tracing import trace_span
 
@@ -155,6 +158,7 @@ class ReplicaSet:
             for r in self._replicas:
                 r.lease = membership.register(
                     shard=r.index, worker=f"replica-{r.index}")
+                self._fed_note(r)
         self._g_fleet.set(len(self._replicas))
 
     def _placement_for(self, i: int, n_total: Optional[int] = None) -> dict:
@@ -319,6 +323,7 @@ class ReplicaSet:
             if self._membership is not None:
                 r.lease = self._membership.register(
                     shard=idx, worker=f"replica-{idx}")
+                self._fed_note(r)
             with self._lock:
                 self._replicas.append(r)
                 self._routed[idx] = 0
@@ -367,6 +372,7 @@ class ReplicaSet:
             if self._membership is not None and r.lease is not None:
                 self._membership.deregister(
                     r.lease.member, r.lease.epoch, reason=reason)
+                self._fed_retire(r)
             for name in r.registry.names():
                 prev = self._gauge_active.pop((r.index, name), None)
                 if prev is not None:
@@ -375,6 +381,20 @@ class ReplicaSet:
                         version=prev).set(0)
             self._c_scale.labels(direction="in", reason=reason).inc()
             return True
+
+    def _fed_note(self, r: Replica) -> None:
+        """Put the replica's lease on the federation roster (when one is
+        installed): the fleet view labels its series ``replica=<name>`` and
+        /fleet/status lists it with its fencing epoch."""
+        fed = _global_federation()
+        if fed is not None and r.lease is not None:
+            fed.note_member(name=r.lease.name, epoch=r.lease.epoch,
+                            role="replica", member=r.lease.member)
+
+    def _fed_retire(self, r: Replica) -> None:
+        fed = _global_federation()
+        if fed is not None and r.lease is not None:
+            fed.retire_member(r.lease.name, r.lease.epoch)
 
     def heartbeat(self) -> None:
         """Renew the lease of every in-set replica (they share our

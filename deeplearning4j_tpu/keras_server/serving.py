@@ -50,6 +50,10 @@ from urllib.parse import urlparse
 import numpy as np
 
 from deeplearning4j_tpu.observability import names as _n
+from deeplearning4j_tpu.observability.federation import (
+    fleet_metrics_text, fleet_status, register_status_provider,
+    trigger_fleet_dump,
+)
 from deeplearning4j_tpu.observability.metrics import global_registry
 from deeplearning4j_tpu.observability.slo import SLOEngine
 from deeplearning4j_tpu.observability.tracing import (
@@ -136,6 +140,17 @@ class _ServeHandler(BaseHTTPRequestHandler):
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
             self.wfile.write(body)
+        elif path == "/fleet/metrics":
+            # the federated view: every member's series, merged — NOT this
+            # process's registry (that is what /metrics is for)
+            body = fleet_metrics_text().encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "text/plain; version=0.0.4")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+        elif path == "/fleet/status":
+            self._json(fleet_status())
         else:
             self._json({"error": f"unknown route {path}"}, code=404)
 
@@ -165,6 +180,13 @@ class _ServeHandler(BaseHTTPRequestHandler):
                             str(req.get("model", "")),
                             str(req.get("session", "")))
                         self._json({"reset": existed})
+                    elif path == "/fleet/dump":
+                        req = self._body()
+                        bundle = trigger_fleet_dump(
+                            str(req.get("reason", "api")),
+                            force=bool(req.get("force")))
+                        self._json({"ok": bundle is not None,
+                                    "path": bundle})
                     else:
                         self._json({"error": f"unknown route {path}"},
                                    code=404)
@@ -402,6 +424,7 @@ class InferenceServer:
         if self.autoscaler is not None:
             self.autoscaler.start()
         _set_active_server(self)
+        register_status_provider("serving", self.status)
         return self
 
     def register(self, name: str, net, version: Optional[str] = None,
@@ -457,6 +480,7 @@ class InferenceServer:
             return eng
 
     def stop(self) -> None:
+        register_status_provider("serving", None)
         if self.autoscaler is not None:
             self.autoscaler.stop()
         self.slo.stop()

@@ -1210,6 +1210,90 @@ class ReplicaLifecycle(Rule):
                     "hold")
 
 
+# ---------------------------------------------------------------------------
+@register
+class FleetTruth(Rule):
+    """A ``/fleet/*`` surface must serve the FEDERATED view, never a
+    process-local registry read dressed up as fleet-wide truth.
+
+    The whole point of observability/federation.py is that every other
+    process's counters are invisible to a local ``MetricsRegistry``;
+    handing ``global_registry().snapshot()`` (or ``.prometheus_text()``)
+    to a fleet route silently reports one process as if it were the
+    fleet — totals look plausible and are wrong, which is worse than
+    absent. Flagged are local-registry ``snapshot()``/``prometheus_text()``
+    calls in fleet scope: inside a function whose name contains ``fleet``,
+    or inside an ``if``/``elif`` branch whose test compares against a
+    string starting with ``/fleet`` (the route-dispatcher shape). The
+    local ``/metrics`` branch of the same dispatcher stays legal.
+    ``observability/federation.py`` is scoped out — it is the one module
+    allowed to fold the local registry into the merged view (labeled).
+    """
+
+    name = "fleet-truth"
+    description = ("process-local registry snapshot()/prometheus_text() "
+                   "served from a /fleet surface — merge through "
+                   "observability/federation.py instead")
+    exclude = ("*/observability/federation.py",)
+
+    _READS = ("snapshot", "prometheus_text")
+
+    @staticmethod
+    def _is_local_registry_read(call: ast.Call) -> bool:
+        if not isinstance(call.func, ast.Attribute) \
+                or call.func.attr not in FleetTruth._READS:
+            return False
+        base = call.func.value
+        if isinstance(base, ast.Call):
+            name = dotted_name(base.func) or ""
+            return name == "global_registry" \
+                or name.endswith(".global_registry")
+        name = (dotted_name(base) or "").lower()
+        leaf = name.rsplit(".", 1)[-1]
+        # a receiver that names the federation is the fix, not the bug
+        return "registry" in leaf and "fed" not in name \
+            and "fleet" not in name
+
+    @staticmethod
+    def _mentions_fleet_route(node: ast.AST) -> bool:
+        return any(isinstance(n, ast.Constant)
+                   and isinstance(n.value, str)
+                   and n.value.startswith("/fleet")
+                   for n in ast.walk(node))
+
+    def check(self, ctx: FileContext) -> Iterator[Violation]:
+        tree = ctx.tree
+        if tree is None:
+            return
+        flagged = set()
+
+        def flag(scope_nodes, why):
+            for node in scope_nodes:
+                for call in ast.walk(node):
+                    if isinstance(call, ast.Call) \
+                            and self._is_local_registry_read(call) \
+                            and call.lineno not in flagged:
+                        flagged.add(call.lineno)
+                        yield self.violation(
+                            ctx, call.lineno,
+                            f"process-local registry "
+                            f".{call.func.attr}() {why} — one process's "
+                            "series served as fleet truth; go through "
+                            "FederatedRegistry / fleet_metrics_text() "
+                            "(observability/federation.py)")
+
+        for fn in walk_functions(tree):
+            if "fleet" in fn.name.lower():
+                yield from flag(fn.body,
+                                f"inside fleet-scoped {fn.name}()")
+                continue
+            for node in ast.walk(fn):
+                if isinstance(node, ast.If) \
+                        and self._mentions_fleet_route(node.test):
+                    yield from flag(node.body,
+                                    "inside a /fleet route branch")
+
+
 def default_rules() -> List[Rule]:
     """Fresh instances of every registered rule, in registration order."""
     return [cls() for cls in REGISTRY.values()]

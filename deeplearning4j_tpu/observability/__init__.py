@@ -19,6 +19,12 @@ from .tracing import (TraceStore, Span, SpanRef, trace_span, start_span,
                       global_trace_store, set_global_trace_store,
                       TRACEPARENT_HEADER)
 from .slo import SLO, SLOEngine, default_serve_objectives
+from .federation import (FederatedRegistry, MetricsPublisher, FleetCollector,
+                         merge_snapshots, global_federation,
+                         set_global_federation, global_fleet_collector,
+                         set_global_fleet_collector, register_status_provider,
+                         fleet_status, fleet_metrics_text,
+                         trigger_fleet_dump)
 from .listener import TelemetryListener, record_hbm_gauges
 from .flight_recorder import (FlightRecorder, global_recorder,
                               dump_on_unhandled, install_signal_handlers,
@@ -40,6 +46,11 @@ __all__ = [
     "current_span", "parse_traceparent", "format_traceparent",
     "global_trace_store", "set_global_trace_store", "TRACEPARENT_HEADER",
     "SLO", "SLOEngine", "default_serve_objectives",
+    "FederatedRegistry", "MetricsPublisher", "FleetCollector",
+    "merge_snapshots", "global_federation", "set_global_federation",
+    "global_fleet_collector", "set_global_fleet_collector",
+    "register_status_provider", "fleet_status", "fleet_metrics_text",
+    "trigger_fleet_dump",
     "TelemetryListener", "record_hbm_gauges",
     "FlightRecorder", "global_recorder", "dump_on_unhandled",
     "install_signal_handlers", "uninstall_signal_handlers",

@@ -1,0 +1,689 @@
+"""Fleet observability federation: one metrics/trace/flight view per fleet.
+
+Every elastic ps_worker subprocess, serving replica, and broker consumer
+holds its own process-local MetricsRegistry, TraceStore, and flight-recorder
+ring; before this plane the coordinator could see none of them. This module
+is the cross-process half of the observability stack, in the spirit of
+Monarch-style regional aggregation over Dapper-style propagated context:
+
+* **Metrics federation** — workers push periodic, cumulative
+  ``MetricsRegistry.snapshot()`` frames over the PS transport seam
+  (length-prefixed JSON frames, no pickle). The coordinator-side
+  :class:`FederatedRegistry` keeps the latest cumulative snapshot per
+  ``(member, epoch)`` and merges on read: counters summed, histograms merged
+  bucket-wise, gauges last-write. Keying by ``(member, epoch)`` is what
+  makes the algebra safe under churn: a restarted worker registers a new
+  epoch, so its fresh-from-zero counters start a NEW series instead of
+  double-counting into the old one, and the dead epoch's final cumulative
+  values stay in the totals forever (fleet counters are monotonic). A
+  fenced zombie's frames are rejected wholesale — its series stop updating
+  — and a dead member's *gauges* drop out of the export while its counters
+  remain.
+* **Shipping cumulative snapshots, not deltas**, makes the wire loss- and
+  replay-tolerant: a dropped frame only delays the view, a duplicated or
+  reordered frame is discarded by the per-member ``seq`` guard, and the
+  final flush at worker exit makes the fleet totals EXACT (pinned by
+  tests/test_federation.py against a 4-worker elastic run).
+* **Trace federation** — workers drain finalized trace records from their
+  local TraceStore and ship them on the same frames; the coordinator calls
+  :meth:`TraceStore.ingest`, which dedups by span id and re-sorts by wall
+  time, so a worker's ``broker.consume``/``ps.push`` fragment stitches into
+  the coordinator's copy of the same trace id (the cross-process extension
+  of the late-fragment merge).
+* **Fleet flight bundles** — :class:`FleetCollector` assembles the
+  coordinator's recorder ring, every live member's shipped events, and dead
+  workers' last on-disk bundles into one bundle with a single merged
+  timeline ordered by wall timestamp (the best causal order available
+  without a fleet clock).
+
+The ``fleet-truth`` graftlint rule enforces that this module is the ONLY
+place a ``/fleet/*`` surface may read a process-local registry: serving a
+process-local ``snapshot()`` as fleet-wide truth is exactly the bug this
+plane exists to fix.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import os
+import socket
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from . import names as _n
+from .metrics import global_registry, render_prometheus
+
+log = logging.getLogger(__name__)
+
+#: worker-side publish interval (seconds); small enough that a SIGKILL'd
+#: worker loses at most a fraction of a second of fleet-view lag
+INTERVAL_ENV = "DL4J_FED_INTERVAL"
+DEFAULT_INTERVAL_S = 0.25
+
+#: max flight events shipped per frame / read back from a dead bundle —
+#: bounds frame size and fleet-bundle assembly cost
+MAX_EVENTS_PER_FRAME = 512
+
+_LabelKey = Tuple[Tuple[str, str], ...]
+
+
+# ----------------------------------------------------------- merge algebra
+
+def _row_key(row: dict) -> _LabelKey:
+    return tuple(sorted((k, str(v)) for k, v in row["labels"].items()))
+
+
+def _copy_row(row: dict) -> dict:
+    out = dict(row)
+    out["labels"] = dict(row["labels"])
+    if "bucket_counts" in out:
+        out["bucket_counts"] = list(out["bucket_counts"])
+        out["buckets"] = list(out["buckets"])
+    return out
+
+
+def _merge_hist_row(dst: dict, src: dict) -> None:
+    """Bucket-wise histogram merge. Series whose bucket boundaries disagree
+    (a version-skewed member) degrade conservatively: the foreign counts
+    land in ``+Inf`` only, so cumulative ``le`` series never lie low."""
+    dst["sum"] += src["sum"]
+    dst["count"] += src["count"]
+    if list(dst["buckets"]) == list(src["buckets"]):
+        dc, sc = dst["bucket_counts"], src["bucket_counts"]
+        for i in range(len(dc)):
+            dc[i] += sc[i]
+    else:
+        dst["bucket_counts"][-1] += sum(src["bucket_counts"])
+
+
+def merge_snapshots(snapshots: Sequence[dict]) -> dict:
+    """Merge ``MetricsRegistry.snapshot()``-shaped dicts into one: counters
+    summed, histograms merged bucket-wise, gauges last-write (argument
+    order is write order). Associative and order-independent for counters
+    and histograms — pinned in tests/test_federation.py. A family whose
+    type disagrees with an earlier snapshot's (version skew) is skipped."""
+    acc: Dict[str, dict] = {}
+    for snap in snapshots:
+        for name, fam in snap.items():
+            a = acc.get(name)
+            if a is None:
+                a = acc[name] = {"type": fam["type"],
+                                 "help": fam.get("help", ""), "rows": {}}
+            elif a["type"] != fam["type"]:
+                continue
+            for row in fam.get("series", ()):
+                key = _row_key(row)
+                cur = a["rows"].get(key)
+                if cur is None:
+                    a["rows"][key] = _copy_row(row)
+                elif a["type"] == "counter":
+                    cur["value"] += row["value"]
+                elif a["type"] == "gauge":
+                    cur["value"] = row["value"]
+                else:
+                    _merge_hist_row(cur, row)
+    return {name: {"type": a["type"], "help": a["help"],
+                   "series": [a["rows"][k] for k in sorted(a["rows"])]}
+            for name, a in sorted(acc.items())}
+
+
+def tag_snapshot(snapshot: dict, labels: Dict[str, str]) -> dict:
+    """Copy of ``snapshot`` with ``labels`` merged into every series — how
+    the fleet view attributes each member's series (``worker=...,
+    role=...``) before the big merge."""
+    out: Dict[str, dict] = {}
+    for name, fam in snapshot.items():
+        rows = []
+        for row in fam.get("series", ()):
+            r = _copy_row(row)
+            r["labels"].update(labels)
+            rows.append(r)
+        out[name] = {"type": fam["type"], "help": fam.get("help", ""),
+                     "series": rows}
+    return out
+
+
+def strip_gauges(snapshot: dict) -> dict:
+    """Drop gauge families — what happens to a dead/fenced member's
+    snapshot at export time (its counters remain, frozen)."""
+    return {name: fam for name, fam in snapshot.items()
+            if fam["type"] != "gauge"}
+
+
+def _member_label_key(role: str) -> str:
+    return {"worker": "worker", "replica": "replica"}.get(role, "member")
+
+
+# -------------------------------------------------------------- federation
+
+class _Member:
+    """One ``(member, epoch)`` generation's latest cumulative state."""
+
+    __slots__ = ("name", "member", "epoch", "role", "seq", "snapshot",
+                 "events", "fenced", "final", "last_ts", "frames", "bytes")
+
+    def __init__(self, name: str, member: Optional[int], epoch: int,
+                 role: str):
+        self.name = name
+        self.member = member
+        self.epoch = int(epoch)
+        self.role = role
+        self.seq = 0
+        self.snapshot: dict = {}
+        self.events: List[dict] = []
+        self.fenced = False
+        self.final = False
+        self.last_ts = 0.0
+        self.frames = 0
+        self.bytes = 0
+
+
+class FederatedRegistry:
+    """Coordinator-side merge point for member metric/trace/event frames.
+
+    ``validate`` is the PR 13 fencing hook — ``MembershipOracle.validate``
+    (side-effect-free, never renews) — so a zombie whose lease lapsed or
+    was superseded cannot keep writing into the fleet view, mirroring
+    exactly the parameter server's push fencing.
+    """
+
+    #: a member generation whose gauges stay exported this long after its
+    #: last frame even without a validate hook; past it the series is
+    #: presumed dead (a SIGKILL'd worker never sends a final frame)
+    STALE_AFTER_S = 30.0
+
+    def __init__(self, *,
+                 validate: Optional[Callable[[int, int], bool]] = None,
+                 registry=None, trace_store=None, clock=time.time):
+        self._lock = threading.Lock()
+        self._members: Dict[Tuple[str, int], _Member] = {}
+        self.validate = validate
+        self._clock = clock
+        if registry is None:
+            registry = global_registry()
+        self._registry = registry
+        if trace_store is None:
+            # resolve eagerly: constructing the global store is what turns
+            # the trace plane ON (trace_span no-ops while it is unbuilt),
+            # and the coordinator must be tracing BEFORE its first
+            # shard-publish span, not from the first ingested frame
+            from .tracing import global_trace_store
+            trace_store = global_trace_store()
+        self._trace_store = trace_store
+        self._c_frames = registry.counter(
+            _n.FED_FRAMES_TOTAL, "federation frames ingested (by outcome)")
+        self._c_bytes = registry.counter(
+            _n.FED_BYTES_TOTAL, "federation frame payload bytes ingested")
+        self._c_traces = registry.counter(
+            _n.FED_TRACE_RECORDS_TOTAL,
+            "trace records stitched from member frames")
+        self._g_members = registry.gauge(
+            _n.FED_MEMBERS, "member generations known to the federation")
+
+    def _store(self):
+        if self._trace_store is not None:
+            return self._trace_store
+        from .tracing import global_trace_store
+        return global_trace_store()
+
+    # ------------------------------------------------------------- ingest
+    def ingest(self, *, name: str, epoch: int, seq: int, snapshot: dict,
+               member: Optional[int] = None, role: str = "worker",
+               events: Sequence[dict] = (), traces: Sequence[dict] = (),
+               final: bool = False, nbytes: int = 0) -> dict:
+        """Apply one member frame; returns ``{"accepted", "fenced"}``.
+
+        A frame from a fenced ``(member, epoch)`` is rejected wholesale
+        (the zombie's series stop updating at their last accepted values);
+        a frame whose ``seq`` is not newer than the last accepted one is a
+        duplicate/reorder and is discarded.
+        """
+        fenced = False
+        if self.validate is not None and member is not None and not final:
+            fenced = not self.validate(member, epoch)
+        with self._lock:
+            key = (name, int(epoch))
+            m = self._members.get(key)
+            if fenced:
+                if m is not None:
+                    m.fenced = True
+                outcome = "fenced"
+            elif m is not None and seq <= m.seq:
+                outcome = "stale"
+            else:
+                if m is None:
+                    m = self._members[key] = _Member(
+                        name, member, epoch, role)
+                m.seq = int(seq)
+                m.snapshot = snapshot
+                m.final = m.final or bool(final)
+                m.last_ts = self._clock()
+                m.frames += 1
+                m.bytes += int(nbytes)
+                if events:
+                    m.events.extend(events)
+                    del m.events[:-MAX_EVENTS_PER_FRAME]
+                outcome = "accepted"
+            n_members = len(self._members)
+        self._c_frames.labels(outcome=outcome).inc()
+        self._c_bytes.inc(max(0, int(nbytes)))
+        self._g_members.set(n_members)
+        if outcome == "accepted" and traces:
+            self.ingest_traces(traces)
+        return {"accepted": outcome == "accepted", "fenced": fenced}
+
+    def ingest_traces(self, records: Sequence[dict]) -> None:
+        """Stitch member-shipped trace records into the coordinator's
+        TraceStore (span-id-deduped late-fragment merge)."""
+        store = self._store()
+        n = 0
+        for rec in records:
+            if isinstance(rec, dict):
+                store.ingest(rec)
+                n += 1
+        if n:
+            self._c_traces.inc(n)
+
+    def note_member(self, *, name: str, epoch: int, role: str,
+                    member: Optional[int] = None) -> None:
+        """Register a member row without a metrics frame — how in-process
+        members (serving replicas, which share the coordinator registry)
+        appear in the fleet member table."""
+        with self._lock:
+            key = (name, int(epoch))
+            m = self._members.get(key)
+            if m is None:
+                m = self._members[key] = _Member(name, member, epoch, role)
+            m.last_ts = self._clock()
+            n = len(self._members)
+        self._g_members.set(n)
+
+    def retire_member(self, name: str, epoch: int) -> None:
+        """Mark a member generation done (graceful leave / scale-in): its
+        gauges drop from the export, its counters stay."""
+        with self._lock:
+            m = self._members.get((name, int(epoch)))
+            if m is not None:
+                m.final = True
+
+    # -------------------------------------------------------------- reads
+    def _live(self, m: _Member, now: float) -> bool:
+        """Should this generation's *gauges* still be exported?"""
+        if m.fenced or m.final:
+            return False
+        if self.validate is not None and m.member is not None:
+            return self.validate(m.member, m.epoch)
+        return now - m.last_ts <= self.STALE_AFTER_S
+
+    def _member_rows(self) -> List[Tuple[_Member, dict]]:
+        """(member, export-filtered snapshot) pairs in last-update order —
+        the order gauge last-write resolves in."""
+        now = self._clock()
+        with self._lock:
+            members = sorted(self._members.values(),
+                             key=lambda m: (m.last_ts, m.name, m.epoch))
+            out = []
+            for m in members:
+                snap = m.snapshot
+                if snap and not self._live(m, now):
+                    snap = strip_gauges(snap)
+                out.append((m, snap))
+            return out
+
+    def totals(self) -> dict:
+        """The merged fleet snapshot WITHOUT member labels: counter totals
+        across every generation that ever reported (monotonic), gauges from
+        live generations only."""
+        return merge_snapshots([s for _, s in self._member_rows() if s])
+
+    def fleet_snapshot(self, local: bool = True) -> dict:
+        """The labeled fleet view: every member's series tagged
+        ``worker``/``replica``/``member`` + ``role``, the coordinator's own
+        registry included as ``role="coordinator"`` when ``local``."""
+        snaps = []
+        if local:
+            snaps.append(tag_snapshot(
+                self._registry.snapshot(),
+                {"member": f"{socket.gethostname()}-{os.getpid()}",
+                 "role": "coordinator"}))
+        for m, snap in self._member_rows():
+            if not snap:
+                continue
+            snaps.append(tag_snapshot(
+                snap, {_member_label_key(m.role): m.name, "role": m.role}))
+        return merge_snapshots(snaps)
+
+    def prometheus_text(self) -> str:
+        """The ``GET /fleet/metrics`` payload."""
+        return render_prometheus(self.fleet_snapshot())
+
+    def member_events(self) -> Dict[str, List[dict]]:
+        """Each member generation's shipped flight events (for the fleet
+        bundle), keyed ``name@epoch``."""
+        with self._lock:
+            return {f"{m.name}@{m.epoch}": list(m.events)
+                    for m in self._members.values() if m.events}
+
+    def status(self) -> dict:
+        now = self._clock()
+        with self._lock:
+            members = sorted(self._members.values(),
+                             key=lambda m: (m.name, m.epoch))
+            rows = [{
+                "name": m.name, "member": m.member, "epoch": m.epoch,
+                "role": m.role, "seq": m.seq, "frames": m.frames,
+                "bytes": m.bytes, "fenced": m.fenced, "final": m.final,
+                "age_s": round(max(0.0, now - m.last_ts), 3),
+                "live": self._live(m, now),
+            } for m in members]
+        return {"members": rows, "generations": len(rows)}
+
+
+# ----------------------------------------------------- worker-side publish
+
+def _interval_s() -> float:
+    try:
+        return float(os.environ.get(INTERVAL_ENV, DEFAULT_INTERVAL_S))
+    except (TypeError, ValueError):
+        return DEFAULT_INTERVAL_S
+
+
+class MetricsPublisher:
+    """Worker-side federation pump: a daemon thread that ships cumulative
+    registry snapshots + new flight events + newly-finalized trace records
+    over ``transport.push_metrics`` every ``interval_s``.
+
+    The transport handed in must be the publisher's OWN connection
+    (``transport.clone()`` for TCP — the base connection is single-threaded
+    by contract). ``stop(final=True)`` joins the thread and then runs one
+    last flush from the calling thread, which is what makes fleet counter
+    totals exact at worker exit: the final frame carries the complete
+    cumulative snapshot, and cumulative-replace semantics make it idempotent.
+    """
+
+    def __init__(self, transport, *, name: str, role: str = "worker",
+                 interval_s: Optional[float] = None, registry=None,
+                 recorder=None, trace_store=None):
+        self._transport = transport
+        self.name = name
+        self.role = role
+        self.interval_s = _interval_s() if interval_s is None \
+            else float(interval_s)
+        self._registry = registry if registry is not None \
+            else global_registry()
+        self._recorder = recorder
+        if trace_store is None:
+            # same eager resolution as FederatedRegistry: a process running
+            # a publisher is part of the fleet trace plane, so build the
+            # global store now — before the worker's first broker.consume
+            from .tracing import global_trace_store
+            trace_store = global_trace_store()
+        self._trace_store = trace_store
+        self._seq = 0
+        self._ev_ts = 0.0
+        self._trace_cursor = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.frames_sent = 0
+        self.fenced = False
+        self._s_publish = self._registry.histogram(
+            _n.FED_PUBLISH_SECONDS,
+            "wall seconds per federation publish flush").labels()
+
+    def _recorder_events(self) -> List[dict]:
+        rec = self._recorder
+        if rec is None:
+            from .flight_recorder import global_recorder
+            rec = self._recorder = global_recorder()
+        evs = [e for e in rec.snapshot() if e.get("ts", 0.0) > self._ev_ts]
+        evs = evs[-MAX_EVENTS_PER_FRAME:]
+        if evs:
+            self._ev_ts = evs[-1].get("ts", self._ev_ts)
+        return evs
+
+    def _traces(self) -> List[dict]:
+        store = self._trace_store
+        if store is None:
+            from .tracing import global_trace_store
+            store = self._trace_store = global_trace_store()
+        cursor, recs = store.drain_finished(self._trace_cursor)
+        self._trace_cursor = cursor
+        return recs
+
+    def flush(self, final: bool = False) -> bool:
+        """One publish frame; returns False when the transport declined
+        (older coordinator) or the frame bounced. Cursor state only
+        advances on success, so a failed flush retries everything."""
+        t0 = time.perf_counter()
+        snap = self._registry.snapshot()
+        events = self._recorder_events()
+        traces = self._traces()
+        self._seq += 1
+        try:
+            res = self._transport.push_metrics(
+                snap, seq=self._seq, name=self.name, role=self.role,
+                events=events, traces=traces, final=final)
+        except Exception as e:
+            log.debug("federation publish failed: %r", e)
+            res = None
+        self._s_publish.observe(time.perf_counter() - t0)
+        if not res or not res.get("accepted"):
+            self.fenced = bool(res and res.get("fenced"))
+            return False
+        self.frames_sent += 1
+        return True
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            self.flush()
+
+    def start(self) -> "MetricsPublisher":
+        self._thread = threading.Thread(
+            target=self._run, name="dl4j-fed-publisher", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self, final: bool = True, timeout: float = 5.0) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout)
+            self._thread = None
+        if final:
+            self.flush(final=True)
+
+
+# ------------------------------------------------------------ fleet bundle
+
+class FleetCollector:
+    """Assembles ONE diagnostic bundle for the whole fleet: the
+    coordinator's recorder ring, every member's federation-shipped events,
+    and the last on-disk bundle of each dead worker pid found under the
+    shared recorder dump dir (which is why elastic ships
+    ``DL4J_FLIGHT_RECORDER_DIR`` into child env). The merged timeline is
+    ordered by wall timestamp — the only causal order available across
+    hosts without a fleet clock — with each line tagged by source."""
+
+    def __init__(self, *, federation: Optional[FederatedRegistry] = None,
+                 recorder=None, dir: Optional[str] = None,
+                 min_interval_s: float = 5.0, registry=None):
+        if recorder is None:
+            from .flight_recorder import global_recorder
+            recorder = global_recorder()
+        self.recorder = recorder
+        self.federation = federation
+        self.dir = dir
+        self.min_interval_s = float(min_interval_s)
+        self._lock = threading.Lock()
+        self._last_dump = 0.0
+        self._seq = 0
+        self._c_dumps = (registry or global_registry()).counter(
+            _n.FLEET_DUMPS_TOTAL, "fleet flight bundles written (by reason)")
+
+    def _dead_bundle_events(self, base: str) -> List[dict]:
+        """Newest bundle per foreign pid, its events tagged by source."""
+        newest: Dict[int, dict] = {}
+        for m in self.recorder.list_bundles(base):
+            pid = m.get("pid")
+            if pid is None or pid == os.getpid():
+                continue
+            if str(os.path.basename(m.get("path", ""))).startswith("fleet-"):
+                continue
+            if pid not in newest:  # list_bundles is newest-first
+                newest[pid] = m
+        out: List[dict] = []
+        for pid, m in newest.items():
+            src = f"bundle:{os.path.basename(m['path'])}"
+            try:
+                with open(os.path.join(m["path"], "events.jsonl")) as f:
+                    lines = f.readlines()[-MAX_EVENTS_PER_FRAME:]
+            except OSError:
+                continue
+            for line in lines:
+                try:
+                    ev = json.loads(line)
+                except ValueError:
+                    continue
+                ev["source"] = src
+                out.append(ev)
+        return out
+
+    def dump(self, reason: str = "manual",
+             force: bool = False) -> Optional[str]:
+        """Write the fleet bundle; returns its path, or None when no dump
+        dir is configured or the rate limit holds (trigger sites — shard
+        handoff, SLO alert edges — are then free no-ops)."""
+        base = self.dir or self.recorder.dump_dir
+        if base is None:
+            return None
+        now = time.time()
+        with self._lock:
+            if not force and now - self._last_dump < self.min_interval_s:
+                return None
+            self._last_dump = now
+            self._seq += 1
+            seq = self._seq
+        timeline: List[dict] = []
+        for ev in self.recorder.snapshot():
+            e = dict(ev)
+            e["source"] = "coordinator"
+            timeline.append(e)
+        member_events = self.federation.member_events() \
+            if self.federation is not None else {}
+        for src, evs in member_events.items():
+            for ev in evs:
+                e = dict(ev)
+                e["source"] = src
+                timeline.append(e)
+        timeline.extend(self._dead_bundle_events(base))
+        timeline.sort(key=lambda e: (e.get("ts", 0.0),
+                                     str(e.get("source", ""))))
+        stamp = time.strftime("%Y%m%d-%H%M%S")
+        name = f"fleet-{stamp}-p{os.getpid()}-{seq:03d}"
+        path = os.path.join(base, name)
+        try:
+            os.makedirs(path, exist_ok=True)
+            with open(os.path.join(path, "merged_timeline.jsonl"), "w") as f:
+                for ev in timeline:
+                    f.write(json.dumps(ev, default=repr) + "\n")
+            files = ["merged_timeline.jsonl"]
+
+            def write_json(fname, obj):
+                with open(os.path.join(path, fname), "w") as f:
+                    json.dump(obj, f, indent=2, default=repr)
+                    f.write("\n")
+                files.append(fname)
+
+            if self.federation is not None:
+                write_json("metrics.json", self.federation.totals())
+                write_json("status.json", self.federation.status())
+            write_json("manifest.json", {
+                "reason": reason, "ts": now, "pid": os.getpid(),
+                "fleet": True, "events": len(timeline),
+                "sources": sorted({e["source"] for e in timeline}),
+                "files": files + ["manifest.json"],
+            })
+        except OSError as e:
+            log.error("fleet collector could not write bundle %s: %r",
+                      path, e)
+            return None
+        self._c_dumps.labels(reason=reason).inc()
+        log.warning("fleet collector: wrote bundle %s (%s)", path, reason)
+        return path
+
+
+# ----------------------------------------------------------------- globals
+
+_FED: Optional[FederatedRegistry] = None
+_COLLECTOR: Optional[FleetCollector] = None
+_PROVIDERS: Dict[str, Callable[[], Any]] = {}
+_GLOBALS_LOCK = threading.Lock()
+
+
+def global_federation() -> Optional[FederatedRegistry]:
+    return _FED
+
+
+def set_global_federation(fed: Optional[FederatedRegistry]) -> None:
+    global _FED
+    _FED = fed
+
+
+def global_fleet_collector() -> Optional[FleetCollector]:
+    return _COLLECTOR
+
+
+def set_global_fleet_collector(col: Optional[FleetCollector]) -> None:
+    global _COLLECTOR
+    _COLLECTOR = col
+
+
+def register_status_provider(name: str,
+                             fn: Optional[Callable[[], Any]]) -> None:
+    """Attach a named block to ``/fleet/status`` (elastic stats, the
+    serving fleet, the autoscaler). ``None`` unregisters."""
+    with _GLOBALS_LOCK:
+        if fn is None:
+            _PROVIDERS.pop(name, None)
+        else:
+            _PROVIDERS[name] = fn
+
+
+def fleet_status() -> dict:
+    """The ``GET /fleet/status`` payload: the federation member table plus
+    every registered subsystem block."""
+    fed = _FED
+    out: Dict[str, Any] = {
+        "federation": fed.status() if fed is not None else None}
+    with _GLOBALS_LOCK:
+        providers = dict(_PROVIDERS)
+    for name, fn in sorted(providers.items()):
+        try:
+            out[name] = fn()
+        except Exception as e:  # one sick subsystem must not 500 the page
+            out[name] = {"error": repr(e)}
+    return out
+
+
+def fleet_metrics_text() -> str:
+    """The ``GET /fleet/metrics`` payload. With no federation running this
+    degrades to an HONEST single-member fleet — the local registry labeled
+    as this one process — never an unlabeled local snapshot masquerading
+    as fleet truth."""
+    fed = _FED
+    if fed is not None:
+        return fed.prometheus_text()
+    snap = tag_snapshot(
+        global_registry().snapshot(),
+        {"member": f"{socket.gethostname()}-{os.getpid()}", "role": "local"})
+    return render_prometheus(snap)
+
+
+def trigger_fleet_dump(reason: str, force: bool = False) -> Optional[str]:
+    """Fire the global fleet collector if one is installed — the hook the
+    SLO alert edge, the elastic shard-handoff path, and the explicit
+    ``/fleet/dump`` API all call."""
+    col = _COLLECTOR
+    if col is None:
+        return None
+    return col.dump(reason=reason, force=force)

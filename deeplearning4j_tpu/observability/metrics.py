@@ -276,37 +276,12 @@ class MetricsRegistry:
     def prometheus_text(self) -> str:
         """Prometheus text exposition format (the ``/metrics`` payload):
         ``# HELP`` / ``# TYPE`` headers, histogram ``_bucket``/``_sum``/
-        ``_count`` expansion with cumulative ``le`` labels."""
-        lines: List[str] = []
-        with self._lock:
-            for name in sorted(self._families):
-                fam = self._families[name]
-                if not fam._series:
-                    continue
-                if fam.help:
-                    lines.append(f"# HELP {name} {fam.help}")
-                lines.append(f"# TYPE {name} {fam.type}")
-                for key in sorted(fam._series):
-                    s = fam._series[key]
-                    if fam.type == "histogram":
-                        cum = 0
-                        for i, le in enumerate(fam.buckets):
-                            cum += s.bucket_counts[i]
-                            lbl = self._fmt_labels(key,
-                                                   (("le", f"{le:g}"),))
-                            lines.append(f"{name}_bucket{lbl} {cum}")
-                        cum += s.bucket_counts[-1]
-                        lbl = self._fmt_labels(key, (("le", "+Inf"),))
-                        lines.append(f"{name}_bucket{lbl} {cum}")
-                        lbl = self._fmt_labels(key)
-                        lines.append(f"{name}_sum{lbl} "
-                                     f"{self._fmt_value(s.sum)}")
-                        lines.append(f"{name}_count{lbl} {s.count}")
-                    else:
-                        lbl = self._fmt_labels(key)
-                        lines.append(f"{name}{lbl} "
-                                     f"{self._fmt_value(s.value)}")
-        return "\n".join(lines) + ("\n" if lines else "")
+        ``_count`` expansion with cumulative ``le`` labels.
+
+        Rendering goes through :func:`render_prometheus` over ``snapshot()``
+        — the same path the fleet federation uses to render merged remote
+        snapshots — so local and federated exposition can never drift."""
+        return render_prometheus(self.snapshot())
 
     def snapshot(self) -> dict:
         """JSON-ready dump of every series (the ``/train/telemetry/data``
@@ -338,6 +313,48 @@ class MetricsRegistry:
         rec = {"ts": time.time(), **meta, "metrics": self.snapshot()}
         with open(path, "a") as f:
             f.write(json.dumps(rec) + "\n")
+
+
+def render_prometheus(snapshot: dict,
+                      extra_labels: Optional[Dict[str, str]] = None) -> str:
+    """Render a ``MetricsRegistry.snapshot()``-shaped dict as Prometheus
+    text exposition. ``extra_labels`` (e.g. ``{"worker": ..., "role": ...}``)
+    are appended to every series — how the federation tags each member's
+    series in the fleet view. Works on any snapshot dict, local or one that
+    crossed the wire as JSON."""
+    extra = tuple(sorted((k, str(v)) for k, v in (extra_labels or {}).items()))
+    fmt_labels = MetricsRegistry._fmt_labels
+    fmt_value = MetricsRegistry._fmt_value
+    lines: List[str] = []
+    for name in sorted(snapshot):
+        fam = snapshot[name]
+        series = fam.get("series") or []
+        if not series:
+            continue
+        if fam.get("help"):
+            lines.append(f"# HELP {name} {fam['help']}")
+        lines.append(f"# TYPE {name} {fam['type']}")
+        for row in sorted(series,
+                          key=lambda r: sorted(r["labels"].items())):
+            key = tuple(sorted(
+                (k, str(v)) for k, v in row["labels"].items())) + extra
+            if fam["type"] == "histogram":
+                cum = 0
+                counts = row["bucket_counts"]
+                for i, le in enumerate(row["buckets"]):
+                    cum += counts[i]
+                    lbl = fmt_labels(key, (("le", f"{le:g}"),))
+                    lines.append(f"{name}_bucket{lbl} {cum}")
+                cum += counts[-1]
+                lbl = fmt_labels(key, (("le", "+Inf"),))
+                lines.append(f"{name}_bucket{lbl} {cum}")
+                lbl = fmt_labels(key)
+                lines.append(f"{name}_sum{lbl} {fmt_value(row['sum'])}")
+                lines.append(f"{name}_count{lbl} {row['count']}")
+            else:
+                lbl = fmt_labels(key)
+                lines.append(f"{name}{lbl} {fmt_value(row['value'])}")
+    return "\n".join(lines) + ("\n" if lines else "")
 
 
 _GLOBAL = MetricsRegistry()

@@ -162,6 +162,14 @@ SLO_ALERTS_TOTAL = "dl4j_slo_alerts_total"
 # --- metrics registry self-protection (observability/metrics.py) -----------
 METRICS_DROPPED_LABELSETS_TOTAL = "dl4j_metrics_dropped_labelsets_total"
 
+# --- fleet observability federation (observability/federation.py) ----------
+FED_FRAMES_TOTAL = "dl4j_fed_frames_total"
+FED_BYTES_TOTAL = "dl4j_fed_bytes_total"
+FED_MEMBERS = "dl4j_fed_members"
+FED_TRACE_RECORDS_TOTAL = "dl4j_fed_trace_records_total"
+FED_PUBLISH_SECONDS = "dl4j_fed_publish_seconds"
+FLEET_DUMPS_TOTAL = "dl4j_fleet_dumps_total"
+
 # --- input pipeline (datasets/prefetch.py) ---------------------------------
 PREFETCH_DEPTH = "dl4j_prefetch_depth"
 PREFETCH_BYTES_TOTAL = "dl4j_prefetch_bytes_total"

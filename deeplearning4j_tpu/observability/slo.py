@@ -294,6 +294,14 @@ class SLOEngine:
                 reason=f"slo-burn-{slo.name}", extra={"slo": entry})
         except Exception:  # lint: swallowed-exception-ok (an alarm dump must never take down the serve path)
             pass
+        try:
+            # when a fleet collector is installed, the burn also snapshots
+            # every member's ring — the alert usually started elsewhere
+            from deeplearning4j_tpu.observability.federation import (
+                trigger_fleet_dump)
+            trigger_fleet_dump(f"slo-burn-{slo.name}")
+        except Exception:  # lint: swallowed-exception-ok (an alarm dump must never take down the serve path)
+            pass
 
     # -- background ticker ---------------------------------------------
     def start(self, interval_s: float = 5.0) -> "SLOEngine":

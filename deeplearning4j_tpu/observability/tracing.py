@@ -375,6 +375,10 @@ class TraceStore:
                            for r in ("sampled_out", "live_overflow")}
         self._sg_live = self._g_live.labels()
         self._index_failed = False
+        #: monotonic finalize counter; every record entering the ring gets
+        #: the next value so drain_finished() can ship "new since seq N"
+        #: to the fleet federation without re-sending the whole ring
+        self._seq = 0  #: guarded-by: _lock
 
     # -- span bookkeeping ----------------------------------------------
     def _open(self, span: Span) -> None:
@@ -464,6 +468,8 @@ class TraceStore:
                 prior["spans"] = merged
                 prior["n_spans"] = len(merged)
                 record = prior
+            self._seq += 1
+            record["seq"] = self._seq
             self._ring[trace_id] = record
             while len(self._ring) > self.capacity:
                 self._ring.popitem(last=False)
@@ -532,6 +538,56 @@ class TraceStore:
     def __len__(self) -> int:
         with self._lock:
             return len(self._ring)
+
+    # -- federation (cross-process stitch) ------------------------------
+    def drain_finished(self, after_seq: int = 0) -> Tuple[int, List[dict]]:
+        """Records finalized since ``after_seq`` (the federation export
+        cursor), plus the new cursor. A late-fragment merge re-stamps its
+        record with a fresh seq, so a trace that grew after its first ship
+        ships again — the receiving :meth:`ingest` dedups by span id."""
+        with self._lock:
+            recs = [dict(r) for r in self._ring.values()
+                    if r.get("seq", 0) > after_seq]
+            return self._seq, recs
+
+    def ingest(self, record: dict) -> None:
+        """Merge a finalized trace record from ANOTHER process into this
+        store — the cross-process half of the late-fragment merge. A
+        worker's ``broker.consume``/``ps.push`` fragment lands on the
+        coordinator's copy of the same trace id: spans are deduped by span
+        id and re-sorted by wall ``ts`` (mono clocks do not compare across
+        processes), and the summary row (root, status, dur) is recomputed
+        over the union so ``/serve/traces`` shows one stitched tree."""
+        spans = list(record.get("spans") or ())
+        trace_id = record.get("trace_id")
+        if not trace_id or not spans:
+            return
+        with self._lock:
+            prior = self._ring.pop(trace_id, None)
+            if prior is not None:
+                seen = {s.get("span_id") for s in prior["spans"]}
+                spans = [s for s in spans if s.get("span_id") not in seen]
+                merged = prior["spans"] + spans
+                rec = dict(prior)
+            else:
+                merged = spans
+                rec = {"trace_id": trace_id,
+                       "keep_reason": record.get("keep_reason", "ingested")}
+            merged.sort(key=lambda s: s.get("ts", 0.0))
+            root = next((s for s in merged if s.get("parent_id") is None),
+                        merged[0])
+            rec["spans"] = merged
+            rec["n_spans"] = len(merged)
+            rec["root"] = root.get("name", "?")
+            rec["ts"] = root.get("ts", 0.0)
+            rec["dur_s"] = root.get("dur_s", 0.0)
+            rec["status"] = "error" if any(
+                s.get("status") != "ok" for s in merged) else "ok"
+            self._seq += 1
+            rec["seq"] = self._seq
+            self._ring[trace_id] = rec
+            while len(self._ring) > self.capacity:
+                self._ring.popitem(last=False)
 
     # -- exemplars ------------------------------------------------------
     def put_exemplar(self, metric: str, value: float,

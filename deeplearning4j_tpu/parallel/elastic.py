@@ -47,6 +47,10 @@ from typing import Dict, List, Optional, Sequence
 import numpy as np
 
 from deeplearning4j_tpu.cloud import MembershipOracle
+from deeplearning4j_tpu.observability.federation import (
+    FederatedRegistry, FleetCollector, register_status_provider,
+    set_global_federation, set_global_fleet_collector,
+)
 from deeplearning4j_tpu.observability.flight_recorder import (
     dump_on_unhandled as _dump_on_unhandled,
     global_recorder as _flight_recorder,
@@ -55,6 +59,9 @@ from deeplearning4j_tpu.observability.metrics import (
     global_registry as _obs_registry,
 )
 from deeplearning4j_tpu.observability.names import ELASTIC_HANDOFFS_TOTAL
+from deeplearning4j_tpu.observability.tracing import (
+    trace_span as _trace_span,
+)
 from deeplearning4j_tpu.observability.watchdog import beat as _wd_beat
 from deeplearning4j_tpu.parallel.param_server import (
     DEFAULT_STALENESS_CAP, ParameterServer, unflatten_tree,
@@ -126,6 +133,8 @@ class ElasticTrainer:
         self.fit_timeout_s = float(fit_timeout_s)
         self.server: Optional[ParameterServer] = None
         self.oracle: Optional[MembershipOracle] = None
+        self.federation: Optional[FederatedRegistry] = None
+        self.collector: Optional[FleetCollector] = None
         self.worker_stats: List[dict] = []
         self.published = 0
         self.restored_from_checkpoint = False
@@ -214,7 +223,17 @@ class ElasticTrainer:
             self.model.params_list, staleness_cap=self.staleness,
             optimizer=self.server_optimizer, server_lr=self.server_lr,
             membership=self.oracle)
-        frontend = ParameterServerTcpFrontend(self.server).start()
+        # fleet observability plane: workers push cumulative metric frames
+        # over the same PS seam; the oracle's side-effect-free validate
+        # fences a zombie's frames exactly like its deltas
+        self.federation = FederatedRegistry(validate=self.oracle.validate)
+        self.collector = FleetCollector(federation=self.federation)
+        set_global_federation(self.federation)
+        set_global_fleet_collector(self.collector)
+        register_status_provider("elastic", lambda: self.stats)
+        frontend = ParameterServerTcpFrontend(
+            self.server, federation=self.federation,
+            collector=self.collector).start()
         broker = LoopbackBroker().start()
         self._ps_port, self._broker_port = frontend.port, broker.port
         saver = None
@@ -274,16 +293,21 @@ class ElasticTrainer:
         producer = BrokerProducer(broker.address)
         try:
             for shard in self._shards:
-                for ds in batches[shard.shard::self.workers]:
-                    producer.publish(
-                        shard.topic,
-                        {"x": np.asarray(ds.features),  # lint: host-sync-in-hot-loop-ok (one-time shard publication before workers spawn, not a train loop)
-                         "y": np.asarray(ds.labels)})  # lint: host-sync-in-hot-loop-ok (one-time shard publication before workers spawn, not a train loop)
-                    self.published += 1
-                # the fin marker closes the shard: a group whose committed
-                # offset reaches it has consumed every sample at least once
-                shard.fin_offset = producer.publish(
-                    shard.topic, {}, meta={"fin": True})
+                # one trace root per shard: every message carries this
+                # span's traceparent, so consume + push stitch under it
+                with _trace_span("shard.publish", topic=shard.topic,
+                                 shard=shard.shard):
+                    for ds in batches[shard.shard::self.workers]:
+                        producer.publish(
+                            shard.topic,
+                            {"x": np.asarray(ds.features),  # lint: host-sync-in-hot-loop-ok (one-time shard publication before workers spawn, not a train loop)
+                             "y": np.asarray(ds.labels)})  # lint: host-sync-in-hot-loop-ok (one-time shard publication before workers spawn, not a train loop)
+                        self.published += 1
+                    # the fin marker closes the shard: a group whose
+                    # committed offset reaches it has consumed every sample
+                    # at least once
+                    shard.fin_offset = producer.publish(
+                        shard.topic, {}, meta={"fin": True})
         finally:
             producer.close()
 
@@ -306,6 +330,13 @@ class ElasticTrainer:
             # generation shares it: gen-0 writes the step executable,
             # a respawned replacement warm-loads it and skips XLA
             env["DL4J_COMPILE_CACHE_DIR"] = compile_cache.cache_dir()
+        rec = _flight_recorder()
+        if rec.dump_dir:
+            # same pinning for the flight-recorder dir: a set_dump_dir()
+            # call on the coordinator never reaches os.environ, so without
+            # this a dead worker's last bundle lands nowhere the fleet
+            # collector can find it
+            env["DL4J_FLIGHT_RECORDER_DIR"] = rec.dump_dir
         self._env_conf = {"env": env, "conf": conf_path}
 
     def _delay(self, shard: int) -> float:
@@ -406,6 +437,10 @@ class ElasticTrainer:
             _flight_recorder().record(
                 "shard_handoff", shard=shard.shard, gen=shard.gen,
                 committed=committed, fin=shard.fin_offset, rc=rc)
+            if self.collector is not None:
+                # a handoff is exactly the moment one process's ring is not
+                # enough: capture the whole fleet's view of the death
+                self.collector.dump(reason="shard-handoff")
             self._spawn(shard)
             return
         raise RuntimeError(

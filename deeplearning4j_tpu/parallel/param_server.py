@@ -59,6 +59,10 @@ from deeplearning4j_tpu.observability.names import (
     ELASTIC_FENCED_PUSHES_TOTAL, PS_PULLS_TOTAL, PS_PUSHES_TOTAL,
     PS_PUSH_WEIGHT, PS_STALENESS, PS_VERSION, PS_WORKER_STEPS_TOTAL,
 )
+from deeplearning4j_tpu.observability.tracing import (
+    current_span as _current_span,
+    trace_span as _trace_span,
+)
 from deeplearning4j_tpu.observability.watchdog import beat as _wd_beat
 
 #: default hard staleness bound: a push based >8 versions back is rejected
@@ -404,6 +408,18 @@ def run_worker_loop(*, transport, replica, step_fn, next_batch,
         puller.request()
 
     def _push_window() -> None:
+        # nest the window's push RPC(s) under one span parented by the
+        # batch's consume span (bound on the transport by the elastic
+        # worker); with no parent, open no span — a static worker would
+        # only mint root-trace noise
+        parent = _current_span() or getattr(transport, "trace_parent", None)
+        if parent is None:
+            return _push_window_inner()
+        with _trace_span("ps.push_window", parent=parent,
+                         worker=str(worker_id)):
+            return _push_window_inner()
+
+    def _push_window_inner() -> None:
         nonlocal version, base_vec, steps_since_push, pushes, rejected
         local, _ = flatten_tree(replica.params_list)
         delta = local - base_vec

@@ -48,6 +48,11 @@ from deeplearning4j_tpu.observability.metrics import (
 from deeplearning4j_tpu.observability.names import (
     PS_WIRE_BYTES_TOTAL, SHM_BYTES_TOTAL, SHM_REAPED_TOTAL, SHM_SEGMENTS,
 )
+from deeplearning4j_tpu.observability.tracing import (
+    current_span as _current_span,
+    parse_traceparent as _parse_traceparent,
+    start_span as _start_span,
+)
 from deeplearning4j_tpu.observability.watchdog import beat as _wd_beat
 from deeplearning4j_tpu.parallel.param_server import (
     ParameterServer, PushResult,
@@ -292,23 +297,107 @@ class Transport:
     def deregister(self, reason: str = "done") -> bool:
         raise NotImplementedError
 
+    # ----------------------------------------------------- tracing (fleet)
+    def bind_trace_parent(self, ref) -> None:
+        """Attach the SpanRef subsequent pushes/pulls parent under when no
+        ambient span is set — the worker run loop binds the consume span of
+        the batch it is training on, so the push stitches into the
+        producer's trace (None clears)."""
+        self._trace_ref = ref
+
+    @property
+    def trace_parent(self):
+        return getattr(self, "_trace_ref", None)
+
+    def _traced(self, name: str, header: dict, **attrs):
+        """Open a span for one RPC and stamp its ``traceparent`` onto the
+        frame header; the server side parents its handling span from the
+        header, which is how one trace id crosses the process boundary.
+        Parentless RPCs (the background puller, the heartbeat) carry no
+        header and open no span — they would only mint root-trace noise.
+        Returns the span (possibly the no-op); caller finishes it."""
+        parent = _current_span() or self.trace_parent
+        if parent is None:
+            from deeplearning4j_tpu.observability.tracing import NOOP_SPAN
+            return NOOP_SPAN
+        sp = _start_span(name, parent=parent, **attrs)
+        tp = sp.traceparent()
+        if tp:
+            header["traceparent"] = tp
+        return sp
+
+    # --------------------------------------------- federation (fleet obs)
+    def push_metrics(self, snapshot: dict, *, seq: int, name: str = "",
+                     role: str = "worker", events=(), traces=(),
+                     final: bool = False) -> Optional[dict]:
+        """Ship one cumulative metrics/events/traces frame to the
+        coordinator's FederatedRegistry; returns its ``{"accepted",
+        "fenced"}`` reply, or None when this transport (or the peer) has no
+        federation — publishing degrades to a no-op, never an error."""
+        return None
+
+    def push_trace(self, records) -> Optional[dict]:
+        """Ship finalized trace records alone (the metrics frame normally
+        carries them; this is the standalone hook for tooling)."""
+        return None
+
+    def dump_fleet(self, reason: str = "api",
+                   force: bool = False) -> Optional[str]:
+        """Ask the coordinator to write a fleet flight bundle; returns its
+        path (None when unsupported or rate-limited)."""
+        return None
+
     def close(self) -> None:
         pass
 
 
 class InprocTransport(Transport):
-    def __init__(self, server: ParameterServer):
+    def __init__(self, server: ParameterServer, federation=None,
+                 collector=None):
         self._server = server
+        self.federation = federation
+        self.collector = collector
 
     def pull(self) -> Tuple[int, np.ndarray]:
         return self._server.pull_flat()
 
     def push(self, delta: np.ndarray, base_version: int) -> PushResult:
+        sp = self._traced("ps.push", {}, transport="inproc")
+        try:
+            ident = self.member_identity
+            if ident is None:
+                res = self._server.push_delta(delta, base_version)
+            else:
+                res = self._server.push_delta(delta, base_version,
+                                              member=ident[0],
+                                              epoch=ident[1])
+            sp.set_attr(accepted=res.accepted, version=res.version)
+            return res
+        finally:
+            sp.finish()
+
+    def push_metrics(self, snapshot: dict, *, seq: int, name: str = "",
+                     role: str = "worker", events=(), traces=(),
+                     final: bool = False) -> Optional[dict]:
+        if self.federation is None:
+            return None
         ident = self.member_identity
-        if ident is None:
-            return self._server.push_delta(delta, base_version)
-        return self._server.push_delta(delta, base_version,
-                                       member=ident[0], epoch=ident[1])
+        return self.federation.ingest(
+            name=name, epoch=ident[1] if ident else 0,
+            member=ident[0] if ident else None, role=role, seq=seq,
+            snapshot=snapshot, events=events, traces=traces, final=final)
+
+    def push_trace(self, records) -> Optional[dict]:
+        if self.federation is None:
+            return None
+        self.federation.ingest_traces(records)
+        return {"ok": True}
+
+    def dump_fleet(self, reason: str = "api",
+                   force: bool = False) -> Optional[str]:
+        if self.collector is None:
+            return None
+        return self.collector.dump(reason=reason, force=force)
 
     def _membership(self):
         oracle = self._server.membership
@@ -409,12 +498,18 @@ class TcpTransport(Transport):
 
     # ------------------------------------------------------------- core API
     def pull(self) -> Tuple[int, np.ndarray]:
-        with self._lock:
-            # lint: blocking-under-lock-ok (the transport lock IS the RPC serializer: one in-flight request per connection, and reconnect backoff must hold it)
-            reply, payload, _ = self._rpc({"op": "pull"})
-        self._rx.inc(len(payload))
-        vec = wire.decode_array(reply["array"], payload)
-        return reply["version"], vec
+        header = {"op": "pull"}
+        sp = self._traced("ps.pull", header, transport="tcp")
+        try:
+            with self._lock:
+                # lint: blocking-under-lock-ok (the transport lock IS the RPC serializer: one in-flight request per connection, and reconnect backoff must hold it)
+                reply, payload, _ = self._rpc(header)
+            self._rx.inc(len(payload))
+            vec = wire.decode_array(reply["array"], payload)
+            sp.set_attr(version=reply["version"])
+            return reply["version"], vec
+        finally:
+            sp.finish()
 
     def push(self, delta: np.ndarray, base_version: int) -> PushResult:
         meta, payload = wire.encode_array(
@@ -424,16 +519,23 @@ class TcpTransport(Transport):
         ident = self.member_identity
         if ident is not None:
             header["member"], header["epoch"] = ident
-        with self._lock:
-            # lint: blocking-under-lock-ok (the transport lock IS the RPC serializer: one in-flight request per connection, and reconnect backoff must hold it)
-            reply, buf, sent = self._rpc(header, payload)
-        self._tx.inc(sent)
-        params = wire.decode_array(reply["array"], buf)
-        return PushResult(accepted=reply["accepted"],
-                          version=reply["version"],
-                          staleness=reply["staleness"],
-                          weight=reply["weight"], params=params,
-                          fenced=reply.get("fenced", False))
+        sp = self._traced("ps.push", header, transport="tcp",
+                          base_version=int(base_version))
+        try:
+            with self._lock:
+                # lint: blocking-under-lock-ok (the transport lock IS the RPC serializer: one in-flight request per connection, and reconnect backoff must hold it)
+                reply, buf, sent = self._rpc(header, payload)
+            self._tx.inc(sent)
+            params = wire.decode_array(reply["array"], buf)
+            sp.set_attr(accepted=reply["accepted"],
+                        version=reply["version"])
+            return PushResult(accepted=reply["accepted"],
+                              version=reply["version"],
+                              staleness=reply["staleness"],
+                              weight=reply["weight"], params=params,
+                              fenced=reply.get("fenced", False))
+        finally:
+            sp.finish()
 
     # ------------------------------------------------- membership (elastic)
     def register(self, shard: int, worker: str = "") -> dict:
@@ -463,6 +565,59 @@ class TcpTransport(Transport):
                 {"op": "deregister", "member": ident[0],
                  "epoch": ident[1], "reason": reason})
         return bool(reply.get("ok"))
+
+    # --------------------------------------------- federation (fleet obs)
+    def push_metrics(self, snapshot: dict, *, seq: int, name: str = "",
+                     role: str = "worker", events=(), traces=(),
+                     final: bool = False) -> Optional[dict]:
+        if getattr(self, "_fed_refused", False):
+            return None
+        header = {"op": "metrics_push", "seq": int(seq), "name": name,
+                  "role": role, "final": bool(final)}
+        ident = self.member_identity
+        if ident is not None:
+            header["member"], header["epoch"] = ident
+        payload = json.dumps(
+            {"snapshot": snapshot, "events": list(events),
+             "traces": list(traces)},
+            separators=(",", ":"), default=repr).encode("utf-8")
+        try:
+            with self._lock:
+                # lint: blocking-under-lock-ok (the transport lock IS the RPC serializer: one in-flight request per connection, and reconnect backoff must hold it)
+                reply, _, sent = self._rpc(header, payload)
+        except RuntimeError:
+            # pre-federation coordinator ("unknown PS op") or one started
+            # without a federation: stop asking, publishing is optional
+            self._fed_refused = True
+            return None
+        self._tx.inc(sent)
+        return reply
+
+    def push_trace(self, records) -> Optional[dict]:
+        if getattr(self, "_fed_refused", False):
+            return None
+        payload = json.dumps(list(records), separators=(",", ":"),
+                             default=repr).encode("utf-8")
+        try:
+            with self._lock:
+                # lint: blocking-under-lock-ok (the transport lock IS the RPC serializer: one in-flight request per connection, and reconnect backoff must hold it)
+                reply, _, _ = self._rpc({"op": "trace_push"}, payload)
+        except RuntimeError:
+            self._fed_refused = True
+            return None
+        return reply
+
+    def dump_fleet(self, reason: str = "api",
+                   force: bool = False) -> Optional[str]:
+        try:
+            with self._lock:
+                # lint: blocking-under-lock-ok (the transport lock IS the RPC serializer: one in-flight request per connection, and reconnect backoff must hold it)
+                reply, _, _ = self._rpc({"op": "dump_fleet",
+                                         "reason": reason,
+                                         "force": bool(force)})
+        except RuntimeError:
+            return None
+        return reply.get("path")
 
     def close(self) -> None:
         with self._lock:
@@ -537,15 +692,26 @@ class ShmTransport(TcpTransport):
 
     # ------------------------------------------------------------- core API
     def pull(self) -> Tuple[int, np.ndarray]:
-        with self._lock:
-            if not self._negotiate():
-                return super().pull()
-            reply, _, _ = self._rpc({"op": "pull_shm", "token": self._token})
-            _, view = self._pull_ring.read(reply["slot"], reply["seq"])
-            vec = np.frombuffer(view, dtype=np.float32).copy()  # lint: hot-path-copy-ok (slot is reused two pulls later while the worker still holds this vec)
-        return reply["version"], vec
+        if self._shm_ok is False:
+            return super().pull()
+        header = {"op": "pull_shm"}
+        sp = self._traced("ps.pull", header, transport="shm")
+        try:
+            with self._lock:
+                if not self._negotiate():
+                    return super().pull()
+                header["token"] = self._token
+                reply, _, _ = self._rpc(header)
+                _, view = self._pull_ring.read(reply["slot"], reply["seq"])
+                vec = np.frombuffer(view, dtype=np.float32).copy()  # lint: hot-path-copy-ok (slot is reused two pulls later while the worker still holds this vec)
+            sp.set_attr(version=reply["version"])
+            return reply["version"], vec
+        finally:
+            sp.finish()
 
     def push(self, delta: np.ndarray, base_version: int) -> PushResult:
+        if self._shm_ok is False:
+            return super().push(delta, base_version)
         meta, payload = wire.encode_array(
             np.asarray(delta, np.float32), self._codec)
         header = {"op": "push_shm", "base_version": int(base_version),
@@ -553,20 +719,28 @@ class ShmTransport(TcpTransport):
         ident = self.member_identity
         if ident is not None:
             header["member"], header["epoch"] = ident
-        with self._lock:
-            if not self._negotiate():
-                return super().push(delta, base_version)
-            header["token"] = self._token
-            header["slot"], header["seq"] = self._push_ring.write(
-                payload, int(base_version))
-            reply, _, _ = self._rpc(header)
-            _, pview = self._pull_ring.read(reply["pslot"], reply["pseq"])
-            params = np.frombuffer(pview, dtype=np.float32).copy()  # lint: hot-path-copy-ok (same slot-reuse hazard as pull)
-        return PushResult(accepted=reply["accepted"],
-                          version=reply["version"],
-                          staleness=reply["staleness"],
-                          weight=reply["weight"], params=params,
-                          fenced=reply.get("fenced", False))
+        sp = self._traced("ps.push", header, transport="shm",
+                          base_version=int(base_version))
+        try:
+            with self._lock:
+                if not self._negotiate():
+                    return super().push(delta, base_version)
+                header["token"] = self._token
+                header["slot"], header["seq"] = self._push_ring.write(
+                    payload, int(base_version))
+                reply, _, _ = self._rpc(header)
+                _, pview = self._pull_ring.read(reply["pslot"],
+                                                reply["pseq"])
+                params = np.frombuffer(pview, dtype=np.float32).copy()  # lint: hot-path-copy-ok (same slot-reuse hazard as pull)
+            sp.set_attr(accepted=reply["accepted"],
+                        version=reply["version"])
+            return PushResult(accepted=reply["accepted"],
+                              version=reply["version"],
+                              staleness=reply["staleness"],
+                              weight=reply["weight"], params=params,
+                              fenced=reply.get("fenced", False))
+        finally:
+            sp.finish()
 
     def close(self) -> None:
         with self._lock:
@@ -629,8 +803,13 @@ class ParameterServerTcpFrontend:
     diagnosable post-mortem."""
 
     def __init__(self, server: ParameterServer, host: str = "127.0.0.1",
-                 port: int = 0):
+                 port: int = 0, federation=None, collector=None):
         self._server = server
+        #: FederatedRegistry / FleetCollector the fleet-observability verbs
+        #: (metrics_push / trace_push / dump_fleet) land on; None keeps the
+        #: verbs disabled (an error reply, like membership without an oracle)
+        self.federation = federation
+        self.collector = collector
         self._host, self._port = host, port
         self._lsock: Optional[socket.socket] = None
         self._stop = threading.Event()
@@ -706,6 +885,20 @@ class ParameterServerTcpFrontend:
                 except (ConnectionError, OSError):
                     return  # worker died mid-reply; its stats are lost only
 
+    def _apply_span(self, header: dict):
+        """Server-side half of the wire-propagated trace: a push/pull frame
+        carrying ``traceparent`` gets a coordinator-local ``ps.apply`` span
+        parented under the worker's RPC span — it lands in the
+        COORDINATOR's TraceStore, stitching the worker's trace id into the
+        fleet view even before the worker ships its own fragments."""
+        ref = _parse_traceparent(header.get("traceparent"))
+        if ref is None:
+            from deeplearning4j_tpu.observability.tracing import NOOP_SPAN
+            return NOOP_SPAN
+        return _start_span("ps.apply", parent=ref,
+                           member=header.get("member"),
+                           epoch=header.get("epoch"))
+
     def _handle(self, header: dict, payload: bytes):
         op = header.get("op")
         if op == "pull":
@@ -713,14 +906,59 @@ class ParameterServerTcpFrontend:
             meta, buf = wire.encode_array(vec, "none")
             return {"version": version, "array": meta}, buf
         if op == "push":
-            delta = wire.decode_array(header["array"], payload)
-            res = self._server.push_delta(
-                delta, header["base_version"],
-                member=header.get("member"), epoch=header.get("epoch"))
+            sp = self._apply_span(header)
+            try:
+                delta = wire.decode_array(header["array"], payload)
+                res = self._server.push_delta(
+                    delta, header["base_version"],
+                    member=header.get("member"), epoch=header.get("epoch"))
+                sp.set_attr(accepted=res.accepted, version=res.version,
+                            fenced=res.fenced)
+            finally:
+                sp.finish()
             meta, buf = wire.encode_array(res.params, "none")
             return {"accepted": res.accepted, "version": res.version,
                     "staleness": res.staleness, "weight": res.weight,
                     "fenced": res.fenced, "array": meta}, buf
+        if op == "metrics_push":
+            fed = self.federation
+            if fed is None:
+                raise ValueError(
+                    "PS op 'metrics_push' requires a federation "
+                    "(ParameterServerTcpFrontend(..., federation=...))")
+            body = json.loads(bytes(payload).decode("utf-8")) \
+                if len(payload) else {}
+            res = fed.ingest(
+                name=header.get("name", ""),
+                epoch=header.get("epoch", 0),
+                member=header.get("member"),
+                role=header.get("role", "worker"),
+                seq=header.get("seq", 0),
+                snapshot=body.get("snapshot") or {},
+                events=body.get("events") or (),
+                traces=body.get("traces") or (),
+                final=bool(header.get("final")),
+                nbytes=len(payload))
+            return res, b""
+        if op == "trace_push":
+            fed = self.federation
+            if fed is None:
+                raise ValueError(
+                    "PS op 'trace_push' requires a federation "
+                    "(ParameterServerTcpFrontend(..., federation=...))")
+            records = json.loads(bytes(payload).decode("utf-8")) \
+                if len(payload) else []
+            fed.ingest_traces(records)
+            return {"ok": True, "ingested": len(records)}, b""
+        if op == "dump_fleet":
+            col = self.collector
+            if col is None:
+                raise ValueError(
+                    "PS op 'dump_fleet' requires a fleet collector "
+                    "(ParameterServerTcpFrontend(..., collector=...))")
+            path = col.dump(reason=header.get("reason", "api"),
+                            force=bool(header.get("force")))
+            return {"ok": path is not None, "path": path}, b""
         if op == "register":
             oracle = self._require_membership(op)
             lease = oracle.register(header["shard"],
@@ -745,14 +983,21 @@ class ParameterServerTcpFrontend:
             return {"version": version, "slot": slot, "seq": seq}, b""
         if op == "push_shm":
             push_ring, pull_ring = self._shm_session(header)
-            _, dview = push_ring.read(header["slot"], header["seq"])
-            # zero-copy: the delta view aliases the client's push slot; it
-            # is fully consumed by push_delta (under the server lock)
-            # before this reply releases the client to write again
-            delta = wire.decode_array(header["array"], dview)
-            res = self._server.push_delta(
-                delta, header["base_version"],
-                member=header.get("member"), epoch=header.get("epoch"))
+            sp = self._apply_span(header)
+            try:
+                _, dview = push_ring.read(header["slot"], header["seq"])
+                # zero-copy: the delta view aliases the client's push slot;
+                # it is fully consumed by push_delta (under the server
+                # lock) before this reply releases the client to write
+                # again
+                delta = wire.decode_array(header["array"], dview)
+                res = self._server.push_delta(
+                    delta, header["base_version"],
+                    member=header.get("member"), epoch=header.get("epoch"))
+                sp.set_attr(accepted=res.accepted, version=res.version,
+                            fenced=res.fenced)
+            finally:
+                sp.finish()
             pslot, pseq = pull_ring.write(wire._byteview(res.params),
                                           res.version)
             return {"accepted": res.accepted, "version": res.version,

@@ -70,6 +70,7 @@ def _run_elastic(args, net, step, transport):
     import threading
 
     from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.observability.federation import MetricsPublisher
     from deeplearning4j_tpu.parallel.param_server import (
         StaleEpochFenced, run_worker_loop)
     from deeplearning4j_tpu.parallel.ps_transport import TransportError
@@ -83,6 +84,13 @@ def _run_elastic(args, net, step, transport):
     stop = threading.Event()
     stop_reason = ["done"]
     hb = transport.clone()
+    # federation: ship cumulative metric snapshots + flight events + finished
+    # traces on a cloned channel; the final flush after the run loop is what
+    # makes the coordinator's fleet totals exact
+    pub_transport = transport.clone()
+    publisher = MetricsPublisher(
+        pub_transport, name=args.worker_name or f"worker-{args.worker_id}",
+        role="worker")
 
     def _heartbeats() -> None:
         # renew at a third of the lease so two misses still leave slack;
@@ -102,6 +110,7 @@ def _run_elastic(args, net, step, transport):
 
     threading.Thread(target=_heartbeats, daemon=True,
                      name="ps-heartbeat").start()
+    publisher.start()
 
     consumer = ReconnectingConsumer(
         _parse_addr(args.broker), args.topic, group=args.group)
@@ -116,6 +125,9 @@ def _run_elastic(args, net, step, transport):
             if meta.get("fin"):
                 saw_fin[0] = True
                 return None
+            # parent subsequent pushes under the consume span of the batch
+            # being trained: producer -> consume -> push stitch into one trace
+            transport.bind_trace_parent(consumer.last_trace_ref)
             return DataSet(arrays["x"], arrays["y"])
         return None
 
@@ -139,6 +151,11 @@ def _run_elastic(args, net, step, transport):
     finally:
         stop.set()
         consumer.close()
+        # the final cumulative frame must land before deregister/close —
+        # it carries the last push-window's counters, and exact fleet
+        # totals depend on it (final frames bypass fencing server-side)
+        publisher.stop(final=True)
+        pub_transport.close()
         hb.close()
     if stop_reason[0] == "lease-expired":
         raise StaleEpochFenced("membership lease expired mid-shard")

@@ -38,6 +38,12 @@ from deeplearning4j_tpu.observability.metrics import (
 from deeplearning4j_tpu.observability.names import (
     BROKER_MESSAGES_TOTAL, BROKER_RECONNECTS_TOTAL,
 )
+from deeplearning4j_tpu.observability.tracing import (
+    TRACEPARENT_HEADER,
+    current_span as _current_span,
+    parse_traceparent as _parse_traceparent,
+    start_span as _start_span,
+)
 from deeplearning4j_tpu.streaming import Route, wire
 
 _messages = _obs_registry().counter(
@@ -208,8 +214,14 @@ class BrokerProducer:
     def publish(self, topic: str, arrays: Dict[str, np.ndarray],
                 meta: Optional[dict] = None, codec: str = "none") -> int:
         metas, payload = wire.pack_arrays(arrays, codec)
-        header = {"op": "publish", "topic": topic,
-                  "meta": dict(meta or {}, arrays=metas)}
+        full_meta = dict(meta or {}, arrays=metas)
+        # wire-propagated tracing: an ambient span (the coordinator's
+        # publish window, a route's ingest span) rides the message meta so
+        # the consumer's process can parent under the same trace id
+        sp = _current_span()
+        if sp is not None and TRACEPARENT_HEADER not in full_meta:
+            full_meta[TRACEPARENT_HEADER] = sp.traceparent()
+        header = {"op": "publish", "topic": topic, "meta": full_meta}
         try:
             reply, _, _ = wire.request(self._sock, header, payload)
         except (ConnectionError, OSError):
@@ -250,6 +262,11 @@ class ReconnectingConsumer:
         self.reconnects = 0
         self.unfinished_tasks = 0
         self.all_tasks_done = threading.Condition()
+        #: SpanRef of the last delivered message's consume span (None when
+        #: the message carried no traceparent) — the worker run loop binds
+        #: this onto its PS transport so the push window stitches into the
+        #: producer's trace
+        self.last_trace_ref = None
 
     # ------------------------------------------------------------ transport
     def _connect(self) -> None:
@@ -313,6 +330,19 @@ class ReconnectingConsumer:
             self._delivered = reply["offset"]
             self._last_delivered = reply["offset"]
             self._next = reply["offset"] + 1
+            ref = _parse_traceparent(meta.get(TRACEPARENT_HEADER))
+            if ref is not None:
+                # the consume hop of the cross-process trace: parented on
+                # the producer's publish span, finished immediately (the
+                # handling work gets its own child spans via
+                # last_trace_ref)
+                csp = _start_span("broker.consume", parent=ref,
+                                  topic=self.topic, group=self.group,
+                                  offset=reply["offset"])
+                csp.finish()
+                self.last_trace_ref = csp.ref()
+            else:
+                self.last_trace_ref = None
             with self.all_tasks_done:
                 self.unfinished_tasks += 1
             return meta, arrays

@@ -26,6 +26,12 @@ keep alive. ``decode_array`` returns a read-only ``np.frombuffer`` view by
 default. Every byte that IS copied on this path (``copy=True`` decodes, the
 ``sendmsg``-unavailable fallback) is counted in ``dl4j_wire_copy_bytes_total``
 — the counter staying flat under load is the proof the copies are gone.
+
+Trace propagation: the header key ``traceparent`` (and the same key inside
+a broker message's ``meta``) is RESERVED for a W3C traceparent string. Both
+transports stamp it on outbound frames when an ambient span exists and
+parent their server-side handling spans from it — that is the entire
+cross-process trace-stitching contract; the framing itself is unchanged.
 """
 from __future__ import annotations
 

@@ -378,6 +378,13 @@ class _Handler(BaseHTTPRequestHandler):
                 self._json(self.ui.serve_traces())
         elif path == "/serve/slo":
             self._json(self.ui.serve_slo())
+        elif path == "/fleet/metrics":
+            # the FEDERATED exposition: every member's series merged, vs
+            # /metrics which is this process's registry only
+            self._text(self.ui.fleet_metrics_text(),
+                       "text/plain; version=0.0.4; charset=utf-8")
+        elif path == "/fleet/status":
+            self._json(self.ui.fleet_status_data())
         elif path == "/train/health/bundles":
             self._json(self.ui.health_bundles())
         elif path == "/train/profiles":
@@ -554,6 +561,22 @@ class UIServer:
         from deeplearning4j_tpu.observability import global_registry
 
         return global_registry().prometheus_text()
+
+    def fleet_metrics_text(self) -> str:
+        """Federated Prometheus text for ``/fleet/metrics``: every fleet
+        member's series merged by the installed FederatedRegistry (falls
+        back to an honest single-member view when none is installed)."""
+        from deeplearning4j_tpu.observability.federation import \
+            fleet_metrics_text
+
+        return fleet_metrics_text()
+
+    def fleet_status_data(self) -> dict:
+        """Fleet roster + registered status providers for
+        ``/fleet/status``."""
+        from deeplearning4j_tpu.observability.federation import fleet_status
+
+        return fleet_status()
 
     def serve_status_data(self) -> dict:
         """Serving-engine snapshot for ``/serve/status``: loaded model

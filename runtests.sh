@@ -73,6 +73,12 @@
 #                                 # burn-rate math + alert actions, cardinality
 #                                 # guard, orphan-span lint rule, the <=2%
 #                                 # tracing overhead budget, bench axis contract
+#   ./runtests.sh fleet [args]    # fleet observability federation: merge
+#                                 # algebra exactness, zombie-gauge fencing,
+#                                 # restart-epoch monotonicity, cross-process
+#                                 # trace stitching, /fleet/* routes, fleet
+#                                 # bundle timeline, fleet-truth lint rule,
+#                                 # the <=2% federation overhead budget
 set -e
 cd "$(dirname "$0")"
 
@@ -234,6 +240,15 @@ if [ "${1-}" = "health" ]; then
   XLA_FLAGS="--xla_force_host_platform_device_count=8" \
   exec python -m pytest tests/test_flight_recorder.py \
     tests/test_bench_contract.py::test_telemetry_overhead_budget -q "$@"
+fi
+
+if [ "${1-}" = "fleet" ]; then
+  shift
+  PALLAS_AXON_POOL_IPS= \
+  JAX_PLATFORMS=cpu \
+  XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+  exec python -m pytest tests/test_federation.py \
+    tests/test_bench_contract.py::test_federation_overhead_budget -q "$@"
 fi
 
 PALLAS_AXON_POOL_IPS= \

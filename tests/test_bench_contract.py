@@ -266,6 +266,74 @@ def test_telemetry_overhead_budget():
         f"{step_s * 1e3:.1f}ms")
 
 
+def test_federation_overhead_budget():
+    """The federation publisher must cost <=2% of an elastic worker's
+    wall clock. Budget-style like the telemetry test above: the publisher
+    is TIME-driven (one flush per DEFAULT_INTERVAL_S on its own thread),
+    so its duty cycle is flush_cost / interval regardless of how many fit
+    steps land inside an interval — requiring
+    ``flush_cost <= 0.02 * DEFAULT_INTERVAL_S`` bounds the overhead at 2%
+    of ANY elastic fit step schedule. Measured over a real TcpTransport to
+    a live frontend with a representatively-populated registry, so the
+    cost includes snapshotting, JSON framing, the socket round trip, and
+    the coordinator-side merge."""
+    import time
+
+    from deeplearning4j_tpu.observability.federation import (
+        DEFAULT_INTERVAL_S, FederatedRegistry, MetricsPublisher,
+    )
+    from deeplearning4j_tpu.observability.flight_recorder import (
+        FlightRecorder,
+    )
+    from deeplearning4j_tpu.observability.metrics import MetricsRegistry
+    from deeplearning4j_tpu.observability.tracing import TraceStore
+    from deeplearning4j_tpu.parallel.param_server import ParameterServer
+    from deeplearning4j_tpu.parallel.ps_transport import (
+        ParameterServerTcpFrontend, TcpTransport,
+    )
+
+    # a registry shaped like a real elastic worker's: a handful of counter
+    # series, the push/step histograms with spread-out observations, gauges
+    reg = MetricsRegistry()
+    for i in range(8):
+        reg.counter("dl4j_ps_worker_steps_total").labels(
+            worker=str(i)).inc(100 + i)
+    h = reg.histogram("dl4j_ps_push_seconds").labels()
+    hs = reg.histogram("dl4j_step_seconds").labels()
+    for i in range(64):
+        h.observe(0.001 * (i + 1))
+        hs.observe(0.002 * (i + 1))
+    reg.gauge("dl4j_ps_version").labels().set(123)
+    rec = FlightRecorder(capacity=256, registry=reg)
+    for i in range(32):
+        rec.record("push_window", window=i)
+
+    fed = FederatedRegistry(registry=MetricsRegistry(),
+                            trace_store=TraceStore())
+    srv = ParameterServer([np.zeros(8, np.float32)])
+    frontend = ParameterServerTcpFrontend(srv, federation=fed).start()
+    t = TcpTransport(("127.0.0.1", frontend.port))
+    try:
+        pub = MetricsPublisher(t, name="budget-w0", interval_s=999.0,
+                               registry=reg, recorder=rec,
+                               trace_store=TraceStore())
+        assert pub.flush()  # warm the path outside the measured window
+        n = 50
+        t0 = time.perf_counter()
+        for i in range(n):
+            reg.counter("dl4j_ps_worker_steps_total").labels(
+                worker="0").inc()  # the snapshot must not be cached
+            assert pub.flush()
+        flush_s = (time.perf_counter() - t0) / n
+    finally:
+        t.close()
+        frontend.stop()
+    assert flush_s <= 0.02 * DEFAULT_INTERVAL_S, (
+        f"federation budget blown: flush costs {flush_s * 1e3:.3f}ms, "
+        f"duty cycle {flush_s / DEFAULT_INTERVAL_S * 100:.2f}% of the "
+        f"{DEFAULT_INTERVAL_S * 1e3:.0f}ms publish interval (budget 2%)")
+
+
 def test_grid_rows_vgg16_and_lstm_hidden():
     """The round-6 grid additions are wired end-to-end: vgg16 is a
     first-class model (metric name, defaults, bench fn) and --hidden is a

@@ -1,0 +1,670 @@
+"""Fleet observability federation tests (ISSUE 20).
+
+Merge algebra exactness (counter sums, bucket-wise histogram merge
+associativity/order-independence, gauge last-write), zombie fencing of
+metric frames (a fenced epoch's series stop updating and its gauges drop
+from export while its counters stay frozen), restart monotonicity across
+epochs (a respawned worker's fresh-from-zero counters never double-count),
+the seq guard, the publisher's final-flush exactness, traceparent riding
+broker meta + PS frame headers, the fleet collector's merged timeline +
+dead-bundle folding, the ``fleet-truth`` lint rule, the ``/fleet/*``
+routes, and the acceptance pin: a real 4-worker elastic run whose
+``GET /fleet/metrics`` worker-step totals exactly equal the sum of the
+workers' process-local counters, with one stitched cross-process trace
+(publish -> consume -> push window -> push -> apply) in the coordinator
+TraceStore.
+"""
+import json
+import os
+import re
+import textwrap
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+import deeplearning4j_tpu.lint as lint
+from deeplearning4j_tpu.nn.conf.builders import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.observability import names as _n
+from deeplearning4j_tpu.observability.federation import (
+    DEFAULT_INTERVAL_S, FederatedRegistry, FleetCollector, MetricsPublisher,
+    fleet_metrics_text, fleet_status, global_federation, merge_snapshots,
+    register_status_provider, set_global_federation,
+    set_global_fleet_collector, strip_gauges, tag_snapshot,
+)
+from deeplearning4j_tpu.observability.flight_recorder import (
+    FlightRecorder, global_recorder,
+)
+from deeplearning4j_tpu.observability.metrics import (
+    MetricsRegistry, render_prometheus,
+)
+from deeplearning4j_tpu.observability.tracing import (
+    TraceStore, global_trace_store, set_global_trace_store, trace_span,
+)
+from deeplearning4j_tpu.cloud import MembershipOracle
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.iterators import ListDataSetIterator
+from deeplearning4j_tpu.parallel.elastic import ElasticTrainer
+from deeplearning4j_tpu.parallel.param_server import ParameterServer
+from deeplearning4j_tpu.parallel.ps_transport import (
+    InprocTransport, ParameterServerTcpFrontend, TcpTransport,
+)
+from deeplearning4j_tpu.streaming.broker import (
+    BrokerProducer, LoopbackBroker, ReconnectingConsumer,
+)
+
+
+@pytest.fixture()
+def fresh_trace_store():
+    prev = global_trace_store()
+    st = TraceStore()
+    set_global_trace_store(st)
+    yield st
+    set_global_trace_store(prev)
+
+
+class FakeClock:
+    def __init__(self, t: float = 100.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+def _net(seed=12345, lr=0.1):
+    conf = (NeuralNetConfiguration.builder()
+            .seed(seed).learning_rate(lr).updater("sgd")
+            .list()
+            .layer(DenseLayer(n_in=4, n_out=8, activation="tanh"))
+            .layer(OutputLayer(n_in=8, n_out=3, loss="mcxent",
+                               activation="softmax"))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _snap(counters=(), gauges=(), hists=()):
+    """Build a real registry snapshot: counters/gauges as (name, labels,
+    value), hists as (name, labels, [observations])."""
+    reg = MetricsRegistry()
+    for name, labels, value in counters:
+        reg.counter(name).labels(**labels).inc(value)
+    for name, labels, value in gauges:
+        reg.gauge(name).labels(**labels).set(value)
+    for name, labels, obs in hists:
+        h = reg.histogram(name).labels(**labels)
+        for v in obs:
+            h.observe(v)
+    return reg.snapshot()
+
+
+def _series(snapshot, name):
+    return snapshot.get(name, {}).get("series", [])
+
+
+def _value(snapshot, name, **labels):
+    for row in _series(snapshot, name):
+        if all(row["labels"].get(k) == v for k, v in labels.items()):
+            return row.get("value", row.get("count"))
+    return None
+
+
+# ----------------------------------------------------------- merge algebra
+
+def test_merge_counters_exact_sum():
+    a = _snap(counters=[("dl4j_x_total", {"w": "a"}, 3)])
+    b = _snap(counters=[("dl4j_x_total", {"w": "a"}, 4),
+                        ("dl4j_x_total", {"w": "b"}, 10)])
+    m = merge_snapshots([a, b])
+    assert _value(m, "dl4j_x_total", w="a") == 7
+    assert _value(m, "dl4j_x_total", w="b") == 10
+
+
+def test_merge_histograms_bucketwise_associative_order_independent():
+    obs = ([0.001, 0.01, 5.0], [0.002, 0.3], [0.5, 0.5, 0.5, 9.0])
+    snaps = [_snap(hists=[("dl4j_h_seconds", {}, o)]) for o in obs]
+
+    left = merge_snapshots([merge_snapshots(snaps[:2]), snaps[2]])
+    right = merge_snapshots([snaps[0], merge_snapshots(snaps[1:])])
+    anyorder = merge_snapshots([snaps[2], snaps[0], snaps[1]])
+    assert left == right == anyorder
+
+    row = _series(left, "dl4j_h_seconds")[0]
+    flat = [v for o in obs for v in o]
+    assert row["count"] == len(flat)
+    assert row["sum"] == pytest.approx(sum(flat))
+    # bucket-wise: every cumulative le count equals a recount of the raw
+    # observations — the merge added buckets element-wise, not just totals
+    cum = 0
+    for edge, n in zip(row["buckets"], row["bucket_counts"]):
+        cum += n
+        assert cum == sum(1 for v in flat if v <= edge)
+
+
+def test_merge_skewed_buckets_degrade_into_inf_only():
+    a = _snap(hists=[("dl4j_h_seconds", {}, [0.001])])
+    b = _snap(hists=[("dl4j_h_seconds", {}, [0.002, 0.004])])
+    bad = json.loads(json.dumps(b))
+    bad["dl4j_h_seconds"]["series"][0]["buckets"] = [1.0, float("inf")]
+    bad["dl4j_h_seconds"]["series"][0]["bucket_counts"] = [2, 0]
+    m = merge_snapshots([a, bad])
+    row = _series(m, "dl4j_h_seconds")[0]
+    assert row["count"] == 3 and row["bucket_counts"][-1] == 2
+
+
+def test_merge_gauges_last_write_and_strip():
+    a = _snap(gauges=[("dl4j_g", {}, 1.0)])
+    b = _snap(gauges=[("dl4j_g", {}, 7.0)])
+    assert _value(merge_snapshots([a, b]), "dl4j_g") == 7.0
+    assert _value(merge_snapshots([b, a]), "dl4j_g") == 1.0
+    assert strip_gauges(a) == {}
+
+
+def test_tag_snapshot_labels_every_series_without_mutating_source():
+    a = _snap(counters=[("dl4j_x_total", {"op": "push"}, 2)])
+    t = tag_snapshot(a, {"worker": "w0", "role": "worker"})
+    assert _value(t, "dl4j_x_total", op="push", worker="w0",
+                  role="worker") == 2
+    assert _series(a, "dl4j_x_total")[0]["labels"] == {"op": "push"}
+
+
+# ------------------------------------------------------- federated registry
+
+def _fed(validate=None, clock=None):
+    return FederatedRegistry(validate=validate, registry=MetricsRegistry(),
+                             trace_store=TraceStore(),
+                             clock=clock or FakeClock())
+
+
+def test_zombie_fenced_frames_rejected_gauges_dropped_counters_frozen():
+    alive = {("1", "1"): True}
+
+    def validate(member, epoch):
+        return alive.get((str(member), str(epoch)), False)
+
+    fed = _fed(validate=validate)
+    frame = _snap(counters=[("dl4j_steps_total", {}, 5)],
+                  gauges=[("dl4j_depth", {}, 3.0)])
+    res = fed.ingest(name="w0", epoch=1, member=1, seq=1, snapshot=frame)
+    assert res["accepted"] and not res["fenced"]
+    assert _value(fed.totals(), "dl4j_steps_total") == 5
+    assert _value(fed.totals(), "dl4j_depth") == 3.0
+
+    alive[("1", "1")] = False  # lease lapsed: the worker is a zombie now
+    late = _snap(counters=[("dl4j_steps_total", {}, 50)],
+                 gauges=[("dl4j_depth", {}, 9.0)])
+    res = fed.ingest(name="w0", epoch=1, member=1, seq=2, snapshot=late)
+    assert res["fenced"] and not res["accepted"]
+    # series stopped at their last accepted values; gauges left the export
+    assert _value(fed.totals(), "dl4j_steps_total") == 5
+    assert _value(fed.totals(), "dl4j_depth") is None
+    st = fed.status()["members"][0]
+    assert st["fenced"] and not st["live"]
+
+
+def test_restart_new_epoch_is_a_fresh_series_and_totals_stay_monotonic():
+    fed = _fed()
+    seen = []
+
+    def total():
+        v = _value(fed.totals(), "dl4j_steps_total") or 0
+        seen.append(v)
+        return v
+
+    fed.ingest(name="shard0-gen0", epoch=1, member=1, seq=1,
+               snapshot=_snap(counters=[("dl4j_steps_total", {}, 4)],
+                              hists=[("dl4j_push_seconds", {},
+                                      [0.1, 0.2])]))
+    assert total() == 4
+    fed.ingest(name="shard0-gen0", epoch=1, member=1, seq=2, final=True,
+               snapshot=_snap(counters=[("dl4j_steps_total", {}, 7)],
+                              hists=[("dl4j_push_seconds", {},
+                                      [0.1, 0.2, 0.3])]))
+    assert total() == 7
+    # the replacement registers a NEW epoch and reports from zero: its 3
+    # steps ADD to the dead generation's frozen 7 (no double count, no
+    # reset) — cumulative-by-generation is what makes this exact
+    fed.ingest(name="shard0-gen1", epoch=2, member=2, seq=1,
+               snapshot=_snap(counters=[("dl4j_steps_total", {}, 3)],
+                              hists=[("dl4j_push_seconds", {}, [0.4])]))
+    assert total() == 10
+    hist = _series(fed.totals(), "dl4j_push_seconds")[0]
+    assert hist["count"] == 4  # 3 final from gen0 + 1 from gen1
+    assert seen == sorted(seen), "fleet counters must never decrease"
+
+
+def test_seq_guard_discards_duplicate_and_reordered_frames():
+    fed = _fed()
+    fed.ingest(name="w0", epoch=1, member=1, seq=5,
+               snapshot=_snap(counters=[("dl4j_steps_total", {}, 9)]))
+    stale = fed.ingest(name="w0", epoch=1, member=1, seq=4,
+                       snapshot=_snap(
+                           counters=[("dl4j_steps_total", {}, 2)]))
+    assert not stale["accepted"] and not stale["fenced"]
+    dup = fed.ingest(name="w0", epoch=1, member=1, seq=5,
+                     snapshot=_snap(
+                         counters=[("dl4j_steps_total", {}, 2)]))
+    assert not dup["accepted"]
+    assert _value(fed.totals(), "dl4j_steps_total") == 9
+
+
+def test_final_frame_bypasses_fencing():
+    # the exit flush races the deregister on the membership oracle: a
+    # graceful worker must still land its last cumulative frame
+    fed = _fed(validate=lambda member, epoch: False)
+    res = fed.ingest(name="w0", epoch=1, member=1, seq=1, final=True,
+                     snapshot=_snap(
+                         counters=[("dl4j_steps_total", {}, 6)]))
+    assert res["accepted"]
+    assert _value(fed.totals(), "dl4j_steps_total") == 6
+    # final also means done: gauges would no longer export
+    assert not fed.status()["members"][0]["live"]
+
+
+def test_fleet_snapshot_labels_members_and_coordinator():
+    fed = _fed()
+    fed.ingest(name="w0", epoch=1, member=1, seq=1, role="worker",
+               snapshot=_snap(counters=[("dl4j_steps_total", {}, 2)]))
+    fed.ingest(name="r0", epoch=2, member=2, seq=1, role="replica",
+               snapshot=_snap(counters=[("dl4j_steps_total", {}, 3)]))
+    snap = fed.fleet_snapshot(local=False)
+    assert _value(snap, "dl4j_steps_total", worker="w0",
+                  role="worker") == 2
+    assert _value(snap, "dl4j_steps_total", replica="r0",
+                  role="replica") == 3
+    text = fed.prometheus_text()
+    assert 'worker="w0"' in text and 'replica="r0"' in text
+
+
+def test_shared_renderer_keeps_local_and_fleet_exposition_identical():
+    reg = MetricsRegistry()
+    reg.counter("dl4j_x_total", "help here").labels(op="a").inc(2)
+    reg.histogram("dl4j_h_seconds").labels().observe(0.01)
+    assert reg.prometheus_text() == render_prometheus(reg.snapshot())
+
+
+# --------------------------------------------------------------- publisher
+
+def test_publisher_final_flush_makes_totals_exact_over_inproc():
+    fed = _fed()
+    worker_reg = MetricsRegistry()
+    rec = FlightRecorder(capacity=64)
+    t = InprocTransport(None, federation=fed)
+    t.bind_member(1, 1)
+    pub = MetricsPublisher(t, name="w0", interval_s=999.0,
+                           registry=worker_reg, recorder=rec,
+                           trace_store=TraceStore())
+    steps = worker_reg.counter("dl4j_steps_total").labels()
+    steps.inc(5)
+    rec.record("push_window", window=1)
+    assert pub.flush()
+    assert _value(fed.totals(), "dl4j_steps_total") == 5
+    steps.inc(3)  # the last window lands after the final periodic flush
+    pub.stop(final=True)
+    assert _value(fed.totals(), "dl4j_steps_total") == 8
+    assert fed.member_events()["w0@1"][0]["kind"] == "push_window"
+    assert pub.frames_sent == 2 and not pub.fenced
+
+
+def test_publisher_marks_itself_fenced_on_rejection():
+    fed = _fed(validate=lambda member, epoch: False)
+    t = InprocTransport(None, federation=fed)
+    t.bind_member(1, 1)
+    pub = MetricsPublisher(t, name="w0", interval_s=999.0,
+                           registry=MetricsRegistry(),
+                           recorder=FlightRecorder(capacity=8),
+                           trace_store=TraceStore())
+    assert not pub.flush()
+    assert pub.fenced
+
+
+# ------------------------------------------------- trace propagation (wire)
+
+def test_traceparent_rides_broker_meta_and_consumer_stitches(fresh_trace_store):
+    broker = LoopbackBroker().start()
+    producer = BrokerProducer(broker.address)
+    consumer = ReconnectingConsumer(broker.address, "t0", group="g0")
+    try:
+        with trace_span("shard.publish", topic="t0") as root:
+            root_ref = root.ref()
+            producer.publish("t0", {"x": np.ones(2, np.float32)})
+        meta, arrays = consumer.get(timeout=2.0)
+        assert meta["traceparent"].split("-")[1] == root_ref.trace_id
+        assert consumer.last_trace_ref is not None
+        assert consumer.last_trace_ref.trace_id == root_ref.trace_id
+        # the consume span itself is already finalized into the local store
+        rec = global_trace_store().get(root_ref.trace_id)
+        names = {s["name"]: s for s in rec["spans"]}
+        assert names["broker.consume"]["parent_id"] == root_ref.span_id
+    finally:
+        consumer.close()
+        producer.close()
+        broker.stop()
+
+
+def test_ps_push_traced_across_tcp_frontend(fresh_trace_store):
+    oracle = MembershipOracle(lease_timeout_s=30.0)
+    srv = ParameterServer([np.zeros(6, np.float32)], membership=oracle)
+    frontend = ParameterServerTcpFrontend(srv).start()
+    t = TcpTransport(("127.0.0.1", frontend.port))
+    try:
+        reg = t.register(0, worker="w0")
+        t.bind_member(reg["member"], reg["epoch"])
+        with trace_span("test.root") as root:
+            res = t.push(np.ones(6, np.float32), 0)
+            assert res.accepted
+        rec = global_trace_store().get(root.trace_id)
+        by_name = {s["name"]: s for s in rec["spans"]}
+        assert by_name["ps.push"]["parent_id"] == root.span_id
+        # the server-side handling span parented from the frame header:
+        # the whole point of wire propagation
+        assert by_name["ps.apply"]["parent_id"] \
+            == by_name["ps.push"]["span_id"]
+        assert by_name["ps.apply"]["attrs"]["member"] == reg["member"]
+    finally:
+        t.close()
+        frontend.stop()
+
+
+def test_parentless_rpcs_open_no_span(fresh_trace_store):
+    # heartbeats and the background puller must not mint root-trace noise
+    srv = ParameterServer([np.zeros(4, np.float32)])
+    frontend = ParameterServerTcpFrontend(srv).start()
+    t = TcpTransport(("127.0.0.1", frontend.port))
+    try:
+        store = global_trace_store()
+        before = len(store)
+        t.pull()
+        t.push(np.ones(4, np.float32), 0)
+        assert len(store) == before
+    finally:
+        t.close()
+        frontend.stop()
+
+
+# ------------------------------------------------------- fleet collector
+
+def test_fleet_collector_merges_timelines_and_dead_bundles(tmp_path):
+    rec = FlightRecorder(capacity=64, dump_dir=str(tmp_path))
+    rec.record("coordinator_event", step=1)
+    fed = _fed()
+    fed.ingest(name="w0", epoch=1, member=1, seq=1, snapshot={},
+               events=[{"kind": "worker_event", "ts": 1.5}])
+    # a dead worker's last on-disk bundle (foreign pid)
+    dead = tmp_path / "flight-20260101-000000-p99999-001-sigkill"
+    dead.mkdir()
+    (dead / "events.jsonl").write_text(
+        json.dumps({"kind": "dead_event", "ts": 1.0}) + "\n")
+    (dead / "manifest.json").write_text(json.dumps(
+        {"reason": "sigkill", "pid": 99999, "ts": 1.0, "events": 1}))
+
+    col = FleetCollector(federation=fed, recorder=rec,
+                         registry=MetricsRegistry())
+    path = col.dump(reason="shard-handoff")
+    assert path is not None and os.path.basename(path).startswith("fleet-")
+    lines = [json.loads(l) for l in
+             open(os.path.join(path, "merged_timeline.jsonl"))]
+    sources = {e["source"] for e in lines}
+    assert "coordinator" in sources and "w0@1" in sources
+    assert any(s.startswith("bundle:flight-") for s in sources)
+    ts = [e.get("ts", 0.0) for e in lines]
+    assert ts == sorted(ts), "merged timeline must be time-ordered"
+    manifest = json.load(open(os.path.join(path, "manifest.json")))
+    assert manifest["fleet"] and manifest["reason"] == "shard-handoff"
+    totals = json.load(open(os.path.join(path, "metrics.json")))
+    assert isinstance(totals, dict)
+    # rate limit: an immediate second trigger is a free no-op
+    assert col.dump(reason="shard-handoff") is None
+    assert col.dump(reason="api", force=True) is not None
+
+
+def test_fleet_collector_without_dump_dir_is_a_noop():
+    col = FleetCollector(recorder=FlightRecorder(capacity=8, dump_dir=None),
+                         registry=MetricsRegistry())
+    assert col.dump(reason="manual", force=True) is None
+
+
+# ------------------------------------------------------------- fleet routes
+
+def test_fleet_status_composes_provider_blocks_with_error_isolation():
+    old_fed = global_federation()
+    try:
+        set_global_federation(None)
+        register_status_provider("good", lambda: {"ok": 1})
+
+        def boom():
+            raise RuntimeError("sick subsystem")
+
+        register_status_provider("bad", boom)
+        st = fleet_status()
+        assert st["federation"] is None
+        assert st["good"] == {"ok": 1}
+        assert "error" in st["bad"]
+    finally:
+        register_status_provider("good", None)
+        register_status_provider("bad", None)
+        set_global_federation(old_fed)
+
+
+def test_fleet_metrics_text_fallback_is_honestly_labeled():
+    old_fed = global_federation()
+    try:
+        set_global_federation(None)
+        text = fleet_metrics_text()
+        assert 'role="local"' in text
+        assert f'-{os.getpid()}"' in text
+    finally:
+        set_global_federation(old_fed)
+
+
+# ---------------------------------------------------- satellite: child env
+
+def test_write_conf_ships_flight_recorder_dir_to_workers(tmp_path):
+    rec = global_recorder()
+    old = rec.dump_dir
+    try:
+        rec.set_dump_dir(str(tmp_path))
+        trainer = ElasticTrainer(_net(), workers=2)
+        trainer._write_conf(str(tmp_path))
+        env = trainer._env_conf["env"]
+        # the regression: set_dump_dir() never touches os.environ, so the
+        # plain environ copy dropped the dir and dead workers' bundles
+        # landed nowhere the fleet collector could see
+        assert env["DL4J_FLIGHT_RECORDER_DIR"] == str(tmp_path)
+    finally:
+        rec.set_dump_dir(old)
+
+
+# ------------------------------------------------------- fleet-truth lint
+
+def _lint_src(tmp_path, source, name="fixture.py"):
+    f = tmp_path / name
+    f.write_text(textwrap.dedent(source))
+    return lint.run_paths([f], ["fleet-truth"])
+
+
+def test_fleet_truth_flags_local_snapshot_in_fleet_function(tmp_path):
+    res = _lint_src(tmp_path, """\
+        from deeplearning4j_tpu.observability.metrics import global_registry
+
+        def fleet_metrics():
+            return global_registry().snapshot()
+        """)
+    assert [v.rule for v in res.violations] == ["fleet-truth"]
+    assert res.violations[0].line == 4
+
+
+def test_fleet_truth_flags_fleet_route_branch_only(tmp_path):
+    res = _lint_src(tmp_path, """\
+        def do_GET(self, path, registry):
+            if path == "/metrics":
+                return registry.prometheus_text()   # local route: legal
+            elif path == "/fleet/metrics":
+                return registry.prometheus_text()   # fleet truth lie
+        """)
+    assert [v.rule for v in res.violations] == ["fleet-truth"]
+    assert res.violations[0].line == 5
+
+
+def test_fleet_truth_negative_federated_reads_are_legal(tmp_path):
+    res = _lint_src(tmp_path, """\
+        def do_GET(self, path, federation):
+            if path == "/fleet/metrics":
+                return federation.prometheus_text()
+
+        def fleet_status_data(self):
+            from deeplearning4j_tpu.observability.federation import \\
+                fleet_status
+            return fleet_status()
+        """)
+    assert res.violations == []
+
+
+def test_fleet_truth_clean_over_real_tree():
+    import pathlib
+    pkg = pathlib.Path(lint.__file__).resolve().parents[1]
+    res = lint.run_paths([pkg], ["fleet-truth"])
+    assert res.violations == []
+
+
+# ------------------------------------- serving: one trace across the stack
+
+def test_http_request_batcher_replica_stitch_into_one_trace(fresh_trace_store):
+    from deeplearning4j_tpu.keras_server.serving import InferenceServer
+
+    server = InferenceServer(port=0, replicas=2).start()
+    try:
+        server.register("m", _net())
+        body = json.dumps(
+            {"model": "m", "inputs": np.ones((1, 4)).tolist()}).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{server.port}/v1/predict", data=body,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=30) as r:
+            assert r.status == 200
+            tp = r.headers["traceparent"]
+        trace_id = tp.split("-")[1]
+        # the ROOT span finalizes right after the response bytes go out —
+        # give the handler thread a beat
+        rec = None
+        deadline = time.time() + 5.0
+        while rec is None and time.time() < deadline:
+            rec = global_trace_store().get(trace_id)
+            if rec is None:
+                time.sleep(0.01)
+        assert rec is not None
+        spans = rec["spans"]
+        assert all(s["trace_id"] == trace_id for s in spans)
+        by_name = {}
+        for s in spans:
+            by_name.setdefault(s["name"], s)
+        root = by_name["http /v1/predict"]
+        assert root["parent_id"] is None
+        assert "replica.route" in by_name  # dispatch seam in the same tree
+        ids = {s["span_id"] for s in spans}
+        for s in spans:
+            if s["parent_id"] is not None:
+                assert s["parent_id"] in ids, \
+                    f"span {s['name']} parent outside the tree"
+        # /fleet/status now carries the serving block (status provider)
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{server.port}/fleet/status",
+                timeout=10) as r:
+            st = json.loads(r.read())
+        assert "serving" in st and "queue" in st["serving"]
+    finally:
+        server.stop()
+
+
+# ------------------------------ acceptance: 4-worker elastic run, exact sum
+
+def _fetch(url):
+    with urllib.request.urlopen(url, timeout=10) as r:
+        return r.read().decode()
+
+
+def test_fleet_metrics_exact_and_trace_stitched_over_elastic_run(fresh_trace_store):
+    """The acceptance pin: run a REAL 4-subprocess elastic fit, then (a)
+    ``GET /fleet/metrics`` worker-step totals exactly equal the sum of the
+    per-worker process-local counters each worker printed at exit, and (b)
+    the coordinator TraceStore holds one stitched cross-process tree
+    publish -> consume -> push window -> push -> apply under a single
+    trace id with correct parent ids."""
+    rng = np.random.default_rng(7)
+    data = [DataSet(rng.normal(size=(8, 4)).astype(np.float32),
+                    np.eye(3, dtype=np.float32)[rng.integers(0, 3, 8)])
+            for _ in range(8)]
+    trainer = (ElasticTrainer.builder(_net())
+               .workers(4).push_frequency(2)
+               .lease_timeout(30.0).fit_timeout(300.0).build())
+    trainer.fit(ListDataSetIterator(data))
+
+    assert len(trainer.worker_stats) == 4
+    local_steps = sum(int(s["steps"]) for s in trainer.worker_stats)
+    assert local_steps == 8  # every batch trained exactly once
+
+    # (a) exactness, straight off the federation object... (the counter
+    # carries one series per worker label: sum them all)
+    fed = trainer.federation
+    total = sum(row["value"]
+                for row in _series(fed.totals(), _n.PS_WORKER_STEPS_TOTAL))
+    assert total == local_steps
+
+    # ...and over the HTTP surface. Sum only role="worker" series: the
+    # coordinator's own registry rides the same page under
+    # role="coordinator" and must not pollute the pin.
+    from deeplearning4j_tpu.ui.server import UIServer
+    ui = UIServer(port=0)
+    try:
+        text = _fetch(f"http://127.0.0.1:{ui.port}/fleet/metrics")
+        pat = re.compile(
+            re.escape(_n.PS_WORKER_STEPS_TOTAL) + r"\{([^}]*)\}\s+(\S+)")
+        http_total = sum(
+            float(m.group(2)) for m in pat.finditer(text)
+            if 'role="worker"' in m.group(1))
+        assert http_total == local_steps
+        st = json.loads(_fetch(f"http://127.0.0.1:{ui.port}/fleet/status"))
+        assert st["federation"]["generations"] >= 4
+        assert st["elastic"]["steps"] == local_steps
+        names = {m["name"] for m in st["federation"]["members"]}
+        assert {"shard0-gen0", "shard1-gen0",
+                "shard2-gen0", "shard3-gen0"} <= names
+    finally:
+        ui.stop()
+
+    # (b) the stitched cross-process trace tree
+    store = global_trace_store()
+    stitched = None
+    for entry in store.list():
+        rec = store.get(entry["trace_id"])
+        names = {s["name"] for s in rec["spans"]}
+        if {"shard.publish", "broker.consume", "ps.push_window",
+                "ps.push", "ps.apply"} <= names:
+            stitched = rec
+            break
+    assert stitched is not None, \
+        "no trace stitched across coordinator + worker + wire"
+    spans = stitched["spans"]
+    assert all(s["trace_id"] == stitched["trace_id"] for s in spans)
+    by_id = {s["span_id"]: s for s in spans}
+
+    def parent_name(s):
+        p = by_id.get(s["parent_id"])
+        return p["name"] if p else None
+
+    roots = [s for s in spans if s["parent_id"] is None]
+    assert [s["name"] for s in roots] == ["shard.publish"]
+    for s in spans:
+        if s["name"] == "broker.consume":
+            assert parent_name(s) == "shard.publish"
+        elif s["name"] == "ps.push_window":
+            assert parent_name(s) == "broker.consume"
+        elif s["name"] == "ps.push":
+            assert parent_name(s) == "ps.push_window"
+        elif s["name"] == "ps.apply":
+            assert parent_name(s) == "ps.push"
